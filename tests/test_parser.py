@@ -1,0 +1,244 @@
+"""DogStatsD parser grammar tests; corpus modeled on the reference test
+strategy (reference parser_test.go) but authored fresh."""
+
+import pytest
+
+from veneur_tpu.samplers import metrics as m
+from veneur_tpu.samplers.parser import ParseError, Parser
+from veneur_tpu.util.fnv import fnv1a_32
+
+
+def parse_one(packet, extend_tags=None):
+    out = []
+    Parser(extend_tags).parse_metric(packet, out.append)
+    assert len(out) == 1
+    return out[0]
+
+
+def parse_all(packet, extend_tags=None):
+    out = []
+    Parser(extend_tags).parse_metric(packet, out.append)
+    return out
+
+
+class TestBasicMetrics:
+    def test_counter(self):
+        metric = parse_one(b"a.b.c:1|c")
+        assert metric.name == "a.b.c"
+        assert metric.type == m.COUNTER
+        assert metric.value == 1.0
+        assert metric.sample_rate == 1.0
+        assert metric.tags == []
+        assert metric.scope == m.MetricScope.MIXED
+
+    def test_gauge(self):
+        assert parse_one(b"x:3.5|g").type == m.GAUGE
+
+    def test_histogram_h_and_d(self):
+        assert parse_one(b"x:1|h").type == m.HISTOGRAM
+        assert parse_one(b"x:1|d").type == m.HISTOGRAM
+
+    def test_timer(self):
+        metric = parse_one(b"lat:250|ms")
+        assert metric.type == m.TIMER
+        assert metric.value == 250.0
+
+    def test_set_keeps_string_value(self):
+        metric = parse_one(b"users:abc|s")
+        assert metric.type == m.SET
+        assert metric.value == "abc"
+
+    def test_negative_and_float_values(self):
+        assert parse_one(b"x:-17.5|g").value == -17.5
+
+    def test_sample_rate(self):
+        metric = parse_one(b"x:1|c|@0.25")
+        assert metric.sample_rate == pytest.approx(0.25)
+
+    def test_tags_sorted_and_joined(self):
+        metric = parse_one(b"x:1|c|#zed,alpha:1")
+        assert metric.tags == ["alpha:1", "zed"]
+        assert metric.key.joined_tags == "alpha:1,zed"
+
+    def test_tags_and_rate_any_order(self):
+        a = parse_one(b"x:1|c|@0.5|#foo:bar")
+        b = parse_one(b"x:1|c|#foo:bar|@0.5")
+        assert a.key == b.key
+        assert a.sample_rate == b.sample_rate == pytest.approx(0.5)
+
+    def test_digest_matches_fnv1a_chain(self):
+        metric = parse_one(b"a.b.c:1|c|#x:1")
+        h = fnv1a_32(b"a.b.c")
+        h = fnv1a_32(b"counter", h)
+        h = fnv1a_32(b"x:1", h)
+        assert metric.digest == h
+
+    def test_digest_identical_for_same_key(self):
+        a = parse_one(b"x:1|c|#t:1,s:2")
+        b = parse_one(b"x:99|c|#s:2,t:1")
+        assert a.digest == b.digest
+        assert a.digest64 == b.digest64
+
+
+class TestMultiValue:
+    def test_multiple_values(self):
+        out = parse_all(b"x:1:2:3|ms")
+        assert [metric.value for metric in out] == [1.0, 2.0, 3.0]
+        assert len({metric.digest for metric in out}) == 1
+
+    def test_multi_value_sets(self):
+        out = parse_all(b"x:a:b|s")
+        assert [metric.value for metric in out] == ["a", "b"]
+
+    def test_multi_value_shares_rate_and_tags(self):
+        out = parse_all(b"x:1:2|h|@0.5|#a:b")
+        assert all(metric.sample_rate == pytest.approx(0.5) for metric in out)
+        assert all(metric.tags == ["a:b"] for metric in out)
+
+    def test_trailing_empty_segment_ignored(self):
+        # parity: "x:1:|c" emits one metric; "x:|c" emits none
+        assert [metric.value for metric in parse_all(b"x:1:|c")] == [1.0]
+        assert parse_all(b"x:|c") == []
+        assert parse_all(b"x:|s") == []
+
+    def test_interior_empty_segment_rejected(self):
+        with pytest.raises(ParseError):
+            parse_all(b"x::1|c")
+
+    def test_lenient_python_numbers_rejected(self):
+        for packet in (b"x: 1|c", b"x:1_0|c", b"x:1|c|@ 0.5", b"x:1 |c"):
+            with pytest.raises(ParseError):
+                parse_all(packet)
+
+
+class TestScopes:
+    def test_local_only(self):
+        metric = parse_one(b"x:1|c|#a:b,veneurlocalonly")
+        assert metric.scope == m.MetricScope.LOCAL_ONLY
+        assert metric.tags == ["a:b"]
+
+    def test_global_only(self):
+        metric = parse_one(b"x:1|c|#veneurglobalonly,a:b")
+        assert metric.scope == m.MetricScope.GLOBAL_ONLY
+        assert metric.tags == ["a:b"]
+
+    def test_magic_tag_prefix_match(self):
+        metric = parse_one(b"x:1|c|#veneurglobalonly:true")
+        assert metric.scope == m.MetricScope.GLOBAL_ONLY
+        assert metric.tags == []
+
+
+class TestExtendTags:
+    def test_extend_tags_added_and_sorted(self):
+        metric = parse_one(b"x:1|c|#m:1", extend_tags=["env:prod"])
+        assert metric.tags == ["env:prod", "m:1"]
+
+    def test_extend_tags_override_key(self):
+        metric = parse_one(b"x:1|c|#env:dev,m:1", extend_tags=["env:prod"])
+        assert metric.tags == ["env:prod", "m:1"]
+
+    def test_extend_tags_on_untagged_metric(self):
+        metric = parse_one(b"x:1|c", extend_tags=["env:prod"])
+        assert metric.tags == ["env:prod"]
+
+
+class TestMalformed:
+    @pytest.mark.parametrize("packet", [
+        b"",
+        b"no.pipes.at.all",
+        b"no.colon|c",
+        b":1|c",                # empty name
+        b"x:1||",               # empty type
+        b"x:1|q",               # unknown type
+        b"x:1|c|",              # trailing empty section
+        b"x:1|c||@0.1",         # empty between pipes
+        b"x:1|c|@0.5|@0.5",     # duplicate rate
+        b"x:1|c|#a|#b",         # duplicate tags
+        b"x:1|c|@2",            # rate out of range
+        b"x:1|c|@0",            # rate out of range
+        b"x:1|c|@nope",         # bad rate
+        b"x:nan|g",             # NaN value
+        b"x:inf|g",             # Inf value
+        b"x:notanumber|g",
+        b"x:1|c|%unknown",      # unknown section
+        b"x:1:2:bad|h",         # bad value among multi-values
+    ])
+    def test_rejected(self, packet):
+        with pytest.raises(ParseError):
+            parse_all(packet)
+
+
+class TestEvents:
+    def test_basic_event(self):
+        ev = Parser().parse_event(b"_e{5,4}:title|text")
+        assert ev.name == "title"
+        assert ev.message == "text"
+
+    def test_full_event(self):
+        ev = Parser().parse_event(
+            b"_e{5,4}:title|text|d:1136239445|h:h1|k:ak|p:low|s:src|t:error|#a:b,c")
+        assert ev.timestamp == 1136239445
+        assert ev.tags["vdogstatsd_hostname"] == "h1"
+        assert ev.tags["vdogstatsd_ak"] == "ak"
+        assert ev.tags["vdogstatsd_pri"] == "low"
+        assert ev.tags["vdogstatsd_st"] == "src"
+        assert ev.tags["vdogstatsd_at"] == "error"
+        assert ev.tags["a"] == "b"
+        assert ev.tags["c"] == ""
+
+    def test_newline_unescape(self):
+        ev = Parser().parse_event(b"_e{5,8}:title|ab\\ncdef")
+        assert ev.message == "ab\ncdef"
+
+    @pytest.mark.parametrize("packet", [
+        b"_e{5,4}:titl|text",        # title length mismatch
+        b"_e{5,9}:title|text",       # text length mismatch
+        b"_e5,4:title|text",         # no braces
+        b"_e{0,4}:|text",            # zero title
+        b"_e{5,4}:title|text|p:urgent",   # bad priority
+        b"_e{5,4}:title|text|t:fatal",    # bad alert
+        b"_e{5,4}:title|text|d:1|d:2",    # duplicate section
+        b"_e{5,4}:title|text|x:9",        # unknown section
+    ])
+    def test_rejected(self, packet):
+        with pytest.raises(ParseError):
+            Parser().parse_event(packet)
+
+
+class TestServiceChecks:
+    def test_basic(self):
+        metric = Parser().parse_service_check(b"_sc|svc.check|0")
+        assert metric.name == "svc.check"
+        assert metric.type == m.STATUS
+        assert metric.value == 0
+
+    def test_full(self):
+        metric = Parser().parse_service_check(
+            b"_sc|svc|2|d:1136239445|h:host9|#q:1|m:bad\\nnews")
+        assert metric.value == 2
+        assert metric.timestamp == 1136239445
+        assert metric.hostname == "host9"
+        assert metric.tags == ["q:1"]
+        assert metric.message == "bad\nnews"
+
+    @pytest.mark.parametrize("packet", [
+        b"_notsc|x|0",
+        b"_sc||0",
+        b"_sc|x|9",
+        b"_sc|x|0|m:msg|h:host",   # section after message
+        b"_sc|x|0|d:1|d:2",
+    ])
+    def test_rejected(self, packet):
+        with pytest.raises(ParseError):
+            Parser().parse_service_check(packet)
+
+
+class TestTagging:
+    def test_empty_everything(self):
+        from veneur_tpu.util.tagging import ExtendTags
+        assert ExtendTags().extend([]) == []
+
+    def test_bare_key_override(self):
+        from veneur_tpu.util.tagging import ExtendTags
+        et = ExtendTags(["region"])
+        assert et.extend(["region:us", "a:1"]) == ["a:1", "region"]

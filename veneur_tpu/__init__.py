@@ -1,0 +1,16 @@
+"""veneur-tpu: a TPU-native observability-aggregation framework.
+
+A DogStatsD/SSF server that aggregates counters, gauges, timers, histograms
+and sets; computes approximate percentiles (t-digest) and set cardinalities
+(HyperLogLog); and flushes every interval to pluggable metric/span sinks,
+with a two-tier local->global merge plane and a consistent-hash proxy.
+
+Unlike the Go reference (stripe/veneur), the aggregation hot path is a
+batched column store: metric keys are rows of fixed-capacity device arrays,
+samples are applied as vectorized JAX kernels in large batches, t-digest
+compression and HLL register updates run as batched device ops over the
+(key x centroid/register) axes, and the shard/global merge is expressed as
+device collectives (psum/pmax) over a `jax.sharding.Mesh`.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,11 @@
+from veneur_tpu.samplers.metrics import (  # noqa: F401
+    AGGREGATES_LOOKUP,
+    Aggregate,
+    HistogramAggregates,
+    InterMetric,
+    MetricKey,
+    MetricScope,
+    MetricType,
+    UDPMetric,
+)
+from veneur_tpu.samplers.parser import Parser  # noqa: F401
