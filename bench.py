@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""veneur-tpu benchmark: aggregated DogStatsD samples/sec.
+
+Drives the full in-process pipeline — packet bytes -> parse -> key intern ->
+device batch apply -> flush — over a mixed workload (counters, gauges,
+timers, sets across many unique keys), and prints ONE JSON line.
+
+Baseline: the reference's published sustained UDP throughput of 60,000
+packets/sec (reference README.md:361-364); see BASELINE.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+BASELINE_SAMPLES_PER_SEC = 60_000.0
+
+
+def make_packets(num_keys: int, values_per_packet: int = 8):
+    """Pre-render a packet corpus: multi-value timers, counters, gauges and
+    sets across num_keys unique keys (veneur-emit-style load)."""
+    import numpy as np
+    rng = np.random.default_rng(42)
+    packets = []
+    samples = 0
+    for i in range(num_keys):
+        kind = i % 4
+        tag = b"#shard:%d,env:bench" % (i % 100)
+        if kind == 0:
+            packets.append(b"bench.counter.%d:%d|c|%s" % (i, rng.integers(1, 100), tag))
+            samples += 1
+        elif kind == 1:
+            packets.append(b"bench.gauge.%d:%.3f|g|%s" % (i, rng.random() * 100, tag))
+            samples += 1
+        elif kind == 2:
+            vals = b":".join(b"%.2f" % v for v in rng.normal(100, 15, values_per_packet))
+            packets.append(b"bench.timer.%d:%s|ms|%s" % (i, vals, tag))
+            samples += values_per_packet
+        else:
+            packets.append(b"bench.set.%d:user%d|s|%s" % (i, rng.integers(0, 10000), tag))
+            samples += 1
+    return packets, samples
+
+
+def run_pipeline(duration_s: float, num_keys: int):
+    from veneur_tpu.config import Config
+    from veneur_tpu.core.server import Server
+
+    cfg = Config()
+    cfg.interval = 10.0
+    cfg.tpu.counter_capacity = max(4096, num_keys)
+    cfg.tpu.gauge_capacity = max(4096, num_keys)
+    cfg.tpu.histo_capacity = max(4096, num_keys)
+    cfg.tpu.set_capacity = max(1024, num_keys // 2)
+    cfg.tpu.batch_cap = 16384
+    cfg.apply_defaults()
+
+    from veneur_tpu.sinks.blackhole import BlackholeMetricSink
+    server = Server(cfg, extra_metric_sinks=[BlackholeMetricSink()])
+
+    packets, samples_per_round = make_packets(num_keys)
+
+    # warmup: trigger every kernel compile path
+    for p in packets[: min(len(packets), 2000)]:
+        server.handle_metric_packet(p)
+    server.store.apply_all_pending()
+    server.flush()
+
+    t0 = time.perf_counter()
+    total_samples = 0
+    while True:
+        for p in packets:
+            server.handle_metric_packet(p)
+        total_samples += samples_per_round
+        if time.perf_counter() - t0 >= duration_s:
+            break
+    server.store.apply_all_pending()
+    server.flush()
+    elapsed = time.perf_counter() - t0
+    return total_samples / elapsed, elapsed
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--duration", type=float, default=10.0)
+    ap.add_argument("--keys", type=int, default=10_000)
+    args = ap.parse_args()
+
+    rate, elapsed = run_pipeline(args.duration, args.keys)
+    print(json.dumps({
+        "metric": "dogstatsd_samples_per_sec",
+        "value": round(rate, 1),
+        "unit": "samples/s",
+        "vs_baseline": round(rate / BASELINE_SAMPLES_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,11 +1,18 @@
 """Test configuration: run JAX on a virtual 8-device CPU mesh so sharding
-and collective paths are exercised without TPU hardware."""
+and collective paths are exercised without TPU hardware.
+
+The environment's axon sitecustomize pins JAX_PLATFORMS=axon (real TPU via
+a tunnel) whenever PALLAS_AXON_POOL_IPS is set; tests override both unless
+VENEUR_TPU_TESTS=1 explicitly opts in to running the suite on hardware.
+"""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+if os.environ.get("VENEUR_TPU_TESTS") != "1":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()

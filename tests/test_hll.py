@@ -1,0 +1,149 @@
+"""HyperLogLog accuracy tests: scalar reference and batched device kernel.
+The p=14 sketch has ~0.8% standard error; we allow 3 sigma."""
+
+import numpy as np
+import pytest
+
+from veneur_tpu.ops import batch_hll as bhll
+from veneur_tpu.ops.hll_ref import HLL, hash_member, pos_val
+
+
+class TestScalarHLL:
+    @pytest.mark.parametrize("n", [100, 1000, 10000, 100000])
+    def test_estimate_accuracy(self, n):
+        h = HLL()
+        for i in range(n):
+            h.insert(b"member-%d" % i)
+        assert h.estimate() == pytest.approx(n, rel=0.03)
+
+    def test_duplicates_not_counted(self):
+        h = HLL()
+        for _ in range(5):
+            for i in range(1000):
+                h.insert(b"m%d" % i)
+        assert h.estimate() == pytest.approx(1000, rel=0.03)
+
+    def test_merge(self):
+        a, b = HLL(), HLL()
+        for i in range(5000):
+            a.insert(b"a%d" % i)
+            b.insert(b"b%d" % i)
+        a.merge(b)
+        assert a.estimate() == pytest.approx(10000, rel=0.03)
+
+    def test_merge_overlapping(self):
+        a, b = HLL(), HLL()
+        for i in range(5000):
+            a.insert(b"x%d" % i)
+            b.insert(b"x%d" % i)
+        a.merge(b)
+        assert a.estimate() == pytest.approx(5000, rel=0.03)
+
+    def test_serialization_roundtrip(self):
+        a = HLL()
+        for i in range(1234):
+            a.insert(b"v%d" % i)
+        b = HLL.from_bytes(a.to_bytes())
+        assert b.estimate() == a.estimate()
+
+    def test_empty(self):
+        assert HLL().estimate() == pytest.approx(0, abs=1)
+
+
+class TestBatchedHLL:
+    def _ingest(self, members_by_row, num_keys, batch=4096):
+        regs = bhll.init_state(num_keys)
+        coo = []
+        for row, members in members_by_row.items():
+            for member in members:
+                idx, rho = pos_val(hash_member(member))
+                coo.append((row, idx, rho))
+        for i in range(0, len(coo), batch):
+            chunk = coo[i:i + batch]
+            pad = batch - len(chunk)
+            rows = np.array([c[0] for c in chunk] + [num_keys] * pad, np.int32)
+            idxs = np.array([c[1] for c in chunk] + [0] * pad, np.int32)
+            rhos = np.array([c[2] for c in chunk] + [0] * pad, np.int32)
+            regs = bhll.apply_batch(regs, rows, idxs, rhos)
+        return regs
+
+    def test_matches_scalar(self):
+        members = [b"user-%d" % i for i in range(20000)]
+        regs = self._ingest({0: members, 1: members[:500]}, 2)
+        scalar = HLL()
+        for member in members:
+            scalar.insert(member)
+        est = bhll.estimate(regs)
+        assert float(est[0]) == pytest.approx(scalar.estimate(), rel=1e-6)
+        assert float(est[1]) == pytest.approx(500, rel=0.05)
+        # registers must be identical to the scalar sketch
+        np.testing.assert_array_equal(np.asarray(regs)[0], scalar.regs)
+
+    def test_empty_row_estimates_zero(self):
+        regs = bhll.init_state(2)
+        est = bhll.estimate(regs)
+        assert float(est[0]) == 0.0
+
+    def test_merge_rows(self):
+        a = self._ingest({0: [b"a%d" % i for i in range(3000)]}, 2)
+        b_scalar = HLL()
+        for i in range(3000):
+            b_scalar.insert(b"b%d" % i)
+        merged = bhll.merge_rows(
+            a, np.array([0], np.int32), b_scalar.regs[None, :])
+        est = bhll.estimate(merged)
+        assert float(est[0]) == pytest.approx(6000, rel=0.03)
+
+    def test_shard_merge(self):
+        a = self._ingest({0: [b"m%d" % i for i in range(4000)]}, 1)
+        b = self._ingest({0: [b"m%d" % i for i in range(2000, 6000)]}, 1)
+        merged = bhll.merge(a, b)
+        est = bhll.estimate(merged)
+        assert float(est[0]) == pytest.approx(6000, rel=0.03)
+
+
+class TestScalarKernels:
+    def test_counters(self):
+        from veneur_tpu.ops import scalars
+        state = scalars.init_counters(4)
+        rows = np.array([0, 0, 1, 4, 2], np.int32)  # 4 = padding
+        vals = np.array([1.0, 2.0, 5.0, 99.0, 1.0], np.float32)
+        rates = np.array([1.0, 0.5, 1.0, 1.0, 0.1], np.float32)
+        state = scalars.apply_counters(state, rows, vals, rates)
+        assert scalars.counter_values(state).tolist() == [5.0, 5.0, 10.0, 0.0]
+
+    def test_counter_truncation_per_sample(self):
+        # parity: each sample contributes trunc(value/rate)
+        from veneur_tpu.ops import scalars
+        state = scalars.init_counters(1)
+        rows = np.array([0, 0], np.int32)
+        vals = np.array([1.0, 1.0], np.float32)
+        rates = np.array([0.3, 0.3], np.float32)
+        state = scalars.apply_counters(state, rows, vals, rates)
+        # trunc(3.33)*2, not trunc(6.66)
+        assert float(scalars.counter_values(state)[0]) == 6.0
+
+    def test_counter_kahan_precision(self):
+        # many small batches must not drift past f32 granularity
+        from veneur_tpu.ops import scalars
+        state = scalars.init_counters(1)
+        rows = np.zeros(1024, np.int32)
+        vals = np.full(1024, 33.0, np.float32)
+        rates = np.ones(1024, np.float32)
+        for _ in range(600):  # 600 * 1024 * 33 = 20,275,200 > 2^24
+            state = scalars.apply_counters(state, rows, vals, rates)
+        got = float(scalars.counter_values(state)[0])
+        assert got == 600 * 1024 * 33.0
+
+    def test_gauges_last_write_wins(self):
+        from veneur_tpu.ops import scalars
+        state = scalars.init_gauges(3)
+        rows = np.array([0, 1, 0, 3], np.int32)
+        vals = np.array([1.0, 2.0, 7.0, 99.0], np.float32)
+        state = scalars.apply_gauges(state, rows, vals)
+        assert state["value"].tolist() == [7.0, 2.0, 0.0]
+        assert state["set"].tolist() == [True, True, False]
+        # second batch: only row 1 updated
+        state = scalars.apply_gauges(
+            state, np.array([1], np.int32), np.array([5.0], np.float32))
+        assert state["value"].tolist() == [7.0, 5.0, 0.0]

@@ -1,0 +1,262 @@
+"""End-to-end server tests, mirroring the reference integration pattern
+(reference server_test.go:66-216): port-0 listeners, short interval, a
+channel sink as the universal flush observer, real UDP sockets."""
+
+import socket
+import time
+
+import pytest
+
+from veneur_tpu.config import Config, SinkConfig, read_config
+from veneur_tpu.core.server import Server
+from veneur_tpu.samplers.metrics import MetricType
+from veneur_tpu.sinks.channel import ChannelMetricSink
+
+
+def generate_config(**overrides) -> Config:
+    cfg = Config()
+    cfg.interval = 0.2
+    cfg.num_readers = 1
+    cfg.hostname = "test-host"
+    cfg.statsd_listen_addresses = []
+    cfg.tpu.counter_capacity = 128
+    cfg.tpu.gauge_capacity = 128
+    cfg.tpu.histo_capacity = 128
+    cfg.tpu.set_capacity = 64
+    cfg.tpu.batch_cap = 256
+    for k, v in overrides.items():
+        setattr(cfg, k, v)
+    return cfg.apply_defaults()
+
+
+def setup_server(cfg=None, **overrides):
+    cfg = cfg or generate_config(**overrides)
+    observer = ChannelMetricSink()
+    server = Server(cfg, extra_metric_sinks=[observer])
+    return server, observer
+
+
+def by_name(metrics):
+    out = {}
+    for metric in metrics:
+        out.setdefault(metric.name, []).append(metric)
+    return out
+
+
+class TestLocalFlush:
+    def test_counter_gauge_flush(self):
+        server, observer = setup_server()
+        server.handle_metric_packet(b"a.b.total:5|c")
+        server.handle_metric_packet(b"a.b.total:3|c")
+        server.handle_metric_packet(b"a.b.level:42.5|g")
+        server.flush()
+        got = by_name(observer.wait_flush())
+        assert got["a.b.total"][0].value == 8.0
+        assert got["a.b.total"][0].type == MetricType.COUNTER
+        assert got["a.b.level"][0].value == 42.5
+        assert got["a.b.level"][0].type == MetricType.GAUGE
+
+    def test_sample_rate_scaling(self):
+        server, observer = setup_server()
+        server.handle_metric_packet(b"hits:1|c|@0.1")
+        server.flush()
+        got = by_name(observer.wait_flush())
+        assert got["hits"][0].value == 10.0
+
+    def test_state_resets_between_flushes(self):
+        server, observer = setup_server()
+        server.handle_metric_packet(b"c1:5|c")
+        server.flush()
+        assert by_name(observer.wait_flush())["c1"][0].value == 5.0
+        # second interval: no samples -> sparse (no sink flush at all,
+        # matching the reference's early return, flusher.go:92-95)
+        server.flush()
+        assert observer.queue.empty()
+        # third interval: fresh count, not accumulated
+        server.handle_metric_packet(b"c1:2|c")
+        server.flush()
+        assert by_name(observer.wait_flush())["c1"][0].value == 2.0
+
+    def test_mixed_histogram_local_server_emits_aggregates_only(self):
+        # a local (forwarding) server emits only aggregates for mixed histos
+        server, observer = setup_server(forward_address="fake:1234")
+        for v in (1, 2, 3, 4, 5):
+            server.handle_metric_packet(b"lat:%d|h" % v)
+        server.flush()
+        got = by_name(observer.wait_flush())
+        assert got["lat.min"][0].value == 1.0
+        assert got["lat.max"][0].value == 5.0
+        assert got["lat.count"][0].value == 5.0
+        assert got["lat.count"][0].type == MetricType.COUNTER
+        assert "lat.50percentile" not in got
+        assert "lat.median" not in got
+
+    def test_local_only_histogram_gets_percentiles(self):
+        server, observer = setup_server(forward_address="fake:1234")
+        for v in range(1, 101):
+            server.handle_metric_packet(
+                b"ll:%d|ms|#veneurlocalonly" % v)
+        server.flush()
+        got = by_name(observer.wait_flush())
+        assert "ll.min" in got and "ll.max" in got and "ll.count" in got
+        assert got["ll.50percentile"][0].value == pytest.approx(50, abs=3)
+        assert got["ll.99percentile"][0].value == pytest.approx(99, abs=2)
+
+    def test_global_scope_not_emitted_locally(self):
+        server, observer = setup_server(forward_address="fake:1234")
+        server.handle_metric_packet(b"gc:5|c|#veneurglobalonly")
+        server.handle_metric_packet(b"gh:5|h|#veneurglobalonly")
+        server.handle_metric_packet(b"users:bob|s")
+        server.flush()
+        assert observer.queue.empty()
+
+    def test_timer_treated_as_histogram(self):
+        server, observer = setup_server()  # global server (no forward)
+        for v in (10, 20, 30):
+            server.handle_metric_packet(b"t1:%d|ms" % v)
+        server.flush()
+        got = by_name(observer.wait_flush())
+        # global server: percentiles for mixed timers; aggregates emit too
+        # because the samples were ingested locally (Local* guards pass,
+        # matching flusher.go:360 + samplers.go:359-463)
+        assert got["t1.50percentile"][0].value == pytest.approx(20, abs=6)
+        assert got["t1.count"][0].value == 3.0
+        assert got["t1.min"][0].value == 10.0
+
+    def test_status_check_flush(self):
+        server, observer = setup_server()
+        server.handle_metric_packet(b"_sc|db.ok|1|#env:x|m:degraded")
+        server.flush()
+        got = by_name(observer.wait_flush())
+        assert got["db.ok"][0].value == 1.0
+        assert got["db.ok"][0].type == MetricType.STATUS
+        assert got["db.ok"][0].message == "degraded"
+
+
+class TestGlobalFlush:
+    def test_set_estimate_flushed_on_global(self):
+        server, observer = setup_server()  # no forward_address -> global
+        for i in range(200):
+            server.handle_metric_packet(b"uniq:u%d|s" % i)
+        server.flush()
+        got = by_name(observer.wait_flush())
+        assert got["uniq"][0].value == pytest.approx(200, rel=0.05)
+        assert got["uniq"][0].type == MetricType.GAUGE
+
+    def test_global_counter_flushed_on_global(self):
+        server, observer = setup_server()
+        server.handle_metric_packet(b"gc:7|c|#veneurglobalonly")
+        server.flush()
+        got = by_name(observer.wait_flush())
+        assert got["gc"][0].value == 7.0
+
+    def test_global_histogram_digest_aggregates(self):
+        server, observer = setup_server()
+        for v in range(1, 101):
+            server.handle_metric_packet(b"gh:%d|h|#veneurglobalonly" % v)
+        server.flush()
+        got = by_name(observer.wait_flush())
+        # global-scope histo on a global server: digest-derived aggregates
+        assert got["gh.min"][0].value == 1.0
+        assert got["gh.max"][0].value == 100.0
+        assert got["gh.count"][0].value == pytest.approx(100.0)
+        assert got["gh.50percentile"][0].value == pytest.approx(50, abs=3)
+
+
+class TestUDPIngest:
+    def test_udp_end_to_end(self):
+        cfg = generate_config(
+            statsd_listen_addresses=["udp://127.0.0.1:0"])
+        server, observer = setup_server(cfg)
+        server.start()
+        try:
+            addr = server.local_addr("udp")
+            assert addr is not None
+            with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+                s.sendto(b"udp.test:17|c", addr)
+                s.sendto(b"udp.multi:1|c\nudp.multi:2|c", addr)
+            deadline = time.time() + 5
+            seen = {}
+            while time.time() < deadline and len(seen) < 2:
+                try:
+                    for metric in observer.wait_flush(timeout=1.0):
+                        seen[metric.name] = metric
+                except Exception:
+                    pass
+            assert seen["udp.test"].value == 17.0
+            assert seen["udp.multi"].value == 3.0
+        finally:
+            server.shutdown()
+
+    def test_tcp_end_to_end(self):
+        cfg = generate_config(
+            statsd_listen_addresses=["tcp://127.0.0.1:0"])
+        server, observer = setup_server(cfg)
+        server.start()
+        try:
+            addr = server.local_addr("tcp")
+            with socket.create_connection(addr) as s:
+                s.sendall(b"tcp.test:9|c\n")
+            deadline = time.time() + 5
+            seen = {}
+            while time.time() < deadline and "tcp.test" not in seen:
+                try:
+                    for metric in observer.wait_flush(timeout=1.0):
+                        seen[metric.name] = metric
+                except Exception:
+                    pass
+            assert seen["tcp.test"].value == 9.0
+        finally:
+            server.shutdown()
+
+
+class TestSinkRouting:
+    def test_routing_and_filters(self):
+        from veneur_tpu.config import Features, SinkRoutingConfig
+        cfg = generate_config()
+        cfg.features.enable_metric_sink_routing = True
+        cfg.metric_sink_routing = [SinkRoutingConfig(
+            name="r1",
+            match=[{"name": {"kind": "prefix", "value": "keep."}}],
+            matched=["channel"], not_matched=[])]
+        server, observer = setup_server(cfg)
+        server.handle_metric_packet(b"keep.me:1|c")
+        server.handle_metric_packet(b"drop.me:1|c")
+        server.flush()
+        got = by_name(observer.wait_flush())
+        assert "keep.me" in got
+        assert "drop.me" not in got
+
+
+class TestConfig:
+    def test_yaml_and_env(self, tmp_path):
+        p = tmp_path / "cfg.yaml"
+        p.write_text(
+            "interval: 5s\n"
+            "percentiles: [0.5, 0.99]\n"
+            "metric_sinks:\n"
+            "  - kind: blackhole\n"
+            "    name: bh\n"
+            "extend_tags: ['env:test']\n")
+        cfg = read_config(str(p), env={"VENEUR_INTERVAL": "30s",
+                                       "VENEUR_DEBUG": "true"})
+        assert cfg.interval == 30.0
+        assert cfg.debug is True
+        assert cfg.percentiles == [0.5, 0.99]
+        assert cfg.metric_sinks[0].kind == "blackhole"
+        assert cfg.is_local is False
+
+    def test_defaults(self):
+        cfg = Config().apply_defaults()
+        assert cfg.interval == 10.0
+        assert cfg.metric_max_length == 4096
+        assert cfg.aggregates == ["min", "max", "count"]
+
+    def test_duration_parsing(self):
+        from veneur_tpu.config import parse_duration
+        assert parse_duration("10s") == 10.0
+        assert parse_duration("500ms") == 0.5
+        assert parse_duration("1m30s") == 90.0
+        assert parse_duration(3) == 3.0
+        with pytest.raises(ValueError):
+            parse_duration("10 parsecs")

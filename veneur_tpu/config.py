@@ -1,0 +1,239 @@
+"""Configuration: YAML file + VENEUR_* environment overlay.
+
+Field parity with reference config.go:12-135 (same yaml keys, same
+defaults: interval 10s, metric_max_length 4096, read buffer 2 MiB,
+aggregates min/max/count), plus a `tpu` block for the device column store
+(capacities, batch size). Durations accept Go-style strings ("10s",
+"500ms") or numbers of seconds. Environment variables VENEUR_<UPPERFIELD>
+override file values (reference README.md:236-247 envconfig behavior).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import socket
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import yaml
+
+from veneur_tpu.util.secret import StringSecret
+
+_DURATION_RE = re.compile(r"(\d+(?:\.\d+)?)(ns|us|µs|ms|s|m|h)")
+_DURATION_UNITS = {"ns": 1e-9, "us": 1e-6, "µs": 1e-6, "ms": 1e-3,
+                   "s": 1.0, "m": 60.0, "h": 3600.0}
+
+
+def parse_duration(v: Any) -> float:
+    """Go-style duration to seconds."""
+    if v is None:
+        return 0.0
+    if isinstance(v, (int, float)):
+        return float(v)
+    s = str(v).strip()
+    if not s:
+        return 0.0
+    matches = _DURATION_RE.findall(s)
+    if not matches or "".join(f"{n}{u}" for n, u in matches) != s:
+        raise ValueError(f"invalid duration: {v!r}")
+    return sum(float(n) * _DURATION_UNITS[u] for n, u in matches)
+
+
+@dataclass
+class SinkConfig:
+    kind: str = ""
+    name: str = ""
+    config: Dict[str, Any] = field(default_factory=dict)
+    max_name_length: int = 0
+    max_tag_length: int = 0
+    max_tags: int = 0
+    strip_tags: List[Dict[str, Any]] = field(default_factory=list)
+    add_tags: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class SourceConfig:
+    kind: str = ""
+    name: str = ""
+    config: Dict[str, Any] = field(default_factory=dict)
+    tags: List[str] = field(default_factory=list)
+
+
+@dataclass
+class SinkRoutingConfig:
+    name: str = ""
+    match: List[Dict[str, Any]] = field(default_factory=list)
+    matched: List[str] = field(default_factory=list)
+    not_matched: List[str] = field(default_factory=list)
+
+
+@dataclass
+class Features:
+    diagnostics_metrics_enabled: bool = False
+    enable_metric_sink_routing: bool = False
+
+
+@dataclass
+class TpuConfig:
+    """Device column-store sizing (no reference equivalent; this is the
+    TPU-native replacement for num_workers map sharding)."""
+
+    counter_capacity: int = 4096
+    gauge_capacity: int = 4096
+    histo_capacity: int = 4096
+    set_capacity: int = 1024
+    batch_cap: int = 8192
+    # number of ingest shards for the multi-chip merge plane
+    shards: int = 1
+
+
+@dataclass
+class Config:
+    aggregates: List[str] = field(default_factory=lambda: ["min", "max", "count"])
+    count_unique_timeseries: bool = False
+    debug: bool = False
+    enable_profiling: bool = False
+    extend_tags: List[str] = field(default_factory=list)
+    features: Features = field(default_factory=Features)
+    flush_on_shutdown: bool = False
+    flush_watchdog_missed_flushes: int = 0
+    forward_address: str = ""
+    forward_only: bool = False
+    grpc_address: str = ""
+    grpc_listen_addresses: List[str] = field(default_factory=list)
+    hostname: str = ""
+    http_address: str = ""
+    http_quit: bool = False
+    indicator_span_timer_name: str = ""
+    interval: float = 10.0
+    metric_max_length: int = 4096
+    metric_sink_routing: List[SinkRoutingConfig] = field(default_factory=list)
+    metric_sinks: List[SinkConfig] = field(default_factory=list)
+    num_readers: int = 1
+    num_span_workers: int = 1
+    num_workers: int = 1
+    objective_span_timer_name: str = ""
+    omit_empty_hostname: bool = False
+    percentiles: List[float] = field(default_factory=lambda: [0.5, 0.75, 0.99])
+    read_buffer_size_bytes: int = 2 * 1024 * 1024
+    sentry_dsn: StringSecret = field(default_factory=StringSecret)
+    sources: List[SourceConfig] = field(default_factory=list)
+    span_channel_capacity: int = 100
+    span_sinks: List[SinkConfig] = field(default_factory=list)
+    ssf_listen_addresses: List[str] = field(default_factory=list)
+    stats_address: str = ""
+    statsd_listen_addresses: List[str] = field(default_factory=list)
+    synchronize_with_interval: bool = False
+    tags_exclude: List[str] = field(default_factory=list)
+    tls_authority_certificate: str = ""
+    tls_certificate: str = ""
+    tls_key: StringSecret = field(default_factory=StringSecret)
+    trace_max_length_bytes: int = 16 * 1024 * 1024
+    veneur_metrics_additional_tags: List[str] = field(default_factory=list)
+    veneur_metrics_scopes: Dict[str, str] = field(default_factory=dict)
+    tpu: TpuConfig = field(default_factory=TpuConfig)
+
+    def apply_defaults(self) -> "Config":
+        if not self.aggregates:
+            self.aggregates = ["min", "max", "count"]
+        if not self.hostname and not self.omit_empty_hostname:
+            self.hostname = socket.gethostname()
+        if self.interval <= 0:
+            self.interval = 10.0
+        if self.metric_max_length <= 0:
+            self.metric_max_length = 4096
+        if self.read_buffer_size_bytes <= 0:
+            self.read_buffer_size_bytes = 2 * 1024 * 1024
+        if self.span_channel_capacity <= 0:
+            self.span_channel_capacity = 100
+        return self
+
+    @property
+    def is_local(self) -> bool:
+        """A server is local iff it forwards (reference server.go:1447)."""
+        return self.forward_address != ""
+
+
+_SUBSECTION_TYPES = {
+    "features": Features,
+    "tpu": TpuConfig,
+}
+_LIST_TYPES = {
+    "metric_sinks": SinkConfig,
+    "span_sinks": SinkConfig,
+    "sources": SourceConfig,
+}
+_SECRET_FIELDS = {"sentry_dsn", "tls_key"}
+_DURATION_FIELDS = {"interval"}
+
+
+def _coerce(name: str, value: Any) -> Any:
+    if name in _DURATION_FIELDS:
+        return parse_duration(value)
+    if name in _SECRET_FIELDS:
+        return StringSecret(str(value) if value is not None else "")
+    if name in _SUBSECTION_TYPES and isinstance(value, dict):
+        cls = _SUBSECTION_TYPES[name]
+        allowed = set(cls.__dataclass_fields__)
+        return cls(**{k: v for k, v in value.items() if k in allowed})
+    if name in _LIST_TYPES and isinstance(value, list):
+        cls = _LIST_TYPES[name]
+        allowed = set(cls.__dataclass_fields__)
+        out = []
+        for item in value or []:
+            item = dict(item or {})
+            if cls is SinkConfig:
+                item.setdefault("config", {})
+            out.append(cls(**{k: v for k, v in item.items() if k in allowed}))
+        return out
+    if name == "metric_sink_routing" and isinstance(value, list):
+        out = []
+        for item in value or []:
+            sinks = (item or {}).get("sinks", {}) or {}
+            out.append(SinkRoutingConfig(
+                name=item.get("name", ""), match=item.get("match", []) or [],
+                matched=sinks.get("matched", []) or [],
+                not_matched=sinks.get("not_matched", []) or []))
+        return out
+    return value
+
+
+def read_config(path: Optional[str] = None, overrides: Optional[dict] = None,
+                env: Optional[dict] = None, strict: bool = False) -> Config:
+    """Load YAML config, overlay VENEUR_* env vars, apply defaults."""
+    raw: Dict[str, Any] = {}
+    if path:
+        with open(path) as f:
+            raw = yaml.safe_load(f) or {}
+    if overrides:
+        raw.update(overrides)
+
+    cfg = Config()
+    known = set(cfg.__dataclass_fields__)
+    for key, value in raw.items():
+        if key not in known:
+            if strict:
+                raise ValueError(f"unknown config field: {key}")
+            continue
+        setattr(cfg, key, _coerce(key, value))
+
+    env = os.environ if env is None else env
+    for key in known:
+        env_key = "VENEUR_" + key.upper().replace(".", "_")
+        if env_key in env:
+            v: Any = env[env_key]
+            current = getattr(cfg, key)
+            if isinstance(current, bool):
+                v = str(v).lower() in ("1", "true", "yes", "on")
+            elif isinstance(current, int) and not isinstance(current, bool):
+                v = int(v)
+            elif isinstance(current, float) and key not in _DURATION_FIELDS:
+                v = float(v)
+            elif isinstance(current, list):
+                v = [s for s in str(v).split(",") if s]
+                if key == "percentiles":
+                    v = [float(x) for x in v]
+            setattr(cfg, key, _coerce(key, v))
+
+    return cfg.apply_defaults()

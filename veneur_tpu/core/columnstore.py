@@ -1,0 +1,399 @@
+"""The device column store: metric keys are rows, samples are batches.
+
+This replaces the reference's per-worker map-of-samplers hot path
+(reference worker.go:59-176, WorkerMetrics.Upsert and the per-type maps)
+with four device-resident tables:
+
+  counters  (K,)      f32 accumulators
+  gauges    (K,)      f32 last-write-wins + set mask
+  histos    (K, C)    t-digest centroid grids + per-key stats
+  sets      (K, 16k)  HLL registers
+
+A host dictionary interns MetricKey (by 64-bit fnv1a digest) to a row id;
+names/tags/scopes never leave the host. Samples append into pinned numpy
+batch buffers and are applied to device arrays in fixed-size padded batches
+(one scatter/sort kernel per batch), so the device sees a few large
+dispatches per second instead of one per packet.
+
+State is interval-scoped: flush snapshots the device arrays and zeroes them
+(the map-swap trick of reference worker.go:470-489); the key dictionary
+persists so steady-state ingest never re-interns.
+
+Capacity management: row capacity doubles on demand (device arrays are
+padded and the jitted kernels recompile once per capacity, amortized to
+zero); batch buffers are fixed-size so kernels compile once per (capacity,
+batch) shape.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from veneur_tpu.ops import batch_hll, batch_tdigest, hll_ref, scalars
+from veneur_tpu.samplers import metrics as m
+from veneur_tpu.samplers.metrics import MetricScope, UDPMetric
+
+
+@dataclass
+class RowMeta:
+    """Host-side identity of a row (never touches the device)."""
+
+    name: str
+    tags: List[str]
+    joined_tags: str
+    digest32: int
+    scope: MetricScope
+    wire_type: str  # counter/gauge/histogram/timer/set/status
+
+
+class _BaseTable:
+    """Row interning + touched tracking + capacity doubling, shared by all
+    device families."""
+
+    def __init__(self, capacity: int = 1024, batch_cap: int = 8192):
+        self.capacity = capacity
+        self.batch_cap = batch_cap
+        self.rows: Dict[int, int] = {}  # digest64 -> row
+        self.meta: List[RowMeta] = []
+        self.touched = np.zeros(capacity, bool)
+        self.lock = threading.Lock()
+        self._init_arrays()
+
+    # subclasses define _init_arrays / _grow_arrays / _apply / reset
+
+    def row_for(self, metric: UDPMetric) -> int:
+        # scope is part of row identity: the reference keeps separate maps
+        # per scope variant (worker.go:59-102), so one MetricKey may hold
+        # state in two scopes at once
+        dict_key = (metric.digest64 << 2) | int(metric.scope)
+        row = self.rows.get(dict_key)
+        if row is None:
+            row = len(self.meta)
+            if row >= self.capacity:
+                self._grow()
+            self.rows[dict_key] = row
+            self.meta.append(RowMeta(
+                name=metric.key.name, tags=list(metric.tags),
+                joined_tags=metric.key.joined_tags, digest32=metric.digest,
+                scope=metric.scope, wire_type=metric.key.type))
+        return row
+
+    def _grow(self):
+        new_cap = self.capacity * 2
+        self.touched = np.concatenate(
+            [self.touched, np.zeros(new_cap - self.capacity, bool)])
+        self._grow_arrays(new_cap)
+        self.capacity = new_cap
+
+    @property
+    def num_rows(self) -> int:
+        return len(self.meta)
+
+
+def _pad_cap(state_leaf, new_cap):
+    pad = new_cap - state_leaf.shape[0]
+    widths = [(0, pad)] + [(0, 0)] * (state_leaf.ndim - 1)
+    return jnp.pad(state_leaf, widths)
+
+
+class CounterTable(_BaseTable):
+    def _init_arrays(self):
+        self.state = scalars.init_counters(self.capacity)
+        self._pend = np.zeros((self.batch_cap, 3), np.float64)  # row,val,rate
+        self._n = 0
+
+    def _grow_arrays(self, new_cap):
+        self.state = jax.tree.map(lambda a: _pad_cap(a, new_cap), self.state)
+
+    def add(self, metric: UDPMetric):
+        with self.lock:
+            row = self.row_for(metric)
+            self.touched[row] = True
+            self._pend[self._n] = (row, metric.value, metric.sample_rate)
+            self._n += 1
+            if self._n >= self.batch_cap:
+                self._apply_locked()
+
+    def _apply_locked(self):
+        if self._n == 0:
+            return
+        n = self._n
+        rows = np.full(self.batch_cap, self.capacity, np.int32)
+        rows[:n] = self._pend[:n, 0]
+        vals = self._pend[:, 1].astype(np.float32)
+        rates = np.maximum(self._pend[:, 2].astype(np.float32), 1e-9)
+        self.state = scalars.apply_counters(self.state, rows, vals, rates)
+        self._n = 0
+
+    def apply_pending(self):
+        with self.lock:
+            self._apply_locked()
+
+    def merge_rows(self, rows: np.ndarray, values: np.ndarray):
+        with self.lock:
+            self.state = scalars.merge_counters(
+                self.state, rows.astype(np.int32), values.astype(np.float32))
+
+    def snapshot_and_reset(self) -> Tuple[np.ndarray, np.ndarray, List[RowMeta]]:
+        with self.lock:
+            self._apply_locked()
+            values = np.asarray(scalars.counter_values(self.state))
+            touched = self.touched.copy()
+            meta = list(self.meta)
+            self.state = scalars.init_counters(self.capacity)
+            self.touched[:] = False
+        return values, touched, meta
+
+
+class GaugeTable(_BaseTable):
+    def _init_arrays(self):
+        self.state = scalars.init_gauges(self.capacity)
+        self._pend = np.zeros((self.batch_cap, 2), np.float64)  # row,val
+        self._n = 0
+
+    def _grow_arrays(self, new_cap):
+        self.state = jax.tree.map(lambda a: _pad_cap(a, new_cap), self.state)
+
+    def add(self, metric: UDPMetric):
+        with self.lock:
+            row = self.row_for(metric)
+            self.touched[row] = True
+            self._pend[self._n] = (row, metric.value)
+            self._n += 1
+            if self._n >= self.batch_cap:
+                self._apply_locked()
+
+    def _apply_locked(self):
+        if self._n == 0:
+            return
+        n = self._n
+        rows = np.full(self.batch_cap, self.capacity, np.int32)
+        rows[:n] = self._pend[:n, 0]
+        vals = self._pend[:, 1].astype(np.float32)
+        self.state = scalars.apply_gauges(self.state, rows, vals)
+        self._n = 0
+
+    def apply_pending(self):
+        with self.lock:
+            self._apply_locked()
+
+    def merge_rows(self, rows: np.ndarray, values: np.ndarray):
+        with self.lock:
+            self.state = scalars.merge_gauges(
+                self.state, rows.astype(np.int32), values.astype(np.float32))
+
+    def snapshot_and_reset(self):
+        with self.lock:
+            self._apply_locked()
+            values = np.asarray(self.state["value"])
+            touched = self.touched.copy()
+            meta = list(self.meta)
+            self.state = scalars.init_gauges(self.capacity)
+            self.touched[:] = False
+        return values, touched, meta
+
+
+class HistoTable(_BaseTable):
+    """Histograms and timers, all scopes, one digest grid."""
+
+    def _init_arrays(self):
+        self.state = batch_tdigest.init_state(self.capacity)
+        self._pend = np.zeros((self.batch_cap, 3), np.float64)  # row,val,w
+        self._n = 0
+
+    def _grow_arrays(self, new_cap):
+        old = self.state
+        new = batch_tdigest.init_state(new_cap)
+        grown = {}
+        for k in new:
+            grown[k] = jax.lax.dynamic_update_slice(
+                new[k], old[k], (0,) * new[k].ndim)
+        self.state = grown
+
+    def add(self, metric: UDPMetric):
+        with self.lock:
+            row = self.row_for(metric)
+            self.touched[row] = True
+            weight = 1.0 / max(metric.sample_rate, 1e-9)
+            self._pend[self._n] = (row, metric.value, weight)
+            self._n += 1
+            if self._n >= self.batch_cap:
+                self._apply_locked()
+
+    def _apply_locked(self):
+        if self._n == 0:
+            return
+        n = self._n
+        rows = np.full(self.batch_cap, self.capacity, np.int32)
+        rows[:n] = self._pend[:n, 0]
+        vals = self._pend[:, 1].astype(np.float32)
+        wts = np.zeros(self.batch_cap, np.float32)
+        wts[:n] = self._pend[:n, 2]
+        self.state = batch_tdigest.apply_batch(self.state, rows, vals, wts)
+        self._n = 0
+
+    def apply_pending(self):
+        with self.lock:
+            self._apply_locked()
+
+    def merge_rows(self, rows, in_means, in_weights, in_min, in_max, in_recip):
+        with self.lock:
+            self.state = batch_tdigest.merge_centroid_rows(
+                self.state, rows.astype(np.int32),
+                in_means.astype(np.float32), in_weights.astype(np.float32),
+                in_min.astype(np.float32), in_max.astype(np.float32),
+                in_recip.astype(np.float32))
+
+    def snapshot_and_reset(self, percentiles: Tuple[float, ...]):
+        """Returns (flush outputs dict of np arrays, centroid export,
+        touched, meta)."""
+        with self.lock:
+            self._apply_locked()
+            out = batch_tdigest.flush_quantiles(self.state, tuple(percentiles))
+            out = {k: np.asarray(v) for k, v in out.items()}
+            export = batch_tdigest.export_centroids(self.state)
+            touched = self.touched.copy()
+            meta = list(self.meta)
+            self.state = batch_tdigest.init_state(self.capacity)
+            self.touched[:] = False
+        return out, export, touched, meta
+
+
+class SetTable(_BaseTable):
+    def __init__(self, capacity: int = 256, batch_cap: int = 8192):
+        super().__init__(capacity, batch_cap)
+
+    def _init_arrays(self):
+        self.state = batch_hll.init_state(self.capacity)
+        self._pend = np.zeros((self.batch_cap, 3), np.int64)  # row,idx,rho
+        self._n = 0
+
+    def _grow_arrays(self, new_cap):
+        self.state = _pad_cap(self.state, new_cap)
+
+    def add(self, metric: UDPMetric):
+        member = metric.value if isinstance(metric.value, bytes) else str(
+            metric.value).encode()
+        h = hll_ref.hash_member(member)
+        idx, rho = hll_ref.pos_val(h)
+        with self.lock:
+            row = self.row_for(metric)
+            self.touched[row] = True
+            self._pend[self._n] = (row, idx, rho)
+            self._n += 1
+            if self._n >= self.batch_cap:
+                self._apply_locked()
+
+    def _apply_locked(self):
+        if self._n == 0:
+            return
+        n = self._n
+        rows = np.full(self.batch_cap, self.capacity, np.int32)
+        rows[:n] = self._pend[:n, 0]
+        idxs = self._pend[:, 1].astype(np.int32)
+        rhos = self._pend[:, 2].astype(np.int32)
+        self.state = batch_hll.apply_batch(self.state, rows, idxs, rhos)
+        self._n = 0
+
+    def apply_pending(self):
+        with self.lock:
+            self._apply_locked()
+
+    def merge_rows(self, rows: np.ndarray, in_regs: np.ndarray):
+        with self.lock:
+            self.state = batch_hll.merge_rows(
+                self.state, rows.astype(np.int32), in_regs.astype(np.int8))
+
+    def snapshot_and_reset(self):
+        with self.lock:
+            self._apply_locked()
+            estimates = np.asarray(batch_hll.estimate(self.state))
+            registers = np.asarray(self.state)
+            touched = self.touched.copy()
+            meta = list(self.meta)
+            self.state = batch_hll.init_state(self.capacity)
+            self.touched[:] = False
+        return estimates, registers, touched, meta
+
+
+@dataclass
+class StatusEntry:
+    value: float = 0.0
+    message: str = ""
+    hostname: str = ""
+
+
+class StatusTable(_BaseTable):
+    """Service checks: last status + message; strings stay on host
+    (reference samplers.go:210-231)."""
+
+    def _init_arrays(self):
+        self.values: List[StatusEntry] = []
+
+    def _grow_arrays(self, new_cap):
+        pass
+
+    def add(self, metric: UDPMetric):
+        with self.lock:
+            row = self.row_for(metric)
+            while len(self.values) <= row:
+                self.values.append(StatusEntry())
+            self.touched[row] = True
+            self.values[row] = StatusEntry(
+                value=float(metric.value), message=metric.message,
+                hostname=metric.hostname)
+
+    def apply_pending(self):
+        pass
+
+    def snapshot_and_reset(self):
+        with self.lock:
+            vals = list(self.values)
+            touched = self.touched.copy()
+            meta = list(self.meta)
+            self.values = [StatusEntry() for _ in vals]
+            self.touched[:] = False
+        return vals, touched, meta
+
+
+class ColumnStore:
+    """All four device families plus host-side status checks."""
+
+    def __init__(self, counter_capacity=1024, gauge_capacity=1024,
+                 histo_capacity=1024, set_capacity=256, batch_cap=8192):
+        self.counters = CounterTable(counter_capacity, batch_cap)
+        self.gauges = GaugeTable(gauge_capacity, batch_cap)
+        self.histos = HistoTable(histo_capacity, batch_cap)
+        self.sets = SetTable(set_capacity, batch_cap)
+        self.statuses = StatusTable()
+        self.processed = 0
+
+    def process(self, metric: UDPMetric) -> None:
+        """Route one parsed metric to its family table (the equivalent of
+        reference worker.go:350-404 ProcessMetric)."""
+        t = metric.key.type
+        if t == m.COUNTER:
+            self.counters.add(metric)
+        elif t == m.GAUGE:
+            self.gauges.add(metric)
+        elif t in (m.HISTOGRAM, m.TIMER):
+            self.histos.add(metric)
+        elif t == m.SET:
+            self.sets.add(metric)
+        elif t == m.STATUS:
+            self.statuses.add(metric)
+        else:
+            return
+        self.processed += 1
+
+    def apply_all_pending(self):
+        self.counters.apply_pending()
+        self.gauges.apply_pending()
+        self.histos.apply_pending()
+        self.sets.apply_pending()

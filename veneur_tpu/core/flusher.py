@@ -1,0 +1,209 @@
+"""Flush generation: device snapshots -> InterMetrics + forwardable state.
+
+Semantic parity with reference flusher.go:26-122 and samplers.go:359-514:
+
+* A local server (forward_address set) emits only histogram *aggregates*
+  for mixed-scope histograms/timers (no percentiles) and forwards their
+  digests; a global server emits *percentiles* (no aggregates) for
+  mixed-scope rows merged from its locals.
+* Local-only rows always flush in their entirety (full percentiles +
+  aggregates) on whichever server owns them.
+* Global-only rows emit nothing on a local server (forward only) and
+  flush with digest-derived ("global") aggregate values on a global one.
+* Sets emit their HLL estimate as a gauge, on global servers only, except
+  local-only sets which flush locally.
+* Counters/gauges: mixed+local rows flush locally; global-only rows flush
+  only on the global server.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from veneur_tpu.core.columnstore import ColumnStore, RowMeta
+from veneur_tpu.samplers import metrics as m
+from veneur_tpu.samplers.metrics import (
+    Aggregate, HistogramAggregates, InterMetric, MetricScope, MetricType,
+)
+
+
+@dataclass
+class ForwardableState:
+    """Host-side snapshot of mergeable state bound for the global tier
+    (the equivalent of reference worker.go:180-217 ForwardableMetrics)."""
+
+    counters: List[Tuple[RowMeta, float]] = field(default_factory=list)
+    gauges: List[Tuple[RowMeta, float]] = field(default_factory=list)
+    # (meta, means, weights, min, max, reciprocal_sum)
+    histograms: List[Tuple[RowMeta, np.ndarray, np.ndarray, float, float, float]] = \
+        field(default_factory=list)
+    # (meta, registers)
+    sets: List[Tuple[RowMeta, np.ndarray]] = field(default_factory=list)
+
+    def __len__(self):
+        return (len(self.counters) + len(self.gauges) + len(self.histograms)
+                + len(self.sets))
+
+
+def _percentile_name(name: str, p: float) -> str:
+    # reference naming truncates: 0.999 -> "99percentile" (samplers.go:498)
+    return f"{name}.{int(p * 100)}percentile"
+
+
+def flush_columnstore(
+    store: ColumnStore,
+    is_local: bool,
+    percentiles: Sequence[float],
+    aggregates: HistogramAggregates,
+    collect_forward: bool = True,
+) -> Tuple[List[InterMetric], ForwardableState]:
+    """Snapshot+reset every table and generate final metrics plus the
+    forwardable snapshot (empty unless is_local and collect_forward)."""
+    now = int(time.time())
+    final: List[InterMetric] = []
+    fwd = ForwardableState()
+
+    # ---- counters & gauges --------------------------------------------
+    c_vals, c_touched, c_meta = store.counters.snapshot_and_reset()
+    for row, meta in enumerate(c_meta):
+        if not c_touched[row]:
+            continue
+        if meta.scope == MetricScope.GLOBAL_ONLY:
+            if is_local:
+                if collect_forward:
+                    fwd.counters.append((meta, float(c_vals[row])))
+                continue
+        final.append(InterMetric(
+            name=meta.name, timestamp=now, value=float(c_vals[row]),
+            tags=list(meta.tags), type=MetricType.COUNTER))
+
+    g_vals, g_touched, g_meta = store.gauges.snapshot_and_reset()
+    for row, meta in enumerate(g_meta):
+        if not g_touched[row]:
+            continue
+        if meta.scope == MetricScope.GLOBAL_ONLY:
+            if is_local:
+                if collect_forward:
+                    fwd.gauges.append((meta, float(g_vals[row])))
+                continue
+        final.append(InterMetric(
+            name=meta.name, timestamp=now, value=float(g_vals[row]),
+            tags=list(meta.tags), type=MetricType.GAUGE))
+
+    # ---- histograms & timers ------------------------------------------
+    # full percentile list is always used for local-only rows
+    # (flusher.go:383-404); the server-level list applies to mixed rows.
+    # Aggregates are always the configured set (generateInterMetrics passes
+    # s.HistogramAggregates unconditionally, flusher.go:360-371) — on a
+    # global server the Local* guards suppress everything except median.
+    full_ps = tuple(percentiles)
+    server_ps = () if is_local else full_ps
+    server_aggs = aggregates
+    all_ps = tuple(sorted(set(full_ps) | {0.5}))  # median always computable
+    out, export, h_touched, h_meta = store.histos.snapshot_and_reset(all_ps)
+    ps_index = {p: i for i, p in enumerate(all_ps)}
+    exp_means, exp_weights, exp_min, exp_max, exp_recip = export
+
+    for row, meta in enumerate(h_meta):
+        if not h_touched[row]:
+            continue
+        scope = meta.scope
+        if scope == MetricScope.MIXED:
+            ps, aggs, use_global = server_ps, server_aggs, False
+        elif scope == MetricScope.LOCAL_ONLY:
+            ps, aggs, use_global = full_ps, aggregates, False
+        else:  # GLOBAL_ONLY
+            if is_local:
+                ps = ()
+                aggs, use_global = HistogramAggregates(), False
+            else:
+                ps, aggs, use_global = full_ps, aggregates, True
+        if is_local and collect_forward and scope != MetricScope.LOCAL_ONLY:
+            fwd.histograms.append((
+                meta, exp_means[row].copy(), exp_weights[row].copy(),
+                float(exp_min[row]), float(exp_max[row]),
+                float(exp_recip[row])))
+        final.extend(_flush_histo_row(
+            meta, row, out, ps_index, now, ps, aggs, use_global))
+
+    # ---- sets ----------------------------------------------------------
+    estimates, registers, s_touched, s_meta = store.sets.snapshot_and_reset()
+    for row, meta in enumerate(s_meta):
+        if not s_touched[row]:
+            continue
+        if meta.scope == MetricScope.LOCAL_ONLY:
+            final.append(InterMetric(
+                name=meta.name, timestamp=now, value=float(estimates[row]),
+                tags=list(meta.tags), type=MetricType.GAUGE))
+            continue
+        if is_local:
+            if collect_forward:
+                fwd.sets.append((meta, registers[row].copy()))
+            continue
+        final.append(InterMetric(
+            name=meta.name, timestamp=now, value=float(estimates[row]),
+            tags=list(meta.tags), type=MetricType.GAUGE))
+
+    # ---- status checks -------------------------------------------------
+    st_vals, st_touched, st_meta = store.statuses.snapshot_and_reset()
+    for row, meta in enumerate(st_meta):
+        if not st_touched[row]:
+            continue
+        entry = st_vals[row]
+        final.append(InterMetric(
+            name=meta.name, timestamp=now, value=entry.value,
+            tags=list(meta.tags), type=MetricType.STATUS,
+            message=entry.message, hostname=entry.hostname))
+
+    return final, fwd
+
+
+def _flush_histo_row(
+    meta: RowMeta, row: int, out: Dict[str, np.ndarray],
+    ps_index: Dict[float, int], now: int,
+    percentiles: Sequence[float], aggregates: HistogramAggregates,
+    use_global: bool,
+) -> List[InterMetric]:
+    """Emit aggregate + percentile metrics for one histogram row; condition
+    and value-selection parity with reference samplers.go:359-514."""
+    ms: List[InterMetric] = []
+    a = aggregates.value
+    lmin, lmax = float(out["lmin"][row]), float(out["lmax"][row])
+    lsum, lweight = float(out["lsum"][row]), float(out["lweight"][row])
+    lrecip = float(out["lrecip"][row])
+    dmin, dmax = float(out["min"][row]), float(out["max"][row])
+    dsum, dcount = float(out["sum"][row]), float(out["count"][row])
+    drecip_hmean = float(out["hmean"][row])
+
+    def emit(suffix, value, mtype=MetricType.GAUGE):
+        ms.append(InterMetric(
+            name=f"{meta.name}.{suffix}", timestamp=now, value=value,
+            tags=list(meta.tags), type=mtype))
+
+    if (a & Aggregate.MAX) and (not math.isinf(lmax) or use_global):
+        emit("max", dmax if use_global else lmax)
+    if (a & Aggregate.MIN) and (not math.isinf(lmin) or use_global):
+        emit("min", dmin if use_global else lmin)
+    if (a & Aggregate.SUM) and (lsum != 0 or use_global):
+        emit("sum", dsum if use_global else lsum)
+    if (a & Aggregate.AVERAGE) and (use_global or (lsum != 0 and lweight != 0)):
+        emit("avg", (dsum / dcount) if use_global else (lsum / lweight))
+    if (a & Aggregate.COUNT) and (lweight != 0 or use_global):
+        emit("count", dcount if use_global else lweight, MetricType.COUNTER)
+    if a & Aggregate.MEDIAN:
+        emit("median", float(out["quantiles"][row, ps_index[0.5]]))
+    if (a & Aggregate.HARMONIC_MEAN) and (
+            use_global or (lrecip != 0 and lweight != 0)):
+        emit("hmean", drecip_hmean if use_global else (lweight / lrecip))
+
+    for p in percentiles:
+        ms.append(InterMetric(
+            name=_percentile_name(meta.name, p), timestamp=now,
+            value=float(out["quantiles"][row, ps_index[p]]),
+            tags=list(meta.tags), type=MetricType.GAUGE))
+    return ms

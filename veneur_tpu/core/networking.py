@@ -1,0 +1,180 @@
+"""Ingest networking: UDP (SO_REUSEPORT multi-reader), TCP line streams,
+and UNIX datagram sockets for DogStatsD.
+
+Parity with reference networking.go:30-324 and socket_linux.go:12-30:
+`num_readers` threads each bind their own SO_REUSEPORT socket so the
+kernel load-balances datagrams; TCP connections are newline-split line
+readers; address URLs select the protocol (udp:// tcp:// unixgram://).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+import threading
+from typing import List
+from urllib.parse import urlparse
+
+logger = logging.getLogger("veneur_tpu.networking")
+
+_MAX_DGRAM = 65536
+
+
+class Listener:
+    def __init__(self, scheme: str, address, sock: socket.socket,
+                 threads: List[threading.Thread]):
+        self.scheme = scheme
+        self.address = address
+        self._socks = [sock] if sock is not None else []
+        self._threads = threads
+        self.closed = False
+
+    def add_socket(self, sock):
+        self._socks.append(sock)
+
+    def close(self):
+        self.closed = True
+        for sock in self._socks:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+
+def _new_udp_socket(host: str, port: int, rcvbuf: int,
+                    reuseport: bool) -> socket.socket:
+    """SO_REUSEPORT + enlarged receive buffer (socket_linux.go:12-30)."""
+    family = socket.AF_INET6 if ":" in host and not host.startswith(
+        "127.") else socket.AF_INET
+    sock = socket.socket(family, socket.SOCK_DGRAM)
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    if reuseport and hasattr(socket, "SO_REUSEPORT"):
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+    if rcvbuf:
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, rcvbuf)
+    sock.bind((host, port))
+    return sock
+
+
+def start_statsd(address: str, server, num_readers: int = 1,
+                 rcvbuf: int = 2 * 1024 * 1024) -> List[Listener]:
+    """Start DogStatsD listeners for one address URL
+    (reference networking.go:30-52 StartStatsd dispatch)."""
+    u = urlparse(address)
+    if u.scheme == "udp":
+        return [_start_statsd_udp(u, server, num_readers, rcvbuf)]
+    if u.scheme == "tcp":
+        return [_start_statsd_tcp(u, server)]
+    if u.scheme in ("unixgram", "unix"):
+        return [_start_statsd_unix(u, server)]
+    raise ValueError(f"unsupported statsd listen scheme: {u.scheme}")
+
+
+def _start_statsd_udp(u, server, num_readers: int, rcvbuf: int) -> Listener:
+    host = u.hostname or "127.0.0.1"
+    port = u.port or 0
+    threads = []
+    first = _new_udp_socket(host, port, rcvbuf, reuseport=num_readers > 1)
+    bound_port = first.getsockname()[1]
+    listener = Listener("udp", first.getsockname(), first, threads)
+    socks = [first]
+    for _ in range(max(0, num_readers - 1)):
+        sock = _new_udp_socket(host, bound_port, rcvbuf, reuseport=True)
+        listener.add_socket(sock)
+        socks.append(sock)
+    for i, sock in enumerate(socks):
+        t = threading.Thread(
+            target=_read_metric_socket, args=(sock, server, listener),
+            name=f"statsd-udp-reader-{i}", daemon=True)
+        t.start()
+        threads.append(t)
+    logger.info("listening for statsd on UDP %s (%d readers)",
+                listener.address, len(socks))
+    return listener
+
+
+def _read_metric_socket(sock, server, listener: Listener) -> None:
+    """Datagram read loop (reference server.go:1103-1140)."""
+    while not listener.closed:
+        try:
+            buf = sock.recv(_MAX_DGRAM)
+        except OSError:
+            return
+        if buf:
+            server.handle_packet_buffer(buf)
+
+
+def _start_statsd_tcp(u, server) -> Listener:
+    host = u.hostname or "127.0.0.1"
+    port = u.port or 0
+    sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    sock.bind((host, port))
+    sock.listen(128)
+    threads: List[threading.Thread] = []
+    listener = Listener("tcp", sock.getsockname(), sock, threads)
+
+    def accept_loop():
+        while not listener.closed:
+            try:
+                conn, _ = sock.accept()
+            except OSError:
+                return
+            t = threading.Thread(
+                target=_read_tcp_lines, args=(conn, server, listener),
+                daemon=True)
+            t.start()
+
+    t = threading.Thread(target=accept_loop, name="statsd-tcp-accept",
+                         daemon=True)
+    t.start()
+    threads.append(t)
+    logger.info("listening for statsd on TCP %s", listener.address)
+    return listener
+
+
+def _read_tcp_lines(conn, server, listener: Listener) -> None:
+    """Newline-delimited stream reader (reference server.go:1323-1340),
+    bounding line length at metric_max_length."""
+    max_len = server.config.metric_max_length
+    buf = b""
+    with conn:
+        while not listener.closed:
+            try:
+                chunk = conn.recv(65536)
+            except OSError:
+                return
+            if not chunk:
+                break
+            buf += chunk
+            while True:
+                nl = buf.find(b"\n")
+                if nl < 0:
+                    break
+                line, buf = buf[:nl], buf[nl + 1:]
+                if line:
+                    server.handle_metric_packet(line)
+            if len(buf) > max_len:
+                logger.warning("dropping over-long TCP line (%d bytes)",
+                               len(buf))
+                return
+
+
+def _start_statsd_unix(u, server) -> Listener:
+    path = u.path or u.netloc
+    try:
+        os.unlink(path)
+    except OSError:
+        pass
+    sock = socket.socket(socket.AF_UNIX, socket.SOCK_DGRAM)
+    sock.bind(path)
+    threads: List[threading.Thread] = []
+    listener = Listener("unixgram", path, sock, threads)
+    t = threading.Thread(
+        target=_read_metric_socket, args=(sock, server, listener),
+        name="statsd-unixgram-reader", daemon=True)
+    t.start()
+    threads.append(t)
+    logger.info("listening for statsd on UNIX datagram %s", path)
+    return listener

@@ -1,0 +1,312 @@
+"""The veneur-tpu server: wires config -> column store -> sources -> sinks.
+
+Structural parity with reference server.go (NewFromConfig:462, Start:711,
+Flush ticker:837-875, HandleMetricPacket:949, Shutdown:1424) with the
+worker pool replaced by the device column store. Ingest threads parse
+packets and append samples to batch buffers; the flush ticker runs the
+device flush kernels and fans InterMetrics out to sinks in parallel.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import socket
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from veneur_tpu import sinks as sinks_mod
+from veneur_tpu.config import Config, SinkConfig
+from veneur_tpu.core import networking
+from veneur_tpu.core.columnstore import ColumnStore
+from veneur_tpu.core.flusher import ForwardableState, flush_columnstore
+from veneur_tpu.samplers import metrics as m
+from veneur_tpu.samplers.metrics import (
+    HistogramAggregates, InterMetric, MetricScope, UDPMetric,
+)
+from veneur_tpu.samplers.parser import ParseError, Parser
+from veneur_tpu.util.matcher import SinkRoutingMatcher
+
+logger = logging.getLogger("veneur_tpu.server")
+
+
+class Server:
+    def __init__(self, config: Config,
+                 extra_metric_sinks: Optional[List] = None,
+                 extra_span_sinks: Optional[List] = None):
+        self.config = config
+        self.interval = config.interval
+        self.parser = Parser(extend_tags=config.extend_tags)
+        self.store = ColumnStore(
+            counter_capacity=config.tpu.counter_capacity,
+            gauge_capacity=config.tpu.gauge_capacity,
+            histo_capacity=config.tpu.histo_capacity,
+            set_capacity=config.tpu.set_capacity,
+            batch_cap=config.tpu.batch_cap)
+        self.aggregates = HistogramAggregates.from_names(config.aggregates)
+        self.percentiles = tuple(config.percentiles)
+
+        sinks_mod.register_builtin_sinks()
+        self.metric_sinks: List = list(extra_metric_sinks or [])
+        for sc in config.metric_sinks:
+            factory = sinks_mod.MetricSinkTypes.get(sc.kind)
+            if factory is None:
+                raise ValueError(f"unknown metric sink kind: {sc.kind}")
+            self.metric_sinks.append(factory(sc, config))
+        self.span_sinks: List = list(extra_span_sinks or [])
+        for sc in config.span_sinks:
+            factory = sinks_mod.SpanSinkTypes.get(sc.kind)
+            if factory is None:
+                raise ValueError(f"unknown span sink kind: {sc.kind}")
+            self.span_sinks.append(factory(sc, config))
+        self._sink_filters = {  # per-sink tag/name filtering config
+            sc.name or sc.kind: sc for sc in config.metric_sinks}
+
+        self._routing = None
+        if config.features.enable_metric_sink_routing:
+            self._routing = [SinkRoutingMatcher(rc)
+                             for rc in config.metric_sink_routing]
+
+        # events & service-check samples buffered between flushes
+        self._other_samples: List = []
+        self._other_lock = threading.Lock()
+
+        self.forwarder: Optional[Callable[[ForwardableState], None]] = None
+
+        self._listeners: List[networking.Listener] = []
+        self._flush_lock = threading.Lock()
+        self._flush_thread: Optional[threading.Thread] = None
+        self._watchdog_thread: Optional[threading.Thread] = None
+        self._shutdown = threading.Event()
+        self.last_flush_unix = time.time()
+        self.flush_count = 0
+        self.stats: Dict[str, float] = {
+            "packets_received": 0, "parse_errors": 0, "metrics_flushed": 0,
+        }
+
+    # -- identity --------------------------------------------------------
+
+    @property
+    def is_local(self) -> bool:
+        return self.config.is_local
+
+    # -- ingest ----------------------------------------------------------
+
+    def handle_metric_packet(self, packet: bytes) -> None:
+        """Dispatch one datagram/line (reference server.go:949-1000)."""
+        self.stats["packets_received"] += 1
+        try:
+            if packet.startswith(b"_sc"):
+                metric = self.parser.parse_service_check(packet)
+                self.ingest_metric(metric)
+            elif packet.startswith(b"_e{"):
+                event = self.parser.parse_event(packet)
+                with self._other_lock:
+                    self._other_samples.append(event)
+            else:
+                self.parser.parse_metric_fast(packet, self.ingest_metric)
+        except ParseError as e:
+            self.stats["parse_errors"] += 1
+            logger.debug("could not parse packet %r: %s", packet[:100], e)
+
+    def handle_packet_buffer(self, buf: bytes) -> None:
+        """Newline-split a multi-metric datagram (server.go:1116-1140)."""
+        if len(buf) > self.config.metric_max_length:
+            self.stats["parse_errors"] += 1
+            return
+        for line in buf.split(b"\n"):
+            if line:
+                self.handle_metric_packet(line)
+
+    def ingest_metric(self, metric: UDPMetric) -> None:
+        self.store.process(metric)
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> None:
+        for sink in self.metric_sinks + self.span_sinks:
+            sink.start(self)
+        for addr in self.config.statsd_listen_addresses:
+            self._listeners.extend(networking.start_statsd(
+                addr, self, num_readers=self.config.num_readers,
+                rcvbuf=self.config.read_buffer_size_bytes))
+        # pre-compile the flush kernels off the ticker path so the first
+        # real flush isn't delayed by XLA compilation (~20-40s on TPU)
+        threading.Thread(target=self._warmup, name="kernel-warmup",
+                         daemon=True).start()
+        self._flush_thread = threading.Thread(
+            target=self._flush_loop, name="flush-ticker", daemon=True)
+        self._flush_thread.start()
+        if self.config.flush_watchdog_missed_flushes > 0:
+            self._watchdog_thread = threading.Thread(
+                target=self._flush_watchdog, name="flush-watchdog", daemon=True)
+            self._watchdog_thread.start()
+
+    def local_addr(self, scheme: str = "udp"):
+        for listener in self._listeners:
+            if listener.scheme == scheme:
+                return listener.address
+        return None
+
+    def shutdown(self) -> None:
+        self._shutdown.set()
+        if self.config.flush_on_shutdown:
+            self.flush()
+        for listener in self._listeners:
+            listener.close()
+        for sink in self.metric_sinks + self.span_sinks:
+            sink.stop()
+
+    # -- flush -----------------------------------------------------------
+
+    def _tick_delay(self) -> float:
+        """Clock-aligned tick (reference server.go:1458 CalculateTickDelay)."""
+        interval = self.interval
+        now = time.time()
+        return interval - (now % interval)
+
+    def _flush_loop(self) -> None:
+        while not self._shutdown.is_set():
+            delay = (self._tick_delay() if self.config.synchronize_with_interval
+                     else self.interval)
+            if self._shutdown.wait(delay):
+                return
+            try:
+                self.flush()
+            except Exception:
+                logger.exception("flush failed")
+
+    def _flush_watchdog(self) -> None:
+        """Die loudly if flushes stall (reference server.go:877-919)."""
+        allowed = self.config.flush_watchdog_missed_flushes * self.interval
+        while not self._shutdown.wait(self.interval):
+            if time.time() - self.last_flush_unix > allowed:
+                logger.critical(
+                    "flush watchdog: no flush for %ds; aborting", allowed)
+                import faulthandler
+                import os
+                faulthandler.dump_traceback(all_threads=True)
+                os._exit(2)
+
+    def _warmup(self) -> None:
+        """Compile the flush kernels against a throwaway store with the same
+        array shapes; never touches (or resets) live state."""
+        try:
+            cfg = self.config
+            scratch = ColumnStore(
+                counter_capacity=cfg.tpu.counter_capacity,
+                gauge_capacity=cfg.tpu.gauge_capacity,
+                histo_capacity=cfg.tpu.histo_capacity,
+                set_capacity=cfg.tpu.set_capacity,
+                batch_cap=cfg.tpu.batch_cap)
+            flush_columnstore(
+                scratch, self.is_local, self.percentiles, self.aggregates,
+                collect_forward=False)
+        except Exception:
+            logger.exception("kernel warmup failed")
+
+    def flush(self) -> None:
+        """One flush pass (reference flusher.go:26-122)."""
+        with self._flush_lock:
+            self._flush_locked()
+
+    def _flush_locked(self) -> None:
+        self.last_flush_unix = time.time()
+        self.flush_count += 1
+
+        with self._other_lock:
+            samples, self._other_samples = self._other_samples, []
+        for sink in self.metric_sinks:
+            try:
+                sink.flush_other_samples(samples)
+            except Exception:
+                logger.exception("sink %s flush_other_samples failed",
+                                 sink.name())
+
+        for sink in self.span_sinks:
+            try:
+                sink.flush()
+            except Exception:
+                logger.exception("span sink %s flush failed", sink.name())
+
+        final, fwd = flush_columnstore(
+            self.store, self.is_local, self.percentiles, self.aggregates,
+            collect_forward=self.forwarder is not None or self.is_local)
+        self.stats["metrics_flushed"] += len(final)
+
+        threads = []
+        if self.is_local and self.forwarder is not None and len(fwd):
+            t = threading.Thread(
+                target=self._forward_safe, args=(fwd,), daemon=True)
+            t.start()
+            threads.append(t)
+
+        if self._routing is not None:
+            for metric in final:
+                route = set()
+                for rule in self._routing:
+                    route.update(rule.route(metric.name, metric.tags))
+                metric.sinks = route
+
+        if final:
+            for sink in self.metric_sinks:
+                t = threading.Thread(
+                    target=self._flush_sink_safe, args=(sink, final),
+                    daemon=True)
+                t.start()
+                threads.append(t)
+        # block until every sink finishes, like the reference's wg.Wait()
+        # (flusher.go:79-121): a hung sink stalls flushes and, if
+        # configured, trips the flush watchdog rather than leaking threads
+        for t in threads:
+            t.join()
+
+    def _forward_safe(self, fwd: ForwardableState) -> None:
+        try:
+            self.forwarder(fwd)
+        except Exception:
+            logger.exception("forward failed")
+
+    def _flush_sink_safe(self, sink, metrics: List[InterMetric]) -> None:
+        try:
+            name = sink.name()
+            selected = [mm for mm in metrics
+                        if mm.sinks is None or name in mm.sinks]
+            sc = self._sink_filters.get(name)
+            if sc is not None:
+                selected = _apply_sink_filters(selected, sc)
+            sink.flush(selected)
+        except Exception:
+            logger.exception("sink %s flush failed", sink.name())
+
+
+def _apply_sink_filters(metrics: List[InterMetric], sc: SinkConfig
+                        ) -> List[InterMetric]:
+    """Per-sink filtering: max name/tag limits, strip/add tags
+    (reference flusher.go:138-213)."""
+    from veneur_tpu.util.matcher import TagMatcher
+    strip = [TagMatcher.from_config(t) for t in sc.strip_tags]
+    out = []
+    for metric in metrics:
+        if sc.max_name_length and len(metric.name) > sc.max_name_length:
+            continue
+        tags = metric.tags
+        if strip:
+            tags = [t for t in tags
+                    if not any(sm.match(t) for sm in strip)]
+        if sc.add_tags:
+            tags = sorted(set(tags) | {
+                f"{k}:{v}" if v else k for k, v in sc.add_tags.items()})
+        if sc.max_tag_length and any(len(t) > sc.max_tag_length for t in tags):
+            continue
+        if sc.max_tags and len(tags) > sc.max_tags:
+            continue
+        if tags is not metric.tags:
+            metric = InterMetric(
+                name=metric.name, timestamp=metric.timestamp,
+                value=metric.value, tags=tags, type=metric.type,
+                message=metric.message, hostname=metric.hostname,
+                sinks=metric.sinks)
+        out.append(metric)
+    return out

@@ -82,8 +82,63 @@ class Event:
 
 
 class Parser:
-    def __init__(self, extend_tags: Optional[Sequence[str]] = None):
+    def __init__(self, extend_tags: Optional[Sequence[str]] = None,
+                 cache_size: int = 1 << 16):
         self.extend_tags = tagging.ExtendTags(extend_tags or ())
+        # metadata cache: everything except the value chunk parses once per
+        # unique timeseries; steady-state traffic repeats keys, so the hot
+        # path becomes one dict hit + value conversion
+        self._meta_cache: Dict[bytes, tuple] = {}
+        self._cache_size = cache_size
+
+    def parse_metric_fast(self, packet: bytes,
+                          cb: Callable[[UDPMetric], None]) -> None:
+        """Cached parse: same grammar and errors as parse_metric."""
+        type_start = packet.find(b"|")
+        if type_start < 0:
+            raise ParseError("need at least 1 pipe for type")
+        value_start = packet.find(b":", 0, type_start)
+        if value_start < 0:
+            raise ParseError("need at least 1 colon")
+        meta_key = packet[:value_start] + packet[type_start:]
+        cached = self._meta_cache.get(meta_key)
+        if cached is None:
+            template: List[UDPMetric] = []
+            self.parse_metric(packet, template.append)
+            if not template:
+                return
+            t = template[0]
+            cached = (t.key, t.digest, t.digest64, t.sample_rate,
+                      t.tags, t.scope)
+            if len(self._meta_cache) >= self._cache_size:
+                self._meta_cache.clear()
+            self._meta_cache[meta_key] = cached
+            # first parse already produced the metrics; deliver and return
+            for metric in template:
+                cb(metric)
+            return
+        key, h32, h64, sample_rate, tags, scope = cached
+        is_set = key.type == m.SET
+        vc = packet[value_start + 1 : type_start]
+        while vc:
+            next_colon = vc.find(b":")
+            if next_colon >= 0:
+                value, vc = vc[:next_colon], vc[next_colon + 1 :]
+            else:
+                value, vc = vc, b""
+            if is_set:
+                val: object = value.decode("utf-8", "replace")
+            else:
+                try:
+                    val = _strict_float(value)
+                except ValueError:
+                    raise ParseError(f"invalid number for metric value: {value!r}")
+                if math.isnan(val) or math.isinf(val):
+                    raise ParseError(f"invalid number for metric value: {value!r}")
+            metric = UDPMetric(
+                key=key, digest=h32, digest64=h64, value=val,
+                sample_rate=sample_rate, tags=tags, scope=scope)
+            cb(metric)
 
     # -- metrics ---------------------------------------------------------
 

@@ -1,0 +1,89 @@
+"""Sink plugin boundary.
+
+Interface parity with reference sinks/sinks.go:42-103: metric sinks receive
+plain host-side InterMetrics per flush (the device column store is invisible
+to them), span sinks ingest SSF spans one at a time and flush per interval.
+Factories register by kind in MetricSinkTypes/SpanSinkTypes (reference
+server.go:62-91, populated in cmd/veneur/main.go:98-170).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from veneur_tpu.samplers.metrics import InterMetric
+
+# sink "kinds" report what they drop: a metric sink is expected to handle
+# every InterMetric it receives
+class MetricSink(abc.ABC):
+    @abc.abstractmethod
+    def name(self) -> str: ...
+
+    @abc.abstractmethod
+    def kind(self) -> str: ...
+
+    def start(self, server) -> None:  # noqa: B027
+        pass
+
+    @abc.abstractmethod
+    def flush(self, metrics: List[InterMetric]) -> None: ...
+
+    def flush_other_samples(self, samples: Sequence[Any]) -> None:  # noqa: B027
+        """Receive events/service-check samples that aren't InterMetrics."""
+
+    def stop(self) -> None:  # noqa: B027
+        pass
+
+
+class SpanSink(abc.ABC):
+    @abc.abstractmethod
+    def name(self) -> str: ...
+
+    def kind(self) -> str:
+        return self.name()
+
+    def start(self, server) -> None:  # noqa: B027
+        pass
+
+    @abc.abstractmethod
+    def ingest(self, span) -> None: ...
+
+    def flush(self) -> None:  # noqa: B027
+        pass
+
+    def stop(self) -> None:  # noqa: B027
+        pass
+
+
+# kind -> factory(config: SinkConfig, server_config: Config) -> sink
+MetricSinkTypes: Dict[str, Callable] = {}
+SpanSinkTypes: Dict[str, Callable] = {}
+
+
+def register_metric_sink(kind: str):
+    def deco(factory):
+        MetricSinkTypes[kind] = factory
+        return factory
+    return deco
+
+
+def register_span_sink(kind: str):
+    def deco(factory):
+        SpanSinkTypes[kind] = factory
+        return factory
+    return deco
+
+
+def register_builtin_sinks() -> None:
+    """Import every built-in sink module for its registration side effect."""
+    from veneur_tpu.sinks import (  # noqa: F401
+        blackhole, channel, debug, localfile,
+    )
+    for mod in ("datadog", "prometheus", "cortex", "signalfx", "kafka",
+                "splunk", "s3", "cloudwatch", "xray", "newrelic",
+                "lightstep", "falconer", "ssfmetrics"):
+        try:
+            __import__(f"veneur_tpu.sinks.{mod}")
+        except ImportError:
+            pass
