@@ -1,9 +1,11 @@
 """Test configuration: run JAX on a virtual 8-device CPU mesh so sharding
 and collective paths are exercised without TPU hardware.
 
-The environment's axon sitecustomize pins JAX_PLATFORMS=axon (real TPU via
-a tunnel) whenever PALLAS_AXON_POOL_IPS is set; tests override both unless
-VENEUR_TPU_TESTS=1 explicitly opts in to running the suite on hardware.
+The environment's axon sitecustomize registers the TPU plugin at
+interpreter startup and pins jax_platforms programmatically, so tests
+must override both the environment and the jax config before any backend
+initializes. Set VENEUR_TPU_TESTS=1 to opt in to running the suite on
+real TPU hardware instead.
 """
 
 import os
@@ -16,3 +18,6 @@ if os.environ.get("VENEUR_TPU_TESTS") != "1":
         os.environ["XLA_FLAGS"] = (
             flags + " --xla_force_host_platform_device_count=8"
         ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,190 @@
+"""Forward-plane tests: local->global streaming, import merge kernels, and
+distributed accuracy — without a cluster (pattern from reference
+flusher_test.go:100-343 and internal/forwardtest)."""
+
+import random
+import time
+
+import pytest
+
+from veneur_tpu.config import Config
+from veneur_tpu.core.server import Server
+from veneur_tpu.forward.protos import metric_pb2
+from veneur_tpu.samplers.metrics import MetricType
+from veneur_tpu.sinks.channel import ChannelMetricSink
+from veneur_tpu.testing.forwardtest import ForwardTestServer
+
+
+def make_config(**overrides) -> Config:
+    cfg = Config()
+    # tests flush manually; a real-sized interval keeps the forward
+    # deadline (== interval) clear of first-compile latency
+    cfg.interval = 10.0
+    cfg.hostname = "test"
+    cfg.tpu.counter_capacity = 128
+    cfg.tpu.gauge_capacity = 128
+    cfg.tpu.histo_capacity = 128
+    cfg.tpu.set_capacity = 64
+    cfg.tpu.batch_cap = 512
+    for k, v in overrides.items():
+        setattr(cfg, k, v)
+    return cfg.apply_defaults()
+
+
+def wait_until(fn, timeout=10.0, step=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(step)
+    return False
+
+
+class TestForwardClient:
+    def test_local_server_forwards_mergeable_state(self):
+        received = []
+        ft = ForwardTestServer(received.extend)
+        ft.start()
+        try:
+            cfg = make_config(forward_address=ft.address)
+            server = Server(cfg, extra_metric_sinks=[ChannelMetricSink()])
+            server.start()
+            server.handle_metric_packet(b"fwd.gc:5|c|#veneurglobalonly")
+            server.handle_metric_packet(b"fwd.gg:2.5|g|#veneurglobalonly")
+            for v in (1, 2, 3):
+                server.handle_metric_packet(b"fwd.lat:%d|ms" % v)
+            for member in (b"a", b"b", b"c"):
+                server.handle_metric_packet(b"fwd.users:%s|s" % member)
+            server.flush()
+            assert wait_until(lambda: len(received) >= 4)
+            by_name = {p.name: p for p in received}
+            assert by_name["fwd.gc"].counter.value == 5
+            assert by_name["fwd.gc"].scope == metric_pb2.Global
+            assert by_name["fwd.gg"].gauge.value == 2.5
+            lat = by_name["fwd.lat"]
+            assert lat.type == metric_pb2.Timer
+            d = lat.histogram.t_digest
+            assert sum(c.weight for c in d.main_centroids) == pytest.approx(3)
+            assert d.min == 1 and d.max == 3
+            assert len(by_name["fwd.users"].set.hyper_log_log) == 16384
+            # mixed counters are NOT forwarded; they flush locally
+            assert "fwd.local" not in by_name
+            server.shutdown()
+        finally:
+            ft.stop()
+
+    def test_forward_bad_address_does_not_crash(self):
+        cfg = make_config(forward_address="127.0.0.1:1")  # nothing listens
+        server = Server(cfg, extra_metric_sinks=[ChannelMetricSink()])
+        server.start()
+        server.handle_metric_packet(b"x:1|h")
+        server.flush()  # must not raise
+        assert server.forward_client.stats["errors_unavailable"] >= 1 or \
+            server.forward_client.stats["errors_send"] >= 1 or \
+            server.forward_client.stats["errors_deadline"] >= 1
+        server.shutdown()
+
+
+class TestLocalGlobalEndToEnd:
+    def _spawn_global(self):
+        gcfg = make_config(grpc_address="127.0.0.1:0")
+        g_obs = ChannelMetricSink()
+        gserver = Server(gcfg, extra_metric_sinks=[g_obs])
+        gserver.start()
+        return gserver, g_obs
+
+    def _spawn_local(self, global_addr):
+        lcfg = make_config(forward_address=global_addr)
+        l_obs = ChannelMetricSink()
+        lserver = Server(lcfg, extra_metric_sinks=[l_obs])
+        lserver.start()
+        return lserver, l_obs
+
+    def test_histogram_percentiles_merge_globally(self):
+        gserver, g_obs = self._spawn_global()
+        l1, _ = self._spawn_local(gserver.import_server.address)
+        l2, _ = self._spawn_local(gserver.import_server.address)
+        try:
+            rng = random.Random(3)
+            data = [rng.normalvariate(100, 15) for _ in range(2000)]
+            for i, v in enumerate(data):
+                (l1 if i % 2 else l2).handle_metric_packet(
+                    b"e2e.lat:%.4f|h" % v)
+            l1.flush()
+            l2.flush()
+            assert wait_until(
+                lambda: gserver.import_server.imported_total >= 2)
+            gserver.flush()
+            got = {}
+            for metric in g_obs.wait_flush(timeout=5):
+                got[metric.name] = metric
+            data.sort()
+            for p in (50, 75, 99):
+                want = data[int(len(data) * p / 100)]
+                assert got[f"e2e.lat.{p}percentile"].value == pytest.approx(
+                    want, rel=0.03), p
+            # global server emits no count for mixed histos merged from
+            # locals (local-stat guards), but each local emitted its own
+        finally:
+            l1.shutdown()
+            l2.shutdown()
+            gserver.shutdown()
+
+    def test_global_counters_and_sets_merge(self):
+        gserver, g_obs = self._spawn_global()
+        l1, _ = self._spawn_local(gserver.import_server.address)
+        l2, _ = self._spawn_local(gserver.import_server.address)
+        try:
+            l1.handle_metric_packet(b"e2e.gc:5|c|#veneurglobalonly")
+            l2.handle_metric_packet(b"e2e.gc:7|c|#veneurglobalonly")
+            for i in range(300):
+                l1.handle_metric_packet(b"e2e.uniq:u%d|s" % i)
+            for i in range(150, 450):
+                l2.handle_metric_packet(b"e2e.uniq:u%d|s" % i)
+            l1.flush()
+            l2.flush()
+            assert wait_until(
+                lambda: gserver.import_server.imported_total >= 4)
+            gserver.flush()
+            got = {}
+            for metric in g_obs.wait_flush(timeout=5):
+                got[metric.name] = metric
+            # counter merge = addition across locals
+            assert got["e2e.gc"].value == 12.0
+            assert got["e2e.gc"].type == MetricType.COUNTER
+            # HLL register-max merge: 450 distinct members, 150 overlapping
+            assert got["e2e.uniq"].value == pytest.approx(450, rel=0.05)
+        finally:
+            l1.shutdown()
+            l2.shutdown()
+            gserver.shutdown()
+
+    def test_import_rejects_nothing_but_still_counts(self):
+        gserver, _ = self._spawn_global()
+        try:
+            from veneur_tpu.forward.client import ForwardClient
+            from veneur_tpu.forward.protos import tdigest_pb2
+
+            client = ForwardClient(gserver.import_server.address)
+            pbm = metric_pb2.Metric(
+                name="direct.histo", tags=["a:b"], type=metric_pb2.Histogram,
+                scope=metric_pb2.Mixed,
+                histogram=metric_pb2.HistogramValue(
+                    t_digest=tdigest_pb2.MergingDigestData(
+                        compression=100.0, min=1.0, max=9.0,
+                        main_centroids=[
+                            tdigest_pb2.Centroid(mean=1.0, weight=2.0),
+                            tdigest_pb2.Centroid(mean=9.0, weight=2.0),
+                        ])))
+            client._send_v2(iter([pbm]), timeout=5)
+            assert wait_until(
+                lambda: gserver.import_server.imported_total >= 1)
+            out, export, touched, meta = \
+                gserver.store.histos.snapshot_and_reset((0.5,))
+            assert touched[0]
+            assert float(out["count"][0]) == pytest.approx(4.0)
+            assert float(out["min"][0]) == 1.0
+            assert float(out["max"][0]) == 9.0
+            client.close()
+        finally:
+            gserver.shutdown()

@@ -107,6 +107,7 @@ class CounterTable(_BaseTable):
         self.state = scalars.init_counters(self.capacity)
         self._pend = np.zeros((self.batch_cap, 3), np.float64)  # row,val,rate
         self._n = 0
+        self._import_acc = np.zeros(self.capacity, np.float64)
 
     def _grow_arrays(self, new_cap):
         self.state = jax.tree.map(lambda a: _pad_cap(a, new_cap), self.state)
@@ -135,18 +136,34 @@ class CounterTable(_BaseTable):
         with self.lock:
             self._apply_locked()
 
-    def merge_rows(self, rows: np.ndarray, values: np.ndarray):
+    def merge_batch(self, stubs: List[UDPMetric], values) -> None:
+        """Import-path merge: intern + touch + accumulate atomically, so a
+        concurrent flush never sees touched-but-valueless rows. Values
+        accumulate host-side in f64 because forwarded counters are exact
+        int64 sums that f32 would quantize."""
         with self.lock:
-            self.state = scalars.merge_counters(
-                self.state, rows.astype(np.int32), values.astype(np.float32))
+            rows = []
+            for stub in stubs:
+                row = self.row_for(stub)
+                self.touched[row] = True
+                rows.append(row)
+            if self._import_acc.shape[0] < self.capacity:
+                grown = np.zeros(self.capacity, np.float64)
+                grown[: self._import_acc.shape[0]] = self._import_acc
+                self._import_acc = grown
+            np.add.at(self._import_acc, rows, np.asarray(values, np.float64))
 
     def snapshot_and_reset(self) -> Tuple[np.ndarray, np.ndarray, List[RowMeta]]:
         with self.lock:
             self._apply_locked()
-            values = np.asarray(scalars.counter_values(self.state))
+            # f64 readout recovers the exact total from the Kahan pair
+            values = (np.asarray(self.state["sum"], np.float64)
+                      - np.asarray(self.state["comp"], np.float64))
+            values[: self._import_acc.shape[0]] += self._import_acc
             touched = self.touched.copy()
             meta = list(self.meta)
             self.state = scalars.init_counters(self.capacity)
+            self._import_acc = np.zeros(self.capacity, np.float64)
             self.touched[:] = False
         return values, touched, meta
 
@@ -183,10 +200,14 @@ class GaugeTable(_BaseTable):
         with self.lock:
             self._apply_locked()
 
-    def merge_rows(self, rows: np.ndarray, values: np.ndarray):
+    def merge_batch(self, stubs: List[UDPMetric], values) -> None:
+        """Import-path merge: overwrite, atomically with interning."""
         with self.lock:
+            rows = np.fromiter(
+                (self.row_for(s) for s in stubs), np.int32, len(stubs))
+            self.touched[rows] = True
             self.state = scalars.merge_gauges(
-                self.state, rows.astype(np.int32), values.astype(np.float32))
+                self.state, rows, np.asarray(values, np.float32))
 
     def snapshot_and_reset(self):
         with self.lock:
@@ -242,13 +263,20 @@ class HistoTable(_BaseTable):
         with self.lock:
             self._apply_locked()
 
-    def merge_rows(self, rows, in_means, in_weights, in_min, in_max, in_recip):
+    def merge_batch(self, stubs: List[UDPMetric], in_means, in_weights,
+                    in_min, in_max, in_recip) -> None:
+        """Import-path digest merge, atomic with interning."""
         with self.lock:
+            rows = np.fromiter(
+                (self.row_for(s) for s in stubs), np.int32, len(stubs))
+            self.touched[rows] = True
             self.state = batch_tdigest.merge_centroid_rows(
-                self.state, rows.astype(np.int32),
-                in_means.astype(np.float32), in_weights.astype(np.float32),
-                in_min.astype(np.float32), in_max.astype(np.float32),
-                in_recip.astype(np.float32))
+                self.state, rows,
+                np.asarray(in_means, np.float32),
+                np.asarray(in_weights, np.float32),
+                np.asarray(in_min, np.float32),
+                np.asarray(in_max, np.float32),
+                np.asarray(in_recip, np.float32))
 
     def snapshot_and_reset(self, percentiles: Tuple[float, ...]):
         """Returns (flush outputs dict of np arrays, centroid export,
@@ -305,10 +333,14 @@ class SetTable(_BaseTable):
         with self.lock:
             self._apply_locked()
 
-    def merge_rows(self, rows: np.ndarray, in_regs: np.ndarray):
+    def merge_batch(self, stubs: List[UDPMetric], in_regs) -> None:
+        """Import-path HLL merge (register max), atomic with interning."""
         with self.lock:
+            rows = np.fromiter(
+                (self.row_for(s) for s in stubs), np.int32, len(stubs))
+            self.touched[rows] = True
             self.state = batch_hll.merge_rows(
-                self.state, rows.astype(np.int32), in_regs.astype(np.int8))
+                self.state, rows, np.asarray(in_regs, np.int8))
 
     def snapshot_and_reset(self):
         with self.lock:

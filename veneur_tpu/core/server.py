@@ -73,6 +73,8 @@ class Server:
         self._other_lock = threading.Lock()
 
         self.forwarder: Optional[Callable[[ForwardableState], None]] = None
+        self.forward_client = None  # set in start() when forward_address
+        self.import_server = None  # set in start() when grpc_address
 
         self._listeners: List[networking.Listener] = []
         self._flush_lock = threading.Lock()
@@ -131,6 +133,19 @@ class Server:
             self._listeners.extend(networking.start_statsd(
                 addr, self, num_readers=self.config.num_readers,
                 rcvbuf=self.config.read_buffer_size_bytes))
+        if self.config.forward_address and self.forwarder is None:
+            from veneur_tpu.forward.client import ForwardClient
+            self.forward_client = ForwardClient(
+                self.config.forward_address, deadline=self.interval)
+            self.forwarder = self.forward_client.forward
+        if self.config.grpc_address:
+            from veneur_tpu.forward.server import ImportServer
+            from veneur_tpu.util.matcher import TagMatcher
+            ignored = [TagMatcher(kind="prefix", value=t)
+                       for t in self.config.tags_exclude]
+            self.import_server = ImportServer(
+                self, self.config.grpc_address, ignored_tags=ignored)
+            self.import_server.start()
         # pre-compile the flush kernels off the ticker path so the first
         # real flush isn't delayed by XLA compilation (~20-40s on TPU)
         threading.Thread(target=self._warmup, name="kernel-warmup",
@@ -155,6 +170,10 @@ class Server:
             self.flush()
         for listener in self._listeners:
             listener.close()
+        if self.import_server is not None:
+            self.import_server.stop()
+        if self.forward_client is not None:
+            self.forward_client.close()
         for sink in self.metric_sinks + self.span_sinks:
             sink.stop()
 

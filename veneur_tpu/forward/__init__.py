@@ -1,0 +1,15 @@
+"""Forward plane: the local->global distribution tier over gRPC.
+
+Wire- and route-compatible with the reference (forwardrpc/forward.proto,
+samplers/metricpb/metric.proto): local servers stream mergeable state
+(t-digests, HLL registers, global counters/gauges) to a global server via
+/forwardrpc.Forward/SendMetricsV2; the global side merges into its device
+column store with batched kernels (counter add, gauge overwrite, HLL
+register max, digest recompress).
+"""
+
+from veneur_tpu.forward.convert import (  # noqa: F401
+    forwardable_to_protos, metric_key_of_proto,
+)
+from veneur_tpu.forward.client import ForwardClient  # noqa: F401
+from veneur_tpu.forward.server import ImportServer  # noqa: F401

@@ -1,0 +1,70 @@
+"""Forward client: streams the flush's mergeable state to the global tier.
+
+Parity with reference flusher.go:516-591 (forward/forwardGrpc): one
+SendMetricsV2 client-stream per flush, deadline-bounded by the interval,
+errors classified and counted but never retried — the next interval's data
+supersedes.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, Optional
+
+import grpc
+
+from veneur_tpu.core.flusher import ForwardableState
+from veneur_tpu.forward.convert import forwardable_to_protos
+from veneur_tpu.forward.protos import forward_pb2, metric_pb2
+
+logger = logging.getLogger("veneur_tpu.forward.client")
+
+_EMPTY_DESERIALIZER = lambda b: b  # google.protobuf.Empty carries nothing
+
+
+class ForwardClient:
+    """gRPC client for /forwardrpc.Forward, built on the generic channel
+    API (no generated stubs needed)."""
+
+    def __init__(self, address: str, deadline: float = 10.0,
+                 channel: Optional[grpc.Channel] = None):
+        self.address = address
+        self.deadline = deadline
+        self._channel = channel or grpc.insecure_channel(address)
+        self._send_v2 = self._channel.stream_unary(
+            "/forwardrpc.Forward/SendMetricsV2",
+            request_serializer=metric_pb2.Metric.SerializeToString,
+            response_deserializer=_EMPTY_DESERIALIZER)
+        self._send_v1 = self._channel.unary_unary(
+            "/forwardrpc.Forward/SendMetrics",
+            request_serializer=forward_pb2.MetricList.SerializeToString,
+            response_deserializer=_EMPTY_DESERIALIZER)
+        self.stats: Dict[str, int] = {
+            "forwarded_total": 0, "errors_deadline": 0,
+            "errors_unavailable": 0, "errors_send": 0,
+        }
+
+    def forward(self, fwd: ForwardableState) -> int:
+        """Serialize and stream one flush's state; returns count sent."""
+        protos = forwardable_to_protos(fwd)
+        if not protos:
+            return 0
+        try:
+            self._send_v2(iter(protos), timeout=self.deadline)
+        except grpc.RpcError as e:
+            code = e.code() if hasattr(e, "code") else None
+            if code == grpc.StatusCode.DEADLINE_EXCEEDED:
+                self.stats["errors_deadline"] += 1
+            elif code == grpc.StatusCode.UNAVAILABLE:
+                self.stats["errors_unavailable"] += 1
+            else:
+                self.stats["errors_send"] += 1
+            logger.warning("could not forward %d metrics to %s: %s",
+                           len(protos), self.address, code)
+            return 0
+        self.stats["forwarded_total"] += len(protos)
+        logger.debug("forwarded %d metrics to %s", len(protos), self.address)
+        return len(protos)
+
+    def close(self) -> None:
+        self._channel.close()

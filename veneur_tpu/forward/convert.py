@@ -1,0 +1,91 @@
+"""Conversions between the device column store and metricpb protos.
+
+Export parity with reference worker.go:180-217 (ForwardableMetrics) and the
+samplers' Metric() methods; import parity with worker.go:410-467
+(ImportMetric) including the scope coercions.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Tuple
+
+import numpy as np
+
+from veneur_tpu.core.flusher import ForwardableState
+from veneur_tpu.forward.protos import metric_pb2, tdigest_pb2
+from veneur_tpu.ops import batch_tdigest
+from veneur_tpu.samplers import metrics as m
+from veneur_tpu.samplers.metrics import MetricKey, MetricScope, update_tags
+
+_SCOPE_TO_PB = {
+    MetricScope.MIXED: metric_pb2.Mixed,
+    MetricScope.LOCAL_ONLY: metric_pb2.Local,
+    MetricScope.GLOBAL_ONLY: metric_pb2.Global,
+}
+_SCOPE_FROM_PB = {v: k for k, v in _SCOPE_TO_PB.items()}
+
+_TYPE_NAME_TO_PB = {
+    m.COUNTER: metric_pb2.Counter,
+    m.GAUGE: metric_pb2.Gauge,
+    m.HISTOGRAM: metric_pb2.Histogram,
+    m.SET: metric_pb2.Set,
+    m.TIMER: metric_pb2.Timer,
+}
+_TYPE_PB_TO_NAME = {v: k for k, v in _TYPE_NAME_TO_PB.items()}
+
+COMPRESSION = batch_tdigest.COMPRESSION
+
+
+def forwardable_to_protos(fwd: ForwardableState) -> List[metric_pb2.Metric]:
+    """Serialize a flush's forwardable snapshot into metricpb Metrics."""
+    out: List[metric_pb2.Metric] = []
+    for meta, value in fwd.counters:
+        out.append(metric_pb2.Metric(
+            name=meta.name, tags=list(meta.tags), type=metric_pb2.Counter,
+            scope=metric_pb2.Global,
+            counter=metric_pb2.CounterValue(value=int(value))))
+    for meta, value in fwd.gauges:
+        out.append(metric_pb2.Metric(
+            name=meta.name, tags=list(meta.tags), type=metric_pb2.Gauge,
+            scope=metric_pb2.Global,
+            gauge=metric_pb2.GaugeValue(value=float(value))))
+    for meta, means, weights, dmin, dmax, drecip in fwd.histograms:
+        nz = weights > 0
+        digest = tdigest_pb2.MergingDigestData(
+            compression=COMPRESSION, min=float(dmin), max=float(dmax),
+            reciprocalSum=float(drecip))
+        for mean, weight in zip(means[nz].tolist(), weights[nz].tolist()):
+            digest.main_centroids.add(mean=mean, weight=weight)
+        mtype = (metric_pb2.Timer if meta.wire_type == m.TIMER
+                 else metric_pb2.Histogram)
+        out.append(metric_pb2.Metric(
+            name=meta.name, tags=list(meta.tags), type=mtype,
+            scope=_SCOPE_TO_PB[meta.scope],
+            histogram=metric_pb2.HistogramValue(t_digest=digest)))
+    for meta, registers in fwd.sets:
+        out.append(metric_pb2.Metric(
+            name=meta.name, tags=list(meta.tags), type=metric_pb2.Set,
+            scope=_SCOPE_TO_PB[meta.scope],
+            set=metric_pb2.SetValue(
+                hyper_log_log=np.asarray(registers, np.int8).tobytes())))
+    return out
+
+
+def metric_key_of_proto(pbm: metric_pb2.Metric,
+                        ignored_tags: Iterable = ()) -> Tuple[MetricKey, int, int, list]:
+    """Build the (key, digest32, digest64, tags) identity for an imported
+    metric (reference NewMetricKeyFromMetric, parser.go:106-131 +
+    IngestMetricProto hashing, server.go:340-355)."""
+    tags = [t for t in pbm.tags
+            if not any(im.match(t) for im in ignored_tags)]
+    type_name = _TYPE_PB_TO_NAME[pbm.type]
+    final, joined, h32, h64 = update_tags(pbm.name, type_name, tags, None)
+    return MetricKey(pbm.name, type_name, joined), h32, h64, final
+
+
+def import_scope(pbm: metric_pb2.Metric) -> MetricScope:
+    """Scope coercion on import: counters/gauges become global-only
+    (reference worker.go:420-423)."""
+    if pbm.type in (metric_pb2.Counter, metric_pb2.Gauge):
+        return MetricScope.GLOBAL_ONLY
+    return _SCOPE_FROM_PB.get(pbm.scope, MetricScope.MIXED)

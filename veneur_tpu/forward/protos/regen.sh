@@ -1,0 +1,5 @@
+#!/bin/sh
+# Regenerate the protobuf modules (protoc >= 3.21). Run from this directory.
+set -e
+protoc --python_out=. tdigest.proto metric.proto forward.proto
+sed -i 's/^import tdigest_pb2/from veneur_tpu.forward.protos import tdigest_pb2/; s/^import metric_pb2/from veneur_tpu.forward.protos import metric_pb2/' metric_pb2.py forward_pb2.py

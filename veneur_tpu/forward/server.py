@@ -1,0 +1,168 @@
+"""Import server: the in-process gRPC endpoint every veneur-tpu can run.
+
+Parity with reference sources/proxy/server.go:26-161 (the grpc import
+source): receives forwarded metric streams, interns keys into the global
+server's column store, and merges state with batched device kernels —
+counter add, gauge overwrite, HLL register max, digest recompress
+(reference worker.go:410-467). Incoming metrics are buffered per stream
+and merged in array-sized chunks so the device sees few large kernel
+calls rather than one per metric.
+"""
+
+from __future__ import annotations
+
+import logging
+from concurrent import futures
+from typing import List, Optional
+
+import grpc
+import numpy as np
+
+from veneur_tpu.forward.convert import import_scope, metric_key_of_proto
+from veneur_tpu.forward.protos import forward_pb2, metric_pb2
+from veneur_tpu.ops import batch_tdigest, hll_ref
+from veneur_tpu.samplers import metrics as m
+from veneur_tpu.samplers.metrics import MetricScope, UDPMetric
+from veneur_tpu.util.matcher import TagMatcher
+
+logger = logging.getLogger("veneur_tpu.forward.server")
+
+_CHUNK = 512
+
+
+class ImportServer:
+    def __init__(self, server, address: str = "127.0.0.1:0",
+                 ignored_tags: Optional[List[TagMatcher]] = None,
+                 max_workers: int = 4):
+        self._server = server
+        self._ignored = list(ignored_tags or [])
+        self._grpc = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers))
+        handler = grpc.method_handlers_generic_handler("forwardrpc.Forward", {
+            "SendMetricsV2": grpc.stream_unary_rpc_method_handler(
+                self._send_metrics_v2,
+                request_deserializer=metric_pb2.Metric.FromString,
+                response_serializer=lambda _: b""),
+            "SendMetrics": grpc.unary_unary_rpc_method_handler(
+                self._send_metrics_v1,
+                request_deserializer=forward_pb2.MetricList.FromString,
+                response_serializer=lambda _: b""),
+        })
+        self._grpc.add_generic_rpc_handlers((handler,))
+        self.port = self._grpc.add_insecure_port(address)
+        if self.port == 0:
+            raise RuntimeError(f"could not bind import server to {address}")
+        self.imported_total = 0
+
+    @property
+    def address(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    def start(self) -> None:
+        self._grpc.start()
+        logger.info("import server listening on %s", self.address)
+
+    def stop(self, grace: float = 1.0) -> None:
+        self._grpc.stop(grace)
+
+    # -- handlers --------------------------------------------------------
+
+    def _send_metrics_v1(self, req, ctx):
+        # unary batch endpoint is retired in the reference importer
+        # (sources/proxy/server.go:138-142); keep the same contract
+        ctx.abort(grpc.StatusCode.UNIMPLEMENTED,
+                  "SendMetrics is not implemented; use SendMetricsV2")
+
+    def _send_metrics_v2(self, request_iterator, ctx):
+        buf: List[metric_pb2.Metric] = []
+        count = 0
+        for pbm in request_iterator:
+            buf.append(pbm)
+            count += 1
+            if len(buf) >= _CHUNK:
+                self._merge_chunk(buf)
+                buf = []
+        if buf:
+            self._merge_chunk(buf)
+        self.imported_total += count
+        return b""
+
+    # -- merge -----------------------------------------------------------
+
+    def _merge_chunk(self, chunk: List[metric_pb2.Metric]) -> None:
+        """Group a chunk per family, then intern+merge each family in one
+        atomic table call (so a concurrent flush never observes touched
+        rows whose state hasn't merged yet)."""
+        store = self._server.store
+        c_stubs, c_vals = [], []
+        g_stubs, g_vals = [], []
+        h_stubs, h_means, h_weights, h_min, h_max, h_recip = [], [], [], [], [], []
+        s_stubs, s_regs = [], []
+
+        for pbm in chunk:
+            which = pbm.WhichOneof("value")
+            if which is None:
+                logger.warning("can't import a metric with no value: %s",
+                               pbm.name)
+                continue
+            scope = import_scope(pbm)
+            if scope == MetricScope.LOCAL_ONLY:
+                logger.warning("gRPC import does not accept local metrics")
+                continue
+            try:
+                key, h32, h64, tags = metric_key_of_proto(pbm, self._ignored)
+            except KeyError:
+                # open proto3 enums: a newer peer may send unknown types;
+                # skip the metric, keep the stream (worker.go ImportMetric
+                # logs-and-continues likewise)
+                logger.warning("unknown metric type %s for %r; skipped",
+                               pbm.type, pbm.name)
+                continue
+            stub = UDPMetric(key=key, digest=h32, digest64=h64,
+                             tags=list(tags), scope=scope)
+            if which == "counter":
+                c_stubs.append(stub)
+                c_vals.append(float(pbm.counter.value))
+            elif which == "gauge":
+                g_stubs.append(stub)
+                g_vals.append(pbm.gauge.value)
+            elif which == "histogram":
+                d = pbm.histogram.t_digest
+                means = np.fromiter(
+                    (c.mean for c in d.main_centroids), np.float64,
+                    len(d.main_centroids))
+                weights = np.fromiter(
+                    (c.weight for c in d.main_centroids), np.float64,
+                    len(d.main_centroids))
+                pm, pw = batch_tdigest.pack_centroids(means, weights)
+                h_stubs.append(stub)
+                h_means.append(pm)
+                h_weights.append(pw)
+                h_min.append(d.min)
+                h_max.append(d.max)
+                h_recip.append(d.reciprocalSum)
+            elif which == "set":
+                regs = _decode_hll(pbm.set.hyper_log_log)
+                if regs is not None:
+                    s_stubs.append(stub)
+                    s_regs.append(regs)
+
+        if c_stubs:
+            store.counters.merge_batch(c_stubs, c_vals)
+        if g_stubs:
+            store.gauges.merge_batch(g_stubs, g_vals)
+        if h_stubs:
+            store.histos.merge_batch(
+                h_stubs, np.stack(h_means), np.stack(h_weights),
+                h_min, h_max, h_recip)
+        if s_stubs:
+            store.sets.merge_batch(s_stubs, np.stack(s_regs))
+
+
+def _decode_hll(data: bytes) -> Optional[np.ndarray]:
+    """Decode a forwarded HLL register dump. Our own format is the raw
+    16384-byte dense register array."""
+    if len(data) == hll_ref.M:
+        return np.frombuffer(data, np.int8)
+    logger.warning("unrecognized HLL payload of %d bytes dropped", len(data))
+    return None

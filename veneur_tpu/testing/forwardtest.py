@@ -1,0 +1,39 @@
+"""In-process gRPC Forward server for tests: collects forwarded metrics
+via a callback (pattern from reference internal/forwardtest/server.go)."""
+
+from __future__ import annotations
+
+from concurrent import futures
+from typing import Callable, List
+
+import grpc
+
+from veneur_tpu.forward.protos import metric_pb2
+
+
+class ForwardTestServer:
+    def __init__(self, handler: Callable[[List[metric_pb2.Metric]], None]):
+        self._handler = handler
+        self._grpc = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
+        h = grpc.method_handlers_generic_handler("forwardrpc.Forward", {
+            "SendMetricsV2": grpc.stream_unary_rpc_method_handler(
+                self._recv,
+                request_deserializer=metric_pb2.Metric.FromString,
+                response_serializer=lambda _: b""),
+        })
+        self._grpc.add_generic_rpc_handlers((h,))
+        self.port = self._grpc.add_insecure_port("127.0.0.1:0")
+
+    @property
+    def address(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    def _recv(self, request_iterator, ctx):
+        self._handler(list(request_iterator))
+        return b""
+
+    def start(self) -> None:
+        self._grpc.start()
+
+    def stop(self) -> None:
+        self._grpc.stop(0.2)
