@@ -50,6 +50,7 @@ class TestForwardClient:
             server = Server(cfg, extra_metric_sinks=[ChannelMetricSink()])
             server.start()
             server.handle_metric_packet(b"fwd.gc:5|c|#veneurglobalonly")
+            server.handle_metric_packet(b"fwd.local:9|c")  # mixed scope
             server.handle_metric_packet(b"fwd.gg:2.5|g|#veneurglobalonly")
             for v in (1, 2, 3):
                 server.handle_metric_packet(b"fwd.lat:%d|ms" % v)

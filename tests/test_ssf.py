@@ -1,0 +1,300 @@
+"""SSF subsystem tests: wire framing, sample conversion, span pipeline,
+metric extraction, trace client (reference protocol/wire_test.go,
+parser ParseMetricSSF tests, ssfmetrics tests, server_test.go:1240-1352)."""
+
+import io
+import socket
+import time
+
+import pytest
+
+from veneur_tpu import protocol, ssf, trace
+from veneur_tpu.samplers.metrics import MetricScope
+from veneur_tpu.samplers.parser import Parser
+
+from test_server import generate_config, setup_server
+
+
+def mkspan(**kw):
+    defaults = dict(id=5, trace_id=6, parent_id=2,
+                    start_timestamp=1_000_000_000,
+                    end_timestamp=5_000_000_000,
+                    name="spanner", service="svc")
+    defaults.update(kw)
+    return ssf.SSFSpan(**defaults)
+
+
+class TestWire:
+    def test_roundtrip(self):
+        span = mkspan()
+        span.metrics.append(ssf.count("x", 1))
+        buf = io.BytesIO()
+        n = protocol.write_ssf(buf, span)
+        assert n == len(buf.getvalue())
+        buf.seek(0)
+        got = protocol.read_ssf(buf)
+        assert got.name == "spanner"
+        assert got.metrics[0].name == "x"
+
+    def test_multiple_frames(self):
+        buf = io.BytesIO()
+        for i in range(3):
+            protocol.write_ssf(buf, mkspan(id=i + 1))
+        buf.seek(0)
+        ids = []
+        while True:
+            span = protocol.read_ssf(buf)
+            if span is None:
+                break
+            ids.append(span.id)
+        assert ids == [1, 2, 3]
+
+    def test_clean_eof(self):
+        assert protocol.read_ssf(io.BytesIO(b"")) is None
+
+    def test_bad_version(self):
+        with pytest.raises(protocol.FramingError):
+            protocol.read_ssf(io.BytesIO(b"\x01\x00\x00\x00\x00"))
+
+    def test_oversize_frame(self):
+        hdr = b"\x00" + (protocol.MAX_SSF_PACKET_LENGTH + 1).to_bytes(4, "big")
+        with pytest.raises(protocol.FramingError):
+            protocol.read_ssf(io.BytesIO(hdr))
+
+    def test_truncated_body_is_framing_error(self):
+        buf = io.BytesIO(b"\x00\x00\x00\x00\x0aabc")
+        with pytest.raises(protocol.FramingError):
+            protocol.read_ssf(buf)
+
+    def test_decode_error_is_not_framing_error(self):
+        # a well-framed but undecodable body must not kill the stream
+        bad = b"\xff" * 10
+        buf = io.BytesIO()
+        buf.write(b"\x00" + len(bad).to_bytes(4, "big") + bad)
+        protocol.write_ssf(buf, mkspan(id=3))
+        buf.seek(0)
+        with pytest.raises(protocol.SSFDecodeError):
+            protocol.read_ssf(buf)
+        # stream is still synchronized: next frame reads fine
+        assert protocol.read_ssf(buf).id == 3
+
+    def test_parse_normalization(self):
+        span = mkspan(name="")
+        span.tags["name"] = "from-tag"
+        span.metrics.append(ssf.SSFSample(name="m", value=1))
+        got = protocol.parse_ssf(span.SerializeToString())
+        assert got.name == "from-tag"
+        assert "name" not in got.tags
+        assert got.metrics[0].sample_rate == 1.0
+
+    def test_valid_trace(self):
+        assert protocol.valid_trace(mkspan())
+        assert not protocol.valid_trace(mkspan(id=0))
+        assert not protocol.valid_trace(mkspan(name=""))
+        assert not protocol.valid_trace(mkspan(end_timestamp=0))
+
+
+class TestParseMetricSSF:
+    def setup_method(self):
+        self.parser = Parser()
+
+    def test_counter(self):
+        m = self.parser.parse_metric_ssf(ssf.count("c", 2, {"k": "v"}))
+        assert (m.name, m.type, m.value) == ("c", "counter", 2.0)
+        assert m.tags == ["k:v"]
+
+    def test_set_uses_message(self):
+        m = self.parser.parse_metric_ssf(ssf.set_sample("s", "member-1"))
+        assert (m.type, m.value) == ("set", "member-1")
+
+    def test_status_uses_status(self):
+        m = self.parser.parse_metric_ssf(
+            ssf.status("st", ssf.CRITICAL, message="down"))
+        assert (m.type, m.value) == ("status", 2)
+
+    def test_scope_enum_and_magic_tags(self):
+        s = ssf.gauge("g", 1)
+        s.scope = 2
+        assert self.parser.parse_metric_ssf(s).scope == MetricScope.GLOBAL_ONLY
+        s2 = ssf.gauge("g", 1, {"veneurlocalonly": "true", "a": "b"})
+        m = self.parser.parse_metric_ssf(s2)
+        assert m.scope == MetricScope.LOCAL_ONLY
+        assert m.tags == ["a:b"]
+
+    def test_timing_value_is_in_resolution_units(self):
+        t = ssf.timing("t", 1.5, 1e-3)  # 1.5s at ms resolution
+        m = self.parser.parse_metric_ssf(t)
+        assert m.value == pytest.approx(1500.0)
+        assert m.type == "histogram"
+
+    def test_indicator_metrics(self):
+        span = mkspan(indicator=True, error=True)
+        out = self.parser.convert_indicator_metrics(span, "ind", "obj")
+        byname = {m.name: m for m in out}
+        assert byname["ind"].value == pytest.approx(4e9)  # 4s in ns
+        assert "error:true" in byname["ind"].tags
+        assert byname["obj"].scope == MetricScope.GLOBAL_ONLY
+        assert "objective:spanner" in byname["obj"].tags
+
+    def test_indicator_metrics_skips_non_indicator(self):
+        assert self.parser.convert_indicator_metrics(mkspan(), "i", "o") == []
+
+    def test_objective_override_tag(self):
+        span = mkspan(indicator=True)
+        span.tags["ssf_objective"] = "custom"
+        out = self.parser.convert_indicator_metrics(span, "", "obj")
+        assert "objective:custom" in out[0].tags
+
+
+class TestSpanPipeline:
+    def test_extraction_to_flush(self):
+        """Samples inside a span reach the aggregation path and flush."""
+        server, observer = setup_server()
+        span = mkspan()
+        span.metrics.append(ssf.count("span.counter", 7))
+        span.metrics.append(ssf.gauge("span.gauge", 1.25))
+        server.metric_extraction.ingest(span)
+        server.flush()
+        got = {m.name: m for m in observer.wait_flush()}
+        assert got["span.counter"].value == 7.0
+        assert got["span.gauge"].value == 1.25
+
+    def test_indicator_span_produces_timers(self):
+        cfg = generate_config()
+        cfg.indicator_span_timer_name = "indicator.timer"
+        server, observer = setup_server(cfg)
+        server.metric_extraction.ingest(mkspan(indicator=True))
+        server.flush()
+        names = {m.name for m in observer.wait_flush()}
+        assert any(n.startswith("indicator.timer") for n in names)
+
+    def test_span_worker_fanout(self):
+        server, observer = setup_server()
+        got = []
+
+        class CollectSink:
+            def name(self):
+                return "collect"
+
+            def kind(self):
+                return "collect"
+
+            def start(self, srv):
+                pass
+
+            def ingest(self, span):
+                got.append(span.id)
+
+            def flush(self):
+                pass
+
+            def stop(self):
+                pass
+
+        server.span_sinks.append(CollectSink())
+        server.start()
+        try:
+            server.ingest_span(mkspan(id=77))
+            deadline = time.time() + 2
+            while not got and time.time() < deadline:
+                time.sleep(0.01)
+            assert got == [77]
+        finally:
+            server.shutdown()
+
+    def test_ssf_udp_ingest(self):
+        cfg = generate_config()
+        cfg.ssf_listen_addresses = ["udp://127.0.0.1:0"]
+        server, observer = setup_server(cfg)
+        server.start()
+        try:
+            addr = server.local_addr("ssf-udp")
+            span = mkspan()
+            span.metrics.append(ssf.count("udp.span.counter", 3))
+            sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            sock.sendto(span.SerializeToString(), addr)
+            deadline = time.time() + 2
+            while (server.metric_extraction.spans_processed == 0
+                   and time.time() < deadline):
+                time.sleep(0.01)
+            assert server.metric_extraction.spans_processed == 1
+            server.flush()
+            got = {m.name for m in observer.wait_flush()}
+            assert "udp.span.counter" in got
+        finally:
+            server.shutdown()
+
+    def test_ssf_framed_tcp_ingest(self):
+        cfg = generate_config()
+        cfg.ssf_listen_addresses = ["tcp://127.0.0.1:0"]
+        server, observer = setup_server(cfg)
+        server.start()
+        try:
+            addr = server.local_addr("ssf-tcp")
+            sock = socket.create_connection(addr)
+            f = sock.makefile("wb")
+            protocol.write_ssf(f, mkspan(id=11))
+            protocol.write_ssf(f, mkspan(id=12))
+            f.flush()
+            deadline = time.time() + 2
+            while (server.metric_extraction.spans_processed < 2
+                   and time.time() < deadline):
+                time.sleep(0.01)
+            assert server.metric_extraction.spans_processed == 2
+            sock.close()
+        finally:
+            server.shutdown()
+
+
+class TestTraceClient:
+    def test_channel_backend_loopback(self):
+        server, observer = setup_server()
+        server.start()
+        try:
+            client = trace.Client(trace.ChannelBackend(server.ingest_span))
+            with client.start_span("op", service="svc") as span:
+                span.add(ssf.count("traced.counter", 2))
+            client.flush()
+            deadline = time.time() + 2
+            while (server.metric_extraction.spans_processed == 0
+                   and time.time() < deadline):
+                time.sleep(0.01)
+            server.flush()
+            got = {m.name for m in observer.wait_flush()}
+            assert "traced.counter" in got
+            client.close()
+        finally:
+            server.shutdown()
+
+    def test_span_lineage(self):
+        client = trace.neutralized_client()
+        parent = client.start_span("parent", service="s")
+        child = parent.child("child")
+        assert child.trace_id == parent.trace_id
+        assert child.proto.parent_id == parent.id
+        assert child.id != parent.id
+        client.close()
+
+    def test_error_flag_on_exception(self):
+        client = trace.neutralized_client()
+        recorded = []
+        client.record = recorded.append
+        with pytest.raises(RuntimeError):
+            with client.start_span("boom", service="s"):
+                raise RuntimeError("x")
+        assert recorded[0].error is True
+        client.close()
+
+    def test_udp_backend(self):
+        rx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        rx.bind(("127.0.0.1", 0))
+        rx.settimeout(2)
+        client = trace.Client(trace.UDPBackend(rx.getsockname()))
+        with client.start_span("udp-span", service="s"):
+            pass
+        client.flush()
+        data, _ = rx.recvfrom(65536)
+        got = protocol.parse_ssf(data)
+        assert got.name == "udp-span"
+        client.close()
+        rx.close()

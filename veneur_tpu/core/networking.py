@@ -178,3 +178,102 @@ def _start_statsd_unix(u, server) -> Listener:
     threads.append(t)
     logger.info("listening for statsd on UNIX datagram %s", path)
     return listener
+
+
+# -- SSF ingest ----------------------------------------------------------
+
+def start_ssf(address: str, server,
+              rcvbuf: int = 2 * 1024 * 1024) -> List[Listener]:
+    """Start SSF listeners for one address URL (reference
+    networking.go:223-324 StartSSF): UDP carries one unframed span per
+    datagram; UNIX/TCP streams carry framed spans (protocol.read_ssf),
+    where any framing error closes the connection."""
+    u = urlparse(address)
+    if u.scheme == "udp":
+        return [_start_ssf_udp(u, server, rcvbuf)]
+    if u.scheme in ("unix", "tcp"):
+        return [_start_ssf_stream(u, server)]
+    raise ValueError(f"unsupported SSF listen scheme: {u.scheme}")
+
+
+def _start_ssf_udp(u, server, rcvbuf: int) -> Listener:
+    host = u.hostname or "127.0.0.1"
+    sock = _new_udp_socket(host, u.port or 0, rcvbuf, reuseport=False)
+    threads: List[threading.Thread] = []
+    listener = Listener("ssf-udp", sock.getsockname(), sock, threads)
+
+    def read_loop():
+        while not listener.closed:
+            try:
+                buf = sock.recv(_MAX_DGRAM)
+            except OSError:
+                return
+            if buf:
+                server.handle_ssf_packet(buf)
+
+    t = threading.Thread(target=read_loop, name="ssf-udp-reader", daemon=True)
+    t.start()
+    threads.append(t)
+    logger.info("listening for SSF on UDP %s", listener.address)
+    return listener
+
+
+def _start_ssf_stream(u, server) -> Listener:
+    if u.scheme == "unix":
+        path = u.path or u.netloc
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        sock.bind(path)
+        address = path
+    else:
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        sock.bind((u.hostname or "127.0.0.1", u.port or 0))
+        address = sock.getsockname()
+    sock.listen(128)
+    threads: List[threading.Thread] = []
+    listener = Listener(f"ssf-{u.scheme}", address, sock, threads)
+
+    def accept_loop():
+        while not listener.closed:
+            try:
+                conn, _ = sock.accept()
+            except OSError:
+                return
+            t = threading.Thread(
+                target=_read_ssf_frames, args=(conn, server, listener),
+                daemon=True)
+            t.start()
+
+    t = threading.Thread(target=accept_loop, name=f"ssf-{u.scheme}-accept",
+                         daemon=True)
+    t.start()
+    threads.append(t)
+    logger.info("listening for SSF on %s %s", u.scheme, address)
+    return listener
+
+
+def _read_ssf_frames(conn, server, listener: Listener) -> None:
+    """Framed stream read loop (reference server.go:1200-1237): framing
+    errors are fatal to the stream, decode-level errors are not."""
+    from veneur_tpu import protocol
+    stream = conn.makefile("rb")
+    with conn:
+        while not listener.closed:
+            try:
+                span = protocol.read_ssf(stream)
+            except protocol.SSFDecodeError as e:
+                # frame boundary is intact; skip the bad span, keep reading
+                logger.debug("dropping undecodable SSF span: %s", e)
+                continue
+            except protocol.FramingError as e:
+                logger.warning("closing SSF stream on framing error: %s", e)
+                return
+            except OSError:
+                return
+            if span is None:
+                return
+            server.ingest_span(span)

@@ -72,6 +72,20 @@ class Server:
         self._other_samples: List = []
         self._other_lock = threading.Lock()
 
+        # span pipeline: bounded channel + worker pool (reference
+        # server.go:728-736, worker.go:547-686); the metric-extraction
+        # sink is always attached (server.go:654-664)
+        from veneur_tpu.sinks.ssfmetrics import MetricExtractionSink
+        self.metric_extraction = MetricExtractionSink(
+            self.ingest_metric, self.parser,
+            indicator_timer_name=config.indicator_span_timer_name,
+            objective_timer_name=config.objective_span_timer_name)
+        self.span_sinks.append(self.metric_extraction)
+        self.span_chan: "queue.Queue" = queue.Queue(
+            maxsize=config.span_channel_capacity)
+        self._span_workers: List[threading.Thread] = []
+        self.spans_dropped = 0
+
         self.forwarder: Optional[Callable[[ForwardableState], None]] = None
         self.forward_client = None  # set in start() when forward_address
         self.import_server = None  # set in start() when grpc_address
@@ -124,15 +138,66 @@ class Server:
     def ingest_metric(self, metric: UDPMetric) -> None:
         self.store.process(metric)
 
+    # -- spans -----------------------------------------------------------
+
+    def handle_ssf_packet(self, packet: bytes) -> None:
+        """One unframed SSF datagram (reference server.go:1053-1100)."""
+        from veneur_tpu import protocol
+        self.stats["packets_received"] += 1
+        try:
+            span = protocol.parse_ssf(packet)
+        except Exception:
+            self.stats["parse_errors"] += 1
+            logger.debug("could not parse SSF packet (%d bytes)", len(packet))
+            return
+        self.ingest_span(span)
+
+    def ingest_span(self, span) -> None:
+        """Enqueue a span for the worker pool; drops (and counts) when the
+        channel is saturated rather than blocking ingest."""
+        try:
+            self.span_chan.put_nowait(span)
+        except queue.Full:
+            self.spans_dropped += 1
+
+    def _span_worker_loop(self) -> None:
+        """Fan each span out to every span sink (worker.go:587-662).
+        On shutdown, drains queued spans (which sit ahead of the None
+        sentinels) before exiting; the timed get covers the case where a
+        full channel swallowed the sentinels."""
+        while True:
+            try:
+                span = self.span_chan.get(timeout=0.5)
+            except queue.Empty:
+                if self._shutdown.is_set():
+                    return
+                continue
+            if span is None:
+                return
+            for sink in self.span_sinks:
+                try:
+                    sink.ingest(span)
+                except Exception:
+                    logger.exception("span sink %s ingest failed",
+                                     sink.name())
+
     # -- lifecycle -------------------------------------------------------
 
     def start(self) -> None:
         for sink in self.metric_sinks + self.span_sinks:
             sink.start(self)
+        for i in range(max(1, self.config.num_span_workers)):
+            t = threading.Thread(target=self._span_worker_loop,
+                                 name=f"span-worker-{i}", daemon=True)
+            t.start()
+            self._span_workers.append(t)
         for addr in self.config.statsd_listen_addresses:
             self._listeners.extend(networking.start_statsd(
                 addr, self, num_readers=self.config.num_readers,
                 rcvbuf=self.config.read_buffer_size_bytes))
+        for addr in self.config.ssf_listen_addresses:
+            self._listeners.extend(networking.start_ssf(
+                addr, self, rcvbuf=self.config.read_buffer_size_bytes))
         if self.config.forward_address and self.forwarder is None:
             from veneur_tpu.forward.client import ForwardClient
             self.forward_client = ForwardClient(
@@ -166,6 +231,16 @@ class Server:
 
     def shutdown(self) -> None:
         self._shutdown.set()
+        # sentinels wake idle workers promptly; a full channel is fine —
+        # workers also poll the shutdown event every 0.5s
+        for _ in self._span_workers:
+            try:
+                self.span_chan.put_nowait(None)
+            except queue.Full:
+                break
+        # let workers drain in-flight spans before the final flush
+        for t in self._span_workers:
+            t.join(timeout=2.0)
         if self.config.flush_on_shutdown:
             self.flush()
         for listener in self._listeners:

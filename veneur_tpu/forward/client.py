@@ -15,7 +15,7 @@ import grpc
 
 from veneur_tpu.core.flusher import ForwardableState
 from veneur_tpu.forward.convert import forwardable_to_protos
-from veneur_tpu.forward.protos import forward_pb2, metric_pb2
+from veneur_tpu.forward.protos import metric_pb2
 
 logger = logging.getLogger("veneur_tpu.forward.client")
 
@@ -34,10 +34,6 @@ class ForwardClient:
         self._send_v2 = self._channel.stream_unary(
             "/forwardrpc.Forward/SendMetricsV2",
             request_serializer=metric_pb2.Metric.SerializeToString,
-            response_deserializer=_EMPTY_DESERIALIZER)
-        self._send_v1 = self._channel.unary_unary(
-            "/forwardrpc.Forward/SendMetrics",
-            request_serializer=forward_pb2.MetricList.SerializeToString,
             response_deserializer=_EMPTY_DESERIALIZER)
         self.stats: Dict[str, int] = {
             "forwarded_total": 0, "errors_deadline": 0,

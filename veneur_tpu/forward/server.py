@@ -128,6 +128,10 @@ class ImportServer:
                 g_vals.append(pbm.gauge.value)
             elif which == "histogram":
                 d = pbm.histogram.t_digest
+                if not d.main_centroids:
+                    # an empty digest carries no samples; merging it would
+                    # still clobber the row's min/max with default zeros
+                    continue
                 means = np.fromiter(
                     (c.mean for c in d.main_centroids), np.float64,
                     len(d.main_centroids))

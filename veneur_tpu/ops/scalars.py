@@ -39,15 +39,6 @@ def apply_counters(state, rows, values, rates):
     return _kahan_add(state, partial)
 
 
-@jax.jit
-def merge_counters(state, rows, in_values):
-    """Import-path merge: plain addition (reference samplers.go:143-145)."""
-    num_keys = state["sum"].shape[0]
-    partial = jnp.zeros((num_keys,), jnp.float32).at[rows].add(
-        in_values, mode="drop")
-    return _kahan_add(state, partial)
-
-
 def counter_values(state):
     return state["sum"] - state["comp"]
 

@@ -1,0 +1,14 @@
+from veneur_tpu.protocol.wire import (  # noqa: F401
+    MAX_SSF_PACKET_LENGTH,
+    SSF_FRAME_LENGTH,
+    FramingError,
+    frame_ssf,
+    InvalidTrace,
+    SSFDecodeError,
+    is_framing_error,
+    parse_ssf,
+    read_ssf,
+    valid_trace,
+    validate_trace,
+    write_ssf,
+)

@@ -1,0 +1,69 @@
+"""Metric-extraction span sink: the bridge from the span pipeline back
+into the aggregation path.
+
+Parity with reference sinks/ssfmetrics/metrics.go:45-161: every ingested
+span has its embedded SSFSamples converted to UDPMetrics and fed to the
+column store; spans that are valid traces additionally yield SLI
+indicator/objective timers (reference parser.go:180-232) and a sampled
+span-name-uniqueness Set (parser.go:238-259).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, List
+
+from veneur_tpu import protocol
+from veneur_tpu.samplers.metrics import UDPMetric
+from veneur_tpu.sinks import SpanSink
+
+logger = logging.getLogger("veneur_tpu.sinks.ssfmetrics")
+
+
+class MetricExtractionSink(SpanSink):
+    def __init__(self, processor: Callable[[UDPMetric], None], parser,
+                 indicator_timer_name: str = "",
+                 objective_timer_name: str = "",
+                 uniqueness_rate: float = 0.01):
+        self._process = processor
+        self._parser = parser
+        self._indicator = indicator_timer_name
+        self._objective = objective_timer_name
+        self._uniqueness_rate = uniqueness_rate
+        self._lock = threading.Lock()
+        self.spans_processed = 0
+        self.metrics_generated = 0
+
+    def name(self) -> str:
+        return "metric_extraction"
+
+    def kind(self) -> str:
+        return "metric_extraction"
+
+    def ingest(self, span) -> None:
+        generated = 0
+        metrics, invalid = self._parser.convert_metrics(span)
+        if invalid:
+            logger.warning("could not parse %d samples from SSF span",
+                           len(invalid))
+        for metric in metrics:
+            self._process(metric)
+        generated += len(metrics)
+
+        if protocol.valid_trace(span):
+            derived: List[UDPMetric] = []
+            derived.extend(self._parser.convert_indicator_metrics(
+                span, self._indicator, self._objective))
+            derived.extend(self._parser.convert_span_uniqueness_metrics(
+                span, self._uniqueness_rate))
+            for metric in derived:
+                self._process(metric)
+            generated += len(derived)
+
+        with self._lock:
+            self.spans_processed += 1
+            self.metrics_generated += generated
+
+    def flush(self) -> None:
+        pass

@@ -1,0 +1,1 @@
+from veneur_tpu.ssf.protos import ssf_pb2  # noqa: F401

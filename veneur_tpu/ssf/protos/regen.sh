@@ -1,0 +1,4 @@
+#!/bin/sh
+# Regenerate the protobuf module (protoc >= 3.21). Run from this directory.
+set -e
+protoc --python_out=. ssf.proto

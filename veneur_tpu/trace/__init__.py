@@ -1,0 +1,263 @@
+"""Trace client library: buffered SSF span reporting.
+
+Parity with the reference trace package (reference trace/client.go:56-230,
+trace/trace.go:1-394): a `Client` buffers spans on a bounded queue and a
+sender thread writes them to a pluggable backend — UDP (one unframed span
+per datagram), UNIX/TCP stream (framed via protocol.write_ssf, with
+reconnect), or a channel backend that loops spans straight into an
+in-process server's span pipeline (reference server.go:518-524
+NewChannelClient). `start_span` produces context-manager spans with
+trace/parent lineage and attached samples.
+"""
+
+from __future__ import annotations
+
+import queue
+import random
+import socket
+import threading
+import time
+from typing import Dict, Optional
+
+from veneur_tpu import protocol, ssf
+
+_ids = random.Random()
+
+
+def _gen_id() -> int:
+    # non-zero positive int63, like the reference's proto ids
+    return _ids.getrandbits(62) | 1
+
+
+class Span:
+    """An in-flight operation being timed; finish() reports it."""
+
+    def __init__(self, client: Optional["Client"], name: str, service: str,
+                 trace_id: int = 0, parent_id: int = 0,
+                 tags: Optional[Dict[str, str]] = None,
+                 indicator: bool = False):
+        self.client = client
+        self.proto = ssf.SSFSpan(
+            id=_gen_id(),
+            trace_id=trace_id or 0,
+            parent_id=parent_id,
+            name=name,
+            service=service,
+            indicator=indicator,
+            start_timestamp=int(time.time() * 1e9),
+        )
+        if not self.proto.trace_id:
+            self.proto.trace_id = self.proto.id
+        if tags:
+            for k, v in tags.items():
+                self.proto.tags[k] = v
+        self._finished = False
+
+    @property
+    def trace_id(self) -> int:
+        return self.proto.trace_id
+
+    @property
+    def id(self) -> int:
+        return self.proto.id
+
+    def set_tag(self, key: str, value: str) -> None:
+        self.proto.tags[key] = value
+
+    def error(self, flag: bool = True) -> None:
+        self.proto.error = flag
+
+    def add(self, *samples) -> None:
+        """Attach metric samples to be extracted on the server."""
+        self.proto.metrics.extend(samples)
+
+    def child(self, name: str, tags: Optional[Dict[str, str]] = None) -> "Span":
+        return Span(self.client, name, self.proto.service,
+                    trace_id=self.proto.trace_id, parent_id=self.proto.id,
+                    tags=tags)
+
+    def finish(self) -> None:
+        if self._finished:
+            return
+        self._finished = True
+        self.proto.end_timestamp = int(time.time() * 1e9)
+        if self.client is not None:
+            self.client.record(self.proto)
+
+    def __enter__(self) -> "Span":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc_type is not None:
+            self.error()
+        self.finish()
+
+
+# -- backends ------------------------------------------------------------
+
+class ChannelBackend:
+    """Deliver spans straight into an in-process server's span channel
+    (the internal loopback, reference server.go:518-524)."""
+
+    def __init__(self, ingest_span):
+        self._ingest = ingest_span
+
+    def send(self, span: ssf.SSFSpan) -> None:
+        self._ingest(span)
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+class UDPBackend:
+    """One unframed protobuf span per datagram."""
+
+    def __init__(self, address):
+        self.address = address
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+
+    def send(self, span: ssf.SSFSpan) -> None:
+        self._sock.sendto(span.SerializeToString(), self.address)
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        self._sock.close()
+
+
+class StreamBackend:
+    """Framed spans over a UNIX or TCP stream, reconnecting on error
+    (reference trace/backend.go:120-230)."""
+
+    def __init__(self, address, unix: bool = False):
+        self.address = address
+        self.unix = unix
+        self._sock: Optional[socket.socket] = None
+        self._lock = threading.Lock()
+
+    def _connect(self) -> socket.socket:
+        if self._sock is None:
+            if self.unix:
+                s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            else:
+                s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            s.connect(self.address)
+            self._sock = s
+        return self._sock
+
+    def send(self, span: ssf.SSFSpan) -> None:
+        # encode outside the retry: an over-size span raises FramingError
+        # (an OSError subclass) and must not tear down a healthy socket
+        frame = protocol.frame_ssf(span)
+        with self._lock:
+            try:
+                self._connect().sendall(frame)
+            except OSError:
+                # drop the connection; retry once on a fresh one
+                self._drop()
+                self._connect().sendall(frame)
+
+    def _drop(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        with self._lock:
+            self._drop()
+
+
+# -- client --------------------------------------------------------------
+
+class Client:
+    """Buffered span reporter: `record` enqueues without blocking (drops
+    and counts when the buffer is full), a sender thread drains to the
+    backend (reference trace/client.go:56-170)."""
+
+    def __init__(self, backend, capacity: int = 1024):
+        self.backend = backend
+        self._q: "queue.Queue" = queue.Queue(maxsize=capacity)
+        self.records_dropped = 0
+        self.records_sent = 0
+        self._closed = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="trace-client-sender", daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while True:
+            span = self._q.get()
+            if span is None:
+                return
+            try:
+                self.backend.send(span)
+                self.records_sent += 1
+            except Exception:
+                self.records_dropped += 1
+
+    def record(self, span: ssf.SSFSpan) -> None:
+        if self._closed.is_set():
+            self.records_dropped += 1
+            return
+        try:
+            self._q.put_nowait(span)
+        except queue.Full:
+            self.records_dropped += 1
+
+    def start_span(self, name: str, service: str = "",
+                   tags: Optional[Dict[str, str]] = None,
+                   parent: Optional[Span] = None,
+                   indicator: bool = False) -> Span:
+        if parent is not None:
+            return Span(self, name, service or parent.proto.service,
+                        trace_id=parent.trace_id, parent_id=parent.id,
+                        tags=tags, indicator=indicator)
+        return Span(self, name, service, tags=tags, indicator=indicator)
+
+    def flush(self, timeout: float = 5.0) -> None:
+        """Wait for the queue to drain."""
+        deadline = time.monotonic() + timeout
+        while not self._q.empty() and time.monotonic() < deadline:
+            time.sleep(0.005)
+        self.backend.flush()
+
+    def close(self) -> None:
+        if self._closed.is_set():
+            return
+        self._closed.set()
+        self._q.put(None)
+        self._thread.join(timeout=2.0)
+        self.backend.close()
+
+
+def neutralized_client() -> Client:
+    """A client whose spans go nowhere — the test-silencing helper
+    (reference trace.NeutralizeClient)."""
+    class _Null:
+        def send(self, span):
+            pass
+
+        def flush(self):
+            pass
+
+        def close(self):
+            pass
+    return Client(_Null())
+
+
+def report_batch(client: Optional[Client], samples) -> None:
+    """Report bare samples through a carrier span (reference
+    trace/metrics.ReportBatch)."""
+    if client is None:
+        return
+    client.record(ssf.span_from_samples(samples))
