@@ -1,0 +1,265 @@
+"""Proxy tier tests: consistent ring, destination pool, routing, discovery
+(reference proxy/handlers/handlers_test.go, destinations_test.go)."""
+
+import http.server
+import json
+import threading
+import time
+
+import pytest
+
+from veneur_tpu.forward.client import ForwardClient
+from veneur_tpu.forward.convert import forwardable_to_protos
+from veneur_tpu.forward.protos import metric_pb2
+from veneur_tpu.proxy import ConsistentRing, EmptyRingError, ProxyServer
+from veneur_tpu.proxy.discovery import HttpJsonDiscoverer, StaticDiscoverer
+from veneur_tpu.proxy.proxy import create_static_proxy
+from veneur_tpu.testing.forwardtest import ForwardTestServer
+from veneur_tpu.util.matcher import TagMatcher
+
+
+def wait_until(pred, timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def mkmetric(name, value=1, tags=()):
+    pbm = metric_pb2.Metric(name=name, type=metric_pb2.Counter,
+                            scope=metric_pb2.Global)
+    pbm.tags.extend(tags)
+    pbm.counter.value = value
+    return pbm
+
+
+class TestRing:
+    def test_empty_ring_raises(self):
+        with pytest.raises(EmptyRingError):
+            ConsistentRing().get("x")
+
+    def test_single_member_gets_everything(self):
+        ring = ConsistentRing()
+        ring.add("a:1")
+        assert all(ring.get(f"k{i}") == "a:1" for i in range(100))
+
+    def test_distribution_roughly_uniform(self):
+        ring = ConsistentRing(replicas=50)
+        members = [f"host{i}:8128" for i in range(4)]
+        ring.set_members(members)
+        counts = {m: 0 for m in members}
+        for i in range(4000):
+            counts[ring.get(f"metric.key.{i}")] += 1
+        for member, n in counts.items():
+            assert 400 < n < 2200, counts
+
+    def test_consistency_on_removal(self):
+        """Removing one of N members remaps only that member's keys."""
+        ring = ConsistentRing(replicas=50)
+        members = [f"host{i}:8128" for i in range(5)]
+        ring.set_members(members)
+        before = {f"k{i}": ring.get(f"k{i}") for i in range(2000)}
+        ring.remove("host3:8128")
+        moved = 0
+        for key, owner in before.items():
+            new = ring.get(key)
+            if owner == "host3:8128":
+                assert new != "host3:8128"
+            elif new != owner:
+                moved += 1
+        assert moved == 0  # keys not owned by the removed member stay put
+
+    def test_set_members_reconciles(self):
+        ring = ConsistentRing()
+        ring.set_members(["a", "b", "c"])
+        ring.set_members(["b", "c", "d"])
+        assert ring.members() == ["b", "c", "d"]
+
+
+class TestProxyRouting:
+    def _boot(self, n=2, **kwargs):
+        received = [[] for _ in range(n)]
+        servers = []
+        for i in range(n):
+            ft = ForwardTestServer(received[i].extend)
+            ft.start()
+            servers.append(ft)
+        proxy = create_static_proxy([s.address for s in servers], **kwargs)
+        proxy.start()
+        return proxy, servers, received
+
+    def test_routes_all_metrics_consistently(self):
+        proxy, servers, received = self._boot(2)
+        try:
+            client = ForwardClient(proxy.address)
+            metrics = [mkmetric(f"m.{i}", i) for i in range(50)]
+            send = client._send_v2
+            send(iter(metrics), timeout=5)
+            assert wait_until(
+                lambda: sum(len(r) for r in received) == 50)
+            # both backends got a share and no metric was duplicated
+            assert all(received), [len(r) for r in received]
+            names = sorted(p.name for r in received for p in r)
+            assert names == sorted(f"m.{i}" for i in range(50))
+
+            # same key -> same backend on a second send
+            first_owner = {p.name: i for i, r in enumerate(received)
+                           for p in r}
+            send(iter([mkmetric(f"m.{i}", 1) for i in range(50)]), timeout=5)
+            assert wait_until(
+                lambda: sum(len(r) for r in received) == 100)
+            for i, r in enumerate(received):
+                for p in r:
+                    assert first_owner[p.name] == i
+            client.close()
+        finally:
+            proxy.stop()
+            for s in servers:
+                s.stop()
+
+    def test_ignored_tags_do_not_affect_key(self):
+        proxy, servers, received = self._boot(
+            2, ignore_tags=[TagMatcher(kind="prefix", value="host:")])
+        try:
+            client = ForwardClient(proxy.address)
+            a = mkmetric("same.metric", 1, tags=["host:a", "env:prod"])
+            b = mkmetric("same.metric", 2, tags=["host:b", "env:prod"])
+            client._send_v2(iter([a, b]), timeout=5)
+            assert wait_until(lambda: sum(len(r) for r in received) == 2)
+            owners = [i for i, r in enumerate(received) for _ in r]
+            assert owners[0] == owners[1]  # ignoring host: keeps them together
+            client.close()
+        finally:
+            proxy.stop()
+            for s in servers:
+                s.stop()
+
+    def test_healthcheck(self):
+        proxy = ProxyServer(StaticDiscoverer([]))
+        proxy.start()
+        assert not proxy.healthy()
+        proxy.stop()
+
+        ft = ForwardTestServer(lambda ms: None)
+        ft.start()
+        proxy2 = create_static_proxy([ft.address])
+        proxy2.start()
+        assert proxy2.healthy()
+        proxy2.stop()
+        ft.stop()
+
+    def test_local_server_through_proxy_to_global(self):
+        """Full chain: local veneur-tpu -> proxy -> global import server."""
+        from test_forward import make_config
+        from veneur_tpu.core.server import Server
+        from veneur_tpu.sinks.channel import ChannelMetricSink
+
+        global_cfg = make_config(grpc_address="127.0.0.1:0")
+        global_obs = ChannelMetricSink()
+        global_server = Server(global_cfg, extra_metric_sinks=[global_obs])
+        global_server.start()
+        assert wait_until(lambda: global_server.import_server is not None)
+
+        proxy = create_static_proxy([global_server.import_server.address])
+        proxy.start()
+
+        local_cfg = make_config(forward_address=proxy.address)
+        local_server = Server(local_cfg,
+                              extra_metric_sinks=[ChannelMetricSink()])
+        local_server.start()
+        try:
+            for v in (1.0, 2.0, 3.0):
+                local_server.handle_metric_packet(
+                    b"proxy.lat:%d|ms" % int(v))
+            local_server.flush()
+            assert wait_until(
+                lambda: global_server.import_server.imported_total >= 1)
+            global_server.flush()
+            got = {m.name: m for m in global_obs.wait_flush(timeout=10)}
+            assert "proxy.lat.50percentile" in got
+            assert got["proxy.lat.50percentile"].value == pytest.approx(
+                2.0, rel=0.25)
+        finally:
+            local_server.shutdown()
+            proxy.stop()
+            global_server.shutdown()
+
+
+class TestDiscovery:
+    def test_http_json_discoverer(self):
+        payload = ["10.0.0.1:8128",
+                   {"Service": {"Address": "10.0.0.2", "Port": 8128}}]
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                body = json.dumps(payload).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        httpd = http.server.HTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        try:
+            url = f"http://127.0.0.1:{httpd.server_port}/v1/health/{{service}}"
+            disc = HttpJsonDiscoverer(url)
+            got = disc.get_destinations_for_service("veneur-global")
+            assert got == ["10.0.0.1:8128", "10.0.0.2:8128"]
+        finally:
+            httpd.shutdown()
+
+    def test_discovery_refresh_updates_pool(self):
+        ft1 = ForwardTestServer(lambda ms: None)
+        ft1.start()
+        ft2 = ForwardTestServer(lambda ms: None)
+        ft2.start()
+        current = [[ft1.address]]
+
+        class FlipDiscoverer(StaticDiscoverer):
+            def __init__(self):
+                pass
+
+            def get_destinations_for_service(self, service):
+                return list(current[0])
+
+        proxy = ProxyServer(FlipDiscoverer(), discovery_interval=0.1)
+        proxy.start()
+        try:
+            assert wait_until(lambda: proxy.destinations.size() == 1)
+            current[0] = [ft1.address, ft2.address]
+            assert wait_until(lambda: proxy.destinations.size() == 2)
+            current[0] = [ft2.address]
+            assert wait_until(lambda: proxy.destinations.size() == 1)
+            assert proxy.destinations.ring.members() == [ft2.address]
+        finally:
+            proxy.stop()
+            ft1.stop()
+            ft2.stop()
+
+    def test_empty_discovery_keeps_pool(self):
+        ft = ForwardTestServer(lambda ms: None)
+        ft.start()
+        current = [[ft.address]]
+
+        class FlipDiscoverer(StaticDiscoverer):
+            def __init__(self):
+                pass
+
+            def get_destinations_for_service(self, service):
+                return list(current[0])
+
+        proxy = ProxyServer(FlipDiscoverer(), discovery_interval=0.1)
+        proxy.start()
+        try:
+            assert wait_until(lambda: proxy.destinations.size() == 1)
+            current[0] = []  # discovery outage must not clear the pool
+            time.sleep(0.3)
+            assert proxy.destinations.size() == 1
+        finally:
+            proxy.stop()
+            ft.stop()

@@ -1,0 +1,2 @@
+from veneur_tpu.proxy.proxy import ProxyServer  # noqa: F401
+from veneur_tpu.proxy.ring import ConsistentRing, EmptyRingError  # noqa: F401

@@ -1,0 +1,186 @@
+"""Destination pool: one buffered gRPC sender per global instance, plus
+the consistent-hash ring that maps metric keys onto them.
+
+Parity with reference proxy/destinations/destinations.go:14-152 and
+proxy/connect/connect.go: each destination has a bounded send queue
+drained by a sender thread that batches metrics into
+Forward.SendMetricsV2 client streams; a destination that keeps failing
+closes itself and is removed from the ring, so traffic re-shards onto
+the survivors until discovery re-adds it.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from typing import Callable, Dict, List, Optional
+
+import grpc
+
+from veneur_tpu.forward.protos import metric_pb2
+from veneur_tpu.proxy.ring import ConsistentRing, EmptyRingError
+
+logger = logging.getLogger("veneur_tpu.proxy.destinations")
+
+_EMPTY_DESERIALIZER = lambda _: b""  # noqa: E731
+
+
+class Destination:
+    def __init__(self, address: str,
+                 on_close: Callable[["Destination"], None],
+                 send_buffer: int = 4096, batch: int = 512,
+                 flush_interval: float = 0.5,
+                 max_consecutive_failures: int = 3):
+        self.address = address
+        self._on_close = on_close
+        self._queue: "queue.Queue" = queue.Queue(maxsize=send_buffer)
+        self._batch = batch
+        self._flush_interval = flush_interval
+        self._max_failures = max_consecutive_failures
+        self._failures = 0
+        self.closed = threading.Event()
+        self.sent_total = 0
+        self.dropped_total = 0
+        self._channel = grpc.insecure_channel(address)
+        self._send_v2 = self._channel.stream_unary(
+            "/forwardrpc.Forward/SendMetricsV2",
+            request_serializer=metric_pb2.Metric.SerializeToString,
+            response_deserializer=_EMPTY_DESERIALIZER)
+        self._thread = threading.Thread(
+            target=self._run, name=f"proxy-dest-{address}", daemon=True)
+        self._thread.start()
+
+    def send(self, metric: metric_pb2.Metric) -> bool:
+        """Non-blocking enqueue first; fall back to a short blocking wait;
+        drop if the destination is closed or still saturated (reference
+        handlers.go:100-164 semantics)."""
+        if self.closed.is_set():
+            self.dropped_total += 1
+            return False
+        try:
+            self._queue.put_nowait(metric)
+            return True
+        except queue.Full:
+            pass
+        try:
+            self._queue.put(metric, timeout=self._flush_interval)
+            return True
+        except queue.Full:
+            self.dropped_total += 1
+            return False
+
+    def _drain_batch(self) -> List[metric_pb2.Metric]:
+        out: List[metric_pb2.Metric] = []
+        try:
+            out.append(self._queue.get(timeout=self._flush_interval))
+        except queue.Empty:
+            return out
+        while len(out) < self._batch:
+            try:
+                out.append(self._queue.get_nowait())
+            except queue.Empty:
+                break
+        return out
+
+    def _run(self) -> None:
+        while not self.closed.is_set():
+            batch = self._drain_batch()
+            if not batch:
+                continue
+            try:
+                self._send_v2(iter(batch), timeout=10.0)
+                self.sent_total += len(batch)
+                self._failures = 0
+            except grpc.RpcError as e:
+                self._failures += 1
+                self.dropped_total += len(batch)
+                code = e.code() if hasattr(e, "code") else None
+                logger.warning("send to %s failed (%s), failure %d/%d",
+                               self.address, code, self._failures,
+                               self._max_failures)
+                if self._failures >= self._max_failures:
+                    self.close(notify=True)
+                    return
+
+    def close(self, notify: bool = False) -> None:
+        if self.closed.is_set():
+            return
+        self.closed.set()
+        if notify:
+            self._on_close(self)
+        try:
+            self._channel.close()
+        except Exception:
+            pass
+
+
+class Destinations:
+    """The live pool: address -> Destination plus the ring."""
+
+    def __init__(self, send_buffer: int = 4096, batch: int = 512,
+                 flush_interval: float = 0.5):
+        self._lock = threading.RLock()
+        self._pool: Dict[str, Destination] = {}
+        self.ring = ConsistentRing()
+        self._send_buffer = send_buffer
+        self._batch = batch
+        self._flush_interval = flush_interval
+
+    def set_destinations(self, addresses: List[str]) -> None:
+        """Reconcile the pool with a fresh discovery result."""
+        with self._lock:
+            wanted = set(addresses)
+            for address in list(self._pool):
+                if address not in wanted:
+                    self._remove_locked(address)
+            for address in addresses:
+                if address not in self._pool:
+                    self._pool[address] = Destination(
+                        address, self._on_destination_closed,
+                        send_buffer=self._send_buffer, batch=self._batch,
+                        flush_interval=self._flush_interval)
+                    self.ring.add(address)
+
+    def _remove_locked(self, address: str) -> None:
+        dest = self._pool.pop(address, None)
+        self.ring.remove(address)
+        if dest is not None:
+            dest.close()
+
+    def _on_destination_closed(self, dest: Destination) -> None:
+        """Self-removal on connection failure (destinations.go:99-110);
+        discovery re-adds the address when it becomes healthy again."""
+        with self._lock:
+            if self._pool.get(dest.address) is dest:
+                self._pool.pop(dest.address)
+                self.ring.remove(dest.address)
+
+    def get(self, key: str) -> Destination:
+        with self._lock:
+            address = self.ring.get(key)
+            dest = self._pool.get(address)
+            if dest is None:
+                raise EmptyRingError(f"no destination for {address}")
+            return dest
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._pool)
+
+    def clear(self) -> None:
+        with self._lock:
+            for address in list(self._pool):
+                self._remove_locked(address)
+
+    def flush_wait(self, timeout: float = 5.0) -> None:
+        """Best-effort wait until queued metrics drain (for tests and
+        graceful shutdown)."""
+        import time
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            pool = list(self._pool.values())
+        for dest in pool:
+            while (not dest._queue.empty()
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)

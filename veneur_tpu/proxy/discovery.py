@@ -1,0 +1,82 @@
+"""Service discovery for the proxy's destination pool.
+
+Parity with reference discovery/ (discoverer.go:5-7, consul/consul.go:30-47,
+kubernetes/kubernetes.go:90-108): a Discoverer maps a service name to the
+current list of healthy destination addresses. Built-ins:
+
+- StaticDiscoverer: a fixed list (the common config-driven case).
+- DnsDiscoverer: resolve an A/AAAA name each refresh; every returned
+  address (with a fixed port) is a destination.
+- HttpJsonDiscoverer: poll an HTTP endpoint returning a JSON array of
+  addresses — the shape a Consul health API proxy or any custom
+  controller can serve (tests use a local HTTP fake, like the
+  reference's consul testdata).
+
+Kubernetes pod-watch discovery requires a cluster client and is out of
+scope for this build; HttpJsonDiscoverer against the kube-apiserver's
+endpoints API covers the same topology.
+"""
+
+from __future__ import annotations
+
+import abc
+import json
+import logging
+import socket
+import urllib.request
+from typing import List
+
+logger = logging.getLogger("veneur_tpu.proxy.discovery")
+
+
+class Discoverer(abc.ABC):
+    @abc.abstractmethod
+    def get_destinations_for_service(self, service: str) -> List[str]: ...
+
+
+class StaticDiscoverer(Discoverer):
+    def __init__(self, destinations: List[str]):
+        self._destinations = list(destinations)
+
+    def get_destinations_for_service(self, service: str) -> List[str]:
+        return list(self._destinations)
+
+
+class DnsDiscoverer(Discoverer):
+    """`service` is "host:port"; each resolved address becomes a
+    destination at that port."""
+
+    def get_destinations_for_service(self, service: str) -> List[str]:
+        host, _, port = service.rpartition(":")
+        if not host:
+            raise ValueError(f"dns discovery needs host:port, got {service!r}")
+        infos = socket.getaddrinfo(host, int(port), proto=socket.IPPROTO_TCP)
+        return sorted({f"{info[4][0]}:{port}" for info in infos})
+
+
+class HttpJsonDiscoverer(Discoverer):
+    """GET `url_template.format(service=...)`, expecting a JSON array of
+    "host:port" strings (or of objects with Address/Port keys, the shape
+    of a Consul health response)."""
+
+    def __init__(self, url_template: str, timeout: float = 5.0):
+        self.url_template = url_template
+        self.timeout = timeout
+
+    def get_destinations_for_service(self, service: str) -> List[str]:
+        url = self.url_template.format(service=service)
+        with urllib.request.urlopen(url, timeout=self.timeout) as resp:
+            payload = json.load(resp)
+        out = []
+        for entry in payload:
+            if isinstance(entry, str):
+                out.append(entry)
+            elif isinstance(entry, dict):
+                # Consul-style: {"Service": {"Address": ..., "Port": ...}}
+                svc = entry.get("Service", entry)
+                addr = svc.get("Address") or entry.get("Node", {}).get(
+                    "Address")
+                port = svc.get("Port")
+                if addr and port:
+                    out.append(f"{addr}:{port}")
+        return out

@@ -1,0 +1,150 @@
+"""veneur-proxy: consistent-hash gRPC router in front of the global tier.
+
+Parity with reference proxy/proxy.go:33-120 and
+proxy/handlers/handlers.go:40-164: a gRPC server accepting
+Forward.SendMetrics (unary MetricList) and SendMetricsV2 (metric stream);
+each metric is keyed by name + type + tags (minus configured ignored
+tags), mapped through the consistent-hash ring to a destination, and
+enqueued on that destination's buffered sender. A discovery loop
+refreshes the destination pool every `discovery_interval`; the
+healthcheck fails while the ring is empty (handlers.go:30-38).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from concurrent import futures
+from typing import Dict, List, Optional
+
+import grpc
+
+from veneur_tpu.forward.protos import forward_pb2, metric_pb2
+from veneur_tpu.proxy.destinations import Destinations
+from veneur_tpu.proxy.discovery import Discoverer, StaticDiscoverer
+from veneur_tpu.proxy.ring import EmptyRingError
+from veneur_tpu.util.matcher import TagMatcher
+
+logger = logging.getLogger("veneur_tpu.proxy")
+
+
+class ProxyServer:
+    def __init__(self, discoverer: Discoverer,
+                 forward_service: str = "veneur-global",
+                 listen_address: str = "127.0.0.1:0",
+                 discovery_interval: float = 10.0,
+                 ignore_tags: Optional[List[TagMatcher]] = None,
+                 send_buffer: int = 4096, batch: int = 512,
+                 max_workers: int = 8):
+        self.discoverer = discoverer
+        self.forward_service = forward_service
+        self.discovery_interval = discovery_interval
+        self._ignore = list(ignore_tags or [])
+        self.destinations = Destinations(
+            send_buffer=send_buffer, batch=batch)
+        self.stats: Dict[str, int] = {
+            "received_total": 0, "routed_total": 0,
+            "no_destination_total": 0, "dropped_total": 0,
+        }
+        self._shutdown = threading.Event()
+        self._discovery_thread: Optional[threading.Thread] = None
+
+        self._grpc = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers))
+        handler = grpc.method_handlers_generic_handler("forwardrpc.Forward", {
+            "SendMetricsV2": grpc.stream_unary_rpc_method_handler(
+                self._send_metrics_v2,
+                request_deserializer=metric_pb2.Metric.FromString,
+                response_serializer=lambda _: b""),
+            "SendMetrics": grpc.unary_unary_rpc_method_handler(
+                self._send_metrics_v1,
+                request_deserializer=forward_pb2.MetricList.FromString,
+                response_serializer=lambda _: b""),
+        })
+        self._grpc.add_generic_rpc_handlers((handler,))
+        self.port = self._grpc.add_insecure_port(listen_address)
+        if self.port == 0:
+            raise RuntimeError(f"could not bind proxy to {listen_address}")
+
+    @property
+    def address(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> None:
+        self._refresh_destinations()
+        self._grpc.start()
+        self._discovery_thread = threading.Thread(
+            target=self._discovery_loop, name="proxy-discovery", daemon=True)
+        self._discovery_thread.start()
+        logger.info("proxy listening on %s (%d destinations)",
+                    self.address, self.destinations.size())
+
+    def stop(self, grace: float = 1.0) -> None:
+        self._shutdown.set()
+        self._grpc.stop(grace)
+        self.destinations.flush_wait(timeout=grace)
+        self.destinations.clear()
+
+    def healthy(self) -> bool:
+        """False while no destinations are connected (handlers.go:30-38)."""
+        return self.destinations.size() > 0
+
+    # -- discovery -------------------------------------------------------
+
+    def _discovery_loop(self) -> None:
+        while not self._shutdown.wait(self.discovery_interval):
+            self._refresh_destinations()
+
+    def _refresh_destinations(self) -> None:
+        try:
+            addresses = self.discoverer.get_destinations_for_service(
+                self.forward_service)
+        except Exception:
+            logger.exception("discovery failed for %s; keeping current pool",
+                             self.forward_service)
+            return
+        if not addresses:
+            # an empty result is treated as a discovery outage: keep
+            # forwarding to the known pool rather than dropping everything
+            logger.warning("discovery returned no destinations for %s",
+                           self.forward_service)
+            return
+        self.destinations.set_destinations(addresses)
+
+    # -- handlers --------------------------------------------------------
+
+    def _send_metrics_v1(self, metric_list, ctx):
+        for pbm in metric_list.metrics:
+            self.handle_metric(pbm)
+        return b""
+
+    def _send_metrics_v2(self, request_iterator, ctx):
+        for pbm in request_iterator:
+            self.handle_metric(pbm)
+        return b""
+
+    def handle_metric(self, pbm: metric_pb2.Metric) -> None:
+        """Route one metric (handlers.go:100-164): hash key is
+        name + lowercase type + joined tags minus ignored tags."""
+        self.stats["received_total"] += 1
+        tags = [t for t in pbm.tags
+                if not any(matcher.match(t) for matcher in self._ignore)]
+        key = "%s%s%s" % (pbm.name,
+                          metric_pb2.Type.Name(pbm.type).lower(),
+                          ",".join(tags))
+        try:
+            dest = self.destinations.get(key)
+        except EmptyRingError:
+            self.stats["no_destination_total"] += 1
+            return
+        if dest.send(pbm):
+            self.stats["routed_total"] += 1
+        else:
+            self.stats["dropped_total"] += 1
+
+
+def create_static_proxy(destination_addresses: List[str],
+                        **kwargs) -> ProxyServer:
+    return ProxyServer(StaticDiscoverer(destination_addresses), **kwargs)

@@ -1,0 +1,101 @@
+"""Consistent-hash ring for sharding metrics across global instances.
+
+Semantics parity with the reference's vendored stathat/consistent ring
+(used at proxy/destinations/destinations.go:127-141): members are placed
+at many virtual points on a ring; `get(key)` walks clockwise from the
+key's hash to the first member, so adding/removing one member only remaps
+~1/N of keys. Hash is fnv1a-64 (our host keying hash) rather than the
+reference's crc32 — both give uniform placement; only intra-cluster
+consistency matters, and every veneur-tpu proxy uses the same function.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Dict, List, Optional
+
+from veneur_tpu.util import fnv
+
+DEFAULT_REPLICAS = 20
+
+
+class EmptyRingError(LookupError):
+    pass
+
+
+class ConsistentRing:
+    def __init__(self, replicas: int = DEFAULT_REPLICAS):
+        self.replicas = replicas
+        self._lock = threading.RLock()
+        self._points: List[int] = []  # sorted hash points
+        self._owner: Dict[int, str] = {}  # point -> member
+        self._members: set = set()
+
+    def _point(self, member: str, i: int) -> int:
+        return fnv.fnv1a_64(f"{i}{member}".encode())
+
+    def add(self, member: str) -> None:
+        with self._lock:
+            if member in self._members:
+                return
+            self._members.add(member)
+            for i in range(self.replicas):
+                pt = self._point(member, i)
+                if pt in self._owner:
+                    continue  # vanishing chance of 64-bit collision
+                self._owner[pt] = member
+                bisect.insort(self._points, pt)
+
+    def remove(self, member: str) -> None:
+        with self._lock:
+            if member not in self._members:
+                return
+            self._members.discard(member)
+            for i in range(self.replicas):
+                pt = self._point(member, i)
+                if self._owner.get(pt) == member:
+                    del self._owner[pt]
+                    idx = bisect.bisect_left(self._points, pt)
+                    if idx < len(self._points) and self._points[idx] == pt:
+                        del self._points[idx]
+
+    def set_members(self, members: List[str]) -> None:
+        with self._lock:
+            for member in list(self._members - set(members)):
+                self.remove(member)
+            for member in members:
+                self.add(member)
+
+    def members(self) -> List[str]:
+        with self._lock:
+            return sorted(self._members)
+
+    def __len__(self) -> int:
+        return len(self._members)
+
+    def get(self, key: str) -> str:
+        with self._lock:
+            if not self._points:
+                raise EmptyRingError("empty consistent-hash ring")
+            h = fnv.fnv1a_64(key.encode())
+            idx = bisect.bisect_right(self._points, h)
+            if idx == len(self._points):
+                idx = 0
+            return self._owner[self._points[idx]]
+
+    def get_two(self, key: str) -> tuple:
+        """The owner and the next distinct member clockwise (for
+        replicated sends; reference ring offers Get/GetTwo/GetN)."""
+        with self._lock:
+            first = self.get(key)
+            if len(self._members) < 2:
+                return first, first
+            h = fnv.fnv1a_64(key.encode())
+            idx = bisect.bisect_right(self._points, h)
+            n = len(self._points)
+            for step in range(n):
+                member = self._owner[self._points[(idx + step) % n]]
+                if member != first:
+                    return first, member
+            return first, first

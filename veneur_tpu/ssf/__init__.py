@@ -82,13 +82,17 @@ def status(name: str, state, message: str = "",
 
 def randomly_sample(rate: float,
                     *samples: ssf_pb2.SSFSample) -> List[ssf_pb2.SSFSample]:
-    """Keep all samples with probability `rate`, stamping the rate on the
-    survivors (reference ssf/samples.go RandomlySample)."""
-    if rate >= 1.0 or _random.random() < rate:
-        for s in samples:
-            s.sample_rate = rate
-        return list(samples)
-    return []
+    """Keep each sample independently with probability `rate`,
+    multiplying the survivor's existing sample_rate by `rate` so
+    pre-sampled values keep scaling correctly (reference
+    ssf/samples.go:134-154 RandomlySample)."""
+    out: List[ssf_pb2.SSFSample] = []
+    for s in samples:
+        if _random.random() <= rate:
+            if 0 < rate <= 1:
+                s.sample_rate = (s.sample_rate or 1.0) * rate
+            out.append(s)
+    return out
 
 
 def span_from_samples(samples: Sequence[ssf_pb2.SSFSample]) -> ssf_pb2.SSFSpan:

@@ -12,6 +12,7 @@ trace/parent lineage and attached samples.
 
 from __future__ import annotations
 
+import logging
 import queue
 import random
 import socket
@@ -20,6 +21,8 @@ import time
 from typing import Dict, Optional
 
 from veneur_tpu import protocol, ssf
+
+logger = logging.getLogger("veneur_tpu.trace")
 
 _ids = random.Random()
 
@@ -197,13 +200,23 @@ class Client:
     def _run(self) -> None:
         while True:
             span = self._q.get()
-            if span is None:
-                return
             try:
-                self.backend.send(span)
-                self.records_sent += 1
-            except Exception:
-                self.records_dropped += 1
+                if span is None:
+                    return
+                try:
+                    self.backend.send(span)
+                    self.records_sent += 1
+                except Exception as e:
+                    self.records_dropped += 1
+                    # log the first failure and then once per 100 so a
+                    # dead backend is visible without flooding
+                    if self.records_dropped == 1 or \
+                            self.records_dropped % 100 == 0:
+                        logger.warning(
+                            "trace backend send failed (%d dropped): %s",
+                            self.records_dropped, e)
+            finally:
+                self._q.task_done()
 
     def record(self, span: ssf.SSFSpan) -> None:
         if self._closed.is_set():
@@ -225,9 +238,13 @@ class Client:
         return Span(self, name, service, tags=tags, indicator=indicator)
 
     def flush(self, timeout: float = 5.0) -> None:
-        """Wait for the queue to drain."""
+        """Wait until every recorded span has been *processed* by the
+        sender (not merely dequeued), bounded by `timeout`."""
         deadline = time.monotonic() + timeout
-        while not self._q.empty() and time.monotonic() < deadline:
+        while time.monotonic() < deadline:
+            with self._q.all_tasks_done:
+                if self._q.unfinished_tasks == 0:
+                    break
             time.sleep(0.005)
         self.backend.flush()
 
