@@ -1,0 +1,158 @@
+"""CLI tests: veneur-emit packet rendering + end-to-end against a real
+server, veneur config validation, veneur-prometheus conversion
+(reference cmd/veneur-emit/main_test.go patterns)."""
+
+import socket
+import time
+
+import pytest
+
+from veneur_tpu.cmd import veneur_emit as emit
+from veneur_tpu.cmd.veneur import main as veneur_main
+from test_server import generate_config, setup_server
+
+
+class TestPacketRendering:
+    def test_metric(self):
+        assert emit.render_metric_packet("a.b", 3, "c", []) == b"a.b:3|c"
+        assert emit.render_metric_packet(
+            "a.b", 2.5, "g", ["x:y", "z"], rate=0.5) == \
+            b"a.b:2.5|g|@0.5|#x:y,z"
+
+    def test_event(self):
+        pkt = emit.render_event_packet(
+            "tt", "hello world", ["env:prod"], priority="low",
+            alert_type="error")
+        assert pkt.startswith(b"_e{2,11}:tt|hello world")
+        assert b"p:low" in pkt
+        assert b"t:error" in pkt
+        assert pkt.endswith(b"#env:prod")
+
+    def test_service_check(self):
+        pkt = emit.render_service_check_packet(
+            "db.up", 2, ["shard:1"], message="down")
+        assert pkt == b"_sc|db.up|2|#shard:1|m:down"
+
+    def test_parse_hostport(self):
+        assert emit.parse_hostport("udp://1.2.3.4:99") == ("udp", "1.2.3.4", 99)
+        assert emit.parse_hostport("tcp://h:1") == ("tcp", "h", 1)
+        assert emit.parse_hostport("127.0.0.1:8126") == \
+            ("udp", "127.0.0.1", 8126)
+
+
+class TestEmitEndToEnd:
+    def _server_with_udp(self):
+        cfg = generate_config()
+        cfg.statsd_listen_addresses = ["udp://127.0.0.1:0"]
+        cfg.ssf_listen_addresses = ["udp://127.0.0.1:0"]
+        server, observer = setup_server(cfg)
+        server.start()
+        return server, observer
+
+    def _wait_metric(self, server, observer, name, timeout=5.0):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            time.sleep(0.05)
+            server.flush()
+            try:
+                flushed = observer.wait_flush(timeout=0.2)
+            except Exception:
+                continue
+            for metric in flushed:
+                if metric.name == name:
+                    return metric
+        raise AssertionError(f"{name} never arrived")
+
+    def test_emit_counter_udp(self):
+        server, observer = self._server_with_udp()
+        try:
+            host, port = server.local_addr("udp")
+            rc = emit.main(["-hostport", f"udp://{host}:{port}",
+                            "-name", "emit.test", "-count", "4",
+                            "-tag", "a:b"])
+            assert rc == 0
+            metric = self._wait_metric(server, observer, "emit.test")
+            assert metric.value == 4.0
+            assert "a:b" in metric.tags
+        finally:
+            server.shutdown()
+
+    def test_emit_command_timing(self):
+        server, observer = self._server_with_udp()
+        try:
+            host, port = server.local_addr("udp")
+            rc = emit.main(["-hostport", f"udp://{host}:{port}",
+                            "-name", "cmd.timer",
+                            "-command", "true"])
+            assert rc == 0
+            metric = self._wait_metric(server, observer, "cmd.timer.max")
+            assert metric.value >= 0
+        finally:
+            server.shutdown()
+
+    def test_emit_command_propagates_exit_code(self):
+        server, _ = self._server_with_udp()
+        try:
+            host, port = server.local_addr("udp")
+            rc = emit.main(["-hostport", f"udp://{host}:{port}",
+                            "-name", "cmd.timer",
+                            "-command", "false"])
+            assert rc != 0
+        finally:
+            server.shutdown()
+
+    def test_emit_span_ssf(self):
+        server, observer = self._server_with_udp()
+        try:
+            host, port = server.local_addr("ssf-udp")
+            rc = emit.main(["-hostport", f"udp://{host}:{port}",
+                            "-mode", "span", "-name", "em.span",
+                            "-span_service", "emit-svc",
+                            "-span_duration", "0.05"])
+            assert rc == 0
+            deadline = time.time() + 5
+            while time.time() < deadline and not server.stats.get(
+                    "packets_received"):
+                time.sleep(0.05)
+            # the span reached the span channel / workers
+            time.sleep(0.2)
+            assert server.spans_dropped == 0
+        finally:
+            server.shutdown()
+
+
+class TestVeneurCLI:
+    def test_version(self, capsys):
+        assert veneur_main(["-version"]) == 0
+        import veneur_tpu
+        assert veneur_tpu.__version__ in capsys.readouterr().out
+
+    def test_validate_config(self, tmp_path, capsys):
+        p = tmp_path / "cfg.yaml"
+        p.write_text("interval: 5s\nhostname: x\n")
+        assert veneur_main(["-f", str(p), "-validate-config"]) == 0
+        assert "config OK" in capsys.readouterr().out
+
+    def test_validate_config_strict_rejects_unknown(self, tmp_path):
+        p = tmp_path / "cfg.yaml"
+        p.write_text("interval: 5s\nnot_a_real_field: 1\n")
+        assert veneur_main(["-f", str(p),
+                            "-validate-config-strict"]) == 1
+
+
+class TestVeneurPrometheus:
+    def test_statsd_emitter(self):
+        from veneur_tpu.cmd.veneur_prometheus import StatsdEmitter
+        from veneur_tpu.samplers.metrics import MetricKey, UDPMetric
+        from veneur_tpu.samplers import metrics as m
+        recv = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        recv.bind(("127.0.0.1", 0))
+        recv.settimeout(5.0)
+        port = recv.getsockname()[1]
+        emitter = StatsdEmitter(f"127.0.0.1:{port}", prefix="pfx.")
+        emitter.ingest_metric(UDPMetric(
+            key=MetricKey(name="up", type=m.GAUGE), value=1.0,
+            tags=["a:b"]))
+        data, _ = recv.recvfrom(65536)
+        assert data == b"pfx.up:1.0|g|#a:b"
+        recv.close()

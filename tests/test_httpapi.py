@@ -1,0 +1,113 @@
+"""Ops HTTP API tests (reference http.go endpoints) and snappy codec
+round-trips used by the cortex sink."""
+
+import json
+
+import yaml
+
+import veneur_tpu
+from veneur_tpu.core.httpapi import HTTPApi, config_to_dict
+from veneur_tpu.util import http as vhttp
+from veneur_tpu.util.secret import StringSecret
+
+from test_server import generate_config, setup_server
+
+
+def api_url(api, path):
+    host, port = api.address
+    return f"http://{host}:{port}{path}"
+
+
+class TestHTTPApi:
+    def _start(self, cfg=None, **kw):
+        api = HTTPApi(cfg or generate_config(), address="127.0.0.1:0", **kw)
+        api.start()
+        return api
+
+    def test_healthcheck_and_version(self):
+        api = self._start()
+        try:
+            assert vhttp.get(api_url(api, "/healthcheck"))[0] == 200
+            status, body = vhttp.get(api_url(api, "/version"))
+            assert status == 200
+            assert body.decode() == veneur_tpu.__version__
+            assert vhttp.get(api_url(api, "/builddate"))[0] == 200
+        finally:
+            api.stop()
+
+    def test_config_endpoints_redact_secrets(self):
+        cfg = generate_config()
+        cfg.sentry_dsn = StringSecret("https://supersecret@sentry.invalid/1")
+        api = self._start(cfg)
+        try:
+            _, body = vhttp.get(api_url(api, "/config/json"))
+            cfg_json = json.loads(body)
+            assert cfg_json["sentry_dsn"] == "REDACTED"
+            assert "supersecret" not in body.decode()
+            assert cfg_json["interval"] == cfg.interval
+            _, body = vhttp.get(api_url(api, "/config/yaml"))
+            cfg_yaml = yaml.safe_load(body)
+            assert cfg_yaml["sentry_dsn"] == "REDACTED"
+        finally:
+            api.stop()
+
+    def test_404(self):
+        api = self._start()
+        try:
+            try:
+                vhttp.get(api_url(api, "/nope"))
+                raise AssertionError("expected HTTPError")
+            except vhttp.HTTPError as e:
+                assert e.status == 404
+        finally:
+            api.stop()
+
+    def test_quitquitquit_disabled_by_default(self):
+        api = self._start()
+        try:
+            try:
+                vhttp.post(api_url(api, "/quitquitquit"), b"")
+                raise AssertionError("expected HTTPError")
+            except vhttp.HTTPError as e:
+                assert e.status == 404
+        finally:
+            api.stop()
+
+    def test_server_integration(self):
+        server, observer = setup_server(http_address="127.0.0.1:0")
+        server.start()
+        try:
+            status, _ = vhttp.get(api_url(server.http_api, "/healthcheck"))
+            assert status == 200
+            _, body = vhttp.get(api_url(server.http_api, "/debug/memory"))
+            assert isinstance(json.loads(body), list)
+        finally:
+            server.shutdown()
+
+    def test_config_to_dict_nested(self):
+        cfg = generate_config()
+        d = config_to_dict(cfg)
+        assert d["tpu"]["counter_capacity"] == cfg.tpu.counter_capacity
+        assert isinstance(d["percentiles"], list)
+
+
+class TestSnappy:
+    def test_roundtrip_small(self):
+        for payload in (b"", b"a", b"hello world" * 3, bytes(range(256))):
+            assert vhttp.snappy_decode(vhttp.snappy_encode(payload)) == payload
+
+    def test_roundtrip_large(self):
+        payload = b"abcdefgh" * 50_000  # > 64 KiB chunking path
+        assert vhttp.snappy_decode(vhttp.snappy_encode(payload)) == payload
+
+    def test_decodes_copies(self):
+        # hand-built stream: literal "abcd" + 1-byte-offset copy of 4 back
+        stream = bytes([8,            # uvarint length 8
+                        3 << 2,       # literal, len 4
+                        ]) + b"abcd" + bytes([
+                        0b000_001_01 | (0 << 5),  # copy1: len 4+0... build below
+                        ])
+        # tag for copy-1: type=1, len-4 in bits 2-4, offset high bits 5-7
+        tag = 0x01 | ((4 - 4) << 2) | (0 << 5)
+        stream = bytes([8, 3 << 2]) + b"abcd" + bytes([tag, 4])
+        assert vhttp.snappy_decode(stream) == b"abcdabcd"

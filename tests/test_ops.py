@@ -1,0 +1,167 @@
+"""Ops-parity tests: scoped self-metrics, diagnostics, crash handling,
+flush self-tracing (reference scopedstatsd/client.go, diagnostics/,
+sentry.go)."""
+
+import logging
+import queue
+import socket
+import time
+
+import pytest
+
+from veneur_tpu.util import crash
+from veneur_tpu.util.scopedstatsd import (
+    TAG_GLOBAL_ONLY, TAG_LOCAL_ONLY, NullClient, ScopedClient,
+)
+from test_server import generate_config, setup_server
+
+
+class TestScopedClient:
+    def test_scope_tags(self):
+        packets = []
+        client = ScopedClient(
+            packet_cb=packets.append,
+            scopes={"gauge": "local", "count": "global"},
+            additional_tags=["svc:veneur"])
+        client.gauge("g", 1.5, tags=["x:y"])
+        client.count("c", 2)
+        client.timing("t", 0.125)
+        assert packets[0] == b"g:1.5|g|#x:y,svc:veneur," + \
+            TAG_LOCAL_ONLY.encode()
+        assert packets[1] == b"c:2|c|#svc:veneur," + TAG_GLOBAL_ONLY.encode()
+        assert packets[2] == b"t:125.000|ms|#svc:veneur"
+
+    def test_udp_emission(self):
+        recv = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        recv.bind(("127.0.0.1", 0))
+        recv.settimeout(5.0)
+        port = recv.getsockname()[1]
+        client = ScopedClient(address=f"127.0.0.1:{port}")
+        client.count("hello", 1)
+        data, _ = recv.recvfrom(4096)
+        assert data == b"hello:1|c"
+        client.close()
+        recv.close()
+
+    def test_timer_context(self):
+        packets = []
+        client = ScopedClient(packet_cb=packets.append)
+        with client.timer("op"):
+            time.sleep(0.01)
+        name, rest = packets[0].split(b":", 1)
+        assert name == b"op"
+        assert float(rest.split(b"|")[0]) >= 10.0
+
+    def test_null_client(self):
+        NullClient().count("x")  # no error, no emission
+
+
+class TestDiagnostics:
+    def test_collect_emits_runtime_gauges(self):
+        from veneur_tpu.core.diagnostics import collect
+        packets = []
+        client = ScopedClient(packet_cb=packets.append)
+        collect(client, start_time=time.time() - 5, include_device=False)
+        names = {p.split(b":", 1)[0].decode() for p in packets}
+        assert {"mem.rss_bytes", "cpu.user_seconds", "threads.count",
+                "uptime_ms"} <= names
+
+    def test_loop(self):
+        from veneur_tpu.core.diagnostics import DiagnosticsLoop
+        packets = []
+        loop = DiagnosticsLoop(ScopedClient(packet_cb=packets.append),
+                               interval=0.05, include_device=False)
+        loop.start()
+        time.sleep(0.3)
+        loop.stop()
+        assert len(packets) >= 4
+
+
+class TestCrash:
+    def teardown_method(self):
+        crash.clear_reporters()
+
+    def test_consume_panic_reports_and_reraises(self):
+        seen = []
+        crash.register_reporter(lambda exc, tb: seen.append((exc, tb)))
+        with pytest.raises(ValueError):
+            try:
+                raise ValueError("boom")
+            except ValueError as e:
+                crash.consume_panic(e)
+        assert "boom" in str(seen[0][0])
+        assert "ValueError" in seen[0][1]
+
+    @pytest.mark.filterwarnings(
+        "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+    def test_guarded_thread(self):
+        seen = []
+        crash.register_reporter(lambda exc, tb: seen.append(exc))
+
+        def body():
+            raise RuntimeError("thread died")
+
+        t = crash.spawn_guarded(body, name="t")
+        t.join(timeout=5)
+        assert seen and "thread died" in str(seen[0])
+
+    def test_logging_hook(self):
+        seen = []
+        crash.register_reporter(lambda exc, tb: seen.append(tb))
+        log = logging.getLogger("test.crash.hook")
+        handler = crash.ReportingHandler()
+        log.addHandler(handler)
+        try:
+            log.error("an error happened")
+            log.info("not reported")
+        finally:
+            log.removeHandler(handler)
+        assert len(seen) == 1
+        assert "an error happened" in seen[0]
+
+
+class TestSelfTelemetry:
+    def test_internal_stats_loop_back(self):
+        server, observer = setup_server(stats_address="internal")
+        server.handle_metric_packet(b"user.metric:1|c")
+        server.flush()
+        observer.wait_flush()
+        # the first flush emitted self-metrics into the store; flush again
+        server.flush()
+        names = {m.name for m in observer.wait_flush()}
+        assert "flush.total_duration_ns" in names
+        assert "flush.metrics_total" in names
+        server.shutdown()
+
+    def test_flush_emits_self_span(self):
+        from veneur_tpu.sinks.channel import ChannelSpanSink
+        span_sink = ChannelSpanSink()
+        server, observer = setup_server()
+        server.span_sinks.insert(0, span_sink)
+        server.start()
+        try:
+            server.handle_metric_packet(b"m:1|c")
+            server.flush()
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                if any(s.name == "flush" for s in span_sink.spans):
+                    break
+                time.sleep(0.05)
+            else:
+                raise AssertionError("flush span never reached span sinks")
+        finally:
+            server.shutdown()
+
+    def test_stats_address_udp(self):
+        recv = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        recv.bind(("127.0.0.1", 0))
+        recv.settimeout(5.0)
+        port = recv.getsockname()[1]
+        server, observer = setup_server(
+            stats_address=f"127.0.0.1:{port}")
+        server.handle_metric_packet(b"m:1|c")
+        server.flush()
+        data, _ = recv.recvfrom(4096)
+        assert b"|" in data  # statsd-shaped self-metric arrived
+        server.shutdown()
+        recv.close()

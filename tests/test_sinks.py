@@ -1,0 +1,456 @@
+"""Sink tests against local HTTP/UDP fakes — the reference's
+httptest.Server pattern (e.g. sinks/datadog/datadog_test.go:496,
+sinks/cortex/cortex_test.go:764)."""
+
+import gzip
+import json
+import socket
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from veneur_tpu.samplers.metrics import InterMetric, MetricType
+from veneur_tpu.ssf.protos import ssf_pb2
+from veneur_tpu.util import http as vhttp
+
+
+class CapturingHTTPServer:
+    """Records every request (path, headers, body) and returns 200."""
+
+    def __init__(self):
+        outer = self
+        self.requests = []
+        self.event = threading.Event()
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_POST(self):  # noqa: N802
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length)
+                if self.headers.get("Content-Encoding") == "gzip":
+                    body = gzip.decompress(body)
+                outer.requests.append(
+                    (self.path, dict(self.headers), body))
+                outer.event.set()
+                self.send_response(200)
+                self.send_header("Content-Length", "2")
+                self.end_headers()
+                self.wfile.write(b"{}")
+
+            do_GET = do_POST  # noqa: N815
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+
+    @property
+    def url(self):
+        host, port = self.httpd.server_address
+        return f"http://{host}:{port}"
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+@pytest.fixture
+def fake():
+    server = CapturingHTTPServer()
+    yield server
+    server.close()
+
+
+def im(name="a.b.c", value=1.0, mtype=MetricType.COUNTER, tags=(),
+       ts=1_700_000_000, hostname="h1", message=""):
+    return InterMetric(name=name, timestamp=ts, value=value,
+                       tags=list(tags), type=mtype, message=message,
+                       hostname=hostname)
+
+
+def make_span(trace_id=1, span_id=2, parent_id=0, name="op",
+              service="svc", error=False, indicator=False, tags=None):
+    s = ssf_pb2.SSFSpan()
+    s.trace_id = trace_id
+    s.id = span_id
+    s.parent_id = parent_id
+    s.name = name
+    s.service = service
+    s.error = error
+    s.indicator = indicator
+    s.start_timestamp = 1_700_000_000_000_000_000
+    s.end_timestamp = 1_700_000_001_000_000_000
+    for k, v in (tags or {}).items():
+        s.tags[k] = v
+    return s
+
+
+class TestDatadog:
+    def _sink(self, fake, **kw):
+        from veneur_tpu.sinks.datadog import DatadogMetricSink
+        return DatadogMetricSink("datadog", api_key="k", api_url=fake.url,
+                                 hostname="dh", interval=10.0, **kw)
+
+    def test_counter_rate_conversion_and_tags(self, fake):
+        sink = self._sink(fake)
+        sink.flush([im(value=50.0, tags=["a:b", "host:other", "device:sda"]),
+                    im("g1", 7.0, MetricType.GAUGE)])
+        path, _, body = fake.requests[0]
+        assert path.startswith("/api/v1/series")
+        assert "api_key=k" in path
+        series = json.loads(body)["series"]
+        counter = next(s for s in series if s["metric"] == "a.b.c")
+        assert counter["type"] == "rate"
+        assert counter["points"][0][1] == pytest.approx(5.0)  # 50/10s
+        assert counter["host"] == "other"
+        assert counter["device"] == "sda"
+        assert "a:b" in counter["tags"]
+        assert not any(t.startswith("host:") for t in counter["tags"])
+        gauge = next(s for s in series if s["metric"] == "g1")
+        assert gauge["type"] == "gauge"
+        assert gauge["points"][0][1] == 7.0
+
+    def test_chunking(self, fake):
+        sink = self._sink(fake, flush_max_per_body=2)
+        sink.flush([im(f"m{i}") for i in range(5)])
+        assert len(fake.requests) == 3
+        total = sum(len(json.loads(b)["series"]) for _, _, b in fake.requests)
+        assert total == 5
+
+    def test_service_checks(self, fake):
+        sink = self._sink(fake)
+        sink.flush([im("check.up", 2.0, MetricType.STATUS,
+                       message="oh no")])
+        path, _, body = fake.requests[0]
+        assert path.startswith("/api/v1/check_run")
+        payload = json.loads(body)
+        assert payload["check"] == "check.up"
+        assert payload["status"] == 2
+        assert payload["message"] == "oh no"
+
+    def test_events(self, fake):
+        from veneur_tpu.samplers.parser import Event
+        sink = self._sink(fake)
+        sink.flush_other_samples([Event(
+            name="deploy", message="v2 shipped", timestamp=123,
+            tags={"alert_type": "warning", "env": "prod"})])
+        path, _, body = fake.requests[0]
+        assert path.startswith("/intake")
+        events = json.loads(body)["events"]["datadog"]
+        assert events[0]["title"] == "deploy"
+        assert events[0]["alert_type"] == "warning"
+        assert "env:prod" in events[0]["tags"]
+
+    def test_span_sink(self, fake):
+        from veneur_tpu.sinks.datadog import DatadogSpanSink
+        sink = DatadogSpanSink("datadog", trace_api_url=fake.url,
+                               hostname="dh")
+        sink.ingest(make_span(trace_id=5, span_id=6,
+                              tags={"resource": "GET /"}))
+        sink.ingest(make_span(trace_id=5, span_id=7, parent_id=6))
+        sink.ingest(make_span(trace_id=0))  # no trace id -> dropped
+        sink.flush()
+        _, _, body = fake.requests[0]
+        traces = json.loads(body)
+        assert len(traces) == 1
+        assert len(traces[0]) == 2
+        assert traces[0][0]["resource"] == "GET /"
+        # second flush with nothing buffered: no POST
+        sink.flush()
+        assert len(fake.requests) == 1
+
+
+class TestCortex:
+    def test_remote_write_roundtrip(self, fake):
+        from veneur_tpu.sinks.cortex import (
+            CortexMetricSink, decode_write_request)
+        sink = CortexMetricSink("cortex", url=fake.url, hostname="ch",
+                                auth_token="tok")
+        sink.flush([im("http.requests", 3.5, MetricType.GAUGE,
+                       tags=["region:us", "bad-label:x"])])
+        _, headers, body = fake.requests[0]
+        assert headers["Content-Encoding"] == "snappy"
+        assert headers["X-Prometheus-Remote-Write-Version"] == "0.1.0"
+        assert headers["Authorization"] == "Bearer tok"
+        series = decode_write_request(vhttp.snappy_decode(body))
+        labels, value, ts = series[0]
+        assert labels["__name__"] == "http.requests".replace(".", "_") \
+            or labels["__name__"] == "http.requests"
+        assert labels["region"] == "us"
+        assert labels["bad_label"] == "x"
+        assert labels["host"] == "h1"  # metric hostname wins
+        assert value == 3.5
+        assert ts == 1_700_000_000_000
+
+    def test_name_sanitization(self):
+        from veneur_tpu.sinks.cortex import sanitize_label, sanitize_name
+        assert sanitize_name("a.b-c/d") == "a_b_c_d"
+        assert sanitize_name("9lives") == "_9lives"
+        assert sanitize_name("ok:name_1") == "ok:name_1"
+        assert sanitize_label("a:b") == "a_b"
+
+    def test_batching(self, fake):
+        from veneur_tpu.sinks.cortex import CortexMetricSink
+        sink = CortexMetricSink("cortex", url=fake.url, hostname="ch",
+                                batch_write_size=2)
+        sink.flush([im(f"m{i}", i, MetricType.GAUGE) for i in range(5)])
+        assert len(fake.requests) == 3
+
+
+class TestPrometheus:
+    def test_exposition(self):
+        from veneur_tpu.sinks.prometheus import render_exposition
+        text = render_exposition([
+            im("req.count", 5, MetricType.COUNTER, tags=["code:200"]),
+            im("check", 0, MetricType.STATUS)])
+        assert 'req_count{code="200"} 5' in text
+        assert "check" not in text
+
+    def test_expose_endpoint_and_repeater(self):
+        from veneur_tpu.sinks.prometheus import PrometheusMetricSink
+        recv = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        recv.bind(("127.0.0.1", 0))
+        recv.settimeout(5.0)
+        port = recv.getsockname()[1]
+        sink = PrometheusMetricSink(
+            "prometheus", repeater_address=f"127.0.0.1:{port}",
+            expose_address="127.0.0.1:0")
+        sink.start(None)
+        try:
+            sink.flush([im("up", 1, MetricType.GAUGE, tags=["a:b"])])
+            data, _ = recv.recvfrom(65536)
+            assert data == b"up:1|g|#a:b"
+            status, body = vhttp.get(
+                f"http://127.0.0.1:{sink.expose_port}/metrics")
+            assert status == 200
+            assert b"up{" in body
+        finally:
+            sink.stop()
+            recv.close()
+
+
+class TestSignalFx:
+    def test_datapoints_and_token_routing(self, fake):
+        from veneur_tpu.sinks.signalfx import SignalFxMetricSink
+        sink = SignalFxMetricSink(
+            "signalfx", api_key="default-tok", endpoint=fake.url,
+            hostname="sh", vary_key_by="customer",
+            per_tag_tokens={"acme": "acme-tok"})
+        sink.flush([
+            im("c1", 2, MetricType.COUNTER, tags=["customer:acme"]),
+            im("g1", 3, MetricType.GAUGE)])
+        assert len(fake.requests) == 2
+        # urllib normalizes header casing; match case-insensitively
+        by_token = {
+            next(v for k, v in h.items() if k.lower() == "x-sf-token"):
+            json.loads(b) for _, h, b in fake.requests}
+        assert by_token["acme-tok"]["counter"][0]["metric"] == "c1"
+        assert by_token["acme-tok"]["counter"][0]["dimensions"][
+            "customer"] == "acme"
+        assert by_token["default-tok"]["gauge"][0]["metric"] == "g1"
+        assert by_token["default-tok"]["gauge"][0]["dimensions"][
+            "host"] == "h1"  # metric hostname wins over sink hostname
+
+
+class TestKafka:
+    def test_metric_sink(self):
+        from veneur_tpu.sinks.kafka import InMemoryProducer, KafkaMetricSink
+        producer = InMemoryProducer()
+        sink = KafkaMetricSink("kafka", producer, metric_topic="metrics")
+        sink.flush([im("k1", 9, tags=["x:y"])])
+        topic, key, value = producer.messages[0]
+        assert topic == "metrics"
+        assert key == b"k1"
+        decoded = json.loads(value)
+        assert decoded["value"] == 9
+        assert decoded["tags"] == ["x:y"]
+
+    def test_span_sink_sampling(self):
+        from veneur_tpu.sinks.kafka import InMemoryProducer, KafkaSpanSink
+        producer = InMemoryProducer()
+        sink = KafkaSpanSink("kafka", producer, span_topic="spans",
+                             encoding="json", sample_rate_percent=50.0)
+        for tid in range(1, 101):
+            sink.ingest(make_span(trace_id=tid))
+        sink.flush()
+        kept = len(producer.messages)
+        assert 0 < kept < 100  # deterministic by trace id, roughly half
+        # identical ingest keeps/drops the same traces
+        decoded = json.loads(producer.messages[0][2])
+        assert "trace_id" in decoded
+
+    def test_span_protobuf_encoding(self):
+        from veneur_tpu.sinks.kafka import InMemoryProducer, KafkaSpanSink
+        producer = InMemoryProducer()
+        sink = KafkaSpanSink("kafka", producer, span_topic="spans")
+        sink.ingest(make_span(trace_id=42))
+        parsed = ssf_pb2.SSFSpan()
+        parsed.ParseFromString(producer.messages[0][2])
+        assert parsed.trace_id == 42
+
+
+class TestS3:
+    def test_tsv_upload(self):
+        from veneur_tpu.sinks.s3 import InMemoryUploader, S3MetricSink
+        uploader = InMemoryUploader()
+        sink = S3MetricSink("s3", uploader, bucket="b", hostname="s3h",
+                            interval=10.0)
+        sink.flush([im("s.m", 4.5, MetricType.GAUGE, tags=["t:1"])])
+        bucket, key, body = uploader.objects[0]
+        assert bucket == "b"
+        assert key.startswith("s3h/")
+        row = gzip.decompress(body).decode().strip().split("\t")
+        assert row[0] == "s.m"
+        assert row[1] == "t:1"
+        assert row[2] == "gauge"
+        assert float(row[5]) == 4.5
+
+
+class TestCloudWatch:
+    def test_put_metric_data(self, fake):
+        from veneur_tpu.sinks.cloudwatch import CloudWatchMetricSink
+        sink = CloudWatchMetricSink("cloudwatch", endpoint=fake.url + "/",
+                                    namespace="ns")
+        sink.flush([im("cw.m", 2.5, MetricType.GAUGE, tags=["az:us-1a"])])
+        _, _, body = fake.requests[0]
+        params = dict(urllib.parse.parse_qsl(body.decode()))
+        assert params["Action"] == "PutMetricData"
+        assert params["Namespace"] == "ns"
+        assert params["MetricData.member.1.MetricName"] == "cw.m"
+        assert float(params["MetricData.member.1.Value"]) == 2.5
+        assert params["MetricData.member.1.Dimensions.member.1.Name"] == "az"
+
+    def test_chunking_and_signing(self, fake):
+        from veneur_tpu.sinks.cloudwatch import CloudWatchMetricSink
+        sink = CloudWatchMetricSink(
+            "cloudwatch", endpoint=fake.url + "/", namespace="ns",
+            region="us-east-1", credentials=("AKID", "SECRET"))
+        sink.flush([im(f"m{i}") for i in range(25)])
+        assert len(fake.requests) == 2
+        _, headers, _ = fake.requests[0]
+        assert headers["Authorization"].startswith(
+            "AWS4-HMAC-SHA256 Credential=AKID/")
+        assert "X-Amz-Date" in headers
+
+
+class TestSplunk:
+    def test_hec_events(self, fake):
+        from veneur_tpu.sinks.splunk import SplunkSpanSink
+        sink = SplunkSpanSink("splunk", hec_address=fake.url, token="tok",
+                              hostname="sph", index="idx")
+        sink.ingest(make_span(trace_id=10, tags={"k": "v"}))
+        sink.ingest(make_span(trace_id=11, error=True))
+        sink.flush()
+        _, headers, body = fake.requests[0]
+        assert headers["Authorization"] == "Splunk tok"
+        events = [json.loads(line) for line in body.splitlines()]
+        assert len(events) == 2
+        assert events[0]["index"] == "idx"
+        assert events[0]["event"]["tags"] == {"k": "v"}
+        assert events[1]["event"]["error"] is True
+
+    def test_sampling_keeps_indicators(self, fake):
+        from veneur_tpu.sinks.splunk import SplunkSpanSink
+        sink = SplunkSpanSink("splunk", hec_address=fake.url, token="t",
+                              hostname="h", sample_rate=10)
+        for tid in range(1, 101):
+            sink.ingest(make_span(trace_id=tid))
+        sink.ingest(make_span(trace_id=7, indicator=True))
+        sink.flush()
+        _, _, body = fake.requests[0]
+        events = [json.loads(line) for line in body.splitlines()]
+        # 10 sampled (trace_id % 10 == 0) + 1 indicator
+        assert len(events) == 11
+
+
+class TestXRay:
+    def test_segments_over_udp(self):
+        from veneur_tpu.sinks.xray import XRaySpanSink
+        recv = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        recv.bind(("127.0.0.1", 0))
+        recv.settimeout(5.0)
+        port = recv.getsockname()[1]
+        sink = XRaySpanSink("xray", daemon_address=f"127.0.0.1:{port}",
+                            annotation_tags=["env"])
+        sink.start(None)
+        try:
+            sink.ingest(make_span(trace_id=99, span_id=100, parent_id=1,
+                                  tags={"env": "prod", "other": "x"}))
+            data, _ = recv.recvfrom(65536)
+            header, payload = data.split(b"\n", 1)
+            assert json.loads(header)["format"] == "json"
+            seg = json.loads(payload)
+            assert seg["trace_id"].startswith("1-")
+            assert seg["annotations"] == {"env": "prod"}
+            assert seg["type"] == "subsegment"
+            assert sink.spans_handled == 1
+        finally:
+            sink.stop()
+            recv.close()
+
+
+class TestFalconerLightstepNewrelic:
+    def test_falconer_sender(self):
+        from veneur_tpu.sinks.falconer import FalconerSpanSink
+        sent = []
+        sink = FalconerSpanSink("falconer", sender=sent.append)
+        sink.ingest(make_span(trace_id=3))
+        assert sink.spans_handled == 1
+        assert sent[0].trace_id == 3
+
+    def test_lightstep(self, fake):
+        from veneur_tpu.sinks.lightstep import LightStepSpanSink
+        sink = LightStepSpanSink("lightstep", access_token="at",
+                                 collector_url=fake.url, num_clients=2)
+        sink.ingest(make_span(trace_id=1))
+        sink.ingest(make_span(trace_id=2))
+        sink.flush()
+        assert len(fake.requests) == 2
+        _, _, body = fake.requests[0]
+        payload = json.loads(body)
+        assert payload["auth"]["access_token"] == "at"
+        assert len(payload["span_records"]) == 1
+
+    def test_newrelic_metrics(self, fake):
+        from veneur_tpu.sinks.newrelic import NewRelicMetricSink
+        sink = NewRelicMetricSink(
+            "newrelic", insert_key="ik", hostname="nh", interval=10.0,
+            metric_url=fake.url + "/metric/v1")
+        sink.flush([im("nr.c", 5, MetricType.COUNTER),
+                    im("nr.g", 6, MetricType.GAUGE)])
+        _, headers, body = fake.requests[0]
+        assert headers["Api-Key"] == "ik"
+        metrics = json.loads(body)[0]["metrics"]
+        count = next(m for m in metrics if m["name"] == "nr.c")
+        assert count["type"] == "count"
+        assert count["interval.ms"] == 10_000
+        gauge = next(m for m in metrics if m["name"] == "nr.g")
+        assert gauge["type"] == "gauge"
+
+    def test_newrelic_spans(self, fake):
+        from veneur_tpu.sinks.newrelic import NewRelicSpanSink
+        sink = NewRelicSpanSink("newrelic", insert_key="ik",
+                                trace_url=fake.url + "/trace/v1")
+        sink.ingest(make_span(trace_id=8, span_id=9, parent_id=4))
+        sink.flush()
+        _, _, body = fake.requests[0]
+        spans = json.loads(body)[0]["spans"]
+        assert spans[0]["trace.id"] == "8"
+        assert spans[0]["attributes"]["parent.id"] == "4"
+        assert spans[0]["attributes"]["duration.ms"] == pytest.approx(1000.0)
+
+
+class TestRegistry:
+    def test_all_kinds_registered(self):
+        from veneur_tpu import sinks as sinks_mod
+        sinks_mod.register_builtin_sinks()
+        for kind in ("datadog", "signalfx", "cortex", "kafka", "s3",
+                     "cloudwatch", "prometheus", "newrelic", "blackhole",
+                     "debug", "localfile", "channel"):
+            assert kind in sinks_mod.MetricSinkTypes, kind
+        for kind in ("datadog", "kafka", "splunk", "xray", "falconer",
+                     "lightstep", "newrelic"):
+            assert kind in sinks_mod.SpanSinkTypes, kind

@@ -1,0 +1,189 @@
+"""OpenMetrics source tests (reference sources/openmetrics tests):
+scrape a fake /metrics endpoint, check conversion + counter deltas."""
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from veneur_tpu.samplers import metrics as m
+from veneur_tpu.sources.openmetrics import OpenMetricsSource, parse_exposition
+
+EXPOSITION_1 = """\
+# HELP http_requests_total Total requests.
+# TYPE http_requests_total counter
+http_requests_total{code="200"} 100
+http_requests_total{code="500"} 5
+# TYPE temperature gauge
+temperature{room="a"} 21.5
+# TYPE rpc_duration_seconds summary
+rpc_duration_seconds{quantile="0.5"} 0.05
+rpc_duration_seconds_sum 17.5
+rpc_duration_seconds_count 200
+# TYPE request_size histogram
+request_size_bucket{le="100"} 30
+request_size_bucket{le="+Inf"} 40
+request_size_sum 3200
+request_size_count 40
+untyped_thing 7
+"""
+
+EXPOSITION_2 = EXPOSITION_1.replace(
+    'http_requests_total{code="200"} 100',
+    'http_requests_total{code="200"} 130').replace(
+    'http_requests_total{code="500"} 5',
+    'http_requests_total{code="500"} 2')  # reset
+
+
+class FakePrometheus:
+    def __init__(self):
+        outer = self
+        self.body = EXPOSITION_1
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_GET(self):  # noqa: N802
+                data = outer.body.encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+
+    @property
+    def url(self):
+        host, port = self.httpd.server_address
+        return f"http://{host}:{port}/metrics"
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+class CollectingIngest:
+    def __init__(self):
+        self.metrics = []
+
+    def ingest_metric(self, metric):
+        self.metrics.append(metric)
+
+    def by_name(self):
+        out = {}
+        for metric in self.metrics:
+            out.setdefault(metric.name, []).append(metric)
+        return out
+
+
+@pytest.fixture
+def fake_prom():
+    server = FakePrometheus()
+    yield server
+    server.close()
+
+
+class TestParseExposition:
+    def test_families(self):
+        rows = list(parse_exposition(EXPOSITION_1))
+        types = {name: ftype for ftype, name, _, _ in rows}
+        assert types["http_requests_total"] == "counter"
+        assert types["temperature"] == "gauge"
+        assert types["rpc_duration_seconds_sum"] == "summary"
+        assert types["request_size_bucket"] == "histogram"
+        assert types["untyped_thing"] == "untyped"
+        labeled = next(r for r in rows if r[1] == "temperature")
+        assert labeled[2] == {"room": "a"}
+        assert labeled[3] == 21.5
+
+    def test_escaped_labels(self):
+        rows = list(parse_exposition(
+            '# TYPE x gauge\nx{msg="say \\"hi\\" now"} 1\n'))
+        assert rows[0][2]["msg"] == 'say "hi" now'
+
+
+class TestOpenMetricsSource:
+    def test_counter_delta_and_conversion(self, fake_prom):
+        src = OpenMetricsSource("om", url=fake_prom.url, scrape_interval=60)
+        ingest = CollectingIngest()
+
+        # first scrape: counters prime the cache, gauges emit immediately
+        src.scrape_once(ingest)
+        got = ingest.by_name()
+        assert "http_requests_total" not in got
+        assert got["temperature"][0].value == 21.5
+        assert got["temperature"][0].type == m.GAUGE
+        assert "room:a" in got["temperature"][0].tags
+        assert got["untyped_thing"][0].type == m.GAUGE
+        # summary: quantile + sum as gauges; count primes
+        assert got["rpc_duration_seconds"][0].value == 0.05
+        assert got["rpc_duration_seconds_sum"][0].value == 17.5
+        assert "rpc_duration_seconds_count" not in got
+
+        # second scrape: counter deltas (and reset handling)
+        fake_prom.body = EXPOSITION_2
+        ingest2 = CollectingIngest()
+        src.scrape_once(ingest2)
+        got2 = ingest2.by_name()
+        deltas = {tuple(mm.tags): mm.value
+                  for mm in got2["http_requests_total"]}
+        assert deltas[("code:200",)] == 30.0
+        assert deltas[("code:500",)] == 2.0  # reset -> new value
+        assert got2["http_requests_total"][0].type == m.COUNTER
+        # unchanged bucket counters emit zero deltas
+        buckets = {tuple(mm.tags): mm.value
+                   for mm in got2["request_size_bucket"]}
+        assert buckets[("le:100",)] == 0.0
+
+    def test_allow_deny(self, fake_prom):
+        src = OpenMetricsSource("om", url=fake_prom.url, scrape_interval=60,
+                                denylist="^rpc_")
+        ingest = CollectingIngest()
+        src.scrape_once(ingest)
+        assert not any(n.startswith("rpc_") for n in ingest.by_name())
+
+        src2 = OpenMetricsSource("om", url=fake_prom.url, scrape_interval=60,
+                                 allowlist="temperature")
+        ingest2 = CollectingIngest()
+        src2.scrape_once(ingest2)
+        assert set(ingest2.by_name()) == {"temperature"}
+
+    def test_extra_tags_and_digest(self, fake_prom):
+        src = OpenMetricsSource("om", url=fake_prom.url, scrape_interval=60,
+                                tags=["src:om"])
+        ingest = CollectingIngest()
+        src.scrape_once(ingest)
+        temp = ingest.by_name()["temperature"][0]
+        assert "src:om" in temp.tags
+        assert temp.digest != 0
+        assert temp.key.joined_tags == ",".join(sorted(["room:a", "src:om"]))
+
+    def test_server_integration(self, fake_prom):
+        from veneur_tpu.config import SourceConfig
+        from test_server import generate_config, setup_server
+        cfg = generate_config()
+        cfg.sources = [SourceConfig(
+            kind="openmetrics", name="om",
+            config={"url": fake_prom.url, "scrape_interval": "0.05s"})]
+        server, observer = setup_server(cfg)
+        server.start()
+        try:
+            import time
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                time.sleep(0.1)
+                server.flush()
+                try:
+                    flushed = observer.wait_flush(timeout=0.5)
+                except Exception:
+                    continue
+                names = {mm.name for mm in flushed}
+                if "temperature" in names:
+                    break
+            else:
+                raise AssertionError("scraped gauge never flushed")
+        finally:
+            server.shutdown()

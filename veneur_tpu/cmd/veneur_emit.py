@@ -1,0 +1,265 @@
+"""veneur-emit: emit metrics/events/service-checks/spans to a veneur.
+
+Parity with reference cmd/veneur-emit/main.go (969 LoC): emit one
+metric via DogStatsD UDP/TCP (`-hostport`), SSF (`-ssf -mode ssf`), or
+gRPC forward; `-command` runs a subprocess and emits its wall time as a
+timer with a `status` tag, propagating the exit code (main.go:169,
+createMetric:594). Event (`-e_*`) and service-check (`-sc_*`) packets
+mirror the DogStatsD grammar the parser accepts (main.go:856/921).
+
+Extra (this framework's benchmark driver): `-pps N -duration S` replays
+the rendered packet at a target rate, reporting achieved throughput.
+
+Run: python -m veneur_tpu.cmd.veneur_emit -hostport udp://127.0.0.1:8126 \
+        -name a.b.c -count 3 -tag foo:bar
+"""
+
+from __future__ import annotations
+
+import argparse
+import socket
+import subprocess
+import sys
+import time
+from typing import List, Optional, Tuple
+
+
+def parse_hostport(hostport: str, default_scheme: str = "udp"
+                   ) -> Tuple[str, str, int]:
+    scheme = default_scheme
+    rest = hostport
+    if "://" in hostport:
+        scheme, rest = hostport.split("://", 1)
+    host, _, port = rest.rpartition(":")
+    return scheme, host or "127.0.0.1", int(port)
+
+
+def render_metric_packet(name: str, value, mtype: str,
+                         tags: List[str], rate: float = 1.0) -> bytes:
+    parts = [f"{name}:{value}|{mtype}"]
+    if rate != 1.0:
+        parts.append(f"@{rate}")
+    if tags:
+        parts.append("#" + ",".join(tags))
+    return "|".join(parts).encode()
+
+
+def render_event_packet(title: str, text: str, tags: List[str],
+                        aggregation_key: str = "", priority: str = "",
+                        source_type: str = "", alert_type: str = "",
+                        hostname: str = "") -> bytes:
+    header = f"_e{{{len(title.encode())},{len(text.encode())}}}:{title}|{text}"
+    sections = []
+    if aggregation_key:
+        sections.append(f"k:{aggregation_key}")
+    if priority:
+        sections.append(f"p:{priority}")
+    if source_type:
+        sections.append(f"s:{source_type}")
+    if alert_type:
+        sections.append(f"t:{alert_type}")
+    if hostname:
+        sections.append(f"h:{hostname}")
+    if tags:
+        sections.append("#" + ",".join(tags))
+    return ("|".join([header] + sections)).encode()
+
+
+def render_service_check_packet(name: str, status: int, tags: List[str],
+                                message: str = "",
+                                hostname: str = "") -> bytes:
+    parts = [f"_sc|{name}|{status}"]
+    if hostname:
+        parts.append(f"h:{hostname}")
+    if tags:
+        parts.append("#" + ",".join(tags))
+    if message:
+        parts.append(f"m:{message}")
+    return "|".join(parts).encode()
+
+
+def send_packet(hostport: str, packet: bytes) -> None:
+    scheme, host, port = parse_hostport(hostport)
+    if scheme == "tcp":
+        with socket.create_connection((host, port), timeout=5.0) as s:
+            s.sendall(packet + b"\n")
+    else:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            s.sendto(packet, (host, port))
+        finally:
+            s.close()
+
+
+def send_span(hostport: str, name: str, service: str, tags: List[str],
+              duration_s: float, error: bool, metrics=()) -> None:
+    """Send one SSF span (UDP datagram, unframed)."""
+    from veneur_tpu.ssf.protos import ssf_pb2
+    scheme, host, port = parse_hostport(hostport)
+    now_ns = time.time_ns()
+    span = ssf_pb2.SSFSpan()
+    span.id = now_ns & 0x7FFFFFFF
+    span.trace_id = span.id
+    span.name = name
+    span.service = service
+    span.start_timestamp = now_ns - int(duration_s * 1e9)
+    span.end_timestamp = now_ns
+    span.error = error
+    for t in tags:
+        k, _, v = t.partition(":")
+        span.tags[k] = v
+    for sample in metrics:
+        span.metrics.append(sample)
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.sendto(span.SerializeToString(), (host, port))
+    finally:
+        s.close()
+
+
+def send_grpc(target: str, name: str, value: float, mtype: str,
+              tags: List[str]) -> None:
+    """Emit one metric over the gRPC forward plane (mode grpc)."""
+    from veneur_tpu.forward.client import ForwardClient
+    from veneur_tpu.forward.protos import metric_pb2
+    pbm = metric_pb2.Metric()
+    pbm.name = name
+    pbm.tags.extend(tags)
+    pbm.scope = metric_pb2.GLOBAL_ONLY
+    if mtype == "gauge":
+        pbm.type = metric_pb2.GAUGE
+        pbm.gauge.value = value
+    else:
+        pbm.type = metric_pb2.COUNTER
+        pbm.counter.value = int(value)
+    client = ForwardClient(target)
+    try:
+        client.send_protos([pbm])
+    finally:
+        client.close()
+
+
+def replay(hostport: str, packet: bytes, pps: float,
+           duration: float) -> Tuple[int, float]:
+    """Blast `packet` at ~pps for `duration` seconds (load driver)."""
+    scheme, host, port = parse_hostport(hostport)
+    assert scheme == "udp", "replay supports udp only"
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    sent = 0
+    start = time.perf_counter()
+    end = start + duration
+    batch = max(1, int(pps // 100))  # pace in 10ms slices
+    try:
+        while True:
+            now = time.perf_counter()
+            if now >= end:
+                break
+            target_sent = (now - start) * pps
+            if sent > target_sent:
+                time.sleep(min(0.01, (sent - target_sent) / pps))
+                continue
+            for _ in range(batch):
+                s.sendto(packet, (host, port))
+            sent += batch
+    finally:
+        s.close()
+    elapsed = time.perf_counter() - start
+    return sent, sent / elapsed
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="veneur-emit")
+    ap.add_argument("-hostport", default="udp://127.0.0.1:8126")
+    ap.add_argument("-mode", choices=["metric", "event", "sc", "span"],
+                    default="metric")
+    ap.add_argument("-name", default="")
+    ap.add_argument("-count", type=float, default=None)
+    ap.add_argument("-gauge", type=float, default=None)
+    ap.add_argument("-timing", default=None,
+                    help="duration, e.g. 30ms")
+    ap.add_argument("-set", dest="set_value", default=None)
+    ap.add_argument("-rate", type=float, default=1.0)
+    ap.add_argument("-tag", action="append", default=[])
+    ap.add_argument("-grpc", action="store_true",
+                    help="emit over the gRPC forward plane")
+    ap.add_argument("-command", nargs=argparse.REMAINDER, default=None,
+                    help="run a command; emit its wall time as a timer")
+    # events
+    ap.add_argument("-e_title", default="")
+    ap.add_argument("-e_text", default="")
+    ap.add_argument("-e_aggregation_key", default="")
+    ap.add_argument("-e_priority", default="")
+    ap.add_argument("-e_source_type", default="")
+    ap.add_argument("-e_alert_type", default="")
+    ap.add_argument("-e_hostname", default="")
+    # service checks
+    ap.add_argument("-sc_name", default="")
+    ap.add_argument("-sc_status", type=int, default=0)
+    ap.add_argument("-sc_msg", default="")
+    # span mode
+    ap.add_argument("-span_service", default="veneur-emit")
+    ap.add_argument("-span_error", action="store_true")
+    ap.add_argument("-span_duration", type=float, default=0.0)
+    # load driver
+    ap.add_argument("-pps", type=float, default=0.0)
+    ap.add_argument("-duration", type=float, default=10.0)
+    args = ap.parse_args(argv)
+
+    if args.command is not None:
+        start = time.perf_counter()
+        proc = subprocess.run(args.command)
+        elapsed_ms = (time.perf_counter() - start) * 1000
+        status = "0" if proc.returncode == 0 else str(proc.returncode)
+        packet = render_metric_packet(
+            args.name or "veneur_emit.command", f"{elapsed_ms:.3f}", "ms",
+            args.tag + [f"status:{status}"])
+        send_packet(args.hostport, packet)
+        return proc.returncode
+
+    if args.mode == "event":
+        send_packet(args.hostport, render_event_packet(
+            args.e_title, args.e_text, args.tag,
+            args.e_aggregation_key, args.e_priority,
+            args.e_source_type, args.e_alert_type, args.e_hostname))
+        return 0
+    if args.mode == "sc":
+        send_packet(args.hostport, render_service_check_packet(
+            args.sc_name, args.sc_status, args.tag, args.sc_msg))
+        return 0
+    if args.mode == "span":
+        send_span(args.hostport, args.name or "veneur_emit.span",
+                  args.span_service, args.tag, args.span_duration,
+                  args.span_error)
+        return 0
+
+    if args.count is not None:
+        value, mtype = args.count, "c"
+    elif args.gauge is not None:
+        value, mtype = args.gauge, "g"
+    elif args.timing is not None:
+        from veneur_tpu.config import parse_duration
+        value, mtype = parse_duration(args.timing) * 1000, "ms"
+    elif args.set_value is not None:
+        value, mtype = args.set_value, "s"
+    else:
+        print("need one of -count/-gauge/-timing/-set", file=sys.stderr)
+        return 2
+
+    if args.grpc:
+        send_grpc(args.hostport,
+                  args.name, float(value),
+                  "gauge" if mtype == "g" else "counter", args.tag)
+        return 0
+
+    packet = render_metric_packet(args.name, value, mtype, args.tag,
+                                  args.rate)
+    if args.pps > 0:
+        sent, rate = replay(args.hostport, packet, args.pps, args.duration)
+        print(f"sent {sent} packets at {rate:.0f}/s")
+        return 0
+    send_packet(args.hostport, packet)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,89 @@
+"""veneur-prometheus: poll a Prometheus endpoint, emit DogStatsD.
+
+Parity with reference cmd/veneur-prometheus/main.go:32-70: every
+interval, scrape `-metrics-host`, convert families (counters to deltas,
+gauges as-is — the same conversion as the openmetrics source), and emit
+DogStatsD packets to `-statsd-host`.
+
+Run: python -m veneur_tpu.cmd.veneur_prometheus \
+        -metrics-host http://127.0.0.1:9090/metrics \
+        -statsd-host 127.0.0.1:8126
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import socket
+import sys
+import threading
+
+from veneur_tpu.samplers import metrics as m
+from veneur_tpu.sources.openmetrics import OpenMetricsSource
+
+log = logging.getLogger("veneur-prometheus")
+
+
+class StatsdEmitter:
+    """Ingest boundary that renders each metric back to DogStatsD."""
+
+    def __init__(self, statsd_host: str, prefix: str = ""):
+        host, _, port = statsd_host.rpartition(":")
+        self.addr = (host or "127.0.0.1", int(port))
+        self.prefix = prefix
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self.emitted = 0
+
+    def ingest_metric(self, metric) -> None:
+        kind = {m.COUNTER: "c", m.GAUGE: "g"}.get(metric.type, "g")
+        tag_part = ("|#" + ",".join(metric.tags)) if metric.tags else ""
+        value = metric.value
+        if kind == "c":
+            value = int(value)
+        packet = f"{self.prefix}{metric.name}:{value}|{kind}{tag_part}"
+        try:
+            self.sock.sendto(packet.encode(), self.addr)
+            self.emitted += 1
+        except OSError as e:
+            log.error("statsd send failed: %s", e)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="veneur-prometheus")
+    ap.add_argument("-metrics-host", dest="metrics_host",
+                    default="http://localhost:9090/metrics")
+    ap.add_argument("-statsd-host", dest="statsd_host",
+                    default="127.0.0.1:8126")
+    ap.add_argument("-interval", default="10s")
+    ap.add_argument("-prefix", default="")
+    ap.add_argument("-ignored-labels", dest="ignored", default="",
+                    help="regex of metric names to skip")
+    ap.add_argument("-added-labels", dest="added", default="",
+                    help="comma-separated extra tags")
+    ap.add_argument("-debug", action="store_true")
+    args = ap.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.DEBUG if args.debug else logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s %(message)s")
+
+    from veneur_tpu.config import parse_duration
+    source = OpenMetricsSource(
+        "veneur-prometheus",
+        url=args.metrics_host,
+        scrape_interval=parse_duration(args.interval),
+        tags=[t for t in args.added.split(",") if t],
+        denylist=args.ignored or None)
+    emitter = StatsdEmitter(args.statsd_host, args.prefix)
+
+    stop = threading.Event()
+    try:
+        source.start(emitter)  # blocks; Ctrl-C stops
+    except KeyboardInterrupt:
+        source.stop()
+        stop.set()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,93 @@
+"""veneur-proxy CLI: the standalone consistent-hash forward router.
+
+Parity with reference cmd/veneur-proxy/main.go:29-120: wire a discoverer
+(static destination list or Consul/K8s poller), start the gRPC proxy
+with its discovery-refresh loop, serve a healthcheck HTTP endpoint, and
+block until signaled.
+
+Run: python -m veneur_tpu.cmd.veneur_proxy -f proxy.yaml
+     python -m veneur_tpu.cmd.veneur_proxy -destinations h1:8128,h2:8128
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import sys
+import threading
+
+import yaml
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="veneur-proxy")
+    ap.add_argument("-f", dest="config", default=None,
+                    help="YAML config file")
+    ap.add_argument("-destinations", default="",
+                    help="comma-separated static global veneur addresses")
+    ap.add_argument("-listen", default="0.0.0.0:8128",
+                    help="gRPC listen address")
+    ap.add_argument("-http", default="",
+                    help="healthcheck HTTP address (host:port)")
+    ap.add_argument("-discovery-interval", default="10s")
+    ap.add_argument("-forward-service", default="veneur-global")
+    ap.add_argument("-debug", action="store_true")
+    args = ap.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.DEBUG if args.debug else logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s %(message)s")
+    log = logging.getLogger("veneur-proxy")
+
+    raw = {}
+    if args.config:
+        with open(args.config) as f:
+            raw = yaml.safe_load(f) or {}
+
+    from veneur_tpu.config import parse_duration
+    from veneur_tpu.proxy.discovery import StaticDiscoverer
+    from veneur_tpu.proxy.proxy import ProxyServer
+
+    destinations = [d for d in (
+        raw.get("forward_address", "").split(",")
+        if raw.get("forward_address") else args.destinations.split(","))
+        if d]
+    interval = parse_duration(
+        raw.get("consul_refresh_interval", args.discovery_interval))
+    listen = raw.get("grpc_address", args.listen)
+
+    discoverer = StaticDiscoverer(destinations)
+    proxy = ProxyServer(
+        discoverer,
+        forward_service=args.forward_service,
+        listen_address=listen,
+        discovery_interval=interval)
+    proxy.start()
+    log.info("veneur-proxy listening on %s -> %s", proxy.address,
+             destinations)
+
+    http_api = None
+    http_addr = raw.get("http_address", args.http)
+    if http_addr:
+        from veneur_tpu.core.httpapi import HTTPApi
+        http_api = HTTPApi(raw, server=None, address=http_addr)
+        http_api.start()
+
+    stop = threading.Event()
+
+    def handle_signal(signum, frame):
+        log.info("received signal %d, shutting down", signum)
+        stop.set()
+
+    signal.signal(signal.SIGINT, handle_signal)
+    signal.signal(signal.SIGTERM, handle_signal)
+    stop.wait()
+    proxy.stop()
+    if http_api is not None:
+        http_api.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,71 @@
+"""Runtime diagnostics self-metrics.
+
+Behavioral parity with reference diagnostics/diagnostics_metrics.go:11-40
+(periodic Go memstats -> statsd gauges + uptime counter), translated to
+the Python/JAX runtime: RSS and CPU from `resource`, GC stats from `gc`,
+thread count, uptime, and per-device TPU/accelerator memory from
+`jax.Device.memory_stats()`.
+"""
+
+from __future__ import annotations
+
+import gc
+import threading
+import time
+from typing import Optional
+
+from veneur_tpu.util.scopedstatsd import ScopedClient
+
+
+def collect(stats: ScopedClient, start_time: float,
+            include_device: bool = True) -> None:
+    """Emit one round of runtime gauges."""
+    import resource
+    ru = resource.getrusage(resource.RUSAGE_SELF)
+    stats.gauge("mem.rss_bytes", ru.ru_maxrss * 1024)
+    stats.gauge("cpu.user_seconds", ru.ru_utime)
+    stats.gauge("cpu.system_seconds", ru.ru_stime)
+    counts = gc.get_count()
+    stats.gauge("gc.gen0_collections", counts[0])
+    stats.gauge("gc.objects_tracked", len(gc.get_objects()))
+    stats.gauge("threads.count", threading.active_count())
+    stats.count("uptime_ms", int((time.time() - start_time) * 1000))
+    if include_device:
+        try:
+            import jax
+            for i, d in enumerate(jax.devices()):
+                ms = d.memory_stats() or {}
+                in_use = ms.get("bytes_in_use")
+                if in_use is not None:
+                    stats.gauge("device.bytes_in_use", in_use,
+                                tags=[f"device:{i}"])
+        except Exception:
+            pass
+
+
+class DiagnosticsLoop:
+    """Emits `collect` every interval on a daemon thread."""
+
+    def __init__(self, stats: ScopedClient, interval: float,
+                 include_device: bool = True):
+        self.stats = stats
+        self.interval = interval
+        self.include_device = include_device
+        self.start_time = time.time()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._loop, name="diagnostics", daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                collect(self.stats, self.start_time, self.include_device)
+            except Exception:
+                pass
+
+    def stop(self) -> None:
+        self._stop.set()

@@ -1,0 +1,147 @@
+"""Operator HTTP API.
+
+Endpoint parity with reference http.go:15-65: /healthcheck, /version,
+/builddate, /config/json, /config/yaml (secrets redacted via
+util.StringSecret), and optional /quitquitquit (config.http_quit).
+Runs a stdlib ThreadingHTTPServer; profiling endpoints are served under
+/debug/ (JAX device memory stats in place of Go pprof heap profiles).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Optional
+
+import yaml
+
+import veneur_tpu
+from veneur_tpu.util.secret import StringSecret
+
+BUILD_DATE = "dev"
+
+
+def config_to_dict(cfg: Any) -> Any:
+    """Recursively serialize the Config dataclass tree, redacting secrets
+    (reference util.StringSecret marshals as REDACTED)."""
+    if isinstance(cfg, StringSecret):
+        return str(cfg)
+    if dataclasses.is_dataclass(cfg) and not isinstance(cfg, type):
+        return {f.name: config_to_dict(getattr(cfg, f.name))
+                for f in dataclasses.fields(cfg)}
+    if isinstance(cfg, dict):
+        return {k: config_to_dict(v) for k, v in cfg.items()}
+    if isinstance(cfg, (list, tuple)):
+        return [config_to_dict(v) for v in cfg]
+    return cfg
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_ref = None  # class attr set per HTTPApi instance subclass
+
+    def log_message(self, fmt, *args):  # silence default stderr access log
+        pass
+
+    def _send(self, status: int, body: bytes,
+              content_type: str = "text/plain") -> None:
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self) -> None:  # noqa: N802
+        api = self.server_ref
+        path = self.path.split("?", 1)[0]
+        if path == "/healthcheck":
+            self._send(200, b"ok\n")
+        elif path == "/healthcheck/ready":
+            ready = api.server is None or api.server.flush_count > 0 \
+                or not api.require_flush_for_ready
+            self._send(200 if ready else 503,
+                       b"ready\n" if ready else b"not ready\n")
+        elif path == "/version":
+            self._send(200, veneur_tpu.__version__.encode())
+        elif path == "/builddate":
+            self._send(200, BUILD_DATE.encode())
+        elif path == "/config/json":
+            body = json.dumps(config_to_dict(api.config), indent=2).encode()
+            self._send(200, body, "application/json")
+        elif path == "/config/yaml":
+            body = yaml.safe_dump(config_to_dict(api.config)).encode()
+            self._send(200, body, "application/x-yaml")
+        elif path == "/debug/memory":
+            self._send(200, _device_memory_report(),
+                       "application/json")
+        elif path == "/debug/threads":
+            import faulthandler
+            import io
+            buf = io.StringIO()
+            faulthandler.dump_traceback(file=buf, all_threads=True)
+            self._send(200, buf.getvalue().encode())
+        else:
+            self._send(404, b"not found\n")
+
+    def do_POST(self) -> None:  # noqa: N802
+        api = self.server_ref
+        path = self.path.split("?", 1)[0]
+        if path == "/quitquitquit" and api.http_quit:
+            self._send(200, b"bye\n")
+            threading.Thread(target=api.quit, daemon=True).start()
+        else:
+            self._send(404, b"not found\n")
+
+
+def _device_memory_report() -> bytes:
+    """JAX stand-in for /debug/pprof/heap: per-device memory stats."""
+    try:
+        import jax
+        stats = []
+        for d in jax.devices():
+            try:
+                ms = d.memory_stats() or {}
+            except Exception:
+                ms = {}
+            stats.append({"device": str(d), "memory_stats": ms})
+        return json.dumps(stats, indent=2, default=str).encode()
+    except Exception as e:
+        return json.dumps({"error": str(e)}).encode()
+
+
+class HTTPApi:
+    """Serves the ops endpoints for a running server (or standalone proxy)."""
+
+    def __init__(self, config, server=None, address: str = "127.0.0.1:0",
+                 http_quit: bool = False, on_quit=None,
+                 require_flush_for_ready: bool = False):
+        self.config = config
+        self.server = server
+        self.http_quit = http_quit
+        self.on_quit = on_quit
+        self.require_flush_for_ready = require_flush_for_ready
+        host, _, port = address.rpartition(":")
+        handler = type("BoundHandler", (_Handler,), {"server_ref": self})
+        self._httpd = ThreadingHTTPServer((host or "127.0.0.1", int(port)),
+                                          handler)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self):
+        return self._httpd.server_address
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="http-api", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    def quit(self) -> None:
+        if self.on_quit is not None:
+            self.on_quit()
+        else:
+            self.stop()

@@ -63,6 +63,16 @@ class Server:
         self._sink_filters = {  # per-sink tag/name filtering config
             sc.name or sc.kind: sc for sc in config.metric_sinks}
 
+        from veneur_tpu import sources as sources_mod
+        sources_mod.register_builtin_sources()
+        self.sources: List = []
+        for src_cfg in config.sources:
+            factory = sources_mod.SourceTypes.get(src_cfg.kind)
+            if factory is None:
+                raise ValueError(f"unknown source kind: {src_cfg.kind}")
+            self.sources.append(factory(src_cfg, config))
+        self._source_threads: List[threading.Thread] = []
+
         self._routing = None
         if config.features.enable_metric_sink_routing:
             self._routing = [SinkRoutingMatcher(rc)
@@ -90,11 +100,45 @@ class Server:
         self.forward_client = None  # set in start() when forward_address
         self.import_server = None  # set in start() when grpc_address
 
+        # self-metrics: UDP to stats_address, or internal loopback so they
+        # re-enter this server's own pipeline (reference scopedstatsd +
+        # NewChannelClient server.go:518-524)
+        from veneur_tpu.util.scopedstatsd import NullClient, ScopedClient
+        if config.stats_address == "internal":
+            # explicit loopback: self-metrics re-enter this server
+            self.statsd = ScopedClient(
+                packet_cb=self._self_packet,
+                scopes=config.veneur_metrics_scopes,
+                additional_tags=config.veneur_metrics_additional_tags)
+        elif config.stats_address:
+            self.statsd = ScopedClient(
+                address=config.stats_address,
+                scopes=config.veneur_metrics_scopes,
+                additional_tags=config.veneur_metrics_additional_tags)
+        else:
+            self.statsd = NullClient()
+
+        # self-tracing: every flush is a span through the internal channel
+        # client into our own span pipeline (reference flusher.go:27-28)
+        from veneur_tpu import trace as trace_mod
+        self.trace_client = trace_mod.Client(
+            trace_mod.ChannelBackend(self.ingest_span),
+            capacity=config.span_channel_capacity)
+
+        self.diagnostics = None
+        if config.features.diagnostics_metrics_enabled:
+            from veneur_tpu.core.diagnostics import DiagnosticsLoop
+            self.diagnostics = DiagnosticsLoop(self.statsd, config.interval)
+
+        self.http_api = None  # set in start() when http_address
         self._listeners: List[networking.Listener] = []
         self._flush_lock = threading.Lock()
         self._flush_thread: Optional[threading.Thread] = None
         self._watchdog_thread: Optional[threading.Thread] = None
         self._shutdown = threading.Event()
+        # set once shutdown() completes, so a CLI embedding this server
+        # can exit when /quitquitquit triggered the shutdown internally
+        self.shutdown_complete = threading.Event()
         self.last_flush_unix = time.time()
         self.flush_count = 0
         self.stats: Dict[str, float] = {
@@ -137,6 +181,13 @@ class Server:
 
     def ingest_metric(self, metric: UDPMetric) -> None:
         self.store.process(metric)
+
+    def _self_packet(self, packet: bytes) -> None:
+        """Loop a self-metric packet straight back into the parse path."""
+        try:
+            self.parser.parse_metric_fast(packet, self.ingest_metric)
+        except ParseError:
+            pass
 
     # -- spans -----------------------------------------------------------
 
@@ -211,10 +262,23 @@ class Server:
             self.import_server = ImportServer(
                 self, self.config.grpc_address, ignored_tags=ignored)
             self.import_server.start()
+        for source in self.sources:
+            t = threading.Thread(target=source.start, args=(self,),
+                                 name=f"source-{source.name()}", daemon=True)
+            t.start()
+            self._source_threads.append(t)
+        if self.config.http_address:
+            from veneur_tpu.core.httpapi import HTTPApi
+            self.http_api = HTTPApi(
+                self.config, server=self, address=self.config.http_address,
+                http_quit=self.config.http_quit, on_quit=self.shutdown)
+            self.http_api.start()
         # pre-compile the flush kernels off the ticker path so the first
         # real flush isn't delayed by XLA compilation (~20-40s on TPU)
         threading.Thread(target=self._warmup, name="kernel-warmup",
                          daemon=True).start()
+        if self.diagnostics is not None:
+            self.diagnostics.start()
         self._flush_thread = threading.Thread(
             target=self._flush_loop, name="flush-ticker", daemon=True)
         self._flush_thread.start()
@@ -231,6 +295,12 @@ class Server:
 
     def shutdown(self) -> None:
         self._shutdown.set()
+        # stop pull sources first (bound-join) so an in-flight scrape
+        # can't ingest after the final flush below
+        for source in self.sources:
+            source.stop()
+        for t in self._source_threads:
+            t.join(timeout=2.0)
         # sentinels wake idle workers promptly; a full channel is fine —
         # workers also poll the shutdown event every 0.5s
         for _ in self._span_workers:
@@ -247,10 +317,18 @@ class Server:
             listener.close()
         if self.import_server is not None:
             self.import_server.stop()
+        if self.http_api is not None:
+            self.http_api.stop()
+            self.http_api = None
         if self.forward_client is not None:
             self.forward_client.close()
+        if self.diagnostics is not None:
+            self.diagnostics.stop()
+        self.trace_client.close()
+        self.statsd.close()
         for sink in self.metric_sinks + self.span_sinks:
             sink.stop()
+        self.shutdown_complete.set()
 
     # -- flush -----------------------------------------------------------
 
@@ -306,8 +384,12 @@ class Server:
             self._flush_locked()
 
     def _flush_locked(self) -> None:
+        flush_start = time.perf_counter()
         self.last_flush_unix = time.time()
         self.flush_count += 1
+        flush_span = self.trace_client.start_span(
+            "flush", service="veneur-tpu",
+            tags={"mode": "local" if self.is_local else "global"})
 
         with self._other_lock:
             samples, self._other_samples = self._other_samples, []
@@ -355,6 +437,17 @@ class Server:
         # configured, trips the flush watchdog rather than leaking threads
         for t in threads:
             t.join()
+
+        flush_span.finish()
+        duration = time.perf_counter() - flush_start
+        self.statsd.gauge("flush.total_duration_ns", int(duration * 1e9))
+        self.statsd.count("flush.metrics_total", len(final))
+        # cumulative process counters emit as gauges (they never reset)
+        self.statsd.gauge("worker.metrics_processed_total",
+                          int(self.stats["packets_received"]))
+        if self.spans_dropped:
+            self.statsd.gauge("worker.ssf.spans_dropped_total",
+                              self.spans_dropped)
 
     def _forward_safe(self, fwd: ForwardableState) -> None:
         try:

@@ -62,5 +62,12 @@ class ForwardClient:
         logger.debug("forwarded %d metrics to %s", len(protos), self.address)
         return len(protos)
 
+    def send_protos(self, protos) -> int:
+        """Stream pre-built metricpb Metrics (veneur-emit's grpc mode)."""
+        protos = list(protos)
+        if protos:
+            self._send_v2(iter(protos), timeout=self.deadline)
+        return len(protos)
+
     def close(self) -> None:
         self._channel.close()

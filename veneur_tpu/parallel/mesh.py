@@ -19,6 +19,7 @@ Cross-host (DCN) hops between tiers use the gRPC forward plane
 
 from __future__ import annotations
 
+import logging
 from functools import partial
 from typing import Dict, Tuple
 
@@ -29,11 +30,32 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from veneur_tpu.ops import batch_hll, batch_tdigest, scalars
 
+logger = logging.getLogger("veneur_tpu.parallel.mesh")
+
 SHARD_AXIS = "shard"
 
 
 def make_mesh(n_devices: int = 0) -> Mesh:
     devices = jax.devices()
+    if n_devices and len(devices) < n_devices:
+        # the default platform (e.g. a single real TPU chip) is smaller
+        # than requested; fall back to the virtual CPU mesh
+        # (xla_force_host_platform_device_count) for sharding validation
+        try:
+            cpu = jax.devices("cpu")
+            if len(cpu) >= n_devices:
+                logger.warning(
+                    "make_mesh: default platform has %d devices < %d "
+                    "requested; falling back to the virtual CPU mesh "
+                    "(validation only — not a production topology)",
+                    len(devices), n_devices)
+                devices = cpu
+            else:
+                logger.warning(
+                    "make_mesh: only %d devices available, %d requested; "
+                    "building an undersized mesh", len(devices), n_devices)
+        except RuntimeError:
+            pass
     if n_devices:
         devices = devices[:n_devices]
     return Mesh(np.asarray(devices), (SHARD_AXIS,))

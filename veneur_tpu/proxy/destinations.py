@@ -54,7 +54,14 @@ class Destination:
     def send(self, metric: metric_pb2.Metric) -> bool:
         """Non-blocking enqueue first; fall back to a short blocking wait;
         drop if the destination is closed or still saturated (reference
-        handlers.go:100-164 semantics)."""
+        handlers.go:100-164 semantics).
+
+        The blocking fallback intentionally applies backpressure to the
+        caller's stream — matching the reference, where a saturated
+        destination channel stalls that gRPC handler goroutine. One sick
+        destination therefore slows (but doesn't kill) streams whose
+        metrics hash to it; the bound is one flush_interval per metric,
+        after which the metric drops."""
         if self.closed.is_set():
             self.dropped_total += 1
             return False

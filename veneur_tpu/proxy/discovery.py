@@ -51,7 +51,11 @@ class DnsDiscoverer(Discoverer):
         if not host:
             raise ValueError(f"dns discovery needs host:port, got {service!r}")
         infos = socket.getaddrinfo(host, int(port), proto=socket.IPPROTO_TCP)
-        return sorted({f"{info[4][0]}:{port}" for info in infos})
+        # IPv6 literals need brackets to be dialable gRPC targets
+        return sorted({
+            (f"[{info[4][0]}]:{port}" if info[0] == socket.AF_INET6
+             else f"{info[4][0]}:{port}")
+            for info in infos})
 
 
 class HttpJsonDiscoverer(Discoverer):

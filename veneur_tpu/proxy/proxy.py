@@ -46,6 +46,9 @@ class ProxyServer:
             "received_total": 0, "routed_total": 0,
             "no_destination_total": 0, "dropped_total": 0,
         }
+        # handle_metric runs on up to max_workers gRPC threads; python
+        # dict += is not atomic, so counter accuracy needs a lock
+        self._stats_lock = threading.Lock()
         self._shutdown = threading.Event()
         self._discovery_thread: Optional[threading.Thread] = None
 
@@ -65,10 +68,16 @@ class ProxyServer:
         self.port = self._grpc.add_insecure_port(listen_address)
         if self.port == 0:
             raise RuntimeError(f"could not bind proxy to {listen_address}")
+        self._listen_host = listen_address.rpartition(":")[0]
 
     @property
     def address(self) -> str:
-        return f"127.0.0.1:{self.port}"
+        # report the bound host; loopback only for wildcard/empty binds
+        # (those aren't dialable as-is)
+        host = self._listen_host
+        if host in ("", "0.0.0.0", "[::]", "::"):
+            host = "127.0.0.1"
+        return f"{host}:{self.port}"
 
     # -- lifecycle -------------------------------------------------------
 
@@ -128,7 +137,8 @@ class ProxyServer:
     def handle_metric(self, pbm: metric_pb2.Metric) -> None:
         """Route one metric (handlers.go:100-164): hash key is
         name + lowercase type + joined tags minus ignored tags."""
-        self.stats["received_total"] += 1
+        with self._stats_lock:
+            self.stats["received_total"] += 1
         tags = [t for t in pbm.tags
                 if not any(matcher.match(t) for matcher in self._ignore)]
         key = "%s%s%s" % (pbm.name,
@@ -137,12 +147,12 @@ class ProxyServer:
         try:
             dest = self.destinations.get(key)
         except EmptyRingError:
-            self.stats["no_destination_total"] += 1
+            with self._stats_lock:
+                self.stats["no_destination_total"] += 1
             return
-        if dest.send(pbm):
-            self.stats["routed_total"] += 1
-        else:
-            self.stats["dropped_total"] += 1
+        routed = dest.send(pbm)
+        with self._stats_lock:
+            self.stats["routed_total" if routed else "dropped_total"] += 1
 
 
 def create_static_proxy(destination_addresses: List[str],

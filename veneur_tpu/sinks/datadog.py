@@ -1,0 +1,224 @@
+"""Datadog sink: metrics, events, service checks, and APM spans.
+
+Behavioral parity with reference sinks/datadog/datadog.go (660 LoC):
+- InterMetrics serialize to DDMetric JSON; counters convert to Datadog
+  "rate" (value/interval) (datadog.go DDMetric conversion), gauges stay
+  gauges, status checks go to /api/v1/check_run.
+- A flush is chunked across `flush_max_per_body` and POSTed in parallel
+  (reference chunks across num_workers goroutines, datadog.go:182-207).
+- `device:` / `host:` magic tags move into dedicated DDMetric fields.
+- Events (from flush_other_samples) post to the events intake.
+- Spans buffer in a bounded ring (2^14, reference datadog.go spanBuffer)
+  and flush to the APM traces endpoint.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+from typing import Any, Dict, List, Sequence
+
+from veneur_tpu.samplers.metrics import InterMetric, MetricType
+from veneur_tpu.sinks import (
+    MetricSink, SpanSink, register_metric_sink, register_span_sink,
+)
+from veneur_tpu.util import http as vhttp
+
+logger = logging.getLogger("veneur_tpu.sinks.datadog")
+
+DATADOG_SPAN_BUFFER_CAP = 1 << 14  # reference datadog.go datadogSpanBufferSize
+
+
+class DatadogMetricSink(MetricSink):
+    def __init__(self, name: str, api_key: str, api_url: str, hostname: str,
+                 interval: float, flush_max_per_body: int = 25_000,
+                 num_workers: int = 4, tags: Sequence[str] = (),
+                 timeout: float = 10.0):
+        self._name = name
+        self.api_key = api_key
+        self.api_url = api_url.rstrip("/")
+        self.hostname = hostname
+        self.interval = max(interval, 1e-9)
+        self.flush_max_per_body = flush_max_per_body
+        self.num_workers = num_workers
+        self.tags = list(tags)
+        self.timeout = timeout
+
+    def name(self) -> str:
+        return self._name
+
+    def kind(self) -> str:
+        return "datadog"
+
+    # -- serialization ----------------------------------------------------
+
+    def _dd_metric(self, m: InterMetric) -> Dict[str, Any]:
+        tags = list(self.tags)
+        host = m.hostname or self.hostname
+        device = ""
+        for t in m.tags:
+            if t.startswith("host:"):
+                host = t[5:]
+            elif t.startswith("device:"):
+                device = t[7:]
+            else:
+                tags.append(t)
+        if m.type == MetricType.COUNTER:
+            # Datadog rate: counts divide by the flush interval
+            dd_type, value = "rate", m.value / self.interval
+        else:
+            dd_type, value = "gauge", m.value
+        out = {
+            "metric": m.name,
+            "points": [[m.timestamp, value]],
+            "type": dd_type,
+            "host": host,
+            "interval": int(self.interval) or 1,
+            "tags": tags,
+        }
+        if device:
+            out["device"] = device
+        return out
+
+    # -- flush ------------------------------------------------------------
+
+    def flush(self, metrics: List[InterMetric]) -> None:
+        checks = [m for m in metrics if m.type == MetricType.STATUS]
+        series = [self._dd_metric(m) for m in metrics
+                  if m.type != MetricType.STATUS]
+        if series:
+            chunks = [series[i:i + self.flush_max_per_body]
+                      for i in range(0, len(series), self.flush_max_per_body)]
+            threads = [threading.Thread(
+                target=self._post_series_safe, args=(chunk,), daemon=True)
+                for chunk in chunks[1:]]
+            for t in threads:
+                t.start()
+            self._post_series_safe(chunks[0])
+            for t in threads:
+                t.join()
+        for check in checks:
+            self._post_safe("/api/v1/check_run", {
+                "check": check.name,
+                "host_name": check.hostname or self.hostname,
+                "status": int(check.value),
+                "message": check.message,
+                "timestamp": check.timestamp,
+                "tags": list(self.tags) + list(check.tags),
+            })
+
+    def _post_series_safe(self, series: List[dict]) -> None:
+        self._post_safe("/api/v1/series", {"series": series})
+
+    def _post_safe(self, path: str, payload: dict) -> None:
+        url = f"{self.api_url}{path}?api_key={self.api_key}"
+        try:
+            vhttp.post_json(url, payload, compress="gzip",
+                            timeout=self.timeout)
+        except Exception as e:
+            logger.error("datadog POST %s failed: %s", path, e)
+
+    # -- events / service checks -----------------------------------------
+
+    def flush_other_samples(self, samples: Sequence[Any]) -> None:
+        """DogStatsD events -> the nonpublic events intake (reference
+        datadog.go FlushOtherSamples)."""
+        events = []
+        for s in samples:
+            tags = dict(getattr(s, "tags", {}) or {})
+            events.append({
+                "title": getattr(s, "name", ""),
+                "text": getattr(s, "message", ""),
+                "date_happened": getattr(s, "timestamp", 0),
+                "hostname": tags.pop("host", self.hostname),
+                "aggregation_key": tags.pop("aggregation_key", ""),
+                "priority": tags.pop("priority", "normal"),
+                "source_type_name": tags.pop("source_type_name", ""),
+                "alert_type": tags.pop("alert_type", "info"),
+                "tags": [f"{k}:{v}" if v else k for k, v in tags.items()]
+                + list(self.tags),
+            })
+        if events:
+            self._post_safe("/intake", {"events": {self._name: events}})
+
+
+class DatadogSpanSink(SpanSink):
+    """Buffers spans in a bounded ring, flushes Datadog APM traces JSON
+    (reference datadog.go span path)."""
+
+    def __init__(self, name: str, trace_api_url: str, hostname: str,
+                 buffer_size: int = DATADOG_SPAN_BUFFER_CAP,
+                 timeout: float = 10.0):
+        self._name = name
+        self.trace_api_url = trace_api_url.rstrip("/")
+        self.hostname = hostname
+        self.buffer: "collections.deque" = collections.deque(maxlen=buffer_size)
+        self.timeout = timeout
+        self._lock = threading.Lock()
+
+    def name(self) -> str:
+        return self._name
+
+    def kind(self) -> str:
+        return "datadog"
+
+    def ingest(self, span) -> None:
+        if not span.trace_id:
+            return
+        with self._lock:
+            self.buffer.append(span)
+
+    def flush(self) -> None:
+        with self._lock:
+            spans, self.buffer = list(self.buffer), collections.deque(
+                maxlen=self.buffer.maxlen)
+        if not spans:
+            return
+        traces: Dict[int, List[dict]] = {}
+        for s in spans:
+            traces.setdefault(s.trace_id, []).append({
+                "trace_id": s.trace_id,
+                "span_id": s.id,
+                "parent_id": s.parent_id,
+                "service": s.service,
+                "name": s.name,
+                "resource": dict(s.tags).get("resource", s.name),
+                "start": s.start_timestamp,
+                "duration": max(s.end_timestamp - s.start_timestamp, 0),
+                "error": 1 if s.error else 0,
+                "meta": dict(s.tags),
+            })
+        try:
+            vhttp.post_json(f"{self.trace_api_url}/v0.3/traces",
+                            list(traces.values()), compress="gzip",
+                            timeout=self.timeout)
+        except Exception as e:
+            logger.error("datadog trace POST failed: %s", e)
+
+
+@register_metric_sink("datadog")
+def _metric_factory(sink_config, server_config):
+    c = sink_config.config
+    return DatadogMetricSink(
+        sink_config.name or "datadog",
+        api_key=str(c.get("datadog_api_key", "")),
+        api_url=c.get("datadog_api_hostname", "https://app.datadoghq.com"),
+        hostname=server_config.hostname,
+        interval=server_config.interval,
+        flush_max_per_body=int(c.get("datadog_flush_max_per_body", 25_000)),
+        num_workers=int(c.get("datadog_span_buffer_size",
+                              server_config.num_workers) or 4),
+        tags=c.get("tags", []) or [])
+
+
+@register_span_sink("datadog")
+def _span_factory(sink_config, server_config):
+    c = sink_config.config
+    return DatadogSpanSink(
+        sink_config.name or "datadog",
+        trace_api_url=c.get("datadog_trace_api_address",
+                            "http://127.0.0.1:8126"),
+        hostname=server_config.hostname,
+        buffer_size=int(c.get("datadog_span_buffer_size",
+                              DATADOG_SPAN_BUFFER_CAP)))

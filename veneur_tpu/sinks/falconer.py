@@ -1,0 +1,83 @@
+"""Falconer sink: spans streamed to a falconer trace store over gRPC.
+
+Behavioral parity with reference sinks/falconer/falconer.go (193 LoC):
+dial the falconer target and send each ingested span. The reference uses
+falconer's generated client; here the SSFSpan protobuf is sent over a
+unary-per-span grpc channel using a generic method path, with a
+pluggable `sender` boundary so tests can capture spans without a live
+falconer."""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, List, Optional
+
+from veneur_tpu.sinks import SpanSink, register_span_sink
+
+logger = logging.getLogger("veneur_tpu.sinks.falconer")
+
+
+class GrpcSpanSender:
+    """Sends serialized SSFSpans over a grpc channel."""
+
+    METHOD = "/falconer.Falconer/SendSpans"
+
+    def __init__(self, target: str):
+        import grpc
+        self._channel = grpc.insecure_channel(target)
+        self._send = self._channel.unary_unary(
+            self.METHOD,
+            request_serializer=lambda span: span.SerializeToString(),
+            response_deserializer=lambda b: b)
+
+    def __call__(self, span) -> None:
+        self._send(span, timeout=5.0)
+
+    def close(self) -> None:
+        self._channel.close()
+
+
+class FalconerSpanSink(SpanSink):
+    def __init__(self, name: str, target: str = "",
+                 sender: Optional[Callable] = None):
+        self._name = name
+        self.target = target
+        self.sender = sender
+        self.spans_handled = 0
+        self.errors = 0
+
+    def name(self) -> str:
+        return self._name
+
+    def kind(self) -> str:
+        return "falconer"
+
+    def start(self, server) -> None:
+        if self.sender is None and self.target:
+            try:
+                self.sender = GrpcSpanSender(self.target)
+            except Exception as e:
+                logger.error("falconer dial %s failed: %s", self.target, e)
+
+    def ingest(self, span) -> None:
+        if self.sender is None:
+            return
+        try:
+            self.sender(span)
+            self.spans_handled += 1
+        except Exception:
+            self.errors += 1
+
+    def stop(self) -> None:
+        close = getattr(self.sender, "close", None)
+        if close is not None:
+            close()
+
+
+@register_span_sink("falconer")
+def _factory(sink_config, server_config):
+    c = sink_config.config
+    return FalconerSpanSink(
+        sink_config.name or "falconer",
+        target=c.get("target", ""),
+        sender=c.get("sender"))

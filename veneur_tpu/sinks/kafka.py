@@ -1,0 +1,234 @@
+"""Kafka sink: metrics and spans to Kafka topics.
+
+Behavioral parity with reference sinks/kafka/kafka.go (449 LoC): an async
+producer publishes each flushed InterMetric (and/or each ingested span)
+to configured topics, encoded as JSON or protobuf, with optional
+partition keying by metric name and span sampling by trace id.
+
+The reference embeds sarama; here the producer is a small pluggable
+transport (`Producer`) so the sink logic — encoding, topics, sampling —
+is identical whether backed by a real client (`kafka-python` if
+installed), a spool file, or the in-memory producer tests use.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from typing import Any, List, Optional
+
+from veneur_tpu.samplers.metrics import InterMetric
+from veneur_tpu.sinks import (
+    MetricSink, SpanSink, register_metric_sink, register_span_sink,
+)
+
+logger = logging.getLogger("veneur_tpu.sinks.kafka")
+
+
+class Producer:
+    """Transport boundary: send(topic, key, value) then flush()."""
+
+    def send(self, topic: str, key: bytes, value: bytes) -> None:
+        raise NotImplementedError
+
+    def flush(self) -> None:  # noqa: B027
+        pass
+
+    def close(self) -> None:  # noqa: B027
+        pass
+
+
+class InMemoryProducer(Producer):
+    """Test producer: records (topic, key, value) tuples."""
+
+    def __init__(self):
+        self.messages: List[tuple] = []
+        self._lock = threading.Lock()
+
+    def send(self, topic: str, key: bytes, value: bytes) -> None:
+        with self._lock:
+            self.messages.append((topic, key, value))
+
+
+class KafkaPythonProducer(Producer):
+    """Real transport via kafka-python, when available."""
+
+    def __init__(self, brokers: str, retries: int = 3):
+        from kafka import KafkaProducer  # gated import
+        self._p = KafkaProducer(bootstrap_servers=brokers.split(","),
+                                retries=retries)
+
+    def send(self, topic: str, key: bytes, value: bytes) -> None:
+        self._p.send(topic, key=key or None, value=value)
+
+    def flush(self) -> None:
+        self._p.flush(timeout=10)
+
+    def close(self) -> None:
+        self._p.close()
+
+
+def make_producer(brokers: str, retries: int = 3) -> Optional[Producer]:
+    try:
+        return KafkaPythonProducer(brokers, retries)
+    except ImportError:
+        logger.error("kafka-python not installed; kafka sink will drop "
+                     "(configure an explicit producer for tests)")
+        return None
+    except Exception as e:
+        logger.error("kafka producer connect failed: %s", e)
+        return None
+
+
+def encode_metric_json(m: InterMetric) -> bytes:
+    return json.dumps({
+        "name": m.name,
+        "timestamp": m.timestamp,
+        "value": m.value,
+        "tags": m.tags,
+        "type": m.type.name.lower(),
+        "hostname": m.hostname,
+    }, separators=(",", ":")).encode()
+
+
+def encode_span_protobuf(span) -> bytes:
+    return span.SerializeToString()
+
+
+def encode_span_json(span) -> bytes:
+    return json.dumps({
+        "trace_id": span.trace_id, "id": span.id,
+        "parent_id": span.parent_id, "service": span.service,
+        "name": span.name, "start_timestamp": span.start_timestamp,
+        "end_timestamp": span.end_timestamp, "error": span.error,
+        "tags": dict(span.tags), "indicator": span.indicator,
+    }, separators=(",", ":")).encode()
+
+
+class KafkaMetricSink(MetricSink):
+    def __init__(self, name: str, producer: Optional[Producer],
+                 check_topic: str = "", event_topic: str = "",
+                 metric_topic: str = "", partition_by_name: bool = True):
+        self._name = name
+        self.producer = producer
+        self.metric_topic = metric_topic
+        self.check_topic = check_topic
+        self.event_topic = event_topic
+        self.partition_by_name = partition_by_name
+
+    def name(self) -> str:
+        return self._name
+
+    def kind(self) -> str:
+        return "kafka"
+
+    def flush(self, metrics: List[InterMetric]) -> None:
+        if self.producer is None or not self.metric_topic:
+            return
+        for m in metrics:
+            key = m.name.encode() if self.partition_by_name else b""
+            self.producer.send(self.metric_topic, key, encode_metric_json(m))
+        self.producer.flush()
+
+    def flush_other_samples(self, samples) -> None:
+        if self.producer is None or not self.event_topic:
+            return
+        for s in samples:
+            body = json.dumps({
+                "name": getattr(s, "name", ""),
+                "message": getattr(s, "message", ""),
+                "timestamp": getattr(s, "timestamp", 0),
+                "tags": dict(getattr(s, "tags", {}) or {}),
+            }, separators=(",", ":")).encode()
+            self.producer.send(self.event_topic, b"", body)
+        self.producer.flush()
+
+    def stop(self) -> None:
+        if self.producer is not None:
+            self.producer.close()
+
+
+class KafkaSpanSink(SpanSink):
+    def __init__(self, name: str, producer: Optional[Producer],
+                 span_topic: str, encoding: str = "protobuf",
+                 sample_rate_percent: float = 100.0,
+                 sample_tag: str = ""):
+        self._name = name
+        self.producer = producer
+        self.span_topic = span_topic
+        self.encode = (encode_span_json if encoding == "json"
+                       else encode_span_protobuf)
+        # sampling hashes the trace id (or sample_tag value) so whole
+        # traces are kept/dropped together (reference kafka.go)
+        self.sample_threshold = int(sample_rate_percent * 100)
+        self.sample_tag = sample_tag
+        self._buffered = 0
+
+    def name(self) -> str:
+        return self._name
+
+    def kind(self) -> str:
+        return "kafka"
+
+    def _sampled_in(self, span) -> bool:
+        if self.sample_threshold >= 100 * 100:
+            return True
+        if self.sample_tag:
+            basis = dict(span.tags).get(self.sample_tag, "")
+            if not basis:
+                return False
+        else:
+            basis = str(span.trace_id)
+        # fnv hash spreads sequential trace ids uniformly (python's int
+        # hash is the identity, which would bias small-id workloads)
+        from veneur_tpu.util import fnv
+        return (fnv.fnv1a_32(basis.encode()) % 10_000) < self.sample_threshold
+
+    def ingest(self, span) -> None:
+        if self.producer is None or not self._sampled_in(span):
+            return
+        self.producer.send(self.span_topic,
+                           str(span.trace_id).encode(), self.encode(span))
+        self._buffered += 1
+
+    def flush(self) -> None:
+        if self.producer is not None and self._buffered:
+            self.producer.flush()
+            self._buffered = 0
+
+    def stop(self) -> None:
+        if self.producer is not None:
+            self.producer.close()
+
+
+@register_metric_sink("kafka")
+def _metric_factory(sink_config, server_config):
+    c = sink_config.config
+    producer: Any = c.get("producer")  # tests inject one
+    if producer is None:
+        producer = make_producer(c.get("broker", "localhost:9092"),
+                                 int(c.get("retries", 3)))
+    return KafkaMetricSink(
+        sink_config.name or "kafka",
+        producer=producer,
+        metric_topic=c.get("metric_topic", ""),
+        check_topic=c.get("check_topic", ""),
+        event_topic=c.get("event_topic", ""),
+        partition_by_name=bool(c.get("partition_by_name", True)))
+
+
+@register_span_sink("kafka")
+def _span_factory(sink_config, server_config):
+    c = sink_config.config
+    producer: Any = c.get("producer")
+    if producer is None:
+        producer = make_producer(c.get("broker", "localhost:9092"),
+                                 int(c.get("retries", 3)))
+    return KafkaSpanSink(
+        sink_config.name or "kafka",
+        producer=producer,
+        span_topic=c.get("span_topic", "veneur_spans"),
+        encoding=c.get("span_serialization_format", "protobuf"),
+        sample_rate_percent=float(c.get("span_sample_rate_percent", 100.0)),
+        sample_tag=c.get("span_sample_tag", ""))

@@ -1,0 +1,158 @@
+"""New Relic sink: metrics and spans via the telemetry ingest APIs.
+
+Behavioral parity with reference sinks/newrelic/*.go (484 LoC), which
+wraps the NR telemetry SDK. The telemetry SDK's wire format is plain
+JSON over HTTPS, implemented here directly:
+- metrics -> POST https://metric-api.newrelic.com/metric/v1
+  [{"common": {...}, "metrics": [{name, type, value, timestamp, attributes}]}]
+- spans   -> POST https://trace-api.newrelic.com/trace/v1
+  [{"common": {...}, "spans": [{id, trace.id, timestamp, attributes}]}]
+Both carry the Api-Key header; counters submit as NR "count" with the
+flush interval, gauges as "gauge".
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import List, Sequence
+
+from veneur_tpu.samplers.metrics import InterMetric, MetricType
+from veneur_tpu.sinks import (
+    MetricSink, SpanSink, register_metric_sink, register_span_sink,
+)
+from veneur_tpu.util import http as vhttp
+
+logger = logging.getLogger("veneur_tpu.sinks.newrelic")
+
+
+def _attributes(tags: Sequence[str]) -> dict:
+    out = {}
+    for t in tags:
+        k, _, v = t.partition(":")
+        out[k] = v or True
+    return out
+
+
+class NewRelicMetricSink(MetricSink):
+    def __init__(self, name: str, insert_key: str, hostname: str,
+                 interval: float, metric_url: str, tags: Sequence[str] = (),
+                 timeout: float = 10.0):
+        self._name = name
+        self.insert_key = insert_key
+        self.hostname = hostname
+        self.interval = interval
+        self.metric_url = metric_url
+        self.common_tags = _attributes(tags)
+        self.timeout = timeout
+
+    def name(self) -> str:
+        return self._name
+
+    def kind(self) -> str:
+        return "newrelic"
+
+    def flush(self, metrics: List[InterMetric]) -> None:
+        out = []
+        for m in metrics:
+            if m.type == MetricType.STATUS:
+                continue
+            entry = {
+                "name": m.name,
+                "value": m.value,
+                "timestamp": m.timestamp,
+                "attributes": {"host": m.hostname or self.hostname,
+                               **_attributes(m.tags)},
+            }
+            if m.type == MetricType.COUNTER:
+                entry["type"] = "count"
+                entry["interval.ms"] = int(self.interval * 1000)
+            else:
+                entry["type"] = "gauge"
+            out.append(entry)
+        if not out:
+            return
+        payload = [{"common": {"attributes": self.common_tags},
+                    "metrics": out}]
+        try:
+            vhttp.post_json(self.metric_url, payload,
+                            headers={"Api-Key": self.insert_key},
+                            compress="gzip", timeout=self.timeout)
+        except Exception as e:
+            logger.error("newrelic metric POST failed: %s", e)
+
+
+class NewRelicSpanSink(SpanSink):
+    def __init__(self, name: str, insert_key: str, trace_url: str,
+                 common_tags: Sequence[str] = (), timeout: float = 10.0):
+        self._name = name
+        self.insert_key = insert_key
+        self.trace_url = trace_url
+        self.common_tags = _attributes(common_tags)
+        self.timeout = timeout
+        self._spans: List[dict] = []
+        self._lock = threading.Lock()
+
+    def name(self) -> str:
+        return self._name
+
+    def kind(self) -> str:
+        return "newrelic"
+
+    def ingest(self, span) -> None:
+        duration_ms = max(span.end_timestamp - span.start_timestamp, 0) / 1e6
+        entry = {
+            "id": format(span.id & ((1 << 64) - 1), "x"),
+            "trace.id": format(span.trace_id & ((1 << 64) - 1), "x"),
+            "timestamp": span.start_timestamp // 10**6,
+            "attributes": {
+                "name": span.name,
+                "service.name": span.service,
+                "duration.ms": duration_ms,
+                "error": bool(span.error),
+                **dict(span.tags),
+            },
+        }
+        if span.parent_id:
+            entry["attributes"]["parent.id"] = format(
+                span.parent_id & ((1 << 64) - 1), "x")
+        with self._lock:
+            self._spans.append(entry)
+
+    def flush(self) -> None:
+        with self._lock:
+            spans, self._spans = self._spans, []
+        if not spans:
+            return
+        payload = [{"common": {"attributes": self.common_tags},
+                    "spans": spans}]
+        try:
+            vhttp.post_json(self.trace_url, payload,
+                            headers={"Api-Key": self.insert_key},
+                            compress="gzip", timeout=self.timeout)
+        except Exception as e:
+            logger.error("newrelic trace POST failed: %s", e)
+
+
+@register_metric_sink("newrelic")
+def _metric_factory(sink_config, server_config):
+    c = sink_config.config
+    return NewRelicMetricSink(
+        sink_config.name or "newrelic",
+        insert_key=str(c.get("insert_key", "")),
+        hostname=server_config.hostname,
+        interval=server_config.interval,
+        metric_url=c.get("metric_url",
+                         "https://metric-api.newrelic.com/metric/v1"),
+        tags=c.get("common_tags", []) or [])
+
+
+@register_span_sink("newrelic")
+def _span_factory(sink_config, server_config):
+    c = sink_config.config
+    return NewRelicSpanSink(
+        sink_config.name or "newrelic",
+        insert_key=str(c.get("insert_key", "")),
+        trace_url=c.get("trace_url",
+                        "https://trace-api.newrelic.com/trace/v1"),
+        common_tags=c.get("common_tags", []) or [])

@@ -1,0 +1,130 @@
+"""Prometheus sink: statsd-exporter repeater or embedded exposition.
+
+Behavioral parity with reference sinks/prometheus/prometheus.go (165 LoC):
+two modes —
+- repeater: re-emit each InterMetric as a statsd line to a
+  statsd_exporter address (UDP/TCP),
+- embedded exposition: serve the last flush in Prometheus text format on
+  a local HTTP port for scraping.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional
+
+from veneur_tpu.samplers.metrics import InterMetric, MetricType
+from veneur_tpu.sinks import MetricSink, register_metric_sink
+from veneur_tpu.sinks.cortex import sanitize_label, sanitize_name
+
+logger = logging.getLogger("veneur_tpu.sinks.prometheus")
+
+
+def render_exposition(metrics: List[InterMetric]) -> str:
+    lines = []
+    for m in metrics:
+        if m.type == MetricType.STATUS:
+            continue
+        labels = []
+        for t in m.tags:
+            k, _, v = t.partition(":")
+            escaped = v.replace("\\", "\\\\").replace('"', '\\"')
+            labels.append(f'{sanitize_label(k)}="{escaped}"')
+        label_str = "{" + ",".join(labels) + "}" if labels else ""
+        lines.append(f"{sanitize_name(m.name)}{label_str} {m.value}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+class PrometheusMetricSink(MetricSink):
+    def __init__(self, name: str, repeater_address: str = "",
+                 network: str = "udp", expose_address: str = ""):
+        self._name = name
+        self.repeater_address = repeater_address
+        self.network = network
+        self.expose_address = expose_address
+        self._exposition = ""
+        self._lock = threading.Lock()
+        self._httpd: Optional[ThreadingHTTPServer] = None
+
+    def name(self) -> str:
+        return self._name
+
+    def kind(self) -> str:
+        return "prometheus"
+
+    def start(self, server) -> None:
+        if not self.expose_address:
+            return
+        host, _, port = self.expose_address.rpartition(":")
+        sink = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_GET(self):  # noqa: N802
+                with sink._lock:
+                    body = sink._exposition.encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._httpd = ThreadingHTTPServer((host or "127.0.0.1", int(port)),
+                                          Handler)
+        threading.Thread(target=self._httpd.serve_forever,
+                         name="prometheus-expose", daemon=True).start()
+
+    @property
+    def expose_port(self) -> int:
+        return self._httpd.server_address[1] if self._httpd else 0
+
+    def flush(self, metrics: List[InterMetric]) -> None:
+        with self._lock:
+            self._exposition = render_exposition(metrics)
+        if not self.repeater_address or not metrics:
+            return
+        host, _, port = self.repeater_address.rpartition(":")
+        lines = []
+        for m in metrics:
+            if m.type == MetricType.STATUS:
+                continue
+            kind = "c" if m.type == MetricType.COUNTER else "g"
+            tag_part = ("|#" + ",".join(m.tags)) if m.tags else ""
+            lines.append(f"{m.name}:{m.value}|{kind}{tag_part}")
+        payload = "\n".join(lines).encode()
+        try:
+            if self.network == "tcp":
+                with socket.create_connection((host, int(port)),
+                                              timeout=5.0) as s:
+                    s.sendall(payload + b"\n")
+            else:
+                s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+                try:  # chunk to stay under typical datagram limits
+                    for i in range(0, len(lines), 25):
+                        s.sendto("\n".join(lines[i:i + 25]).encode(),
+                                 (host, int(port)))
+                finally:
+                    s.close()
+        except OSError as e:
+            logger.error("prometheus repeater send failed: %s", e)
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+
+
+@register_metric_sink("prometheus")
+def _factory(sink_config, server_config):
+    c = sink_config.config
+    return PrometheusMetricSink(
+        sink_config.name or "prometheus",
+        repeater_address=c.get("repeater_address", ""),
+        network=c.get("network_type", "udp"),
+        expose_address=c.get("expose_address", ""))

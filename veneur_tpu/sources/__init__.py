@@ -1,0 +1,45 @@
+"""Pull-based ingest plugin boundary.
+
+Interface parity with reference sources/sources.go:10-19: a Source has a
+name, a blocking Start(ingest) loop, and Stop; `ingest` accepts parsed
+UDPMetrics into the aggregation path. Factories register by kind in
+SourceTypes (reference server.go:62-91)."""
+
+from __future__ import annotations
+
+import abc
+from typing import Callable, Dict
+
+from veneur_tpu.samplers.metrics import UDPMetric
+
+
+class Ingest(abc.ABC):
+    @abc.abstractmethod
+    def ingest_metric(self, metric: UDPMetric) -> None: ...
+
+
+class Source(abc.ABC):
+    @abc.abstractmethod
+    def name(self) -> str: ...
+
+    @abc.abstractmethod
+    def start(self, ingest: Ingest) -> None:
+        """Run the source; blocks until stop() (called on its own thread)."""
+
+    @abc.abstractmethod
+    def stop(self) -> None: ...
+
+
+# kind -> factory(config: SourceConfig, server_config: Config) -> source
+SourceTypes: Dict[str, Callable] = {}
+
+
+def register_source(kind: str):
+    def deco(factory):
+        SourceTypes[kind] = factory
+        return factory
+    return deco
+
+
+def register_builtin_sources() -> None:
+    from veneur_tpu.sources import openmetrics  # noqa: F401

@@ -1,0 +1,173 @@
+"""OpenMetrics source: scrapes a Prometheus /metrics endpoint.
+
+Behavioral parity with reference sources/openmetrics/openmetrics.go
+(61-401): every scrape_interval, GET the endpoint, parse the text
+exposition format, and convert families to UDPMetrics —
+- counter: monotonic cumulative -> per-interval delta via a value cache
+  (first observation primes the cache and emits nothing); resets emit
+  the new value (`Query` :157, `Convert` :205);
+- gauge/untyped: gauge;
+- summary: quantile samples become gauges tagged `quantile:<q>`; _sum
+  and _count become gauge + counter-delta;
+- histogram: bucket counts become counter-deltas tagged `le:<bound>`
+  (convertHistogram :330), plus _sum/_count.
+An optional allowlist/denylist regex filters family names.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+import threading
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from veneur_tpu.samplers.metrics import MetricScope, UDPMetric, update_tags
+from veneur_tpu.samplers import metrics as m
+from veneur_tpu.sources import Ingest, Source, register_source
+from veneur_tpu.util import http as vhttp
+
+logger = logging.getLogger("veneur_tpu.sources.openmetrics")
+
+_LINE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>.*)\})?\s+(?P<value>[^ ]+)(?:\s+\d+)?$")
+_LABEL = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def parse_exposition(text: str) -> Iterator[Tuple[str, str, Dict[str, str],
+                                                  float]]:
+    """Yield (family_type, name, labels, value) from the text format."""
+    types: Dict[str, str] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 4 and parts[1] == "TYPE":
+                types[parts[2]] = parts[3]
+            continue
+        match = _LINE.match(line)
+        if not match:
+            continue
+        name = match.group("name")
+        labels = {k: v.replace('\\"', '"').replace("\\\\", "\\")
+                  for k, v in _LABEL.findall(match.group("labels") or "")}
+        try:
+            value = float(match.group("value"))
+        except ValueError:
+            continue
+        base = name
+        for suffix in ("_bucket", "_sum", "_count", "_total"):
+            if name.endswith(suffix) and name[:-len(suffix)] in types:
+                base = name[:-len(suffix)]
+                break
+        yield types.get(base, types.get(name, "untyped")), name, labels, value
+
+
+def _tags(labels: Dict[str, str], extra: List[str]) -> List[str]:
+    return sorted([f"{k}:{v}" for k, v in labels.items()] + extra)
+
+
+class OpenMetricsSource(Source):
+    def __init__(self, name: str, url: str, scrape_interval: float,
+                 tags: Optional[List[str]] = None,
+                 allowlist: Optional[str] = None,
+                 denylist: Optional[str] = None,
+                 scope: MetricScope = MetricScope.MIXED,
+                 timeout: float = 10.0):
+        self._name = name
+        self.url = url
+        self.scrape_interval = scrape_interval
+        self.tags = list(tags or [])
+        self.allow = re.compile(allowlist) if allowlist else None
+        self.deny = re.compile(denylist) if denylist else None
+        self.scope = scope
+        self.timeout = timeout
+        self._stop = threading.Event()
+        # cumulative-counter cache: (name, tag-string) -> last value
+        self._counter_cache: Dict[Tuple[str, str], float] = {}
+
+    def name(self) -> str:
+        return self._name
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def start(self, ingest: Ingest) -> None:
+        while not self._stop.wait(self.scrape_interval):
+            try:
+                self.scrape_once(ingest)
+            except Exception as e:
+                logger.error("openmetrics scrape of %s failed: %s",
+                             self.url, e)
+
+    # -- conversion -------------------------------------------------------
+
+    def _emit(self, ingest: Ingest, name: str, mtype: str, value: float,
+              tags: List[str]) -> None:
+        final, joined, h32, h64 = update_tags(name, mtype, tags, None)
+        ingest.ingest_metric(UDPMetric(
+            key=m.MetricKey(name=name, type=mtype, joined_tags=joined),
+            digest=h32, digest64=h64, value=value, sample_rate=1.0,
+            tags=final, scope=self.scope))
+
+    def _counter_delta(self, name: str, tags: List[str],
+                       value: float) -> Optional[float]:
+        key = (name, ",".join(tags))
+        prev = self._counter_cache.get(key)
+        self._counter_cache[key] = value
+        if prev is None:
+            return None  # first scrape primes the cache
+        if value < prev:
+            return value  # counter reset: emit the new count
+        return value - prev
+
+    def scrape_once(self, ingest: Ingest) -> int:
+        status, body = vhttp.get(self.url, timeout=self.timeout)
+        count = 0
+        for ftype, name, labels, value in parse_exposition(body.decode()):
+            if self.allow and not self.allow.search(name):
+                continue
+            if self.deny and self.deny.search(name):
+                continue
+            tags = _tags(labels, self.tags)
+            if ftype == "counter":
+                delta = self._counter_delta(name, tags, value)
+                if delta is not None:
+                    self._emit(ingest, name, m.COUNTER, delta, tags)
+                    count += 1
+            elif ftype in ("gauge", "untyped"):
+                self._emit(ingest, name, m.GAUGE, value, tags)
+                count += 1
+            elif ftype in ("histogram", "summary"):
+                if name.endswith("_sum"):
+                    self._emit(ingest, name, m.GAUGE, value, tags)
+                    count += 1
+                elif name.endswith(("_count", "_bucket")):
+                    delta = self._counter_delta(name, tags, value)
+                    if delta is not None:
+                        self._emit(ingest, name, m.COUNTER, delta, tags)
+                        count += 1
+                else:  # summary quantile sample
+                    self._emit(ingest, name, m.GAUGE, value, tags)
+                    count += 1
+        return count
+
+
+@register_source("openmetrics")
+def _factory(source_config, server_config):
+    c = source_config.config
+    scope = {"local": MetricScope.LOCAL_ONLY,
+             "global": MetricScope.GLOBAL_ONLY}.get(
+        c.get("scope", ""), MetricScope.MIXED)
+    from veneur_tpu.config import parse_duration
+    return OpenMetricsSource(
+        source_config.name or "openmetrics",
+        url=c.get("url", ""),
+        scrape_interval=parse_duration(c.get("scrape_interval", "10s")),
+        tags=list(source_config.tags or []),
+        allowlist=c.get("allowlist") or None,
+        denylist=c.get("denylist") or None,
+        scope=scope,
+        timeout=parse_duration(c.get("scrape_timeout", "10s")))

@@ -1,0 +1,159 @@
+"""HTTP POST helpers shared by HTTP sinks.
+
+Behavioral parity with reference http/http.go (282 LoC): JSON/protobuf
+POST with optional gzip/deflate compression, timeout, and a tiny
+pure-Python snappy *block-format* encoder for Prometheus remote-write
+(reference sinks/cortex/cortex.go uses github.com/golang/snappy).
+
+Everything here is stdlib-only: urllib for transport so sinks work in the
+hermetic test environment without `requests`.
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import urllib.error
+import urllib.request
+import zlib
+from typing import Any, Dict, Optional, Tuple
+
+
+class HTTPError(Exception):
+    def __init__(self, status: int, body: bytes = b""):
+        super().__init__(f"HTTP {status}: {body[:200]!r}")
+        self.status = status
+        self.body = body
+
+
+def snappy_encode(data: bytes) -> bytes:
+    """Encode `data` in snappy block format using only literal elements.
+
+    The snappy format permits a stream consisting entirely of literals
+    (no back-references); any conformant decoder accepts it. Layout:
+    uvarint(len(data)) then literal chunks. A literal tag byte has low
+    bits 00 and encodes lengths <=60 inline; longer literals store the
+    length in 1-4 little-endian bytes selected by tag values 60-63.
+    """
+    out = bytearray()
+    # preamble: uncompressed length as uvarint
+    n = len(data)
+    while n >= 0x80:
+        out.append((n & 0x7F) | 0x80)
+        n >>= 7
+    out.append(n)
+    pos = 0
+    total = len(data)
+    while pos < total:
+        chunk = data[pos:pos + 65536]
+        ln = len(chunk) - 1
+        if ln < 60:
+            out.append(ln << 2)
+        elif ln < (1 << 8):
+            out.append(60 << 2)
+            out.append(ln)
+        else:  # chunk capped at 65536 so two bytes always suffice
+            out.append(61 << 2)
+            out += ln.to_bytes(2, "little")
+        out += chunk
+        pos += len(chunk)
+    return bytes(out)
+
+
+def snappy_decode(data: bytes) -> bytes:
+    """Decode snappy block format (full format: literals + copies).
+
+    Used only by tests and the cortex test fake; kept complete so any
+    real snappy writer's output round-trips too.
+    """
+    # uvarint preamble
+    ulen = 0
+    shift = 0
+    pos = 0
+    while True:
+        b = data[pos]
+        pos += 1
+        ulen |= (b & 0x7F) << shift
+        if not b & 0x80:
+            break
+        shift += 7
+    out = bytearray()
+    while pos < len(data):
+        tag = data[pos]
+        pos += 1
+        elem_type = tag & 0x03
+        if elem_type == 0:  # literal
+            ln = tag >> 2
+            if ln >= 60:
+                extra = ln - 59
+                ln = int.from_bytes(data[pos:pos + extra], "little")
+                pos += extra
+            ln += 1
+            out += data[pos:pos + ln]
+            pos += ln
+        elif elem_type == 1:  # copy, 1-byte offset
+            ln = ((tag >> 2) & 0x7) + 4
+            offset = ((tag >> 5) << 8) | data[pos]
+            pos += 1
+            _copy(out, offset, ln)
+        elif elem_type == 2:  # copy, 2-byte offset
+            ln = (tag >> 2) + 1
+            offset = int.from_bytes(data[pos:pos + 2], "little")
+            pos += 2
+            _copy(out, offset, ln)
+        else:  # copy, 4-byte offset
+            ln = (tag >> 2) + 1
+            offset = int.from_bytes(data[pos:pos + 4], "little")
+            pos += 4
+            _copy(out, offset, ln)
+    if len(out) != ulen:
+        raise ValueError(f"snappy: length mismatch {len(out)} != {ulen}")
+    return bytes(out)
+
+
+def _copy(out: bytearray, offset: int, length: int) -> None:
+    if offset <= 0 or offset > len(out):
+        raise ValueError("snappy: bad copy offset")
+    for _ in range(length):  # may overlap; copy byte-wise
+        out.append(out[-offset])
+
+
+def post(url: str, body: bytes, *,
+         content_type: str = "application/json",
+         headers: Optional[Dict[str, str]] = None,
+         compress: Optional[str] = None,
+         timeout: float = 10.0) -> Tuple[int, bytes]:
+    """POST `body`, optionally compressed ("gzip"/"deflate"), returning
+    (status, response body). Raises HTTPError on non-2xx."""
+    hdrs = {"Content-Type": content_type}
+    if compress == "gzip":
+        body = gzip.compress(body, compresslevel=6)
+        hdrs["Content-Encoding"] = "gzip"
+    elif compress == "deflate":
+        body = zlib.compress(body, 6)
+        hdrs["Content-Encoding"] = "deflate"
+    if headers:
+        hdrs.update(headers)
+    req = urllib.request.Request(url, data=body, headers=hdrs, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        raise HTTPError(e.code, e.read()) from e
+
+
+def post_json(url: str, obj: Any, *, headers: Optional[Dict[str, str]] = None,
+              compress: Optional[str] = "gzip",
+              timeout: float = 10.0) -> Tuple[int, bytes]:
+    return post(url, json.dumps(obj, separators=(",", ":")).encode(),
+                headers=headers, compress=compress, timeout=timeout)
+
+
+def get(url: str, *, headers: Optional[Dict[str, str]] = None,
+        timeout: float = 10.0) -> Tuple[int, bytes]:
+    req = urllib.request.Request(url, headers=headers or {}, method="GET")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        raise HTTPError(e.code, e.read()) from e

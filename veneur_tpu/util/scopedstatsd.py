@@ -1,0 +1,101 @@
+"""Scoped self-metrics client.
+
+Behavioral parity with reference scopedstatsd/client.go:13-119: a statsd
+client wrapper that appends the `veneurlocalonly` / `veneurglobalonly`
+magic tag to each metric according to per-method scope configuration
+(`veneur_metrics_scopes`: gauges default local, counts default global),
+plus `veneur_metrics_additional_tags` on everything. Metrics emit as
+DogStatsD packets to `stats_address`, or into a callback (the server's
+internal loopback, so self-metrics re-enter its own pipeline).
+"""
+
+from __future__ import annotations
+
+import socket
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+TAG_LOCAL_ONLY = "veneurlocalonly"
+TAG_GLOBAL_ONLY = "veneurglobalonly"
+
+_SCOPE_TAGS = {"local": TAG_LOCAL_ONLY, "global": TAG_GLOBAL_ONLY}
+
+
+class ScopedClient:
+    def __init__(self, address: str = "",
+                 packet_cb: Optional[Callable[[bytes], None]] = None,
+                 scopes: Optional[Dict[str, str]] = None,
+                 additional_tags: Sequence[str] = ()):
+        """scopes maps metric kind ("gauge"/"count"/"timing") to
+        "local"/"global"/"" (reference MetricScopes struct)."""
+        self.scopes = scopes or {}
+        self.additional_tags = list(additional_tags)
+        self._cb = packet_cb
+        self._sock = None
+        self._addr = None
+        if address and packet_cb is None:
+            host, _, port = address.rpartition(":")
+            self._addr = (host or "127.0.0.1", int(port))
+            self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+
+    def _emit(self, name: str, value, kind: str, tags: Sequence[str],
+              rate: float) -> None:
+        final = list(tags) + self.additional_tags
+        scope_tag = _SCOPE_TAGS.get(self.scopes.get(
+            {"c": "count", "g": "gauge", "ms": "timing"}[kind], ""))
+        if scope_tag:
+            final.append(scope_tag)
+        parts = [f"{name}:{value}|{kind}"]
+        if rate != 1.0:
+            parts.append(f"@{rate}")
+        if final:
+            parts.append("#" + ",".join(final))
+        packet = "|".join(parts).encode()
+        if self._cb is not None:
+            self._cb(packet)
+        elif self._sock is not None:
+            try:
+                self._sock.sendto(packet, self._addr)
+            except OSError:
+                pass
+
+    def count(self, name: str, value: int = 1,
+              tags: Sequence[str] = (), rate: float = 1.0) -> None:
+        self._emit(name, int(value), "c", tags, rate)
+
+    def gauge(self, name: str, value: float,
+              tags: Sequence[str] = (), rate: float = 1.0) -> None:
+        self._emit(name, value, "g", tags, rate)
+
+    def timing(self, name: str, seconds: float,
+               tags: Sequence[str] = (), rate: float = 1.0) -> None:
+        self._emit(name, f"{seconds * 1000:.3f}", "ms", tags, rate)
+
+    def timer(self, name: str, tags: Sequence[str] = ()):
+        """Context manager: times the with-block."""
+        client = self
+
+        class _Timer:
+            def __enter__(self):
+                self.start = time.perf_counter()
+                return self
+
+            def __exit__(self, *exc):
+                client.timing(name, time.perf_counter() - self.start, tags)
+
+        return _Timer()
+
+    def close(self) -> None:
+        if self._sock is not None:
+            self._sock.close()
+            self._sock = None
+
+
+class NullClient(ScopedClient):
+    """Drops everything (trace.NeutralizeClient analog for tests)."""
+
+    def __init__(self):
+        super().__init__()
+
+    def _emit(self, *a, **kw) -> None:
+        pass
