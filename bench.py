@@ -62,18 +62,21 @@ def run_pipeline(duration_s: float, num_keys: int):
     server = Server(cfg, extra_metric_sinks=[BlackholeMetricSink()])
 
     packets, samples_per_round = make_packets(num_keys)
+    # batch into datagram-sized buffers (~40 metrics each, like a client
+    # pipelining into 1400-byte datagrams) for the native batch path
+    datagrams = [b"\n".join(packets[i:i + 40])
+                 for i in range(0, len(packets), 40)]
 
-    # warmup: trigger every kernel compile path
-    for p in packets[: min(len(packets), 2000)]:
-        server.handle_metric_packet(p)
+    # warmup: intern every key (first pass is the Python slow path) and
+    # trigger every kernel compile path
+    server.handle_packet_batch(datagrams)
     server.store.apply_all_pending()
     server.flush()
 
     t0 = time.perf_counter()
     total_samples = 0
     while True:
-        for p in packets:
-            server.handle_metric_packet(p)
+        server.handle_packet_batch(datagrams)
         total_samples += samples_per_round
         if time.perf_counter() - t0 >= duration_s:
             break

@@ -1,0 +1,182 @@
+"""Parity tests: the native (C++) batch ingest path must be observably
+identical to the per-packet Python parser path — same aggregated state,
+same stats counters — across the DogStatsD grammar, including the lines
+the native parser defers (events, service checks, malformed packets,
+non-ASCII set members, unknown keys).
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from veneur_tpu import native
+from veneur_tpu.config import Config
+from veneur_tpu.core.server import Server
+from veneur_tpu.sinks.channel import ChannelMetricSink
+
+pytestmark = pytest.mark.skipif(
+    not native.available(),
+    reason=f"native parser unavailable: {native.unavailable_reason()}")
+
+
+def make_server(disable_native: bool):
+    cfg = Config()
+    cfg.interval = 10.0
+    cfg.tpu.disable_native_parser = disable_native
+    cfg.apply_defaults()
+    ch = ChannelMetricSink()
+    return Server(cfg, extra_metric_sinks=[ch]), ch
+
+
+def flush_rows(server, ch):
+    server.flush()
+    return sorted(
+        (m.name, m.type.name, round(float(m.value), 4), tuple(m.tags))
+        for m in ch.wait_flush())
+
+
+def run_both(datagram_batches):
+    """Feed the same batches through native and Python servers; return
+    ((metrics, stats), (metrics, stats))."""
+    out = []
+    for disable in (False, True):
+        server, ch = make_server(disable)
+        if not disable:
+            assert server._ingester is not None
+        for batch in datagram_batches:
+            server.handle_packet_batch(batch)
+        rows = flush_rows(server, ch)
+        out.append((rows, dict(server.stats)))
+    return out
+
+
+CORPUS = [
+    b"c1:5|c|#a:b",
+    b"c1:3|c|#a:b",
+    b"c1:2|c|@0.5|#a:b",
+    b"g1:2.5|g",
+    b"g1:7|g",  # last write wins
+    b"t1:1:2:3:4|ms|@0.5|#x:y",
+    b"h1:0.25|h",
+    b"d1:9|d",  # distribution -> histogram
+    b"s1:u1|s\ns1:u2|s\ns1:u1|s",
+    b"bad packet",
+    b"nopipe:1",
+    b"novalue|c",
+    b":1|c",
+    b"x:|c",           # empty value chunk: no samples, no error
+    b"x:1:|c",         # trailing empty segment ignored
+    b"x::1|c",         # empty inner segment: error
+    b"dup:1|c|@0.5|@0.5",
+    b"dup2:1|c|#a|#b",
+    b"weird:1e999|c",  # overflow -> error
+    b"tiny:1e-999|g",  # underflow -> 0.0, fine
+    b"neg:-12.5|g",
+    b"plus:+3|c",
+    b"exp:2.5e2|ms",
+    b"dot:.5|g",
+    b"dotted:5.|g",
+    b"under:1_0|c",    # underscores rejected
+    b"space: 1|c",     # whitespace rejected
+    b"nan:nan|g",
+    b"inf:inf|g",
+    b"hex:0x10|c",
+    b"_sc|check|1|m:oops",
+    b"_sc|check|9",
+    b"_e{5,4}:title|text",
+    b"_e{2,2}:ab|cd|t:error",
+    b"_scx:1|c",       # _sc prefix but not a service check -> error path
+    b"_metric:1|c",    # leading underscore, ordinary metric
+    b"glob:1|c|#veneurglobalonly",
+    b"loc:1|ms|#veneurlocalonly,env:x",
+    b"setnonascii:caf\xc3\xa9|s",   # non-ASCII member defers to Python
+    b"s1:\xff\xfe|s",               # invalid UTF-8 member
+    b"multi:1:2:3|c|#m:n",
+    b"rate0:1|c|@0",
+    b"rate2:1|c|@2",
+]
+
+
+class TestNativeParity:
+    def test_corpus_single_pass(self):
+        (nat, nat_stats), (py, py_stats) = run_both([CORPUS])
+        assert nat == py
+        assert nat_stats == py_stats
+
+    def test_corpus_repeated_passes(self):
+        # second pass exercises the registered-key native fast path
+        (nat, nat_stats), (py, py_stats) = run_both([CORPUS, CORPUS, CORPUS])
+        assert nat == py
+        assert nat_stats == py_stats
+
+    def test_randomized_traffic(self):
+        rng = random.Random(1234)
+        names = [f"m{i}" for i in range(50)]
+        batches = []
+        for _ in range(5):
+            batch = []
+            for _ in range(200):
+                name = rng.choice(names)
+                kind = rng.choice([b"c", b"g", b"ms", b"h", b"s"])
+                tags = rng.choice([b"", b"|#a:b", b"|#a:b,c:d",
+                                   b"|#veneurglobalonly,x:y"])
+                rate = rng.choice([b"", b"|@0.5", b"|@0.1"])
+                if kind == b"s":
+                    val = f"user{rng.randrange(100)}".encode()
+                else:
+                    val = f"{rng.uniform(-100, 100):.4f}".encode()
+                batch.append(b"%s:%s|%s%s%s" %
+                             (name.encode(), val, kind, rate, tags))
+            batches.append([b"\n".join(batch[i:i + 25])
+                            for i in range(0, len(batch), 25)])
+        (nat, nat_stats), (py, py_stats) = run_both(batches)
+        assert nat == py
+        assert nat_stats == py_stats
+
+    def test_oversized_datagram_dropped(self):
+        server, ch = make_server(False)
+        big = b"x:1|c\n" * 2000  # > metric_max_length
+        server.handle_packet_batch([big, b"ok:1|c"])
+        assert server.stats["parse_errors"] == 1
+        rows = flush_rows(server, ch)
+        assert [r[0] for r in rows] == ["ok"]
+
+    def test_interning_registers_keys(self):
+        server, _ = make_server(False)
+        server.handle_packet_batch([b"a:1|c\nb:2|g\nc:3|ms\nd:x|s"])
+        assert server._ingester.interned_keys == 4
+        # second pass: no unknown lines -> counts all native
+        before = server.stats["packets_received"]
+        server.handle_packet_batch([b"a:1|c\nb:2|g\nc:3|ms\nd:x|s"])
+        assert server.stats["packets_received"] == before + 4
+
+
+class TestNativeParser:
+    def test_hll_hash_parity(self):
+        from veneur_tpu.ops import hll_ref
+        parser = native.NativeParser()
+        parser.register(b"s|s", native.FAM_SET, 0, 1.0)
+        members = [b"a", b"user42", b"x" * 100]
+        res = parser.parse(b"\n".join(b"s:%s|s" % mm for mm in members))
+        for i, member in enumerate(members):
+            idx, rho = hll_ref.pos_val(hll_ref.hash_member(member))
+            assert res.s_idx[i] == idx, member
+            assert res.s_rho[i] == rho, member
+
+    def test_multivalue_and_rates(self):
+        parser = native.NativeParser()
+        parser.register(b"t|ms|@0.25|#x:y", native.FAM_HISTO, 3, 0.25)
+        res = parser.parse(b"t:1:2:3|ms|@0.25|#x:y")
+        assert list(res.h_rows) == [3, 3, 3]
+        assert list(res.h_vals) == [1.0, 2.0, 3.0]
+        assert list(res.h_wts) == [4.0, 4.0, 4.0]
+        assert res.samples == 3
+
+    def test_unknown_keys_deferred(self):
+        parser = native.NativeParser()
+        res = parser.parse(b"a:1|c\nb:2|g")
+        assert res.lines == 2
+        assert res.samples == 0
+        assert res.unknown == [b"a:1|c", b"b:2|g"]

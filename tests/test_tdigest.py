@@ -12,6 +12,14 @@ from veneur_tpu.ops import batch_tdigest as btd
 from veneur_tpu.ops.tdigest_ref import MergingDigest
 
 
+def _means_weights(state):
+    """Centroid (means, weights) view of a slot-accumulator digest state."""
+    w = np.asarray(state["weights"])
+    wv = np.asarray(state["wv"])
+    means = np.divide(wv, w, out=np.zeros_like(wv), where=w > 0)
+    return means, w
+
+
 def uniform_digest(rng, n=10000):
     td = MergingDigest(100)
     data = [rng.random() for _ in range(n)]
@@ -149,13 +157,13 @@ class TestBatchedDigest:
         state = btd.init_state(3)
         state = self._ingest({0: [(rng.random(), 1.0) for _ in range(1000)]},
                              3, rng=rng)
-        before = np.asarray(state["means"]).copy()
+        before = np.asarray(state["wv"]).copy()
         # a batch touching only row 2 must leave row 0 bit-identical
         rows = np.array([2] * 64, np.int32)
         vals = np.random.default_rng(0).random(64).astype(np.float32)
         wts = np.ones(64, np.float32)
         state = btd.apply_batch(state, rows, vals, wts)
-        after = np.asarray(state["means"])
+        after = np.asarray(state["wv"])
         np.testing.assert_array_equal(before[0], after[0])
         np.testing.assert_array_equal(before[1], after[1])
         assert float(np.sum(np.asarray(state["weights"])[2])) == 64.0
@@ -201,7 +209,7 @@ class TestBatchedDigest:
         s2 = self._ingest({0: [(v, 1.0) for v in data[half:]]}, 1, rng=rng)
         merged = btd.merge_centroid_rows(
             s1, np.array([0], np.int32),
-            np.asarray(s2["means"]), np.asarray(s2["weights"]),
+            *_means_weights(s2),
             np.asarray(s2["dmin"]), np.asarray(s2["dmax"]),
             np.asarray(s2["drecip"]))
         out = btd.flush_quantiles(merged, (0.1, 0.5, 0.9))

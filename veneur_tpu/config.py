@@ -86,6 +86,9 @@ class TpuConfig:
     batch_cap: int = 8192
     # number of ingest shards for the multi-chip merge plane
     shards: int = 1
+    # force the pure-Python per-packet parser (the C++ batch parser is
+    # used whenever it compiles; this is the escape hatch)
+    disable_native_parser: bool = False
 
 
 @dataclass

@@ -91,6 +91,21 @@ class _BaseTable:
         self._grow_arrays(new_cap)
         self.capacity = new_cap
 
+    def _append_batch(self, columns) -> None:
+        """Vectorized append of parallel sample columns into the pending
+        buffer (the native-parser fast path), applying whenever full.
+        Caller holds self.lock; rows must already be interned."""
+        n = len(columns[0])
+        i = 0
+        while i < n:
+            take = min(self.batch_cap - self._n, n - i)
+            for col, data in enumerate(columns):
+                self._pend[self._n:self._n + take, col] = data[i:i + take]
+            self._n += take
+            i += take
+            if self._n >= self.batch_cap:
+                self._apply_locked()
+
     @property
     def num_rows(self) -> int:
         return len(self.meta)
@@ -135,6 +150,12 @@ class CounterTable(_BaseTable):
     def apply_pending(self):
         with self.lock:
             self._apply_locked()
+
+    def add_batch(self, rows, vals, rates) -> None:
+        """Native-parser fast path: pre-interned rows, parallel columns."""
+        with self.lock:
+            self.touched[rows] = True
+            self._append_batch((rows, vals, rates))
 
     def merge_batch(self, stubs: List[UDPMetric], values) -> None:
         """Import-path merge: intern + touch + accumulate atomically, so a
@@ -200,6 +221,12 @@ class GaugeTable(_BaseTable):
         with self.lock:
             self._apply_locked()
 
+    def add_batch(self, rows, vals) -> None:
+        """Native-parser fast path; buffer order preserves last-write-wins."""
+        with self.lock:
+            self.touched[rows] = True
+            self._append_batch((rows, vals))
+
     def merge_batch(self, stubs: List[UDPMetric], values) -> None:
         """Import-path merge: overwrite, atomically with interning."""
         with self.lock:
@@ -223,10 +250,15 @@ class GaugeTable(_BaseTable):
 class HistoTable(_BaseTable):
     """Histograms and timers, all scopes, one digest grid."""
 
+    # applied batches between slot-grid recompressions: ingestion is pure
+    # scatter-accumulate; a periodic recompress re-tightens slot means
+    RECOMPRESS_EVERY = 64
+
     def _init_arrays(self):
         self.state = batch_tdigest.init_state(self.capacity)
         self._pend = np.zeros((self.batch_cap, 3), np.float64)  # row,val,w
         self._n = 0
+        self._applies = 0
 
     def _grow_arrays(self, new_cap):
         old = self.state
@@ -258,10 +290,19 @@ class HistoTable(_BaseTable):
         wts[:n] = self._pend[:n, 2]
         self.state = batch_tdigest.apply_batch(self.state, rows, vals, wts)
         self._n = 0
+        self._applies += 1
+        if self._applies % self.RECOMPRESS_EVERY == 0:
+            self.state = batch_tdigest.recompress_state(self.state)
 
     def apply_pending(self):
         with self.lock:
             self._apply_locked()
+
+    def add_batch(self, rows, vals, weights) -> None:
+        """Native-parser fast path: weights are 1/sample_rate."""
+        with self.lock:
+            self.touched[rows] = True
+            self._append_batch((rows, vals, weights))
 
     def merge_batch(self, stubs: List[UDPMetric], in_means, in_weights,
                     in_min, in_max, in_recip) -> None:
@@ -332,6 +373,12 @@ class SetTable(_BaseTable):
     def apply_pending(self):
         with self.lock:
             self._apply_locked()
+
+    def add_batch(self, rows, reg_idx, rho) -> None:
+        """Native-parser fast path: members already hashed to (idx, rho)."""
+        with self.lock:
+            self.touched[rows] = True
+            self._append_batch((rows, reg_idx, rho))
 
     def merge_batch(self, stubs: List[UDPMetric], in_regs) -> None:
         """Import-path HLL merge (register max), atomic with interning."""

@@ -1,0 +1,120 @@
+"""Batched ingest: the native parser wired to the device column store.
+
+This is the framework's hot ingest loop (the TPU-build replacement for the
+reference's ReadMetricSocket -> ParseMetric -> Worker.ProcessMetric chain,
+reference server.go:1103-1140, samplers/parser.go:349, worker.go:350):
+packet buffers are parsed by the C++ batch parser into per-family COO
+columns, which append straight into the column store's pending buffers —
+one lock acquisition and one memcpy per family per buffer instead of one
+object, one dict lookup, and one lock per sample.
+
+Slow-path contract: lines the native parser defers (unknown keys, events,
+service checks, malformed packets, non-ASCII set members) are replayed
+through Server.handle_metric_packet, which preserves exact parse/error
+semantics; metric lines that intern a new key are then registered with the
+native table, so each unique timeseries pays the Python path exactly once.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional
+
+from veneur_tpu import native
+from veneur_tpu.samplers import metrics as m
+
+logger = logging.getLogger("veneur_tpu.ingest")
+
+_FAMILY_BY_TYPE = {
+    m.COUNTER: native.FAM_COUNTER,
+    m.GAUGE: native.FAM_GAUGE,
+    m.HISTOGRAM: native.FAM_HISTO,
+    m.TIMER: native.FAM_HISTO,
+    m.SET: native.FAM_SET,
+}
+
+
+class BatchIngester:
+    """One native intern table + parse buffers per server.
+
+    Falls back to None from `create` when the native library is
+    unavailable; callers then stay on the per-packet Python path.
+    """
+
+    def __init__(self, server):
+        self.server = server
+        self.store = server.store
+        self.parser = server.parser
+        self._native = native.NativeParser()
+        self._lock = threading.Lock()  # parse buffers are single-use
+
+    @classmethod
+    def create(cls, server) -> Optional["BatchIngester"]:
+        if not native.available():
+            return None
+        try:
+            return cls(server)
+        except Exception:
+            logger.exception("native batch ingester unavailable")
+            return None
+
+    def ingest_buffer(self, buf: bytes) -> int:
+        """Parse and aggregate one newline-joined packet buffer; returns
+        the number of samples taken (native + slow path not counted)."""
+        store = self.store
+        with self._lock:
+            res = self._native.parse(buf)
+            # native lines count as received; unknown lines are counted by
+            # handle_metric_packet below
+            self.server.stats["packets_received"] += res.lines - len(res.unknown)
+            if len(res.c_rows):
+                store.counters.add_batch(res.c_rows, res.c_vals, res.c_rates)
+            if len(res.g_rows):
+                store.gauges.add_batch(res.g_rows, res.g_vals)
+            if len(res.h_rows):
+                store.histos.add_batch(res.h_rows, res.h_vals, res.h_wts)
+            if len(res.s_rows):
+                store.sets.add_batch(res.s_rows, res.s_idx, res.s_rho)
+            store.processed += res.samples
+            unknown = res.unknown  # views invalidate on next parse; list of
+            # bytes is already materialized
+        for line in unknown:
+            self.server.handle_metric_packet(line)
+            if not (line.startswith(b"_e{") or line.startswith(b"_sc")):
+                self._register_line(line)
+        return res.samples
+
+    def _register_line(self, line: bytes) -> None:
+        """After the slow path interned a metric line's key, teach the
+        native table its (family, row, rate) so the next occurrence never
+        leaves C++."""
+        type_start = line.find(b"|")
+        if type_start < 0:
+            return
+        value_start = line.find(b":", 0, type_start)
+        if value_start < 0:
+            return
+        meta_key = line[:value_start] + line[type_start:]
+        cached = self.parser._meta_cache.get(meta_key)
+        if cached is None:
+            return  # line never parsed cleanly; stays on the slow path
+        key, _h32, h64, rate, _tags, scope = cached
+        family = _FAMILY_BY_TYPE.get(key.type)
+        if family is None:
+            return
+        table = {
+            native.FAM_COUNTER: self.store.counters,
+            native.FAM_GAUGE: self.store.gauges,
+            native.FAM_HISTO: self.store.histos,
+            native.FAM_SET: self.store.sets,
+        }[family]
+        dict_key = (h64 << 2) | int(scope)
+        row = table.rows.get(dict_key)
+        if row is None:
+            return
+        self._native.register(meta_key, family, row, rate)
+
+    @property
+    def interned_keys(self) -> int:
+        return self._native.size()

@@ -95,14 +95,25 @@ def _start_statsd_udp(u, server, num_readers: int, rcvbuf: int) -> Listener:
 
 
 def _read_metric_socket(sock, server, listener: Listener) -> None:
-    """Datagram read loop (reference server.go:1103-1140)."""
+    """Datagram read loop (reference server.go:1103-1140): block for the
+    first datagram, then drain whatever the kernel has queued without
+    blocking, so bursts reach the native batch parser as one buffer."""
     while not listener.closed:
         try:
             buf = sock.recv(_MAX_DGRAM)
         except OSError:
             return
-        if buf:
-            server.handle_packet_buffer(buf)
+        if not buf:
+            continue
+        batch = [buf]
+        while len(batch) < 512:
+            try:
+                batch.append(sock.recv(_MAX_DGRAM, socket.MSG_DONTWAIT))
+            except (BlockingIOError, InterruptedError):
+                break
+            except OSError:
+                break
+        server.handle_packet_batch(batch)
 
 
 def _start_statsd_tcp(u, server) -> Listener:

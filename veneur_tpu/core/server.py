@@ -130,6 +130,11 @@ class Server:
             from veneur_tpu.core.diagnostics import DiagnosticsLoop
             self.diagnostics = DiagnosticsLoop(self.statsd, config.interval)
 
+        # native batch ingest engine (None -> pure-Python per-packet path)
+        from veneur_tpu.core.ingest import BatchIngester
+        self._ingester = (None if config.tpu.disable_native_parser
+                          else BatchIngester.create(self))
+
         self.http_api = None  # set in start() when http_address
         self._listeners: List[networking.Listener] = []
         self._flush_lock = threading.Lock()
@@ -152,6 +157,23 @@ class Server:
         return self.config.is_local
 
     # -- ingest ----------------------------------------------------------
+
+    def handle_packet_batch(self, datagrams) -> None:
+        """Fast path: parse a batch of datagrams through the native batch
+        parser straight into the column store. Falls back to the
+        per-packet Python path when the native library is unavailable."""
+        if self._ingester is None:
+            for dgram in datagrams:
+                self.handle_packet_buffer(dgram)
+            return
+        good = []
+        for dgram in datagrams:
+            if len(dgram) > self.config.metric_max_length:
+                self.stats["parse_errors"] += 1
+            else:
+                good.append(dgram)
+        if good:
+            self._ingester.ingest_buffer(b"\n".join(good))
 
     def handle_metric_packet(self, packet: bytes) -> None:
         """Dispatch one datagram/line (reference server.go:949-1000)."""

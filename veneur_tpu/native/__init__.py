@@ -1,0 +1,224 @@
+"""Native (C++) host kernels: the batch DogStatsD parser.
+
+The shared library is compiled from dogstatsd.cc on first use with the
+system g++ and cached next to the source, keyed by a hash of the source, so
+a source edit triggers exactly one rebuild. Everything degrades gracefully:
+if no compiler is available the package reports unavailable and callers
+stay on the pure-Python parser.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import logging
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+logger = logging.getLogger("veneur_tpu.native")
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "dogstatsd.cc")
+
+_lib = None
+_lib_err: str | None = None
+_lib_lock = threading.Lock()
+
+# family codes, mirroring dogstatsd.cc
+FAM_COUNTER = 0
+FAM_GAUGE = 1
+FAM_HISTO = 2
+FAM_SET = 3
+
+
+def _build_lib_path() -> str:
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    build_dir = os.path.join(_HERE, "_build")
+    os.makedirs(build_dir, exist_ok=True)
+    return os.path.join(build_dir, f"libvntdogstatsd-{digest}.so")
+
+
+def _compile(path: str) -> None:
+    tmp = path + f".tmp{os.getpid()}"
+    cmd = ["g++", "-O3", "-std=c++20", "-shared", "-fPIC",
+           "-o", tmp, _SRC]
+    subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    os.replace(tmp, path)  # atomic vs concurrent builders
+
+
+def _declare(lib) -> None:
+    i64, i32p = ctypes.c_int64, ctypes.POINTER(ctypes.c_int32)
+    f32p, i64p = ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int64)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.vnt_new.restype = ctypes.c_void_p
+    lib.vnt_new.argtypes = []
+    lib.vnt_free.restype = None
+    lib.vnt_free.argtypes = [ctypes.c_void_p]
+    lib.vnt_size.restype = i64
+    lib.vnt_size.argtypes = [ctypes.c_void_p]
+    lib.vnt_register.restype = None
+    lib.vnt_register.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, i64, ctypes.c_int32,
+        ctypes.c_int32, ctypes.c_double]
+    lib.vnt_parse.restype = i64
+    lib.vnt_parse.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, i64,
+        i32p, f32p, f32p, i64, i64p,          # counters
+        i32p, f32p, i64, i64p,                # gauges
+        i32p, f32p, f32p, i64, i64p,          # histos
+        i32p, i32p, i32p, i64, i64p,          # sets
+        i64p, i64p, i64, i64p,                # unknown lines
+        i64p,                                 # samples parsed
+    ]
+
+
+def load():
+    """Returns the loaded ctypes library, or None if unavailable."""
+    global _lib, _lib_err
+    if _lib is not None or _lib_err is not None:
+        return _lib
+    with _lib_lock:
+        if _lib is not None or _lib_err is not None:
+            return _lib
+        if os.environ.get("VENEUR_TPU_DISABLE_NATIVE"):
+            _lib_err = "disabled via VENEUR_TPU_DISABLE_NATIVE"
+            return None
+        try:
+            path = _build_lib_path()
+            if not os.path.exists(path):
+                _compile(path)
+            lib = ctypes.CDLL(path)
+            _declare(lib)
+            _lib = lib
+        except Exception as e:  # missing g++, compile error, load error
+            _lib_err = str(e)
+            logger.warning("native parser unavailable, using Python "
+                           "fallback: %s", e)
+    return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def unavailable_reason() -> str | None:
+    load()
+    return _lib_err
+
+
+class ParseResult:
+    """Output of one NativeParser.parse call; arrays are views trimmed to
+    their filled lengths and valid until the parser's next parse call."""
+
+    __slots__ = ("lines", "samples", "c_rows", "c_vals", "c_rates",
+                 "g_rows", "g_vals", "h_rows", "h_vals", "h_wts",
+                 "s_rows", "s_idx", "s_rho", "unknown")
+
+    def __init__(self):
+        self.lines = 0
+        self.samples = 0
+        self.unknown = []
+
+
+def _ptr(arr: np.ndarray, ctype):
+    return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+class NativeParser:
+    """One intern table + reusable output buffers around the C library.
+
+    Thread safety: the C table is internally locked (shared for parse,
+    exclusive for register), but the output buffers here are not — callers
+    either hold their own lock or use one NativeParser per thread.
+    """
+
+    def __init__(self, lib=None):
+        self._lib = lib if lib is not None else load()
+        if self._lib is None:
+            raise RuntimeError(
+                f"native parser unavailable: {_lib_err}")
+        self._eng = self._lib.vnt_new()
+        self._cap = 0
+        self._outs = [ctypes.c_int64() for _ in range(6)]  # c,g,h,s,unk,samples
+
+    def __del__(self):
+        try:
+            if self._eng:
+                self._lib.vnt_free(self._eng)
+                self._eng = None
+        except Exception:
+            pass
+
+    def _ensure_capacity(self, cap: int) -> None:
+        if cap <= self._cap:
+            return
+        cap = max(cap, 4096)
+        self._c_rows = np.empty(cap, np.int32)
+        self._c_vals = np.empty(cap, np.float32)
+        self._c_rates = np.empty(cap, np.float32)
+        self._g_rows = np.empty(cap, np.int32)
+        self._g_vals = np.empty(cap, np.float32)
+        self._h_rows = np.empty(cap, np.int32)
+        self._h_vals = np.empty(cap, np.float32)
+        self._h_wts = np.empty(cap, np.float32)
+        self._s_rows = np.empty(cap, np.int32)
+        self._s_idx = np.empty(cap, np.int32)
+        self._s_rho = np.empty(cap, np.int32)
+        self._unk_off = np.empty(cap, np.int64)
+        self._unk_len = np.empty(cap, np.int64)
+        self._cap = cap
+
+    def size(self) -> int:
+        return self._lib.vnt_size(self._eng)
+
+    def register(self, meta_key: bytes, family: int, row: int,
+                 rate: float) -> None:
+        self._lib.vnt_register(
+            self._eng, meta_key, len(meta_key), family, row, rate)
+
+    def parse(self, buf: bytes) -> ParseResult:
+        """Parse a newline-joined packet buffer; returns trimmed COO views
+        plus the list of (unknown) raw lines for the Python slow path."""
+        # worst-case samples per family: one per two bytes of a line, plus
+        # one per line; unknown list worst case: every line
+        n_lines = buf.count(b"\n") + 1
+        self._ensure_capacity(len(buf) // 2 + n_lines + 1)
+        i32, f32, i64 = ctypes.c_int32, ctypes.c_float, ctypes.c_int64
+        ns = self._outs
+        cap = i64(self._cap)
+        lines = self._lib.vnt_parse(
+            self._eng, buf, len(buf),
+            _ptr(self._c_rows, i32), _ptr(self._c_vals, f32),
+            _ptr(self._c_rates, f32), cap, ctypes.byref(ns[0]),
+            _ptr(self._g_rows, i32), _ptr(self._g_vals, f32),
+            cap, ctypes.byref(ns[1]),
+            _ptr(self._h_rows, i32), _ptr(self._h_vals, f32),
+            _ptr(self._h_wts, f32), cap, ctypes.byref(ns[2]),
+            _ptr(self._s_rows, i32), _ptr(self._s_idx, i32),
+            _ptr(self._s_rho, i32), cap, ctypes.byref(ns[3]),
+            _ptr(self._unk_off, i64), _ptr(self._unk_len, i64),
+            cap, ctypes.byref(ns[4]),
+            ctypes.byref(ns[5]))
+        res = ParseResult()
+        res.lines = lines
+        cn, gn, hn, sn, un = (ns[i].value for i in range(5))
+        res.samples = ns[5].value
+        res.c_rows = self._c_rows[:cn]
+        res.c_vals = self._c_vals[:cn]
+        res.c_rates = self._c_rates[:cn]
+        res.g_rows = self._g_rows[:gn]
+        res.g_vals = self._g_vals[:gn]
+        res.h_rows = self._h_rows[:hn]
+        res.h_vals = self._h_vals[:hn]
+        res.h_wts = self._h_wts[:hn]
+        res.s_rows = self._s_rows[:sn]
+        res.s_idx = self._s_idx[:sn]
+        res.s_rho = self._s_rho[:sn]
+        res.unknown = [
+            buf[self._unk_off[i]:self._unk_off[i] + self._unk_len[i]]
+            for i in range(un)]
+        return res

@@ -1,0 +1,375 @@
+// Native batch DogStatsD parser + metric-key intern table.
+//
+// The hot ingest path of the framework: newline-joined packet buffers are
+// parsed here in one call (GIL released by ctypes), emitting per-family
+// COO sample arrays that the device column store applies as large batches.
+// This is the TPU build's equivalent of the reference's compiled-Go hot
+// path (reference samplers/parser.go:349-503 ParseMetric + server.go:1004
+// ingestMetric keying), built as a host C++ kernel per SURVEY.md §2's
+// native-components note.
+//
+// Parity contract: any line this parser cannot handle bit-exactly the way
+// the Python reference parser (veneur_tpu/samplers/parser.py) would —
+// events, service checks, unknown keys, malformed values, non-ASCII set
+// members — is routed back to Python via the `unknown` list, so observable
+// behavior (aggregated state, error counts, error messages) is identical.
+//
+// Intern model: the table maps the raw "meta key" bytes of a line (name
+// chunk + everything from the type pipe onward, i.e. the line minus its
+// value chunk) to a (family, row, sample_rate) entry. Rows are assigned by
+// the Python column store when it first sees a key via the slow path and
+// registered here; after that the line never touches Python again.
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <shared_mutex>
+#include <string>
+#include <string_view>
+#include <unordered_map>
+
+#include <locale.h>
+#include <math.h>
+#include <stdlib.h>
+
+namespace {
+
+enum Family : int32_t {
+  FAM_COUNTER = 0,
+  FAM_GAUGE = 1,
+  FAM_HISTO = 2,
+  FAM_SET = 3,
+};
+
+struct Entry {
+  int32_t family;
+  int32_t row;
+  float rate;  // sample rate (1.0 if unset); weight for histos is 1/rate
+};
+
+struct SvHash {
+  using is_transparent = void;
+  size_t operator()(std::string_view s) const noexcept {
+    return std::hash<std::string_view>{}(s);
+  }
+};
+struct SvEq {
+  using is_transparent = void;
+  bool operator()(std::string_view a, std::string_view b) const noexcept {
+    return a == b;
+  }
+};
+
+struct Engine {
+  std::unordered_map<std::string, Entry, SvHash, SvEq> table;
+  mutable std::shared_mutex mu;
+  locale_t c_locale;
+
+  Engine() : c_locale(newlocale(LC_ALL_MASK, "C", nullptr)) {}
+  ~Engine() {
+    if (c_locale) freelocale(c_locale);
+  }
+};
+
+// ---- hashing (parity with veneur_tpu/ops/hll_ref.py) ----------------------
+
+constexpr uint64_t kFnv64Offset = 0xCBF29CE484222325ULL;
+constexpr uint64_t kFnv64Prime = 0x100000001B3ULL;
+constexpr int kHllP = 14;
+
+inline uint64_t fnv1a64(const uint8_t* data, size_t n) {
+  uint64_t h = kFnv64Offset;
+  for (size_t i = 0; i < n; i++) {
+    h ^= data[i];
+    h *= kFnv64Prime;
+  }
+  return h;
+}
+
+inline uint64_t hash_member(const uint8_t* data, size_t n) {
+  uint64_t h = fnv1a64(data, n);
+  h ^= h >> 33;
+  h *= 0xFF51AFD7ED558CCDULL;
+  h ^= h >> 33;
+  h *= 0xC4CEB9FE1A85EC53ULL;
+  h ^= h >> 33;
+  return h;
+}
+
+inline void pos_val(uint64_t h, int32_t* idx, int32_t* rho) {
+  *idx = static_cast<int32_t>(h >> (64 - kHllP));
+  uint64_t w = (h << kHllP) | (1ULL << (kHllP - 1));
+  *rho = __builtin_clzll(w) + 1;
+}
+
+// ---- strict float parsing -------------------------------------------------
+
+// Validates the exact decimal-float grammar the Python path accepts
+// (float() minus underscores/whitespace/inf/nan, parser.py _strict_float):
+//   [+-]? ( D+ (\. D*)? | \. D+ ) ( [eE] [+-]? D+ )?
+// Everything else returns false and the line falls back to Python.
+inline bool valid_float_grammar(const uint8_t* s, size_t n) {
+  size_t i = 0;
+  if (n == 0) return false;
+  if (s[i] == '+' || s[i] == '-') i++;
+  size_t int_digits = 0;
+  while (i < n && s[i] >= '0' && s[i] <= '9') {
+    i++;
+    int_digits++;
+  }
+  size_t frac_digits = 0;
+  if (i < n && s[i] == '.') {
+    i++;
+    while (i < n && s[i] >= '0' && s[i] <= '9') {
+      i++;
+      frac_digits++;
+    }
+  }
+  if (int_digits == 0 && frac_digits == 0) return false;
+  if (i < n && (s[i] == 'e' || s[i] == 'E')) {
+    i++;
+    if (i < n && (s[i] == '+' || s[i] == '-')) i++;
+    size_t exp_digits = 0;
+    while (i < n && s[i] >= '0' && s[i] <= '9') {
+      i++;
+      exp_digits++;
+    }
+    if (exp_digits == 0) return false;
+  }
+  return i == n;
+}
+
+inline bool parse_float(const Engine* e, const uint8_t* s, size_t n,
+                        double* out) {
+  if (n >= 64 || !valid_float_grammar(s, n)) return false;
+  char buf[64];
+  memcpy(buf, s, n);
+  buf[n] = 0;
+  char* end = nullptr;
+  double v = strtod_l(buf, &end, e->c_locale);
+  if (end != buf + n) return false;
+  // overflow to inf is a ParseError in the Python path; underflow to 0 is not
+  if (!isfinite(v)) return false;
+  *out = v;
+  return true;
+}
+
+struct Out {
+  int32_t* c_rows;
+  float* c_vals;
+  float* c_rates;
+  int64_t c_cap, c_n = 0;
+  int32_t* g_rows;
+  float* g_vals;
+  int64_t g_cap, g_n = 0;
+  int32_t* h_rows;
+  float* h_vals;
+  float* h_wts;
+  int64_t h_cap, h_n = 0;
+  int32_t* s_rows;
+  int32_t* s_idx;
+  int32_t* s_rho;
+  int64_t s_cap, s_n = 0;
+  int64_t* unk_off;
+  int64_t* unk_len;
+  int64_t unk_cap, unk_n = 0;
+  int64_t samples = 0;
+};
+
+inline bool push_unknown(Out* o, int64_t off, int64_t len) {
+  if (o->unk_n >= o->unk_cap) return false;
+  o->unk_off[o->unk_n] = off;
+  o->unk_len[o->unk_n] = len;
+  o->unk_n++;
+  return true;
+}
+
+// Parses one line; returns false only if it must go to the Python slow path.
+inline bool parse_line(const Engine* e, const uint8_t* line, size_t len,
+                       std::string& keybuf, Out* o) {
+  if (len == 0) return true;  // blank lines are skipped by the splitter anyway
+  // events and service checks dispatch on these exact prefixes
+  // (reference server.go:949-1000); other '_' names are ordinary metrics
+  if (len >= 3 && line[0] == '_' &&
+      ((line[1] == 'e' && line[2] == '{') ||
+       (line[1] == 's' && line[2] == 'c'))) {
+    return false;
+  }
+
+  const uint8_t* pipe =
+      static_cast<const uint8_t*>(memchr(line, '|', len));
+  if (pipe == nullptr) return false;
+  size_t type_start = pipe - line;
+  const uint8_t* colon =
+      static_cast<const uint8_t*>(memchr(line, ':', type_start));
+  if (colon == nullptr) return false;
+  size_t value_start = colon - line;
+
+  keybuf.clear();
+  keybuf.append(reinterpret_cast<const char*>(line), value_start);
+  keybuf.append(reinterpret_cast<const char*>(line + type_start),
+                len - type_start);
+  auto it = e->table.find(std::string_view(keybuf));
+  if (it == e->table.end()) return false;
+  const Entry& ent = it->second;
+
+  // one sample per colon-separated value; a trailing empty segment is
+  // ignored, an empty segment elsewhere is an error (Python path parity)
+  const uint8_t* vc = line + value_start + 1;
+  size_t vlen = type_start - value_start - 1;
+  int64_t n_emitted[4] = {o->c_n, o->g_n, o->h_n, o->s_n};
+  int64_t samples_before = o->samples;
+  while (vlen > 0) {
+    const uint8_t* next =
+        static_cast<const uint8_t*>(memchr(vc, ':', vlen));
+    size_t seg_len = (next == nullptr) ? vlen : (size_t)(next - vc);
+    const uint8_t* seg = vc;
+    if (next == nullptr) {
+      vlen = 0;
+    } else {
+      vlen -= seg_len + 1;
+      vc = next + 1;
+    }
+
+    bool ok = false;
+    switch (ent.family) {
+      case FAM_SET: {
+        // non-ASCII members go to Python: its parser round-trips them
+        // through UTF-8 decode with replacement, changing the hashed bytes
+        bool ascii = true;
+        for (size_t i = 0; i < seg_len; i++) {
+          if (seg[i] >= 0x80) {
+            ascii = false;
+            break;
+          }
+        }
+        if (!ascii || o->s_n >= o->s_cap) break;
+        int32_t idx, rho;
+        pos_val(hash_member(seg, seg_len), &idx, &rho);
+        o->s_rows[o->s_n] = ent.row;
+        o->s_idx[o->s_n] = idx;
+        o->s_rho[o->s_n] = rho;
+        o->s_n++;
+        ok = true;
+        break;
+      }
+      case FAM_COUNTER: {
+        double v;
+        if (o->c_n >= o->c_cap || !parse_float(e, seg, seg_len, &v)) break;
+        o->c_rows[o->c_n] = ent.row;
+        o->c_vals[o->c_n] = static_cast<float>(v);
+        o->c_rates[o->c_n] = ent.rate;
+        o->c_n++;
+        ok = true;
+        break;
+      }
+      case FAM_GAUGE: {
+        double v;
+        if (o->g_n >= o->g_cap || !parse_float(e, seg, seg_len, &v)) break;
+        o->g_rows[o->g_n] = ent.row;
+        o->g_vals[o->g_n] = static_cast<float>(v);
+        o->g_n++;
+        ok = true;
+        break;
+      }
+      case FAM_HISTO: {
+        double v;
+        if (o->h_n >= o->h_cap || !parse_float(e, seg, seg_len, &v)) break;
+        o->h_rows[o->h_n] = ent.row;
+        o->h_vals[o->h_n] = static_cast<float>(v);
+        o->h_wts[o->h_n] = 1.0f / ent.rate;
+        o->h_n++;
+        ok = true;
+        break;
+      }
+      default:
+        break;
+    }
+    if (!ok) {
+      // a malformed segment fails the whole line in the Python parser;
+      // roll back everything this line emitted and defer to Python
+      o->c_n = n_emitted[0];
+      o->g_n = n_emitted[1];
+      o->h_n = n_emitted[2];
+      o->s_n = n_emitted[3];
+      o->samples = samples_before;
+      return false;
+    }
+    o->samples++;
+  }
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* vnt_new() { return new Engine(); }
+
+void vnt_free(void* e) { delete static_cast<Engine*>(e); }
+
+int64_t vnt_size(void* ep) {
+  Engine* e = static_cast<Engine*>(ep);
+  std::shared_lock lock(e->mu);
+  return static_cast<int64_t>(e->table.size());
+}
+
+void vnt_register(void* ep, const uint8_t* key, int64_t keylen,
+                  int32_t family, int32_t row, double rate) {
+  Engine* e = static_cast<Engine*>(ep);
+  Entry ent{family, row, static_cast<float>(rate)};
+  std::unique_lock lock(e->mu);
+  e->table.insert_or_assign(
+      std::string(reinterpret_cast<const char*>(key), keylen), ent);
+}
+
+// Parses a newline-joined buffer of packets. Returns the number of
+// non-empty lines seen (the packets_received delta). Per-family sample
+// arrays are filled up to their capacities; lines the native path cannot
+// take are returned as (offset, length) pairs for the Python slow path.
+int64_t vnt_parse(void* ep, const uint8_t* buf, int64_t buflen,
+                  int32_t* c_rows, float* c_vals, float* c_rates,
+                  int64_t c_cap, int64_t* c_n,
+                  int32_t* g_rows, float* g_vals, int64_t g_cap, int64_t* g_n,
+                  int32_t* h_rows, float* h_vals, float* h_wts,
+                  int64_t h_cap, int64_t* h_n,
+                  int32_t* s_rows, int32_t* s_idx, int32_t* s_rho,
+                  int64_t s_cap, int64_t* s_n,
+                  int64_t* unk_off, int64_t* unk_len, int64_t unk_cap,
+                  int64_t* unk_n, int64_t* samples_out) {
+  Engine* e = static_cast<Engine*>(ep);
+  Out o;
+  o.c_rows = c_rows; o.c_vals = c_vals; o.c_rates = c_rates; o.c_cap = c_cap;
+  o.g_rows = g_rows; o.g_vals = g_vals; o.g_cap = g_cap;
+  o.h_rows = h_rows; o.h_vals = h_vals; o.h_wts = h_wts; o.h_cap = h_cap;
+  o.s_rows = s_rows; o.s_idx = s_idx; o.s_rho = s_rho; o.s_cap = s_cap;
+  o.unk_off = unk_off; o.unk_len = unk_len; o.unk_cap = unk_cap;
+
+  int64_t lines = 0;
+  thread_local std::string keybuf;
+  std::shared_lock lock(e->mu);
+  int64_t pos = 0;
+  while (pos < buflen) {
+    const uint8_t* nl = static_cast<const uint8_t*>(
+        memchr(buf + pos, '\n', buflen - pos));
+    int64_t line_len = (nl == nullptr) ? (buflen - pos)
+                                       : (nl - (buf + pos));
+    if (line_len > 0) {
+      lines++;
+      if (!parse_line(e, buf + pos, line_len, keybuf, &o)) {
+        push_unknown(&o, pos, line_len);
+      }
+    }
+    pos += line_len + 1;
+  }
+  *c_n = o.c_n;
+  *g_n = o.g_n;
+  *h_n = o.h_n;
+  *s_n = o.s_n;
+  *unk_n = o.unk_n;
+  *samples_out = o.samples;
+  return lines;
+}
+
+}  // extern "C"

@@ -10,18 +10,23 @@ whole table of digests is three dense device arrays (means, weights of shape
   2. Per-row midpoint quantiles come from a segmented prefix-sum (cumsum +
      running-max trick over row starts).
   3. Each sample maps to a k-scale bucket (arcsine scale, parity with
-     merging_digest.go:259-262) and is scatter-added into a per-key partial
-     digest grid.
-  4. The partial grid merges with the main store: concat along the centroid
-     axis, per-row sort, recompute k-buckets from combined prefix weights,
-     and segment-reduce via a one-hot matmul (MXU-friendly einsum).
+     merging_digest.go:259-262) and is scatter-added into the per-key slot
+     grid, stored as (weight, weight*value) accumulators so ingestion is
+     pure scatter-add — O(B log B) per batch, independent of table size.
+  4. Slot means blur slightly as batches with shifting distributions land
+     in the same k-bucket; a periodic `recompress_state` pass (sort by
+     slot mean, re-bucket by combined prefix weights, segment-reduce via a
+     one-hot matmul — the MXU path) re-tightens them. The import/collective
+     merge paths always recompress.
 
-The same invariant as the reference holds: every centroid spans at most one
-k-unit, so quantile error bounds match the sequential algorithm's class.
-Bucketing by floor(k) bounds the store at `compression` centroids per key
-(the reference's bound is ceil(pi*compression/2); ours is tighter but the
-same order). Validated against veneur_tpu.ops.tdigest_ref by statistical
-tests (tests/test_batch_tdigest.py).
+The same invariant as the reference holds: every slot spans at most one
+k-unit of its batch, so quantile error stays in the sequential algorithm's
+class (the reference likewise buffers raw samples and merges amortized,
+merging_digest.go:115-140). Bucketing by floor(k) bounds the store at
+`compression` centroids per key (the reference's bound is
+ceil(pi*compression/2); ours is tighter but the same order). Validated
+against veneur_tpu.ops.tdigest_ref by statistical tests
+(tests/test_tdigest.py).
 """
 
 from __future__ import annotations
@@ -47,7 +52,7 @@ def init_state(num_keys: int) -> Dict[str, jnp.ndarray]:
     k = num_keys
     f = jnp.float32
     return {
-        "means": jnp.zeros((k, C), f),
+        "wv": jnp.zeros((k, C), f),  # per-slot sum of weight*value
         "weights": jnp.zeros((k, C), f),
         "dmin": jnp.full((k,), _INF, f),
         "dmax": jnp.full((k,), -_INF, f),
@@ -116,7 +121,7 @@ def apply_batch(state, rows, values, weights):
       padding and is dropped by every scatter.
     values: (B,) f32 sample values; weights: (B,) f32 (1/sample_rate).
     """
-    num_keys = state["means"].shape[0]
+    num_keys = state["wv"].shape[0]
     valid = rows < num_keys
 
     # scalar per-key stats (exact, not sketched)
@@ -136,23 +141,29 @@ def apply_batch(state, rows, values, weights):
     state["dmin"] = state["dmin"].at[rows].min(vmin, mode="drop")
     state["dmax"] = state["dmax"].at[rows].max(vmax, mode="drop")
 
-    # partial digest for this batch: lex-sort then k-bucket scatter
+    # k-bucket each sample by its batch-local midpoint quantile, then
+    # scatter-accumulate straight into the slot grids
     srows, svals, swts = jax.lax.sort(
         (rows, values, w_eff), num_keys=2, dimension=-1)
-    bucket, totals = _bucketize(srows, swts, num_keys)
-    batch_w = jnp.zeros((num_keys, C), jnp.float32).at[srows, bucket].add(
+    bucket, _totals = _bucketize(srows, swts, num_keys)
+    state["weights"] = state["weights"].at[srows, bucket].add(
         swts, mode="drop")
-    batch_wv = jnp.zeros((num_keys, C), jnp.float32).at[srows, bucket].add(
+    state["wv"] = state["wv"].at[srows, bucket].add(
         swts * svals, mode="drop")
-    batch_m = jnp.where(batch_w > 0, batch_wv / jnp.maximum(batch_w, 1e-30), 0.0)
+    return state
 
-    # merge partial into main store; untouched rows keep exact prior state
-    cat_m = jnp.concatenate([state["means"], batch_m], axis=-1)
-    cat_w = jnp.concatenate([state["weights"], batch_w], axis=-1)
-    new_m, new_w = _recompress(cat_m, cat_w, num_keys)
-    touched = (totals > 0)[:, None]
-    state["means"] = jnp.where(touched, new_m, state["means"])
-    state["weights"] = jnp.where(touched, new_w, state["weights"])
+
+@jax.jit
+def recompress_state(state):
+    """Re-tighten every row's slot grid: sort slots by mean and re-bucket
+    by combined prefix weights. Run periodically between batches (and by
+    every merge path); ingestion itself never needs it."""
+    state = dict(state)
+    w = state["weights"]
+    m = jnp.where(w > 0, state["wv"] / jnp.maximum(w, 1e-30), 0.0)
+    new_m, new_w = _recompress(m, w, w.shape[0])
+    state["wv"] = new_m * new_w
+    state["weights"] = new_w
     return state
 
 
@@ -165,25 +176,28 @@ def merge_centroid_rows(state, rows, in_means, in_weights, in_min, in_max,
     rows: (B,) int32 target row per incoming digest (row == K pads);
     in_means/in_weights: (B, C) centroid arrays; in_min/in_max/in_recip: (B,).
     """
-    num_keys = state["means"].shape[0]
+    num_keys = state["wv"].shape[0]
     state = dict(state)
     state["dmin"] = state["dmin"].at[rows].min(in_min, mode="drop")
     state["dmax"] = state["dmax"].at[rows].max(in_max, mode="drop")
     state["drecip"] = state["drecip"].at[rows].add(in_recip, mode="drop")
 
     # overlay incoming digests on a per-key grid (same-row digests pre-blend
-    # by bucket), then a full sort+recompress merges them with the store
+    # by bucket), then a full sort+recompress merges them with the store —
+    # recompression here keeps skewed incoming digests from blurring slots
     grid_w = jnp.zeros((num_keys, C), jnp.float32).at[rows].add(
         in_weights, mode="drop")
     grid_wv = jnp.zeros((num_keys, C), jnp.float32).at[rows].add(
         in_weights * in_means, mode="drop")
     grid_m = jnp.where(grid_w > 0, grid_wv / jnp.maximum(grid_w, 1e-30), 0.0)
 
-    cat_m = jnp.concatenate([state["means"], grid_m], axis=-1)
-    cat_w = jnp.concatenate([state["weights"], grid_w], axis=-1)
+    w = state["weights"]
+    m = jnp.where(w > 0, state["wv"] / jnp.maximum(w, 1e-30), 0.0)
+    cat_m = jnp.concatenate([m, grid_m], axis=-1)
+    cat_w = jnp.concatenate([w, grid_w], axis=-1)
     new_m, new_w = _recompress(cat_m, cat_w, num_keys)
     touched = (jnp.sum(grid_w, axis=-1) > 0)[:, None]
-    state["means"] = jnp.where(touched, new_m, state["means"])
+    state["wv"] = jnp.where(touched, new_m * new_w, state["wv"])
     state["weights"] = jnp.where(touched, new_w, state["weights"])
     return state
 
@@ -193,7 +207,9 @@ def flush_quantiles(state, percentiles: Sequence[float]):
     """Compute per-key digest outputs: quantiles (K, P), plus digest count,
     sum, min, max, hmean. Interpolation parity with merging_digest.go:302-332
     (uniform within centroid, bounds at neighbor midpoints, min/max ends)."""
-    means, weights = state["means"], state["weights"]
+    weights = state["weights"]
+    means = jnp.where(weights > 0,
+                      state["wv"] / jnp.maximum(weights, 1e-30), 0.0)
     num_keys = means.shape[0]
 
     sort_key = jnp.where(weights > 0, means, _INF)
@@ -269,6 +285,9 @@ def pack_centroids(means, weights, cap: int = C):
 
 def export_centroids(state):
     """Device->host view of the serializable digest state (forward plane)."""
-    return (np.asarray(state["means"]), np.asarray(state["weights"]),
+    w = np.asarray(state["weights"])
+    wv = np.asarray(state["wv"])
+    means = np.divide(wv, w, out=np.zeros_like(wv), where=w > 0)
+    return (means, w,
             np.asarray(state["dmin"]), np.asarray(state["dmax"]),
             np.asarray(state["drecip"]))

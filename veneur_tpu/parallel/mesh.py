@@ -85,14 +85,16 @@ def _merge_digest_allgather(histo_state):
     """Inside shard_map: gather every shard's centroid grid and recompress.
     Equivalent to the global veneur re-inserting each local digest's
     centroids (worker.go:455-457), done once as a batched kernel."""
-    num_keys = histo_state["means"].shape[0]
-    g_means = jax.lax.all_gather(histo_state["means"], SHARD_AXIS)  # (n,K,C)
-    g_weights = jax.lax.all_gather(histo_state["weights"], SHARD_AXIS)
+    num_keys = histo_state["wv"].shape[0]
+    w = histo_state["weights"]
+    m = jnp.where(w > 0, histo_state["wv"] / jnp.maximum(w, 1e-30), 0.0)
+    g_means = jax.lax.all_gather(m, SHARD_AXIS)  # (n,K,C)
+    g_weights = jax.lax.all_gather(w, SHARD_AXIS)
     cat_m = jnp.moveaxis(g_means, 0, 1).reshape(num_keys, -1)
     cat_w = jnp.moveaxis(g_weights, 0, 1).reshape(num_keys, -1)
     new_m, new_w = batch_tdigest._recompress(cat_m, cat_w, num_keys)
     return {
-        "means": new_m,
+        "wv": new_m * new_w,
         "weights": new_w,
         "dmin": jax.lax.pmin(histo_state["dmin"], SHARD_AXIS),
         "dmax": jax.lax.pmax(histo_state["dmax"], SHARD_AXIS),
