@@ -35,14 +35,15 @@ class StatsdEmitter:
         self.emitted = 0
 
     def ingest_metric(self, metric) -> None:
+        from veneur_tpu.cmd.veneur_emit import render_metric_packet
         kind = {m.COUNTER: "c", m.GAUGE: "g"}.get(metric.type, "g")
-        tag_part = ("|#" + ",".join(metric.tags)) if metric.tags else ""
-        value = metric.value
-        if kind == "c":
-            value = int(value)
-        packet = f"{self.prefix}{metric.name}:{value}|{kind}{tag_part}"
+        # counter deltas stay float: truncating would permanently drop
+        # fractional growth of slow cumulative counters
+        packet = render_metric_packet(
+            f"{self.prefix}{metric.name}", metric.value, kind,
+            list(metric.tags))
         try:
-            self.sock.sendto(packet.encode(), self.addr)
+            self.sock.sendto(packet, self.addr)
             self.emitted += 1
         except OSError as e:
             log.error("statsd send failed: %s", e)

@@ -27,7 +27,13 @@ def collect(stats: ScopedClient, start_time: float,
     stats.gauge("cpu.system_seconds", ru.ru_stime)
     counts = gc.get_count()
     stats.gauge("gc.gen0_collections", counts[0])
-    stats.gauge("gc.objects_tracked", len(gc.get_objects()))
+    # O(1) allocation telemetry; gc.get_objects() would materialize a list
+    # of every live object while holding the GIL
+    gen_stats = gc.get_stats()
+    stats.gauge("gc.collections_total",
+                sum(g["collections"] for g in gen_stats))
+    stats.gauge("gc.collected_total",
+                sum(g["collected"] for g in gen_stats))
     stats.gauge("threads.count", threading.active_count())
     stats.count("uptime_ms", int((time.time() - start_time) * 1000))
     if include_device:

@@ -76,11 +76,16 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(200, _device_memory_report(),
                        "application/json")
         elif path == "/debug/threads":
-            import faulthandler
-            import io
-            buf = io.StringIO()
-            faulthandler.dump_traceback(file=buf, all_threads=True)
-            self._send(200, buf.getvalue().encode())
+            # faulthandler needs a real fd; format stacks directly instead
+            import sys
+            import traceback
+            names = {t.ident: t.name for t in threading.enumerate()}
+            parts = []
+            for ident, frame in sys._current_frames().items():
+                parts.append(f"Thread {names.get(ident, '?')} ({ident}):\n")
+                parts.extend(traceback.format_stack(frame))
+                parts.append("\n")
+            self._send(200, "".join(parts).encode())
         else:
             self._send(404, b"not found\n")
 

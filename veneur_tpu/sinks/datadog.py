@@ -90,12 +90,23 @@ class DatadogMetricSink(MetricSink):
         if series:
             chunks = [series[i:i + self.flush_max_per_body]
                       for i in range(0, len(series), self.flush_max_per_body)]
-            threads = [threading.Thread(
-                target=self._post_series_safe, args=(chunk,), daemon=True)
-                for chunk in chunks[1:]]
+            # concurrency capped at num_workers POSTs (reference
+            # datadog.go:182-207 chunks a flush across num_workers)
+            it = iter(chunks)
+
+            def worker():
+                while True:
+                    try:
+                        chunk = next(it)
+                    except StopIteration:
+                        return
+                    self._post_series_safe(chunk)
+
+            threads = [threading.Thread(target=worker, daemon=True)
+                       for _ in range(min(self.num_workers, len(chunks)) - 1)]
             for t in threads:
                 t.start()
-            self._post_series_safe(chunks[0])
+            worker()
             for t in threads:
                 t.join()
         for check in checks:
@@ -207,7 +218,7 @@ def _metric_factory(sink_config, server_config):
         hostname=server_config.hostname,
         interval=server_config.interval,
         flush_max_per_body=int(c.get("datadog_flush_max_per_body", 25_000)),
-        num_workers=int(c.get("datadog_span_buffer_size",
+        num_workers=int(c.get("datadog_num_workers",
                               server_config.num_workers) or 4),
         tags=c.get("tags", []) or [])
 

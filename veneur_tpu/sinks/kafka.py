@@ -18,7 +18,7 @@ import logging
 import threading
 from typing import Any, List, Optional
 
-from veneur_tpu.samplers.metrics import InterMetric
+from veneur_tpu.samplers.metrics import InterMetric, MetricType
 from veneur_tpu.sinks import (
     MetricSink, SpanSink, register_metric_sink, register_span_sink,
 )
@@ -124,12 +124,22 @@ class KafkaMetricSink(MetricSink):
         return "kafka"
 
     def flush(self, metrics: List[InterMetric]) -> None:
-        if self.producer is None or not self.metric_topic:
+        if self.producer is None:
             return
+        sent = False
         for m in metrics:
+            # service checks route to check_topic (reference
+            # sinks/kafka/kafka.go FlushCheck split), everything else to
+            # metric_topic
+            topic = (self.check_topic if m.type == MetricType.STATUS
+                     else self.metric_topic)
+            if not topic:
+                continue
             key = m.name.encode() if self.partition_by_name else b""
-            self.producer.send(self.metric_topic, key, encode_metric_json(m))
-        self.producer.flush()
+            self.producer.send(topic, key, encode_metric_json(m))
+            sent = True
+        if sent:
+            self.producer.flush()
 
     def flush_other_samples(self, samples) -> None:
         if self.producer is None or not self.event_topic:

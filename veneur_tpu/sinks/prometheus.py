@@ -90,14 +90,15 @@ class PrometheusMetricSink(MetricSink):
         if not self.repeater_address or not metrics:
             return
         host, _, port = self.repeater_address.rpartition(":")
+        from veneur_tpu.cmd.veneur_emit import render_metric_packet
         lines = []
         for m in metrics:
             if m.type == MetricType.STATUS:
                 continue
             kind = "c" if m.type == MetricType.COUNTER else "g"
-            tag_part = ("|#" + ",".join(m.tags)) if m.tags else ""
-            lines.append(f"{m.name}:{m.value}|{kind}{tag_part}")
-        payload = "\n".join(lines).encode()
+            lines.append(render_metric_packet(
+                m.name, m.value, kind, list(m.tags)))
+        payload = b"\n".join(lines)
         try:
             if self.network == "tcp":
                 with socket.create_connection((host, int(port)),
@@ -107,7 +108,7 @@ class PrometheusMetricSink(MetricSink):
                 s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
                 try:  # chunk to stay under typical datagram limits
                     for i in range(0, len(lines), 25):
-                        s.sendto("\n".join(lines[i:i + 25]).encode(),
+                        s.sendto(b"\n".join(lines[i:i + 25]),
                                  (host, int(port)))
                 finally:
                     s.close()
