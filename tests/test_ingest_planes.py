@@ -1,0 +1,180 @@
+"""Tests for the auxiliary ingest planes: gRPC (DogStatsD packets + SSF
+spans), TLS TCP with mutual auth, and unique-timeseries accounting."""
+
+from __future__ import annotations
+
+import socket
+import ssl
+import subprocess
+import time
+
+import grpc
+import pytest
+
+from veneur_tpu import ssf
+from veneur_tpu.config import Config
+from veneur_tpu.core.server import Server
+from veneur_tpu.sinks.channel import ChannelMetricSink, ChannelSpanSink
+
+
+def make_server(**cfg_kwargs):
+    cfg = Config()
+    cfg.interval = 100.0
+    for k, v in cfg_kwargs.items():
+        setattr(cfg, k, v)
+    cfg.apply_defaults()
+    ch = ChannelMetricSink()
+    spans = ChannelSpanSink()
+    server = Server(cfg, extra_metric_sinks=[ch], extra_span_sinks=[spans])
+    server.start()
+    return server, ch, spans
+
+
+def flushed(server, ch):
+    server.flush()
+    return {m.name: m for m in ch.wait_flush()}
+
+
+class TestGrpcIngest:
+    def test_send_packet_and_span(self):
+        server, ch, spans = make_server(
+            grpc_listen_addresses=["127.0.0.1:0"])
+        try:
+            addr = server.grpc_ingest_servers[0].address
+            chan = grpc.insecure_channel(addr)
+            send_packet = chan.unary_unary(
+                "/dogstatsd.DogstatsdGRPC/SendPacket",
+                request_serializer=lambda b: b,
+                response_deserializer=lambda b: b)
+            from veneur_tpu.core.protos import dogstatsd_pb2
+            pkt = dogstatsd_pb2.DogstatsdPacket(
+                packetBytes=b"grpc.count:7|c\ngrpc.gauge:1.5|g")
+            send_packet(pkt.SerializeToString())
+
+            span = ssf.SSFSpan(
+                id=5, trace_id=5, name="op", service="svc",
+                start_timestamp=1, end_timestamp=2)
+            send_span = chan.unary_unary(
+                "/ssf.SSFGRPC/SendSpan",
+                request_serializer=lambda b: b,
+                response_deserializer=lambda b: b)
+            send_span(span.SerializeToString())
+            chan.close()
+
+            deadline = time.time() + 5
+            while time.time() < deadline and not spans.spans:
+                time.sleep(0.02)
+            # assert before flush(): the channel span sink drains its
+            # buffer into the queue on every flush
+            assert any(s.name == "op" for s in spans.spans)
+            metrics = flushed(server, ch)
+            assert metrics["grpc.count"].value == 7
+            assert metrics["grpc.gauge"].value == 1.5
+        finally:
+            server.shutdown()
+
+
+def _openssl(*args):
+    subprocess.run(["openssl", *args], check=True, capture_output=True)
+
+
+@pytest.fixture(scope="module")
+def tls_certs(tmp_path_factory):
+    """Self-signed CA + server and client certs (the reference ships
+    equivalent fixtures in testdata/*.pem for TestTCPConfig)."""
+    d = tmp_path_factory.mktemp("tls")
+    ca_key, ca_crt = d / "ca.key", d / "ca.crt"
+    _openssl("req", "-x509", "-newkey", "rsa:2048", "-nodes",
+             "-keyout", str(ca_key), "-out", str(ca_crt),
+             "-days", "1", "-subj", "/CN=test-ca")
+    for who, cn in (("server", "127.0.0.1"), ("client", "test-client")):
+        key, csr, crt = d / f"{who}.key", d / f"{who}.csr", d / f"{who}.crt"
+        _openssl("req", "-newkey", "rsa:2048", "-nodes",
+                 "-keyout", str(key), "-out", str(csr), "-subj", f"/CN={cn}")
+        ext = d / f"{who}.ext"
+        ext.write_text("subjectAltName=IP:127.0.0.1\n" if who == "server"
+                       else "extendedKeyUsage=clientAuth\n")
+        _openssl("x509", "-req", "-in", str(csr), "-CA", str(ca_crt),
+                 "-CAkey", str(ca_key), "-CAcreateserial",
+                 "-out", str(crt), "-days", "1", "-extfile", str(ext))
+    return d
+
+
+class TestTLSTCP:
+    def _server(self, certs, require_client_cert: bool):
+        from veneur_tpu.util.secret import StringSecret
+        kwargs = dict(
+            statsd_listen_addresses=["tcp://127.0.0.1:0"],
+            tls_certificate=(certs / "server.crt").read_text(),
+            tls_key=StringSecret((certs / "server.key").read_text()),
+        )
+        if require_client_cert:
+            kwargs["tls_authority_certificate"] = (
+                certs / "ca.crt").read_text()
+        return make_server(**kwargs)
+
+    def _connect(self, certs, addr, with_client_cert: bool):
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+        ctx.load_verify_locations(cafile=str(certs / "ca.crt"))
+        if with_client_cert:
+            ctx.load_cert_chain(str(certs / "client.crt"),
+                                str(certs / "client.key"))
+        raw = socket.create_connection(addr, timeout=5)
+        return ctx.wrap_socket(raw, server_hostname="127.0.0.1")
+
+    def test_tls_roundtrip(self, tls_certs):
+        server, ch, _ = self._server(tls_certs, require_client_cert=False)
+        try:
+            conn = self._connect(tls_certs, server.local_addr("tcp"), False)
+            conn.sendall(b"tls.count:3|c\n")
+            conn.close()
+            deadline = time.time() + 5
+            while (time.time() < deadline
+                   and server.stats["packets_received"] < 1):
+                time.sleep(0.02)
+            assert flushed(server, ch)["tls.count"].value == 3
+        finally:
+            server.shutdown()
+
+    def test_mutual_auth_requires_client_cert(self, tls_certs):
+        server, ch, _ = self._server(tls_certs, require_client_cert=True)
+        try:
+            addr = server.local_addr("tcp")
+            # with client cert: accepted
+            conn = self._connect(tls_certs, addr, True)
+            conn.sendall(b"mtls.count:1|c\n")
+            conn.close()
+            # without a client cert the server rejects the handshake; with
+            # TLS 1.3 the client may only see the alert (or a reset) on
+            # first read — either way, the packet must not be ingested
+            try:
+                conn2 = self._connect(tls_certs, addr, False)
+                conn2.sendall(b"mtls.count:100|c\n")
+                conn2.recv(1)
+                conn2.close()
+            except (ssl.SSLError, ConnectionError, OSError):
+                pass
+            deadline = time.time() + 5
+            while (time.time() < deadline
+                   and server.stats["packets_received"] < 1):
+                time.sleep(0.02)
+            assert flushed(server, ch)["mtls.count"].value == 1
+        finally:
+            server.shutdown()
+
+
+class TestUniqueTimeseries:
+    def test_exact_count(self):
+        server, ch, _ = make_server(count_unique_timeseries=True)
+        try:
+            server.handle_packet_batch([
+                b"a:1|c\na:2|c\nb:1|g\nc:1:2|ms\nd:x|s\nd:y|s",
+                b"a:1|c|#tag:one",  # distinct timeseries (tags differ)
+            ])
+            assert server.store.unique_timeseries() == 5
+            server.flush()
+            ch.wait_flush()
+            # interval-scoped: resets after flush
+            assert server.store.unique_timeseries() == 0
+        finally:
+            server.shutdown()

@@ -1,0 +1,100 @@
+"""Tests for trace context propagation (ambient parenting + HTTP header
+inject/extract across the reference's supported formats)."""
+
+from __future__ import annotations
+
+from veneur_tpu import trace
+from veneur_tpu.trace import context as tctx
+
+
+class _Capture:
+    def __init__(self):
+        self.spans = []
+
+    def send(self, span):
+        self.spans.append(span)
+
+    def flush(self):
+        pass
+
+    def close(self):
+        pass
+
+
+def make_client():
+    backend = _Capture()
+    return trace.Client(backend), backend
+
+
+class TestAmbientParenting:
+    def test_nested_spans_share_trace(self):
+        client, backend = make_client()
+        with tctx.start_span("outer", service="svc", client=client) as outer:
+            assert tctx.current_span() is outer
+            with tctx.start_span("inner", client=client) as inner:
+                assert inner.trace_id == outer.trace_id
+                assert inner.proto.parent_id == outer.id
+                assert inner.proto.service == "svc"
+        assert tctx.current_span() is None
+        client.flush()
+        client.close()
+        assert [s.name for s in backend.spans] == ["inner", "outer"]
+
+    def test_error_flag_on_exception(self):
+        client, backend = make_client()
+        try:
+            with tctx.start_span("boom", service="svc", client=client):
+                raise RuntimeError("x")
+        except RuntimeError:
+            pass
+        client.flush()
+        client.close()
+        assert backend.spans[0].error is True
+
+    def test_global_client(self):
+        client, backend = make_client()
+        tctx.set_global_client(client)
+        try:
+            with tctx.start_span("g", service="svc"):
+                pass
+            client.flush()
+            assert [s.name for s in backend.spans] == ["g"]
+        finally:
+            tctx.set_global_client(None)
+            client.close()
+
+
+class TestHeaderPropagation:
+    def test_inject_extract_roundtrip(self):
+        client, _ = make_client()
+        with tctx.start_span("out", service="svc", client=client) as span:
+            headers = tctx.inject_headers(span)
+            assert headers["ot-tracer-sampled"] == "true"
+            tid, sid = tctx.extract_context(headers)
+            assert tid == span.trace_id
+            assert sid == span.id
+        client.close()
+
+    def test_extract_formats(self):
+        cases = [
+            ({"ot-tracer-traceid": "ff", "ot-tracer-spanid": "10"},
+             (255, 16)),
+            ({"Trace-Id": "12", "Span-Id": "34"}, (12, 34)),
+            ({"X-Trace-Id": "5", "X-Span-Id": "6"}, (5, 6)),
+            ({"Traceid": "7", "Spanid": "8"}, (7, 8)),
+            ({}, (0, 0)),
+            ({"Trace-Id": "nope", "Span-Id": "1"}, (0, 0)),
+        ]
+        for headers, want in cases:
+            assert tctx.extract_context(headers) == want, headers
+
+    def test_continue_remote_trace(self):
+        client, backend = make_client()
+        headers = {"Trace-Id": "42", "Span-Id": "7"}
+        with tctx.start_span_from_headers("handler", headers,
+                                          service="svc", client=client) as s:
+            assert s.trace_id == 42
+            assert s.proto.parent_id == 7
+        client.flush()
+        client.close()
+        assert backend.spans[0].name == "handler"

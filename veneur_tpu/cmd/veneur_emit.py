@@ -21,6 +21,10 @@ import socket
 import subprocess
 import sys
 import time
+
+from veneur_tpu.protocol.render import (  # noqa: F401 (re-export)
+    render_event_packet, render_metric_packet, render_service_check_packet,
+)
 from typing import List, Optional, Tuple
 
 
@@ -32,50 +36,6 @@ def parse_hostport(hostport: str, default_scheme: str = "udp"
         scheme, rest = hostport.split("://", 1)
     host, _, port = rest.rpartition(":")
     return scheme, host or "127.0.0.1", int(port)
-
-
-def render_metric_packet(name: str, value, mtype: str,
-                         tags: List[str], rate: float = 1.0) -> bytes:
-    parts = [f"{name}:{value}|{mtype}"]
-    if rate != 1.0:
-        parts.append(f"@{rate}")
-    if tags:
-        parts.append("#" + ",".join(tags))
-    return "|".join(parts).encode()
-
-
-def render_event_packet(title: str, text: str, tags: List[str],
-                        aggregation_key: str = "", priority: str = "",
-                        source_type: str = "", alert_type: str = "",
-                        hostname: str = "") -> bytes:
-    header = f"_e{{{len(title.encode())},{len(text.encode())}}}:{title}|{text}"
-    sections = []
-    if aggregation_key:
-        sections.append(f"k:{aggregation_key}")
-    if priority:
-        sections.append(f"p:{priority}")
-    if source_type:
-        sections.append(f"s:{source_type}")
-    if alert_type:
-        sections.append(f"t:{alert_type}")
-    if hostname:
-        sections.append(f"h:{hostname}")
-    if tags:
-        sections.append("#" + ",".join(tags))
-    return ("|".join([header] + sections)).encode()
-
-
-def render_service_check_packet(name: str, status: int, tags: List[str],
-                                message: str = "",
-                                hostname: str = "") -> bytes:
-    parts = [f"_sc|{name}|{status}"]
-    if hostname:
-        parts.append(f"h:{hostname}")
-    if tags:
-        parts.append("#" + ",".join(tags))
-    if message:
-        parts.append(f"m:{message}")
-    return "|".join(parts).encode()
 
 
 def send_packet(hostport: str, packet: bytes) -> None:

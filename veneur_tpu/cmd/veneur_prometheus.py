@@ -18,6 +18,7 @@ import socket
 import sys
 import threading
 
+from veneur_tpu.protocol.render import render_metric_packet
 from veneur_tpu.samplers import metrics as m
 from veneur_tpu.sources.openmetrics import OpenMetricsSource
 
@@ -35,7 +36,6 @@ class StatsdEmitter:
         self.emitted = 0
 
     def ingest_metric(self, metric) -> None:
-        from veneur_tpu.cmd.veneur_emit import render_metric_packet
         kind = {m.COUNTER: "c", m.GAUGE: "g"}.get(metric.type, "g")
         # counter deltas stay float: truncating would permanently drop
         # fractional growth of slow cumulative counters

@@ -476,3 +476,14 @@ class ColumnStore:
         self.gauges.apply_pending()
         self.histos.apply_pending()
         self.sets.apply_pending()
+
+    def unique_timeseries(self) -> int:
+        """Timeseries touched this interval. The reference approximates
+        this with a per-worker HLL over key digests (worker.go:305-347);
+        the column store's touched masks make it exact for free."""
+        total = 0
+        for table in (self.counters, self.gauges, self.histos, self.sets,
+                      self.statuses):
+            with table.lock:
+                total += int(np.count_nonzero(table.touched))
+        return total

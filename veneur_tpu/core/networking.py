@@ -71,6 +71,60 @@ def start_statsd(address: str, server, num_readers: int = 1,
     raise ValueError(f"unsupported statsd listen scheme: {u.scheme}")
 
 
+def build_tls_context(config):
+    """Server-side TLS context from config (reference server.go:569-627:
+    tls_key + tls_certificate enable TLS on TCP listeners;
+    tls_authority_certificate additionally requires client certs).
+    Values may be inline PEM strings (like the reference's YAML) or file
+    paths."""
+    import ssl
+    import tempfile
+
+    key = config.tls_key.reveal() if config.tls_key else ""
+    cert = config.tls_certificate
+    if not key and not cert:
+        if config.tls_authority_certificate:
+            raise ValueError(
+                "tls_authority_certificate requires tls_key and "
+                "tls_certificate")
+        return None
+    if not key or not cert:
+        # half-configured TLS must fail loudly, never fall back to
+        # plaintext (the reference errors in NewFromConfig likewise)
+        raise ValueError(
+            "tls_key and tls_certificate must both be set to enable TLS")
+
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+
+    def materialize(pem_or_path: str) -> str:
+        if "-----BEGIN" not in pem_or_path:
+            return pem_or_path
+        f = tempfile.NamedTemporaryFile(
+            mode="w", suffix=".pem", delete=False)
+        f.write(pem_or_path)
+        f.close()
+        return f.name
+
+    cert_file, key_file = materialize(cert), materialize(key)
+    try:
+        ctx.load_cert_chain(cert_file, key_file)
+    finally:
+        for path, original in ((cert_file, cert), (key_file, key)):
+            if path != original:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+    ca = config.tls_authority_certificate
+    if ca:
+        ctx.verify_mode = ssl.CERT_REQUIRED
+        if "-----BEGIN" in ca:
+            ctx.load_verify_locations(cadata=ca)
+        else:
+            ctx.load_verify_locations(cafile=ca)
+    return ctx
+
+
 def _start_statsd_udp(u, server, num_readers: int, rcvbuf: int) -> Listener:
     host = u.hostname or "127.0.0.1"
     port = u.port or 0
@@ -126,6 +180,23 @@ def _start_statsd_tcp(u, server) -> Listener:
     threads: List[threading.Thread] = []
     listener = Listener("tcp", sock.getsockname(), sock, threads)
 
+    tls_ctx = build_tls_context(server.config)
+
+    def handle_conn(conn):
+        if tls_ctx is not None:
+            # handshake off the accept loop (reference server.go:1264-1293
+            # handleTCPGoroutine wraps each conn)
+            try:
+                conn = tls_ctx.wrap_socket(conn, server_side=True)
+            except Exception as e:
+                logger.warning("TLS handshake failed: %s", e)
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                return
+        _read_tcp_lines(conn, server, listener)
+
     def accept_loop():
         while not listener.closed:
             try:
@@ -133,15 +204,15 @@ def _start_statsd_tcp(u, server) -> Listener:
             except OSError:
                 return
             t = threading.Thread(
-                target=_read_tcp_lines, args=(conn, server, listener),
-                daemon=True)
+                target=handle_conn, args=(conn,), daemon=True)
             t.start()
 
     t = threading.Thread(target=accept_loop, name="statsd-tcp-accept",
                          daemon=True)
     t.start()
     threads.append(t)
-    logger.info("listening for statsd on TCP %s", listener.address)
+    logger.info("listening for statsd on TCP %s%s", listener.address,
+                " (TLS)" if tls_ctx is not None else "")
     return listener
 
 

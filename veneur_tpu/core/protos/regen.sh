@@ -1,0 +1,4 @@
+#!/bin/sh
+# Regenerate the protobuf modules (protoc >= 3.21). Run from this directory.
+set -e
+protoc --python_out=. dogstatsd.proto

@@ -99,6 +99,7 @@ class Server:
         self.forwarder: Optional[Callable[[ForwardableState], None]] = None
         self.forward_client = None  # set in start() when forward_address
         self.import_server = None  # set in start() when grpc_address
+        self.grpc_ingest_servers: List = []  # per grpc_listen_addresses
 
         # self-metrics: UDP to stats_address, or internal loopback so they
         # re-enter this server's own pipeline (reference scopedstatsd +
@@ -276,6 +277,11 @@ class Server:
             self.forward_client = ForwardClient(
                 self.config.forward_address, deadline=self.interval)
             self.forwarder = self.forward_client.forward
+        for addr in self.config.grpc_listen_addresses:
+            from veneur_tpu.core.grpc_ingest import GrpcIngestServer
+            gi = GrpcIngestServer(self, addr)
+            gi.start()
+            self.grpc_ingest_servers.append(gi)
         if self.config.grpc_address:
             from veneur_tpu.forward.server import ImportServer
             from veneur_tpu.util.matcher import TagMatcher
@@ -339,6 +345,8 @@ class Server:
             listener.close()
         if self.import_server is not None:
             self.import_server.stop()
+        for gi in self.grpc_ingest_servers:
+            gi.stop()
         if self.http_api is not None:
             self.http_api.stop()
             self.http_api = None
@@ -412,6 +420,14 @@ class Server:
         flush_span = self.trace_client.start_span(
             "flush", service="veneur-tpu",
             tags={"mode": "local" if self.is_local else "global"})
+
+        if self.config.count_unique_timeseries:
+            # exact count of timeseries touched this interval (reference
+            # flusher.go:43 flush.unique_timeseries_total)
+            self.statsd.count(
+                "flush.unique_timeseries_total",
+                self.store.unique_timeseries(),
+                tags=[f"global_veneur:{str(not self.is_local).lower()}"])
 
         with self._other_lock:
             samples, self._other_samples = self._other_samples, []

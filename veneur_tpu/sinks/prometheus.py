@@ -16,6 +16,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional
 
+from veneur_tpu.protocol.render import render_metric_packet
 from veneur_tpu.samplers.metrics import InterMetric, MetricType
 from veneur_tpu.sinks import MetricSink, register_metric_sink
 from veneur_tpu.sinks.cortex import sanitize_label, sanitize_name
@@ -90,7 +91,6 @@ class PrometheusMetricSink(MetricSink):
         if not self.repeater_address or not metrics:
             return
         host, _, port = self.repeater_address.rpartition(":")
-        from veneur_tpu.cmd.veneur_emit import render_metric_packet
         lines = []
         for m in metrics:
             if m.type == MetricType.STATUS:

@@ -1,0 +1,126 @@
+"""Context propagation for the trace client.
+
+The reference offers an OpenTracing compatibility layer — a global
+tracer, span-from-context helpers, and multi-format HTTP header
+inject/extract (reference trace/trace.go:1-394 GlobalTracer /
+StartSpanFromContext; trace/opentracing.go:36-65 HeaderFormats). The
+Python-native shape of the same capabilities: a contextvar carries the
+active span, `start_span` parents from it automatically, and
+`inject_headers` / `extract_context` speak the reference's wire header
+formats (Envoy ot-tracer-*, OpenTracing Trace-Id, Ruby X-Trace-Id, and
+the Veneur Traceid/Spanid pair) so spans interoperate across services.
+"""
+
+from __future__ import annotations
+
+import contextvars
+from typing import Dict, Mapping, Optional, Tuple
+
+from veneur_tpu import trace as trace_mod
+
+_current_span: contextvars.ContextVar[Optional[trace_mod.Span]] = (
+    contextvars.ContextVar("veneur_tpu_current_span", default=None))
+_global_client: Optional[trace_mod.Client] = None
+
+# (traceid header, spanid header, base) — tried in order on extract;
+# the first (Envoy/LightStep) format is used on inject, like the
+# reference's defaultHeaderFormat (opentracing.go:67-69)
+HEADER_FORMATS = (
+    ("ot-tracer-traceid", "ot-tracer-spanid", 16),
+    ("trace-id", "span-id", 10),
+    ("x-trace-id", "x-span-id", 10),
+    ("traceid", "spanid", 10),
+)
+
+
+def set_global_client(client: Optional[trace_mod.Client]) -> None:
+    global _global_client
+    _global_client = client
+
+
+def global_client() -> Optional[trace_mod.Client]:
+    return _global_client
+
+
+def current_span() -> Optional[trace_mod.Span]:
+    return _current_span.get()
+
+
+class _ActiveSpan:
+    """Context manager that makes a span the ambient parent while open."""
+
+    def __init__(self, span: trace_mod.Span):
+        self.span = span
+        self._token = None
+
+    def __enter__(self) -> trace_mod.Span:
+        self._token = _current_span.set(self.span)
+        return self.span
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        _current_span.reset(self._token)
+        if exc_type is not None:
+            self.span.error()
+        self.span.finish()
+
+
+def start_span(name: str, service: str = "",
+               tags: Optional[Dict[str, str]] = None,
+               client: Optional[trace_mod.Client] = None,
+               indicator: bool = False) -> _ActiveSpan:
+    """Start a span parented on the ambient one (the
+    StartSpanFromContext equivalent); use as a context manager."""
+    client = client or _global_client
+    parent = _current_span.get()
+    if parent is not None:
+        span = trace_mod.Span(
+            client, name, service or parent.proto.service,
+            trace_id=parent.trace_id, parent_id=parent.id, tags=tags,
+            indicator=indicator)
+    else:
+        span = trace_mod.Span(client, name, service, tags=tags,
+                              indicator=indicator)
+    return _ActiveSpan(span)
+
+
+def inject_headers(span: trace_mod.Span,
+                   headers: Optional[Dict[str, str]] = None
+                   ) -> Dict[str, str]:
+    """Write the span's lineage into HTTP headers (Envoy format, plus the
+    sampled flag the reference always sets)."""
+    headers = headers if headers is not None else {}
+    tid_key, sid_key, base = HEADER_FORMATS[0]
+    fmt = (lambda v: format(v, "x")) if base == 16 else str
+    headers[tid_key] = fmt(span.trace_id)
+    headers[sid_key] = fmt(span.id)
+    headers["ot-tracer-sampled"] = "true"
+    return headers
+
+
+def extract_context(headers: Mapping[str, str]) -> Tuple[int, int]:
+    """Read (trace_id, span_id) from HTTP headers, trying each supported
+    format in order; returns (0, 0) when none is present. Lookup is
+    case-insensitive, like the reference's textMapReaderGet."""
+    lowered = {str(k).lower(): v for k, v in headers.items()}
+    for tid_key, sid_key, base in HEADER_FORMATS:
+        tid, sid = lowered.get(tid_key), lowered.get(sid_key)
+        if tid is None or sid is None:
+            continue
+        try:
+            return int(tid, base), int(sid, base)
+        except ValueError:
+            continue
+    return 0, 0
+
+
+def start_span_from_headers(name: str, headers: Mapping[str, str],
+                            service: str = "",
+                            tags: Optional[Dict[str, str]] = None,
+                            client: Optional[trace_mod.Client] = None
+                            ) -> _ActiveSpan:
+    """Continue a remote trace: parent the new span on header lineage."""
+    trace_id, span_id = extract_context(headers)
+    client = client or _global_client
+    span = trace_mod.Span(client, name, service, trace_id=trace_id,
+                          parent_id=span_id, tags=tags)
+    return _ActiveSpan(span)

@@ -15,6 +15,8 @@ import socket
 import time
 from typing import Callable, Dict, List, Optional, Sequence
 
+from veneur_tpu.protocol.render import render_metric_packet
+
 TAG_LOCAL_ONLY = "veneurlocalonly"
 TAG_GLOBAL_ONLY = "veneurglobalonly"
 
@@ -45,12 +47,7 @@ class ScopedClient:
             {"c": "count", "g": "gauge", "ms": "timing"}[kind], ""))
         if scope_tag:
             final.append(scope_tag)
-        parts = [f"{name}:{value}|{kind}"]
-        if rate != 1.0:
-            parts.append(f"@{rate}")
-        if final:
-            parts.append("#" + ",".join(final))
-        packet = "|".join(parts).encode()
+        packet = render_metric_packet(name, value, kind, final, rate)
         if self._cb is not None:
             self._cb(packet)
         elif self._sock is not None:
