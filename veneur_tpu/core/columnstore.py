@@ -39,6 +39,10 @@ from veneur_tpu.ops import batch_hll, batch_tdigest, hll_ref, scalars
 from veneur_tpu.samplers import metrics as m
 from veneur_tpu.samplers.metrics import MetricScope, UDPMetric
 
+# pending-buffer padding marker: any out-of-range row is dropped by the
+# scatter kernels (mode="drop"), independent of table capacity
+PAD_ROW = np.int32(2**31 - 1)
+
 
 @dataclass
 class RowMeta:
@@ -92,15 +96,15 @@ class _BaseTable:
         self.capacity = new_cap
 
     def _append_batch(self, columns) -> None:
-        """Vectorized append of parallel sample columns into the pending
-        buffer (the native-parser fast path), applying whenever full.
-        Caller holds self.lock; rows must already be interned."""
+        """Vectorized append of parallel sample columns into the typed
+        pending buffers (the native-parser fast path), applying whenever
+        full. Caller holds self.lock; rows must already be interned."""
         n = len(columns[0])
         i = 0
         while i < n:
             take = min(self.batch_cap - self._n, n - i)
-            for col, data in enumerate(columns):
-                self._pend[self._n:self._n + take, col] = data[i:i + take]
+            for buf, data in zip(self._pcols, columns):
+                buf[self._n:self._n + take] = data[i:i + take]
             self._n += take
             i += take
             if self._n >= self.batch_cap:
@@ -120,7 +124,10 @@ def _pad_cap(state_leaf, new_cap):
 class CounterTable(_BaseTable):
     def _init_arrays(self):
         self.state = scalars.init_counters(self.capacity)
-        self._pend = np.zeros((self.batch_cap, 3), np.float64)  # row,val,rate
+        self._prow = np.full(self.batch_cap, PAD_ROW, np.int32)
+        self._pval = np.zeros(self.batch_cap, np.float32)
+        self._prate = np.ones(self.batch_cap, np.float32)
+        self._pcols = (self._prow, self._pval, self._prate)
         self._n = 0
         self._import_acc = np.zeros(self.capacity, np.float64)
 
@@ -131,20 +138,22 @@ class CounterTable(_BaseTable):
         with self.lock:
             row = self.row_for(metric)
             self.touched[row] = True
-            self._pend[self._n] = (row, metric.value, metric.sample_rate)
-            self._n += 1
+            n = self._n
+            self._prow[n] = row
+            self._pval[n] = metric.value
+            self._prate[n] = max(metric.sample_rate, 1e-9)
+            self._n = n + 1
             if self._n >= self.batch_cap:
                 self._apply_locked()
 
     def _apply_locked(self):
         if self._n == 0:
             return
-        n = self._n
-        rows = np.full(self.batch_cap, self.capacity, np.int32)
-        rows[:n] = self._pend[:n, 0]
-        vals = self._pend[:, 1].astype(np.float32)
-        rates = np.maximum(self._pend[:, 2].astype(np.float32), 1e-9)
+        # dispatch on copies: execution is async and jax may alias numpy
+        # buffers zero-copy, while these buffers are refilled immediately
+        rows, vals, rates = (c.copy() for c in self._pcols)
         self.state = scalars.apply_counters(self.state, rows, vals, rates)
+        self._prow[: self._n] = PAD_ROW
         self._n = 0
 
     def apply_pending(self):
@@ -192,7 +201,9 @@ class CounterTable(_BaseTable):
 class GaugeTable(_BaseTable):
     def _init_arrays(self):
         self.state = scalars.init_gauges(self.capacity)
-        self._pend = np.zeros((self.batch_cap, 2), np.float64)  # row,val
+        self._prow = np.full(self.batch_cap, PAD_ROW, np.int32)
+        self._pval = np.zeros(self.batch_cap, np.float32)
+        self._pcols = (self._prow, self._pval)
         self._n = 0
 
     def _grow_arrays(self, new_cap):
@@ -202,19 +213,19 @@ class GaugeTable(_BaseTable):
         with self.lock:
             row = self.row_for(metric)
             self.touched[row] = True
-            self._pend[self._n] = (row, metric.value)
-            self._n += 1
+            n = self._n
+            self._prow[n] = row
+            self._pval[n] = metric.value
+            self._n = n + 1
             if self._n >= self.batch_cap:
                 self._apply_locked()
 
     def _apply_locked(self):
         if self._n == 0:
             return
-        n = self._n
-        rows = np.full(self.batch_cap, self.capacity, np.int32)
-        rows[:n] = self._pend[:n, 0]
-        vals = self._pend[:, 1].astype(np.float32)
+        rows, vals = (c.copy() for c in self._pcols)
         self.state = scalars.apply_gauges(self.state, rows, vals)
+        self._prow[: self._n] = PAD_ROW
         self._n = 0
 
     def apply_pending(self):
@@ -256,7 +267,10 @@ class HistoTable(_BaseTable):
 
     def _init_arrays(self):
         self.state = batch_tdigest.init_state(self.capacity)
-        self._pend = np.zeros((self.batch_cap, 3), np.float64)  # row,val,w
+        self._prow = np.full(self.batch_cap, PAD_ROW, np.int32)
+        self._pval = np.zeros(self.batch_cap, np.float32)
+        self._pwt = np.zeros(self.batch_cap, np.float32)
+        self._pcols = (self._prow, self._pval, self._pwt)
         self._n = 0
         self._applies = 0
 
@@ -273,22 +287,20 @@ class HistoTable(_BaseTable):
         with self.lock:
             row = self.row_for(metric)
             self.touched[row] = True
-            weight = 1.0 / max(metric.sample_rate, 1e-9)
-            self._pend[self._n] = (row, metric.value, weight)
-            self._n += 1
+            n = self._n
+            self._prow[n] = row
+            self._pval[n] = metric.value
+            self._pwt[n] = 1.0 / max(metric.sample_rate, 1e-9)
+            self._n = n + 1
             if self._n >= self.batch_cap:
                 self._apply_locked()
 
     def _apply_locked(self):
         if self._n == 0:
             return
-        n = self._n
-        rows = np.full(self.batch_cap, self.capacity, np.int32)
-        rows[:n] = self._pend[:n, 0]
-        vals = self._pend[:, 1].astype(np.float32)
-        wts = np.zeros(self.batch_cap, np.float32)
-        wts[:n] = self._pend[:n, 2]
+        rows, vals, wts = (c.copy() for c in self._pcols)
         self.state = batch_tdigest.apply_batch(self.state, rows, vals, wts)
+        self._prow[: self._n] = PAD_ROW
         self._n = 0
         self._applies += 1
         if self._applies % self.RECOMPRESS_EVERY == 0:
@@ -340,7 +352,10 @@ class SetTable(_BaseTable):
 
     def _init_arrays(self):
         self.state = batch_hll.init_state(self.capacity)
-        self._pend = np.zeros((self.batch_cap, 3), np.int64)  # row,idx,rho
+        self._prow = np.full(self.batch_cap, PAD_ROW, np.int32)
+        self._pidx = np.zeros(self.batch_cap, np.int32)
+        self._prho = np.zeros(self.batch_cap, np.int32)
+        self._pcols = (self._prow, self._pidx, self._prho)
         self._n = 0
 
     def _grow_arrays(self, new_cap):
@@ -354,20 +369,20 @@ class SetTable(_BaseTable):
         with self.lock:
             row = self.row_for(metric)
             self.touched[row] = True
-            self._pend[self._n] = (row, idx, rho)
-            self._n += 1
+            n = self._n
+            self._prow[n] = row
+            self._pidx[n] = idx
+            self._prho[n] = rho
+            self._n = n + 1
             if self._n >= self.batch_cap:
                 self._apply_locked()
 
     def _apply_locked(self):
         if self._n == 0:
             return
-        n = self._n
-        rows = np.full(self.batch_cap, self.capacity, np.int32)
-        rows[:n] = self._pend[:n, 0]
-        idxs = self._pend[:, 1].astype(np.int32)
-        rhos = self._pend[:, 2].astype(np.int32)
+        rows, idxs, rhos = (c.copy() for c in self._pcols)
         self.state = batch_hll.apply_batch(self.state, rows, idxs, rhos)
+        self._prow[: self._n] = PAD_ROW
         self._n = 0
 
     def apply_pending(self):

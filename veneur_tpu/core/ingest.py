@@ -62,9 +62,16 @@ class BatchIngester:
     def ingest_buffer(self, buf: bytes) -> int:
         """Parse and aggregate one newline-joined packet buffer; returns
         the number of samples taken (native + slow path not counted)."""
+        return self._ingest(lambda: self._native.parse(buf))
+
+    def ingest_ptr(self, ptr, length: int) -> int:
+        """Zero-copy variant over a native reader's joined buffer."""
+        return self._ingest(lambda: self._native.parse_ptr(ptr, length))
+
+    def _ingest(self, parse) -> int:
         store = self.store
         with self._lock:
-            res = self._native.parse(buf)
+            res = parse()
             # native lines count as received; unknown lines are counted by
             # handle_metric_packet below
             self.server.stats["packets_received"] += res.lines - len(res.unknown)

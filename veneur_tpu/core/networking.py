@@ -149,9 +149,31 @@ def _start_statsd_udp(u, server, num_readers: int, rcvbuf: int) -> Listener:
 
 
 def _read_metric_socket(sock, server, listener: Listener) -> None:
-    """Datagram read loop (reference server.go:1103-1140): block for the
-    first datagram, then drain whatever the kernel has queued without
-    blocking, so bursts reach the native batch parser as one buffer."""
+    """Datagram read loop (reference server.go:1103-1140). With the
+    native library available the whole hot path is C++: recvmmsg drains
+    the kernel queue into one joined buffer which the batch parser
+    consumes in place; Python only sees slow-path lines. Otherwise:
+    block for the first datagram, drain without blocking, and hand the
+    batch to the parser."""
+    if getattr(server, "_ingester", None) is not None:
+        try:
+            from veneur_tpu import native
+            max_len = server.config.metric_max_length
+            reader = native.NativeReader(max_msgs=512, max_dgram=max_len + 1)
+        except Exception:
+            reader = None
+        if reader is not None:
+            ing = server._ingester
+            fd = sock.fileno()
+            while not listener.closed:
+                length, _n, dropped = reader.read(fd, max_len)
+                if length < 0:
+                    return
+                if dropped:
+                    server.stats["parse_errors"] += dropped
+                if length > 0:
+                    ing.ingest_ptr(reader.buf_ptr, length)
+            return
     while not listener.closed:
         try:
             buf = sock.recv(_MAX_DGRAM)

@@ -64,9 +64,19 @@ def _declare(lib) -> None:
     lib.vnt_register.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, i64, ctypes.c_int32,
         ctypes.c_int32, ctypes.c_double]
+    lib.vnt_reader_new.restype = ctypes.c_void_p
+    lib.vnt_reader_new.argtypes = [ctypes.c_int32, i64]
+    lib.vnt_reader_free.restype = None
+    lib.vnt_reader_free.argtypes = [ctypes.c_void_p]
+    lib.vnt_reader_buf.restype = ctypes.c_void_p
+    lib.vnt_reader_buf.argtypes = [ctypes.c_void_p]
+    lib.vnt_reader_read.restype = i64
+    lib.vnt_reader_read.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, i64, ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32)]
     lib.vnt_parse.restype = i64
     lib.vnt_parse.argtypes = [
-        ctypes.c_void_p, ctypes.c_char_p, i64,
+        ctypes.c_void_p, ctypes.c_void_p, i64,
         i32p, f32p, f32p, i64, i64p,          # counters
         i32p, f32p, i64, i64p,                # gauges
         i32p, f32p, f32p, i64, i64p,          # histos
@@ -128,6 +138,37 @@ def _ptr(arr: np.ndarray, ctype):
     return arr.ctypes.data_as(ctypes.POINTER(ctype))
 
 
+class NativeReader:
+    """Batched UDP datagram reader (recvmmsg) producing newline-joined
+    buffers for NativeParser.parse_ptr. One per reader thread."""
+
+    def __init__(self, max_msgs: int = 512, max_dgram: int = 65536,
+                 lib=None):
+        self._lib = lib if lib is not None else load()
+        if self._lib is None:
+            raise RuntimeError(f"native reader unavailable: {_lib_err}")
+        self._r = self._lib.vnt_reader_new(max_msgs, max_dgram)
+        self.buf_ptr = self._lib.vnt_reader_buf(self._r)
+        self._n1 = ctypes.c_int32()
+        self._n2 = ctypes.c_int32()
+
+    def __del__(self):
+        try:
+            if self._r:
+                self._lib.vnt_reader_free(self._r)
+                self._r = None
+        except Exception:
+            pass
+
+    def read(self, fd: int, max_len: int, timeout_ms: int = 500):
+        """Returns (joined_length, n_datagrams, n_dropped_oversize);
+        joined_length < 0 means the socket is dead."""
+        length = self._lib.vnt_reader_read(
+            self._r, fd, max_len, timeout_ms,
+            ctypes.byref(self._n1), ctypes.byref(self._n2))
+        return length, self._n1.value, self._n2.value
+
+
 class NativeParser:
     """One intern table + reusable output buffers around the C library.
 
@@ -183,15 +224,21 @@ class NativeParser:
     def parse(self, buf: bytes) -> ParseResult:
         """Parse a newline-joined packet buffer; returns trimmed COO views
         plus the list of (unknown) raw lines for the Python slow path."""
-        # worst-case samples per family: one per two bytes of a line, plus
-        # one per line; unknown list worst case: every line
-        n_lines = buf.count(b"\n") + 1
-        self._ensure_capacity(len(buf) // 2 + n_lines + 1)
+        ptr = ctypes.cast(ctypes.c_char_p(buf), ctypes.c_void_p)
+        return self.parse_ptr(ptr, len(buf), keepalive=buf)
+
+    def parse_ptr(self, ptr, length: int, keepalive=None) -> ParseResult:
+        """Zero-copy parse of `length` bytes at `ptr` (a c_void_p), e.g.
+        the native UDP reader's joined buffer. `keepalive` pins a Python
+        owner of the memory for the duration of the call."""
+        # worst-case bound: every other byte a sample value or a 1-byte
+        # line, for both the per-family arrays and the unknown list
+        self._ensure_capacity(length // 2 + 2)
         i32, f32, i64 = ctypes.c_int32, ctypes.c_float, ctypes.c_int64
         ns = self._outs
         cap = i64(self._cap)
         lines = self._lib.vnt_parse(
-            self._eng, buf, len(buf),
+            self._eng, ptr, length,
             _ptr(self._c_rows, i32), _ptr(self._c_vals, f32),
             _ptr(self._c_rates, f32), cap, ctypes.byref(ns[0]),
             _ptr(self._g_rows, i32), _ptr(self._g_vals, f32),
@@ -218,7 +265,10 @@ class NativeParser:
         res.s_rows = self._s_rows[:sn]
         res.s_idx = self._s_idx[:sn]
         res.s_rho = self._s_rho[:sn]
+        base = ptr if isinstance(ptr, int) else ptr.value
         res.unknown = [
-            buf[self._unk_off[i]:self._unk_off[i] + self._unk_len[i]]
+            ctypes.string_at(base + int(self._unk_off[i]),
+                             int(self._unk_len[i]))
             for i in range(un)]
+        del keepalive
         return res

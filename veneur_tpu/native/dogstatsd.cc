@@ -33,6 +33,13 @@
 #include <math.h>
 #include <stdlib.h>
 
+#include <errno.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <sys/uio.h>
+
+#include <vector>
+
 namespace {
 
 enum Family : int32_t {
@@ -370,6 +377,96 @@ int64_t vnt_parse(void* ep, const uint8_t* buf, int64_t buflen,
   *unk_n = o.unk_n;
   *samples_out = o.samples;
   return lines;
+}
+
+// ---- batched UDP reader (recvmmsg) ----------------------------------------
+//
+// The kernel-facing half of the native ingest loop (the SO_REUSEPORT
+// multi-reader equivalent of reference networking.go:54-107 +
+// server.go:1103-1140): poll the socket, drain up to max_msgs queued
+// datagrams in one recvmmsg syscall, and compact them into one
+// newline-joined buffer ready for vnt_parse. Oversized datagrams are
+// dropped and counted (metric_max_length parity with
+// Server.handle_packet_buffer).
+
+namespace {
+
+struct Reader {
+  int32_t max_msgs;
+  int64_t max_dgram;
+  std::vector<uint8_t> scratch;  // max_msgs contiguous datagram slots
+  std::vector<uint8_t> joined;   // compacted newline-joined output
+  std::vector<mmsghdr> hdrs;
+  std::vector<iovec> iovs;
+
+  Reader(int32_t msgs, int64_t dgram)
+      : max_msgs(msgs),
+        max_dgram(dgram),
+        scratch(static_cast<size_t>(msgs) * dgram),
+        joined(static_cast<size_t>(msgs) * (dgram + 1)),
+        hdrs(msgs),
+        iovs(msgs) {
+    for (int32_t i = 0; i < msgs; i++) {
+      iovs[i].iov_base = scratch.data() + static_cast<size_t>(i) * dgram;
+      iovs[i].iov_len = dgram;
+      memset(&hdrs[i], 0, sizeof(mmsghdr));
+      hdrs[i].msg_hdr.msg_iov = &iovs[i];
+      hdrs[i].msg_hdr.msg_iovlen = 1;
+    }
+  }
+};
+
+}  // namespace
+
+void* vnt_reader_new(int32_t max_msgs, int64_t max_dgram) {
+  return new Reader(max_msgs, max_dgram);
+}
+
+void vnt_reader_free(void* r) { delete static_cast<Reader*>(r); }
+
+const uint8_t* vnt_reader_buf(void* r) {
+  return static_cast<Reader*>(r)->joined.data();
+}
+
+// Waits up to timeout_ms for readability, then drains queued datagrams.
+// Returns the joined buffer length (0 = timeout/nothing), or -1 on a
+// fatal socket error (caller should exit its read loop).
+int64_t vnt_reader_read(void* rp, int32_t fd, int64_t max_len,
+                        int32_t timeout_ms, int32_t* n_dgrams,
+                        int32_t* n_dropped) {
+  Reader* r = static_cast<Reader*>(rp);
+  *n_dgrams = 0;
+  *n_dropped = 0;
+
+  struct pollfd pfd = {fd, POLLIN, 0};
+  int pr = poll(&pfd, 1, timeout_ms);
+  if (pr < 0) return (errno == EINTR) ? 0 : -1;
+  if (pr == 0) return 0;
+  if (pfd.revents & (POLLERR | POLLNVAL)) return -1;
+
+  int got = recvmmsg(fd, r->hdrs.data(), r->max_msgs, MSG_DONTWAIT, nullptr);
+  if (got < 0) {
+    if (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR) return 0;
+    return -1;
+  }
+
+  uint8_t* out = r->joined.data();
+  int64_t pos = 0;
+  for (int i = 0; i < got; i++) {
+    int64_t len = r->hdrs[i].msg_len;
+    if (len <= 0) continue;
+    if (len > max_len) {
+      (*n_dropped)++;
+      continue;
+    }
+    memcpy(out + pos, r->scratch.data() + static_cast<size_t>(i) * r->max_dgram,
+           len);
+    pos += len;
+    out[pos++] = '\n';
+    (*n_dgrams)++;
+  }
+  if (pos > 0) pos--;  // trailing separator
+  return pos;
 }
 
 }  // extern "C"

@@ -43,25 +43,26 @@ def merge_rows(regs, rows, in_regs):
     return jnp.maximum(regs, grid)
 
 
-@jax.jit
 def estimate(regs):
     """Per-key LogLog-Beta estimate (parity with the reference's vendored
-    estimator, hyperloglog.go:207-231 + utils.go:12-22)."""
+    estimator, hyperloglog.go:207-231 + utils.go:12-22). On TPU this
+    dispatches to the fused single-pass pallas kernel."""
+    from veneur_tpu.ops import pallas_hll
+    return pallas_hll.estimate(regs)
+
+
+@jax.jit
+def _estimate_jnp(regs):
+    """Two-pass jnp formulation (the portable fallback)."""
     ez = jnp.sum(regs == 0, axis=-1).astype(jnp.float32)
     s = jnp.sum(jnp.exp2(-regs.astype(jnp.float32)), axis=-1)
     zl = jnp.log(ez + 1.0)
-    beta = (hll_ref._BETA14_EZ * ez
-            + 0.070471823 * zl
-            + 0.17393686 * zl**2
-            + 0.16339839 * zl**3
-            - 0.09237745 * zl**4
-            + 0.03738027 * zl**5
-            - 0.005384159 * zl**6
-            + 0.00042419 * zl**7)
-    alpha = 0.7213 / (1 + 1.079 / M)
+    beta = hll_ref._BETA14_EZ * ez
+    for i, c in enumerate(hll_ref._BETA14):
+        beta = beta + c * zl ** (i + 1)
     # parity: the reference adds 0.5 inside and rounds on return
     # (hyperloglog.go:225-231), so estimates are whole numbers
-    est = jnp.floor(alpha * M * (M - ez) / (beta + s) + 1.0)
+    est = jnp.floor(hll_ref._ALPHA * M * (M - ez) / (beta + s) + 1.0)
     # a key with no insertions estimates 0
     return jnp.where(ez >= M, 0.0, est)
 

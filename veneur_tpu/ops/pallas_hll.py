@@ -1,0 +1,99 @@
+"""Pallas TPU kernel: fused HyperLogLog estimation.
+
+The flush-time estimate is the heaviest read of the column store: the
+(K, 16384) int8 register table is the largest device array, and the jnp
+formulation (veneur_tpu.ops.batch_hll.estimate) reads it twice — once for
+the zero-register count, once for the 2^-rho sum. This kernel tiles rows
+into VMEM ((32, 128) int8-aligned blocks) and produces both reductions
+plus the final LogLog-Beta estimate in a single pass over HBM, the
+bandwidth-bound op's floor.
+
+Safety: `estimate` compiles the kernel lazily and permanently falls back
+to the jnp path on any failure (non-TPU backends run interpret mode only
+under tests). Numerical parity with the reference's vendored estimator
+(hyperloglog.go:207-231) is asserted by tests/test_pallas.py.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+
+import jax
+import jax.numpy as jnp
+
+from veneur_tpu.ops import hll_ref
+
+logger = logging.getLogger("veneur_tpu.ops.pallas_hll")
+
+M = hll_ref.M  # 16384 registers per key
+TK = 128  # rows per grid step: (128, 16384) int8 block = 2 MiB VMEM
+
+
+def _estimate_block(regs):
+    """The per-tile math: regs (TK, M) int8 -> (TK,) f32 estimates."""
+    zero = (regs == 0).astype(jnp.float32)
+    ez = jnp.sum(zero, axis=-1)
+    s = jnp.sum(jnp.exp2(-regs.astype(jnp.float32)), axis=-1)
+    zl = jnp.log(ez + 1.0)
+    beta = hll_ref._BETA14_EZ * ez
+    for i, c in enumerate(hll_ref._BETA14):
+        beta = beta + c * zl ** (i + 1)
+    est = jnp.floor(hll_ref._ALPHA * M * (M - ez) / (beta + s) + 1.0)
+    return jnp.where(ez >= M, 0.0, est)
+
+
+def _kernel(regs_ref, out_ref):
+    out_ref[0, :] = _estimate_block(regs_ref[:])
+
+
+@functools.partial(jax.jit, static_argnums=1)
+def _estimate_pallas(regs, interpret: bool):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    num_keys = regs.shape[0]
+    n_tiles = num_keys // TK
+    out = pl.pallas_call(
+        _kernel,
+        grid=(n_tiles,),
+        in_specs=[pl.BlockSpec((TK, M), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((1, TK), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((n_tiles, TK), jnp.float32),
+        interpret=interpret,
+    )(regs)
+    return out.reshape(num_keys)
+
+
+class _State:
+    failed = False
+
+
+def available(num_keys: int) -> bool:
+    return (not _State.failed) and num_keys % TK == 0
+
+
+def estimate(regs) -> jnp.ndarray:
+    """Per-key LogLog-Beta estimates via the fused kernel; falls back to
+    the two-pass jnp path when the kernel is unavailable."""
+    from veneur_tpu.ops import batch_hll
+
+    num_keys = regs.shape[0]
+    if isinstance(regs, jax.core.Tracer):
+        # inside an outer jit the fallback try/except below could not
+        # catch lowering-time failures; stay on the portable path
+        return batch_hll._estimate_jnp(regs)
+    platform = jax.devices()[0].platform
+    if platform not in ("tpu", "axon") or not available(num_keys):
+        # off-TPU the fused read buys nothing; interpret mode is for the
+        # parity tests only
+        return batch_hll._estimate_jnp(regs)
+    try:
+        return _estimate_pallas(regs, False)
+    except Exception as e:
+        _State.failed = True
+        logger.warning("pallas HLL estimate unavailable (%s); using jnp "
+                       "fallback", e)
+        return batch_hll._estimate_jnp(regs)
