@@ -86,15 +86,165 @@ def run_pipeline(duration_s: float, num_keys: int):
     return total_samples / elapsed, elapsed
 
 
+def _mk_server(num_keys: int, **cfg_overrides):
+    from veneur_tpu.config import Config
+    from veneur_tpu.core.server import Server
+    from veneur_tpu.sinks.blackhole import BlackholeMetricSink
+
+    cfg = Config()
+    cfg.interval = 10.0
+    cfg.tpu.counter_capacity = max(4096, num_keys)
+    cfg.tpu.gauge_capacity = max(4096, num_keys)
+    cfg.tpu.histo_capacity = max(4096, num_keys)
+    cfg.tpu.set_capacity = max(1024, num_keys // 2)
+    cfg.tpu.batch_cap = 16384
+    for k, v in cfg_overrides.items():
+        setattr(cfg, k, v)
+    cfg.apply_defaults()
+    return Server(cfg, extra_metric_sinks=[BlackholeMetricSink()])
+
+
+def run_scenario_counter(duration_s: float):
+    """BASELINE config 1: one counter key, blackhole sink."""
+    server = _mk_server(16)
+    dgram = b"\n".join(b"bench.one:1|c" for _ in range(40))
+    server.handle_packet_batch([dgram])
+    server.store.apply_all_pending()
+    server.flush()
+    t0 = time.perf_counter()
+    total = 0
+    while time.perf_counter() - t0 < duration_s:
+        for _ in range(50):
+            server.handle_packet_batch([dgram])
+        total += 50 * 40
+    server.store.apply_all_pending()
+    server.flush()
+    return total / (time.perf_counter() - t0)
+
+
+def run_scenario_timers(duration_s: float, num_keys: int = 1000):
+    """BASELINE config 2: t-digest stress, multi-value timer packets."""
+    import numpy as np
+    rng = np.random.default_rng(1)
+    packets = []
+    for i in range(num_keys):
+        vals = b":".join(b"%.2f" % v for v in rng.normal(100, 15, 8))
+        packets.append(b"bench.timer.%d:%s|ms" % (i, vals))
+    datagrams = [b"\n".join(packets[i:i + 40])
+                 for i in range(0, len(packets), 40)]
+    server = _mk_server(num_keys * 2)
+    server.handle_packet_batch(datagrams)
+    server.store.apply_all_pending()
+    server.flush()
+    t0 = time.perf_counter()
+    total = 0
+    while time.perf_counter() - t0 < duration_s:
+        server.handle_packet_batch(datagrams)
+        total += num_keys * 8
+    server.store.apply_all_pending()
+    server.flush()
+    return total / (time.perf_counter() - t0)
+
+
+def run_scenario_forward(duration_s: float, num_keys: int = 50_000):
+    """BASELINE config 4: local->global t-digest merge over forwardrpc."""
+    import numpy as np
+    server_global = _mk_server(num_keys, grpc_address="127.0.0.1:0")
+    from veneur_tpu.forward.server import ImportServer
+    imp = ImportServer(server_global, "127.0.0.1:0")
+    imp.start()
+    local = _mk_server(num_keys, forward_address=imp.address)
+    from veneur_tpu.forward.client import ForwardClient
+    client = ForwardClient(imp.address, deadline=30.0)
+    local.forwarder = client.forward
+
+    rng = np.random.default_rng(2)
+    packets = [b"bench.fwd.%d:%s|ms" % (
+        i, b":".join(b"%.2f" % v for v in rng.normal(50, 9, 4)))
+        for i in range(num_keys)]
+    datagrams = [b"\n".join(packets[i:i + 40])
+                 for i in range(0, len(packets), 40)]
+    local.handle_packet_batch(datagrams)
+    local.store.apply_all_pending()
+    t0 = time.perf_counter()
+    rounds = 0
+    while time.perf_counter() - t0 < duration_s:
+        local.handle_packet_batch(datagrams)
+        local.flush()  # flush forwards the digests and resets state
+        rounds += 1
+    elapsed = time.perf_counter() - t0
+    server_global.flush()
+    client.close()
+    imp.stop()
+    # merged keys per second through the full forward+import+merge plane
+    return rounds * num_keys / elapsed
+
+
+def run_scenario_ssf(duration_s: float, num_keys: int = 10_000):
+    """BASELINE config 5 (scaled): SSF spans with attached samples ->
+    span workers -> metric extraction -> aggregation."""
+    from veneur_tpu import ssf
+    server = _mk_server(num_keys, interval=3600.0, span_channel_capacity=8192)
+    server.start()  # span workers drain the channel
+    spans = []
+    for i in range(2000):
+        span = ssf.SSFSpan(
+            id=i + 1, trace_id=i + 1, name=f"op{i % 50}",
+            service="bench", start_timestamp=1, end_timestamp=2)
+        span.metrics.append(ssf.count(f"bench.span.c{i % num_keys}", 2))
+        span.metrics.append(
+            ssf.timing(f"bench.span.t{i % num_keys}", 0.01, 1e-3))
+        spans.append(span.SerializeToString())
+    for s in spans[:100]:
+        server.handle_ssf_packet(s)
+    server.flush()
+    t0 = time.perf_counter()
+    sent = 0
+    while time.perf_counter() - t0 < duration_s:
+        for s in spans:
+            server.handle_ssf_packet(s)
+        sent += len(spans)
+        # let workers drain before timing ends (bounded)
+        drain_deadline = time.perf_counter() + 30
+        while (not server.span_chan.empty()
+               and time.perf_counter() < drain_deadline):
+            time.sleep(0.001)
+    elapsed = time.perf_counter() - t0
+    server.store.apply_all_pending()
+    server.flush()
+    processed = sent - server.spans_dropped
+    server.shutdown()
+    return processed * 2 / elapsed
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--duration", type=float, default=10.0)
     ap.add_argument("--keys", type=int, default=10_000)
+    ap.add_argument("--scenario", default="mixed",
+                    choices=["mixed", "counter", "timers", "forward", "ssf"],
+                    help="mixed is the headline metric; the rest mirror "
+                         "the BASELINE.json config suite")
     args = ap.parse_args()
 
-    rate, elapsed = run_pipeline(args.duration, args.keys)
+    if args.scenario == "mixed":
+        rate, _ = run_pipeline(args.duration, args.keys)
+        metric = "dogstatsd_samples_per_sec"
+    elif args.scenario == "counter":
+        rate = run_scenario_counter(args.duration)
+        metric = "counter_samples_per_sec"
+    elif args.scenario == "timers":
+        rate = run_scenario_timers(args.duration, min(args.keys, 1000))
+        metric = "timer_samples_per_sec"
+    elif args.scenario == "forward":
+        rate = run_scenario_forward(args.duration, args.keys)
+        metric = "forwarded_digest_keys_per_sec"
+    else:
+        rate = run_scenario_ssf(args.duration, args.keys)
+        metric = "ssf_extracted_samples_per_sec"
+
     print(json.dumps({
-        "metric": "dogstatsd_samples_per_sec",
+        "metric": metric,
         "value": round(rate, 1),
         "unit": "samples/s",
         "vs_baseline": round(rate / BASELINE_SAMPLES_PER_SEC, 3),

@@ -54,6 +54,21 @@ def main(argv=None) -> int:
     if args.debug:
         cfg.debug = True
 
+    # crash reporting: ERROR+ records and thread panics route to the
+    # registered reporters (reference sentry.go + the logrus hook,
+    # cmd/veneur/main.go:63-79); sentry-sdk is optional and gated
+    from veneur_tpu.util import crash
+    logging.getLogger().addHandler(crash.ReportingHandler())
+    if cfg.sentry_dsn:
+        try:
+            import sentry_sdk
+            sentry_sdk.init(dsn=cfg.sentry_dsn.reveal())
+            crash.register_reporter(
+                lambda exc, tb: sentry_sdk.capture_exception(exc))
+        except ImportError:
+            log.warning("sentry_dsn set but sentry-sdk is unavailable; "
+                        "crashes log locally only")
+
     from veneur_tpu.core.server import Server
     server = Server(cfg)
     server.start()

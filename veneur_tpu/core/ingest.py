@@ -46,8 +46,9 @@ class BatchIngester:
         self.server = server
         self.store = server.store
         self.parser = server.parser
-        self._native = native.NativeParser()
-        self._lock = threading.Lock()  # parse buffers are single-use
+        self._engine = native.Engine()  # shared intern table
+        self._tls = threading.local()   # per-thread parse buffers
+        self._stats_lock = threading.Lock()
 
     @classmethod
     def create(cls, server) -> Optional["BatchIngester"]:
@@ -59,33 +60,42 @@ class BatchIngester:
             logger.exception("native batch ingester unavailable")
             return None
 
+    def _parser(self) -> native.NativeParser:
+        p = getattr(self._tls, "parser", None)
+        if p is None:
+            p = native.NativeParser(engine=self._engine)
+            self._tls.parser = p
+        return p
+
     def ingest_buffer(self, buf: bytes) -> int:
         """Parse and aggregate one newline-joined packet buffer; returns
         the number of samples taken (native + slow path not counted)."""
-        return self._ingest(lambda: self._native.parse(buf))
+        parser = self._parser()
+        return self._ingest(parser.parse(buf))
 
     def ingest_ptr(self, ptr, length: int) -> int:
         """Zero-copy variant over a native reader's joined buffer."""
-        return self._ingest(lambda: self._native.parse_ptr(ptr, length))
+        parser = self._parser()
+        return self._ingest(parser.parse_ptr(ptr, length))
 
-    def _ingest(self, parse) -> int:
+    def _ingest(self, res) -> int:
         store = self.store
-        with self._lock:
-            res = parse()
-            # native lines count as received; unknown lines are counted by
-            # handle_metric_packet below
-            self.server.stats["packets_received"] += res.lines - len(res.unknown)
-            if len(res.c_rows):
-                store.counters.add_batch(res.c_rows, res.c_vals, res.c_rates)
-            if len(res.g_rows):
-                store.gauges.add_batch(res.g_rows, res.g_vals)
-            if len(res.h_rows):
-                store.histos.add_batch(res.h_rows, res.h_vals, res.h_wts)
-            if len(res.s_rows):
-                store.sets.add_batch(res.s_rows, res.s_idx, res.s_rho)
+        # native lines count as received; unknown lines are counted by
+        # handle_metric_packet below. Stats increments are read-modify-
+        # write, so concurrent readers serialize on a small lock.
+        with self._stats_lock:
+            self.server.stats["packets_received"] += (
+                res.lines - len(res.unknown))
             store.processed += res.samples
-            unknown = res.unknown  # views invalidate on next parse; list of
-            # bytes is already materialized
+        if len(res.c_rows):
+            store.counters.add_batch(res.c_rows, res.c_vals, res.c_rates)
+        if len(res.g_rows):
+            store.gauges.add_batch(res.g_rows, res.g_vals)
+        if len(res.h_rows):
+            store.histos.add_batch(res.h_rows, res.h_vals, res.h_wts)
+        if len(res.s_rows):
+            store.sets.add_batch(res.s_rows, res.s_idx, res.s_rho)
+        unknown = res.unknown
         for line in unknown:
             self.server.handle_metric_packet(line)
             if not (line.startswith(b"_e{") or line.startswith(b"_sc")):
@@ -120,8 +130,8 @@ class BatchIngester:
         row = table.rows.get(dict_key)
         if row is None:
             return
-        self._native.register(meta_key, family, row, rate)
+        self._engine.register(meta_key, family, row, rate)
 
     @property
     def interned_keys(self) -> int:
-        return self._native.size()
+        return self._engine.size()

@@ -258,10 +258,11 @@ class Server:
     # -- lifecycle -------------------------------------------------------
 
     def start(self) -> None:
+        from veneur_tpu.util.crash import guarded
         for sink in self.metric_sinks + self.span_sinks:
             sink.start(self)
         for i in range(max(1, self.config.num_span_workers)):
-            t = threading.Thread(target=self._span_worker_loop,
+            t = threading.Thread(target=guarded(self._span_worker_loop),
                                  name=f"span-worker-{i}", daemon=True)
             t.start()
             self._span_workers.append(t)
@@ -308,7 +309,8 @@ class Server:
         if self.diagnostics is not None:
             self.diagnostics.start()
         self._flush_thread = threading.Thread(
-            target=self._flush_loop, name="flush-ticker", daemon=True)
+            target=guarded(self._flush_loop), name="flush-ticker",
+            daemon=True)
         self._flush_thread.start()
         if self.config.flush_watchdog_missed_flushes > 0:
             self._watchdog_thread = threading.Thread(

@@ -169,30 +169,50 @@ class NativeReader:
         return length, self._n1.value, self._n2.value
 
 
-class NativeParser:
-    """One intern table + reusable output buffers around the C library.
-
-    Thread safety: the C table is internally locked (shared for parse,
-    exclusive for register), but the output buffers here are not — callers
-    either hold their own lock or use one NativeParser per thread.
-    """
+class Engine:
+    """Owns one C++ intern table, shareable by many NativeParsers (the
+    C table takes a shared lock for parse, exclusive for register)."""
 
     def __init__(self, lib=None):
         self._lib = lib if lib is not None else load()
         if self._lib is None:
-            raise RuntimeError(
-                f"native parser unavailable: {_lib_err}")
-        self._eng = self._lib.vnt_new()
-        self._cap = 0
-        self._outs = [ctypes.c_int64() for _ in range(6)]  # c,g,h,s,unk,samples
+            raise RuntimeError(f"native engine unavailable: {_lib_err}")
+        self.ptr = self._lib.vnt_new()
 
     def __del__(self):
         try:
-            if self._eng:
-                self._lib.vnt_free(self._eng)
-                self._eng = None
+            if self.ptr:
+                self._lib.vnt_free(self.ptr)
+                self.ptr = None
         except Exception:
             pass
+
+    def size(self) -> int:
+        return self._lib.vnt_size(self.ptr)
+
+    def register(self, meta_key: bytes, family: int, row: int,
+                 rate: float) -> None:
+        self._lib.vnt_register(
+            self.ptr, meta_key, len(meta_key), family, row, rate)
+
+
+class NativeParser:
+    """Reusable parse-output buffers over a (possibly shared) Engine.
+
+    Thread safety: the C table is internally locked, but the output
+    buffers here are not — callers either hold their own lock or use one
+    NativeParser per thread (sharing the engine).
+    """
+
+    def __init__(self, lib=None, engine: "Engine | None" = None):
+        self._lib = lib if lib is not None else load()
+        if self._lib is None:
+            raise RuntimeError(
+                f"native parser unavailable: {_lib_err}")
+        self.engine = engine if engine is not None else Engine(self._lib)
+        self._eng = self.engine.ptr
+        self._cap = 0
+        self._outs = [ctypes.c_int64() for _ in range(6)]  # c,g,h,s,unk,samples
 
     def _ensure_capacity(self, cap: int) -> None:
         if cap <= self._cap:
@@ -214,12 +234,11 @@ class NativeParser:
         self._cap = cap
 
     def size(self) -> int:
-        return self._lib.vnt_size(self._eng)
+        return self.engine.size()
 
     def register(self, meta_key: bytes, family: int, row: int,
                  rate: float) -> None:
-        self._lib.vnt_register(
-            self._eng, meta_key, len(meta_key), family, row, rate)
+        self.engine.register(meta_key, family, row, rate)
 
     def parse(self, buf: bytes) -> ParseResult:
         """Parse a newline-joined packet buffer; returns trimmed COO views
