@@ -13,10 +13,86 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
+import traceback
 
 BASELINE_SAMPLES_PER_SEC = 60_000.0
+
+# one authoritative name per scenario, shared by the success and the
+# error-path JSON so harnesses can key records by metric name
+METRIC_NAMES = {
+    "mixed": "dogstatsd_samples_per_sec",
+    "counter": "counter_samples_per_sec",
+    "timers": "timer_samples_per_sec",
+    "hll": "hll_samples_per_sec",
+    "forward": "forwarded_digest_keys_per_sec",
+    "ssf": "ssf_extracted_samples_per_sec",
+    "device": "device_samples_per_sec",
+}
+
+
+def emit(obj) -> None:
+    """Print the single benchmark JSON line (flushed immediately so it
+    survives even if teardown hangs afterwards)."""
+    print(json.dumps(obj), flush=True)
+
+
+def initialize_backend(max_attempts: int = 2,
+                       probe_timeout: float = 150.0) -> str:
+    """Bring up the JAX backend before constructing any pipeline object so
+    a backend failure is visible up front (round-1 failure modes: axon TPU
+    init raising UNAVAILABLE deep inside Server construction, or hanging
+    outright). Because a hung plugin init can't be recovered in-process,
+    the accelerator is probed in a SUBPROCESS with a hard timeout first;
+    only a healthy probe lets the main process bind to it. Any probe
+    failure falls back to CPU so a benchmark number always lands (the
+    platform field in the JSON line records the fallback)."""
+    import subprocess
+
+    fallback_reason = None
+    if "JAX_PLATFORMS" not in os.environ:
+        for attempt in range(1, max_attempts + 1):
+            try:
+                probe = subprocess.run(
+                    [sys.executable, "-c",
+                     "import jax; d=jax.devices(); "
+                     "print(jax.default_backend(), len(d))"],
+                    timeout=probe_timeout, capture_output=True, text=True)
+            except subprocess.TimeoutExpired:
+                fallback_reason = f"probe timeout ({probe_timeout:.0f}s)"
+                print(f"bench: backend probe attempt {attempt} timed out",
+                      file=sys.stderr)
+                continue
+            if probe.returncode == 0:
+                fallback_reason = None
+                print(f"bench: backend probe ok: {probe.stdout.strip()}",
+                      file=sys.stderr)
+                break
+            fallback_reason = (probe.stderr.strip().splitlines() or
+                               ["unknown probe error"])[-1][:300]
+            print(f"bench: backend probe attempt {attempt} failed rc="
+                  f"{probe.returncode}: {fallback_reason}", file=sys.stderr)
+            time.sleep(3 * attempt)
+
+    from veneur_tpu.util.jaxplatform import force_cpu, honor_env_platform
+
+    if fallback_reason is not None:
+        force_cpu()
+    else:
+        # a JAX_PLATFORMS set by the caller must beat any programmatic pin
+        # the host sitecustomize applied
+        honor_env_platform()
+
+    import jax
+
+    devs = jax.devices()
+    platform = jax.default_backend()
+    print(f"bench: backend={platform} devices={devs}", file=sys.stderr)
+    if fallback_reason is not None:
+        return f"cpu-fallback({fallback_reason})"
+    return platform
 
 
 def make_packets(num_keys: int, values_per_packet: int = 8):
@@ -217,38 +293,184 @@ def run_scenario_ssf(duration_s: float, num_keys: int = 10_000):
     return processed * 2 / elapsed
 
 
+def run_scenario_device(duration_s: float, num_keys: int = 100_000,
+                        batch: int = 65_536):
+    """Device-only throughput: samples/s through the batched apply kernels
+    plus one flush pass, with pre-staged on-device COO arrays — separates
+    device kernel throughput from host parse/intern overhead."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from veneur_tpu.ops import batch_hll, batch_tdigest, scalars
+
+    percentiles = (0.5, 0.9, 0.99)
+    quarter = batch // 4
+    rng = np.random.default_rng(7)
+    f32 = np.float32
+    b = {
+        "c_rows": rng.integers(0, num_keys, quarter).astype(np.int32),
+        "c_vals": (rng.random(quarter) * 10).astype(f32),
+        "c_rates": np.ones(quarter, f32),
+        "g_rows": rng.integers(0, num_keys, quarter).astype(np.int32),
+        "g_vals": rng.random(quarter).astype(f32),
+        "h_rows": rng.integers(0, num_keys, quarter).astype(np.int32),
+        "h_vals": rng.normal(100, 15, quarter).astype(f32),
+        "h_wts": np.ones(quarter, f32),
+        "s_rows": rng.integers(0, max(1, num_keys // 8), quarter).astype(
+            np.int32),
+        "s_idx": rng.integers(0, batch_hll.M, quarter).astype(np.int32),
+        "s_rho": rng.integers(1, 30, quarter).astype(np.int32),
+    }
+    b = jax.device_put(b)
+
+    @jax.jit
+    def apply_step(counters, gauges, histos, sets, data):
+        counters = scalars.apply_counters(
+            counters, data["c_rows"], data["c_vals"], data["c_rates"])
+        gauges = scalars.apply_gauges(gauges, data["g_rows"], data["g_vals"])
+        histos = batch_tdigest.apply_batch(
+            histos, data["h_rows"], data["h_vals"], data["h_wts"])
+        sets = batch_hll.apply_batch(
+            sets, data["s_rows"], data["s_idx"], data["s_rho"])
+        return counters, gauges, histos, sets
+
+    @jax.jit
+    def flush_step(counters, histos, sets):
+        return (scalars.counter_values(counters),
+                batch_tdigest.flush_quantiles(histos, percentiles),
+                batch_hll.estimate(sets))
+
+    state = (scalars.init_counters(num_keys),
+             scalars.init_gauges(num_keys),
+             batch_tdigest.init_state(num_keys),
+             batch_hll.init_state(max(1, num_keys // 8)))
+    # warmup/compile
+    state = apply_step(*state, b)
+    jax.block_until_ready(flush_step(state[0], state[2], state[3]))
+
+    t0 = time.perf_counter()
+    applies = 0
+    while time.perf_counter() - t0 < duration_s:
+        for _ in range(20):
+            state = apply_step(*state, b)
+        applies += 20
+    jax.block_until_ready(state)
+    apply_elapsed = time.perf_counter() - t0
+
+    tf = time.perf_counter()
+    out = flush_step(state[0], state[2], state[3])
+    jax.block_until_ready(out)
+    flush_latency = time.perf_counter() - tf
+
+    rate = applies * batch / apply_elapsed
+    return rate, flush_latency
+
+
+def run_scenario_hll(duration_s: float, num_keys: int = 10_000,
+                     cardinality: int = 100):
+    """BASELINE config 3: mixed keys at tag cardinality 100 — HLL stress
+    (each base key fans out to `cardinality` distinct tag combinations)."""
+    import numpy as np
+    rng = np.random.default_rng(3)
+    base = max(1, num_keys // cardinality)
+    packets = []
+    for i in range(base):
+        for t in range(cardinality):
+            packets.append(
+                b"bench.hll.%d:user%d|s|#card:%d,env:bench"
+                % (i, rng.integers(0, 100_000), t))
+    datagrams = [b"\n".join(packets[i:i + 40])
+                 for i in range(0, len(packets), 40)]
+    server = _mk_server(num_keys * 2)
+    server.handle_packet_batch(datagrams)
+    server.store.apply_all_pending()
+    server.flush()
+    t0 = time.perf_counter()
+    total = 0
+    while time.perf_counter() - t0 < duration_s:
+        server.handle_packet_batch(datagrams)
+        total += len(packets)
+    server.store.apply_all_pending()
+    server.flush()
+    return total / (time.perf_counter() - t0)
+
+
+SCENARIOS = ["mixed", "counter", "timers", "hll", "forward", "ssf", "device"]
+
+
+def run_one(scenario: str, duration: float, keys: int, on_tpu: bool = True):
+    """Returns (metric_name, rate, extra_fields)."""
+    extra = {}
+    metric = METRIC_NAMES[scenario]
+    if scenario == "mixed":
+        rate, _ = run_pipeline(duration, keys)
+        # companion device-only figure so host overhead and device
+        # throughput are separable in one headline run (scaled down on a
+        # CPU fallback, where the 100k-key grids are host-loop slow)
+        try:
+            dev_keys = max(keys, 100_000) if on_tpu else min(keys, 10_000)
+            drate, dflush = run_scenario_device(
+                min(duration, 5.0), dev_keys)
+            extra["device_samples_per_sec"] = round(drate, 1)
+            extra["device_flush_latency_s"] = round(dflush, 4)
+        except Exception as e:
+            extra["device_bench_error"] = f"{type(e).__name__}: {e}"
+    elif scenario == "counter":
+        rate = run_scenario_counter(duration)
+    elif scenario == "timers":
+        rate = run_scenario_timers(duration, min(keys, 1000))
+    elif scenario == "hll":
+        rate = run_scenario_hll(duration, keys)
+    elif scenario == "forward":
+        rate = run_scenario_forward(duration, keys)
+    elif scenario == "device":
+        dev_keys = max(keys, 100_000) if on_tpu else min(keys, 10_000)
+        rate, dflush = run_scenario_device(duration, dev_keys)
+        extra["flush_latency_s"] = round(dflush, 4)
+    else:
+        rate = run_scenario_ssf(duration, keys)
+    return metric, rate, extra
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--duration", type=float, default=10.0)
     ap.add_argument("--keys", type=int, default=10_000)
-    ap.add_argument("--scenario", default="mixed",
-                    choices=["mixed", "counter", "timers", "forward", "ssf"],
+    ap.add_argument("--scenario", default="mixed", choices=SCENARIOS,
                     help="mixed is the headline metric; the rest mirror "
                          "the BASELINE.json config suite")
     args = ap.parse_args()
 
-    if args.scenario == "mixed":
-        rate, _ = run_pipeline(args.duration, args.keys)
-        metric = "dogstatsd_samples_per_sec"
-    elif args.scenario == "counter":
-        rate = run_scenario_counter(args.duration)
-        metric = "counter_samples_per_sec"
-    elif args.scenario == "timers":
-        rate = run_scenario_timers(args.duration, min(args.keys, 1000))
-        metric = "timer_samples_per_sec"
-    elif args.scenario == "forward":
-        rate = run_scenario_forward(args.duration, args.keys)
-        metric = "forwarded_digest_keys_per_sec"
-    else:
-        rate = run_scenario_ssf(args.duration, args.keys)
-        metric = "ssf_extracted_samples_per_sec"
+    metric = METRIC_NAMES[args.scenario]
+    try:
+        platform = initialize_backend()
+    except Exception as e:
+        emit({"metric": metric, "value": 0.0, "unit": "samples/s",
+              "vs_baseline": 0.0,
+              "error": f"backend init failed: {type(e).__name__}: {e}"})
+        return 1
 
-    print(json.dumps({
+    on_tpu = not platform.startswith("cpu")
+    try:
+        metric, rate, extra = run_one(
+            args.scenario, args.duration, args.keys, on_tpu)
+    except Exception as e:
+        traceback.print_exc()
+        emit({"metric": metric, "value": 0.0, "unit": "samples/s",
+              "vs_baseline": 0.0, "platform": platform,
+              "error": f"{type(e).__name__}: {e}"})
+        return 1
+
+    emit({
         "metric": metric,
         "value": round(rate, 1),
         "unit": "samples/s",
         "vs_baseline": round(rate / BASELINE_SAMPLES_PER_SEC, 3),
-    }))
+        "platform": platform,
+        **extra,
+    })
+    return 0
 
 
 if __name__ == "__main__":

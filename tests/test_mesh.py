@@ -1,0 +1,211 @@
+"""Multi-device merge-plane tests over the virtual 8-device CPU mesh.
+
+Validates veneur_tpu.parallel.mesh — the ICI collective equivalent of the
+reference's forward/import merge semantics (reference worker.go:410-467):
+counter psum exactness, gauge last-set-wins, HLL register pmax against the
+scalar oracle, and t-digest all_gather+recompress quantile accuracy within
+the reference's own test tolerance (reference tdigest/histo_test.go:95-176,
+epsilon 0.02 in uniform-value space).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from veneur_tpu.ops import batch_hll, batch_tdigest, hll_ref, tdigest_ref
+from veneur_tpu.parallel import mesh as pmesh
+
+N_DEV = 8
+NUM_KEYS = 64
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    if len(jax.devices()) < N_DEV:
+        pytest.skip(f"needs {N_DEV} devices (virtual CPU mesh)")
+    return pmesh.make_mesh(N_DEV)
+
+
+def _merged(mesh, state, batches):
+    state = pmesh.apply_shard_batches(state, batches)
+    return pmesh.merge_shards(mesh, state)
+
+
+class TestCounterMerge:
+    def test_psum_exactness(self, mesh):
+        batch = 512
+        state = pmesh.init_sharded_state(mesh, NUM_KEYS)
+        batches = pmesh.make_shard_batches(N_DEV, NUM_KEYS, batch, seed=11)
+        merged = _merged(mesh, state, batches)
+
+        want = np.zeros(NUM_KEYS, np.float64)
+        contrib = np.trunc(
+            np.asarray(batches["c_vals"], np.float64)
+            / np.asarray(batches["c_rates"], np.float64))
+        np.add.at(want, np.asarray(batches["c_rows"]).reshape(-1),
+                  contrib.reshape(-1))
+        got = np.asarray(merged["counters"], np.float64)
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_full_step_matches_manual(self, mesh):
+        state = pmesh.init_sharded_state(mesh, NUM_KEYS)
+        batches = pmesh.make_shard_batches(N_DEV, NUM_KEYS, 128, seed=3)
+        _, merged = pmesh.full_step(mesh, state, batches)
+        manual = _merged(
+            mesh, pmesh.init_sharded_state(mesh, NUM_KEYS), batches)
+        np.testing.assert_allclose(np.asarray(merged["counters"]),
+                                   np.asarray(manual["counters"]))
+
+
+class TestGaugeMerge:
+    def test_last_set_shard_wins(self, mesh):
+        """Each shard sets a disjoint-but-overlapping key range; the merged
+        value for a key must come from the highest shard index that set it,
+        and keys no shard set must stay unset."""
+        state = pmesh.init_sharded_state(mesh, NUM_KEYS)
+        batches = pmesh.make_shard_batches(N_DEV, NUM_KEYS, 16, seed=5)
+        # shard s writes value 1000*s+k to keys [0, 8*(s+1)) — shard 7
+        # covers the most keys; key k's winner is the highest shard with
+        # 8*(s+1) > k
+        rows = np.full((N_DEV, 16), 2**31 - 1, np.int32)
+        vals = np.zeros((N_DEV, 16), np.float32)
+        for s in range(N_DEV):
+            span = min(16, 8 * (s + 1))
+            rows[s, :span] = np.arange(span)
+            vals[s, :span] = 1000 * s + np.arange(span)
+        batches["g_rows"] = rows
+        batches["g_vals"] = vals
+        merged = _merged(mesh, state, batches)
+
+        got_vals = np.asarray(merged["gauges"]["value"])
+        got_set = np.asarray(merged["gauges"]["set"])
+        for k in range(16):
+            assert got_set[k]
+            assert got_vals[k] == pytest.approx(1000 * (N_DEV - 1) + k)
+        # rows 16..: nothing wrote them
+        assert not got_set[16:].any()
+
+    def test_single_shard_writer(self, mesh):
+        """A key only shard 2 writes must surface shard 2's value."""
+        state = pmesh.init_sharded_state(mesh, NUM_KEYS)
+        batches = pmesh.make_shard_batches(N_DEV, NUM_KEYS, 4, seed=6)
+        rows = np.full((N_DEV, 4), 2**31 - 1, np.int32)
+        vals = np.zeros((N_DEV, 4), np.float32)
+        rows[2, 0] = 42
+        vals[2, 0] = 7.5
+        batches["g_rows"] = rows
+        batches["g_vals"] = vals
+        merged = _merged(mesh, state, batches)
+        assert np.asarray(merged["gauges"]["set"])[42]
+        assert np.asarray(merged["gauges"]["value"])[42] == pytest.approx(7.5)
+
+
+class TestHLLMerge:
+    def test_pmax_matches_scalar_oracle(self, mesh):
+        """Shard-merged registers must equal the elementwise max of every
+        shard's registers, and the estimate must match the scalar oracle
+        computed from those merged registers."""
+        rng = np.random.default_rng(17)
+        batch = 256
+        state = pmesh.init_sharded_state(mesh, NUM_KEYS)
+        batches = pmesh.make_shard_batches(N_DEV, NUM_KEYS, batch, seed=17)
+        merged = _merged(mesh, state, batches)
+
+        # oracle: scatter-max on host over all shards
+        want = np.zeros((NUM_KEYS, batch_hll.M), np.int8)
+        rows = np.asarray(batches["s_rows"]).reshape(-1)
+        idx = np.asarray(batches["s_idx"]).reshape(-1)
+        rho = np.asarray(batches["s_rho"]).reshape(-1)
+        np.maximum.at(want, (rows, idx), rho.astype(np.int8))
+        got = np.asarray(merged["sets"])
+        np.testing.assert_array_equal(got, want)
+
+        est = np.asarray(batch_hll.estimate(merged["sets"]))
+        for k in rng.choice(NUM_KEYS, 8, replace=False):
+            oracle = hll_ref.estimate_from_registers(want[k])
+            assert est[k] == pytest.approx(oracle, rel=1e-3)
+
+    def test_true_cardinality_accuracy(self, mesh):
+        """Distinct members spread over shards: merged estimate within the
+        ~0.8% p14 standard error (3 sigma) of the true cardinality."""
+        n_members = 20_000
+        members = [b"member-%d" % i for i in range(n_members)]
+        hashes = [hll_ref.hash_member(mb) for mb in members]
+        pos = np.array([hll_ref.pos_val(h) for h in hashes], np.int64)
+        per = n_members // N_DEV
+        rows = np.zeros((N_DEV, per), np.int32)  # all into key 0
+        idx = np.zeros((N_DEV, per), np.int32)
+        rho = np.zeros((N_DEV, per), np.int32)
+        for s in range(N_DEV):
+            sl = slice(s * per, (s + 1) * per)
+            idx[s] = pos[sl, 0]
+            rho[s] = pos[sl, 1]
+        state = pmesh.init_sharded_state(mesh, NUM_KEYS)
+        batches = pmesh.make_shard_batches(N_DEV, NUM_KEYS, per, seed=1)
+        batches["s_rows"], batches["s_idx"], batches["s_rho"] = rows, idx, rho
+        merged = _merged(mesh, state, batches)
+        est = float(np.asarray(batch_hll.estimate(merged["sets"]))[0])
+        assert est == pytest.approx(n_members, rel=0.03)
+
+
+class TestDigestMerge:
+    def test_allgather_recompress_quantiles(self, mesh):
+        """Uniform samples split across shards: merged quantiles within
+        the reference's 0.02 uniform-space tolerance of the true values
+        and of a scalar reference digest fed all samples."""
+        rng = np.random.default_rng(23)
+        per = 2048
+        data = rng.uniform(0.0, 1.0, (N_DEV, per)).astype(np.float32)
+
+        state = pmesh.init_sharded_state(mesh, NUM_KEYS)
+        batches = pmesh.make_shard_batches(N_DEV, NUM_KEYS, per, seed=2)
+        batches["h_rows"] = np.zeros((N_DEV, per), np.int32)
+        batches["h_vals"] = data
+        batches["h_wts"] = np.ones((N_DEV, per), np.float32)
+        merged = _merged(mesh, state, batches)
+
+        ps = (0.25, 0.5, 0.9, 0.99)
+        out = batch_tdigest.flush_quantiles(merged["histos"], ps)
+        ref = tdigest_ref.MergingDigest()
+        for v in data.reshape(-1):
+            ref.add(float(v))
+        allv = np.sort(data.reshape(-1))
+        for j, q in enumerate(ps):
+            got = float(out["quantiles"][0, j])
+            true = float(allv[int(q * (len(allv) - 1))])
+            assert got == pytest.approx(true, abs=0.02), q
+            assert got == pytest.approx(ref.quantile(q), abs=0.02), q
+        assert float(out["count"][0]) == pytest.approx(N_DEV * per, rel=1e-3)
+        assert float(out["min"][0]) == pytest.approx(float(allv[0]), abs=1e-6)
+        assert float(out["max"][0]) == pytest.approx(float(allv[-1]), abs=1e-6)
+
+    def test_merge_matches_single_shard_ingest(self, mesh):
+        """Splitting a stream over 8 shards then merging must agree with
+        ingesting the whole stream into one digest state."""
+        rng = np.random.default_rng(29)
+        per = 1024
+        data = rng.normal(100.0, 15.0, (N_DEV, per)).astype(np.float32)
+
+        state = pmesh.init_sharded_state(mesh, NUM_KEYS)
+        batches = pmesh.make_shard_batches(N_DEV, NUM_KEYS, per, seed=4)
+        batches["h_rows"] = np.zeros((N_DEV, per), np.int32)
+        batches["h_vals"] = data
+        batches["h_wts"] = np.ones((N_DEV, per), np.float32)
+        merged = _merged(mesh, state, batches)
+
+        single = batch_tdigest.init_state(NUM_KEYS)
+        single = batch_tdigest.apply_batch(
+            single, np.zeros(N_DEV * per, np.int32), data.reshape(-1),
+            np.ones(N_DEV * per, np.float32))
+
+        ps = (0.5, 0.9, 0.99)
+        got = batch_tdigest.flush_quantiles(merged["histos"], ps)
+        want = batch_tdigest.flush_quantiles(single, ps)
+        for j in range(len(ps)):
+            # both are approximations of the same stream; they must agree
+            # within twice the documented quantile tolerance (normal data,
+            # sigma 15 => value-space slack scales with sigma)
+            assert float(got["quantiles"][0, j]) == pytest.approx(
+                float(want["quantiles"][0, j]), abs=2 * 0.02 * 15)
+        assert float(got["count"][0]) == pytest.approx(
+            float(want["count"][0]), rel=1e-3)

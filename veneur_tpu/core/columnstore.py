@@ -58,7 +58,22 @@ class RowMeta:
 
 class _BaseTable:
     """Row interning + touched tracking + capacity doubling, shared by all
-    device families."""
+    device families.
+
+    Lock discipline (double-buffered hot path — the device-side analog of
+    the reference's map-swap, worker.go:470-489):
+
+      * ``lock`` (buffer lock) protects the pending sample columns, the
+        row dictionary, meta, and touched masks. Reader threads hold it
+        only for memcpy-scale work.
+      * ``apply_lock`` protects the device-resident ``state``. It is
+        always acquired while still holding ``lock`` (which fixes batch
+        application order to buffer-swap order — load-bearing for gauge
+        last-write-wins) but is held WITHOUT ``lock`` during the actual
+        kernel dispatch, so readers filling the fresh buffer never block
+        on a device call.
+      * Order: ``lock`` then ``apply_lock``; never the reverse.
+    """
 
     def __init__(self, capacity: int = 1024, batch_cap: int = 8192):
         self.capacity = capacity
@@ -67,9 +82,46 @@ class _BaseTable:
         self.meta: List[RowMeta] = []
         self.touched = np.zeros(capacity, bool)
         self.lock = threading.Lock()
+        self.apply_lock = threading.Lock()
         self._init_arrays()
 
-    # subclasses define _init_arrays / _grow_arrays / _apply / reset
+    # subclasses define _init_arrays / _grow_arrays / _apply_cols / reset
+
+    def _swap_locked(self):
+        """Copy out and reset the pending columns (caller holds ``lock``).
+        Returns the column copies, or None when nothing is pending. The
+        whole buffer is copied; rows beyond the fill point are PAD_ROW and
+        dropped by the scatter kernels."""
+        if self._n == 0:
+            return None
+        cols = tuple(c.copy() for c in self._pcols)
+        self._prow[: self._n] = PAD_ROW
+        self._n = 0
+        return cols
+
+    def intern(self, metric: UDPMetric) -> int:
+        """Intern a metric's row WITHOUT marking it touched — used by
+        callers that batch values themselves (ordered gauge replay-merge
+        in core.ingest). Touched must only be set once the value is in a
+        pending buffer or the state, else a concurrent flush would emit a
+        touched-but-valueless row (a fabricated 0.0)."""
+        with self.lock:
+            return self.row_for(metric)
+
+    def _dispatch_pending_locked(self):
+        """Swap the pending buffer out under ``lock`` and apply it to the
+        device state with ``lock`` released (``apply_lock`` held). Caller
+        holds ``lock`` on entry and on return."""
+        cols = self._swap_locked()
+        if cols is None:
+            return
+        self.apply_lock.acquire()
+        self.lock.release()
+        try:
+            self._apply_cols(cols)
+        finally:
+            self.apply_lock.release()
+            self.lock.acquire()
 
     def row_for(self, metric: UDPMetric) -> int:
         # scope is part of row identity: the reference keeps separate maps
@@ -92,12 +144,15 @@ class _BaseTable:
         new_cap = self.capacity * 2
         self.touched = np.concatenate(
             [self.touched, np.zeros(new_cap - self.capacity, bool)])
-        self._grow_arrays(new_cap)
+        # _grow_arrays re-lays-out the device state, so it needs the state
+        # lock; caller already holds the buffer lock (correct lock order)
+        with self.apply_lock:
+            self._grow_arrays(new_cap)
         self.capacity = new_cap
 
     def _append_batch(self, columns) -> None:
         """Vectorized append of parallel sample columns into the typed
-        pending buffers (the native-parser fast path), applying whenever
+        pending buffers (the native-parser fast path), dispatching whenever
         full. Caller holds self.lock; rows must already be interned."""
         n = len(columns[0])
         i = 0
@@ -108,7 +163,7 @@ class _BaseTable:
             self._n += take
             i += take
             if self._n >= self.batch_cap:
-                self._apply_locked()
+                self._dispatch_pending_locked()
 
     @property
     def num_rows(self) -> int:
@@ -144,21 +199,17 @@ class CounterTable(_BaseTable):
             self._prate[n] = max(metric.sample_rate, 1e-9)
             self._n = n + 1
             if self._n >= self.batch_cap:
-                self._apply_locked()
+                self._dispatch_pending_locked()
 
-    def _apply_locked(self):
-        if self._n == 0:
-            return
-        # dispatch on copies: execution is async and jax may alias numpy
-        # buffers zero-copy, while these buffers are refilled immediately
-        rows, vals, rates = (c.copy() for c in self._pcols)
+    def _apply_cols(self, cols):
+        # cols are copies: execution is async and jax may alias numpy
+        # buffers zero-copy, while the live buffers are refilled immediately
+        rows, vals, rates = cols
         self.state = scalars.apply_counters(self.state, rows, vals, rates)
-        self._prow[: self._n] = PAD_ROW
-        self._n = 0
 
     def apply_pending(self):
         with self.lock:
-            self._apply_locked()
+            self._dispatch_pending_locked()
 
     def add_batch(self, rows, vals, rates) -> None:
         """Native-parser fast path: pre-interned rows, parallel columns."""
@@ -185,16 +236,25 @@ class CounterTable(_BaseTable):
 
     def snapshot_and_reset(self) -> Tuple[np.ndarray, np.ndarray, List[RowMeta]]:
         with self.lock:
-            self._apply_locked()
+            cols = self._swap_locked()
+            self.apply_lock.acquire()
+            touched = self.touched.copy()
+            meta = list(self.meta)
+            import_acc = self._import_acc
+            self._import_acc = np.zeros(self.capacity, np.float64)
+            self.touched[:] = False
+        # readout + reset happen outside the buffer lock: samples arriving
+        # during the flush land in the fresh buffers / next-interval state
+        try:
+            if cols is not None:
+                self._apply_cols(cols)
             # f64 readout recovers the exact total from the Kahan pair
             values = (np.asarray(self.state["sum"], np.float64)
                       - np.asarray(self.state["comp"], np.float64))
-            values[: self._import_acc.shape[0]] += self._import_acc
-            touched = self.touched.copy()
-            meta = list(self.meta)
+            values[: import_acc.shape[0]] += import_acc
             self.state = scalars.init_counters(self.capacity)
-            self._import_acc = np.zeros(self.capacity, np.float64)
-            self.touched[:] = False
+        finally:
+            self.apply_lock.release()
         return values, touched, meta
 
 
@@ -218,19 +278,15 @@ class GaugeTable(_BaseTable):
             self._pval[n] = metric.value
             self._n = n + 1
             if self._n >= self.batch_cap:
-                self._apply_locked()
+                self._dispatch_pending_locked()
 
-    def _apply_locked(self):
-        if self._n == 0:
-            return
-        rows, vals = (c.copy() for c in self._pcols)
+    def _apply_cols(self, cols):
+        rows, vals = cols
         self.state = scalars.apply_gauges(self.state, rows, vals)
-        self._prow[: self._n] = PAD_ROW
-        self._n = 0
 
     def apply_pending(self):
         with self.lock:
-            self._apply_locked()
+            self._dispatch_pending_locked()
 
     def add_batch(self, rows, vals) -> None:
         """Native-parser fast path; buffer order preserves last-write-wins."""
@@ -239,22 +295,34 @@ class GaugeTable(_BaseTable):
             self._append_batch((rows, vals))
 
     def merge_batch(self, stubs: List[UDPMetric], values) -> None:
-        """Import-path merge: overwrite, atomically with interning."""
+        """Import-path merge: overwrite. Interning is atomic under the
+        buffer lock; the state update rides the apply ticket so it orders
+        after any already-swapped local batches."""
         with self.lock:
             rows = np.fromiter(
                 (self.row_for(s) for s in stubs), np.int32, len(stubs))
             self.touched[rows] = True
+            self.apply_lock.acquire()
+        try:
             self.state = scalars.merge_gauges(
                 self.state, rows, np.asarray(values, np.float32))
+        finally:
+            self.apply_lock.release()
 
     def snapshot_and_reset(self):
         with self.lock:
-            self._apply_locked()
-            values = np.asarray(self.state["value"])
+            cols = self._swap_locked()
+            self.apply_lock.acquire()
             touched = self.touched.copy()
             meta = list(self.meta)
-            self.state = scalars.init_gauges(self.capacity)
             self.touched[:] = False
+        try:
+            if cols is not None:
+                self._apply_cols(cols)
+            values = np.asarray(self.state["value"])
+            self.state = scalars.init_gauges(self.capacity)
+        finally:
+            self.apply_lock.release()
         return values, touched, meta
 
 
@@ -293,22 +361,18 @@ class HistoTable(_BaseTable):
             self._pwt[n] = 1.0 / max(metric.sample_rate, 1e-9)
             self._n = n + 1
             if self._n >= self.batch_cap:
-                self._apply_locked()
+                self._dispatch_pending_locked()
 
-    def _apply_locked(self):
-        if self._n == 0:
-            return
-        rows, vals, wts = (c.copy() for c in self._pcols)
+    def _apply_cols(self, cols):
+        rows, vals, wts = cols
         self.state = batch_tdigest.apply_batch(self.state, rows, vals, wts)
-        self._prow[: self._n] = PAD_ROW
-        self._n = 0
         self._applies += 1
         if self._applies % self.RECOMPRESS_EVERY == 0:
             self.state = batch_tdigest.recompress_state(self.state)
 
     def apply_pending(self):
         with self.lock:
-            self._apply_locked()
+            self._dispatch_pending_locked()
 
     def add_batch(self, rows, vals, weights) -> None:
         """Native-parser fast path: weights are 1/sample_rate."""
@@ -318,11 +382,14 @@ class HistoTable(_BaseTable):
 
     def merge_batch(self, stubs: List[UDPMetric], in_means, in_weights,
                     in_min, in_max, in_recip) -> None:
-        """Import-path digest merge, atomic with interning."""
+        """Import-path digest merge; interning atomic under the buffer
+        lock, state update ordered via the apply ticket."""
         with self.lock:
             rows = np.fromiter(
                 (self.row_for(s) for s in stubs), np.int32, len(stubs))
             self.touched[rows] = True
+            self.apply_lock.acquire()
+        try:
             self.state = batch_tdigest.merge_centroid_rows(
                 self.state, rows,
                 np.asarray(in_means, np.float32),
@@ -330,19 +397,32 @@ class HistoTable(_BaseTable):
                 np.asarray(in_min, np.float32),
                 np.asarray(in_max, np.float32),
                 np.asarray(in_recip, np.float32))
+        finally:
+            self.apply_lock.release()
 
     def snapshot_and_reset(self, percentiles: Tuple[float, ...]):
         """Returns (flush outputs dict of np arrays, centroid export,
         touched, meta)."""
         with self.lock:
-            self._apply_locked()
-            out = batch_tdigest.flush_quantiles(self.state, tuple(percentiles))
-            out = {k: np.asarray(v) for k, v in out.items()}
-            export = batch_tdigest.export_centroids(self.state)
+            cols = self._swap_locked()
+            self.apply_lock.acquire()
             touched = self.touched.copy()
             meta = list(self.meta)
-            self.state = batch_tdigest.init_state(self.capacity)
             self.touched[:] = False
+        try:
+            if cols is not None:
+                self._apply_cols(cols)
+            # recompress before reading quantiles: scatter-accumulate
+            # ingest blurs slot means between periodic recompressions, so
+            # re-tighten the grid at read time to hold the one-k-unit
+            # invariant the t-digest error bound relies on
+            state = batch_tdigest.recompress_state(self.state)
+            out = batch_tdigest.flush_quantiles(state, tuple(percentiles))
+            out = {k: np.asarray(v) for k, v in out.items()}
+            export = batch_tdigest.export_centroids(state)
+            self.state = batch_tdigest.init_state(self.capacity)
+        finally:
+            self.apply_lock.release()
         return out, export, touched, meta
 
 
@@ -375,19 +455,15 @@ class SetTable(_BaseTable):
             self._prho[n] = rho
             self._n = n + 1
             if self._n >= self.batch_cap:
-                self._apply_locked()
+                self._dispatch_pending_locked()
 
-    def _apply_locked(self):
-        if self._n == 0:
-            return
-        rows, idxs, rhos = (c.copy() for c in self._pcols)
+    def _apply_cols(self, cols):
+        rows, idxs, rhos = cols
         self.state = batch_hll.apply_batch(self.state, rows, idxs, rhos)
-        self._prow[: self._n] = PAD_ROW
-        self._n = 0
 
     def apply_pending(self):
         with self.lock:
-            self._apply_locked()
+            self._dispatch_pending_locked()
 
     def add_batch(self, rows, reg_idx, rho) -> None:
         """Native-parser fast path: members already hashed to (idx, rho)."""
@@ -396,23 +472,34 @@ class SetTable(_BaseTable):
             self._append_batch((rows, reg_idx, rho))
 
     def merge_batch(self, stubs: List[UDPMetric], in_regs) -> None:
-        """Import-path HLL merge (register max), atomic with interning."""
+        """Import-path HLL merge (register max); interning atomic under
+        the buffer lock, state update ordered via the apply ticket."""
         with self.lock:
             rows = np.fromiter(
                 (self.row_for(s) for s in stubs), np.int32, len(stubs))
             self.touched[rows] = True
+            self.apply_lock.acquire()
+        try:
             self.state = batch_hll.merge_rows(
                 self.state, rows, np.asarray(in_regs, np.int8))
+        finally:
+            self.apply_lock.release()
 
     def snapshot_and_reset(self):
         with self.lock:
-            self._apply_locked()
-            estimates = np.asarray(batch_hll.estimate(self.state))
-            registers = np.asarray(self.state)
+            cols = self._swap_locked()
+            self.apply_lock.acquire()
             touched = self.touched.copy()
             meta = list(self.meta)
-            self.state = batch_hll.init_state(self.capacity)
             self.touched[:] = False
+        try:
+            if cols is not None:
+                self._apply_cols(cols)
+            estimates = np.asarray(batch_hll.estimate(self.state))
+            registers = np.asarray(self.state)
+            self.state = batch_hll.init_state(self.capacity)
+        finally:
+            self.apply_lock.release()
         return estimates, registers, touched, meta
 
 
@@ -467,6 +554,12 @@ class ColumnStore:
         self.sets = SetTable(set_capacity, batch_cap)
         self.statuses = StatusTable()
         self.processed = 0
+        self._processed_lock = threading.Lock()
+
+    def count_processed(self, n: int) -> None:
+        """Locked sample-count increment (readers race on += otherwise)."""
+        with self._processed_lock:
+            self.processed += n
 
     def process(self, metric: UDPMetric) -> None:
         """Route one parsed metric to its family table (the equivalent of
@@ -484,7 +577,7 @@ class ColumnStore:
             self.statuses.add(metric)
         else:
             return
-        self.processed += 1
+        self.count_processed(1)
 
     def apply_all_pending(self):
         self.counters.apply_pending()

@@ -81,6 +81,6 @@ class GrpcIngestServer:
         return _EMPTY
 
     def _send_span(self, request, context):
-        self._server.stats["packets_received"] += 1
+        self._server.stats.inc("packets_received")
         self._server.ingest_span(request)
         return _EMPTY

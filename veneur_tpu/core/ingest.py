@@ -21,6 +21,8 @@ import logging
 import threading
 from typing import Optional
 
+import numpy as np
+
 from veneur_tpu import native
 from veneur_tpu.samplers import metrics as m
 
@@ -48,7 +50,6 @@ class BatchIngester:
         self.parser = server.parser
         self._engine = native.Engine()  # shared intern table
         self._tls = threading.local()   # per-thread parse buffers
-        self._stats_lock = threading.Lock()
 
     @classmethod
     def create(cls, server) -> Optional["BatchIngester"]:
@@ -80,26 +81,72 @@ class BatchIngester:
 
     def _ingest(self, res) -> int:
         store = self.store
-        # native lines count as received; unknown lines are counted by
-        # handle_metric_packet below. Stats increments are read-modify-
-        # write, so concurrent readers serialize on a small lock.
-        with self._stats_lock:
-            self.server.stats["packets_received"] += (
-                res.lines - len(res.unknown))
-            store.processed += res.samples
+        server = self.server
+        # native lines count as received; unknown lines are counted in the
+        # replay loop below
+        server.stats.inc("packets_received", res.lines - len(res.unknown))
+        store.count_processed(res.samples)
+        unknown = res.unknown
+
+        # Counters/histograms/sets merge commutatively, so replay order
+        # vs. native-column order is irrelevant for them. Gauges are
+        # last-write-wins: a deferred line can fall anywhere relative to
+        # the native lines of the same row, so replayed gauge samples are
+        # captured (not applied) and merged with the native gauge columns
+        # by line index before one ordered add_batch.
+        if unknown:
+            gauge_rows: list = []
+            gauge_vals: list = []
+            gauge_lines: list = []
+            line_no = 0
+
+            def capture(metric):
+                if metric.key.type == m.GAUGE:
+                    row = store.gauges.intern(metric)
+                    gauge_rows.append(row)
+                    gauge_vals.append(metric.value)
+                    gauge_lines.append(line_no)
+                    store.count_processed(1)
+                else:
+                    server.ingest_metric(metric)
+
+            from veneur_tpu.samplers.parser import ParseError
+            for line, line_no in zip(unknown, res.unknown_lines):
+                if line.startswith(b"_e{") or line.startswith(b"_sc"):
+                    server.handle_metric_packet(line)
+                    continue
+                server.stats.inc("packets_received")
+                try:
+                    self.parser.parse_metric_fast(line, capture)
+                except ParseError as e:
+                    server.stats.inc("parse_errors")
+                    logger.debug("could not parse line %r: %s",
+                                 line[:100], e)
+                    continue
+                self._register_line(line)
+        else:
+            gauge_rows = None
+
         if len(res.c_rows):
             store.counters.add_batch(res.c_rows, res.c_vals, res.c_rates)
-        if len(res.g_rows):
+        if gauge_rows:
+            all_rows = np.concatenate(
+                [res.g_rows, np.asarray(gauge_rows, np.int32)])
+            all_vals = np.concatenate(
+                [res.g_vals, np.asarray(gauge_vals, np.float32)])
+            all_lines = np.concatenate(
+                [res.g_lines, np.asarray(gauge_lines, np.int32)])
+            # stable sort: a line is either native or deferred, never
+            # both, and multi-value samples share a line index, so append
+            # order breaks ties correctly
+            order = np.argsort(all_lines, kind="stable")
+            store.gauges.add_batch(all_rows[order], all_vals[order])
+        elif len(res.g_rows):
             store.gauges.add_batch(res.g_rows, res.g_vals)
         if len(res.h_rows):
             store.histos.add_batch(res.h_rows, res.h_vals, res.h_wts)
         if len(res.s_rows):
             store.sets.add_batch(res.s_rows, res.s_idx, res.s_rho)
-        unknown = res.unknown
-        for line in unknown:
-            self.server.handle_metric_packet(line)
-            if not (line.startswith(b"_e{") or line.startswith(b"_sc")):
-                self._register_line(line)
         return res.samples
 
     def _register_line(self, line: bytes) -> None:

@@ -170,7 +170,7 @@ def _read_metric_socket(sock, server, listener: Listener) -> None:
                 if length < 0:
                     return
                 if dropped:
-                    server.stats["parse_errors"] += dropped
+                    server.stats.inc("parse_errors", dropped)
                 if length > 0:
                     ing.ingest_ptr(reader.buf_ptr, length)
             return

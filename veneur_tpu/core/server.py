@@ -31,6 +31,62 @@ from veneur_tpu.util.matcher import SinkRoutingMatcher
 logger = logging.getLogger("veneur_tpu.server")
 
 
+class _SpanSinkWorker:
+    """Per-sink span ingest isolation: each external span sink gets a
+    bounded queue and one dedicated thread, so a slow or hung sink drops
+    its own spans instead of stalling the shared span workers — the
+    TPU-build equivalent of the reference's 9 s per-sink ingest timeout
+    (reference worker.go:588-656). Internal sinks (metric extraction) are
+    called inline by the span workers and bypass this."""
+
+    def __init__(self, sink, capacity: int):
+        self.sink = sink
+        self.queue: "queue.Queue" = queue.Queue(maxsize=max(16, capacity))
+        self.dropped = 0
+        self._dropped_lock = threading.Lock()
+        self._stop = threading.Event()
+        self.thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        from veneur_tpu.util.crash import guarded
+        self.thread = threading.Thread(
+            target=guarded(self._loop),
+            name=f"span-sink-{self.sink.name()}", daemon=True)
+        self.thread.start()
+
+    def submit(self, span) -> None:
+        try:
+            self.queue.put_nowait(span)
+        except queue.Full:
+            with self._dropped_lock:
+                self.dropped += 1
+
+    def _loop(self) -> None:
+        while True:
+            try:
+                span = self.queue.get(timeout=0.5)
+            except queue.Empty:
+                if self._stop.is_set():
+                    return
+                continue
+            if span is None:
+                return
+            try:
+                self.sink.ingest(span)
+            except Exception:
+                logger.exception(
+                    "span sink %s ingest failed", self.sink.name())
+
+    def stop(self, timeout: float = 2.0) -> None:
+        self._stop.set()
+        try:
+            self.queue.put_nowait(None)
+        except queue.Full:
+            pass
+        if self.thread is not None:
+            self.thread.join(timeout)
+
+
 class Server:
     def __init__(self, config: Config,
                  extra_metric_sinks: Optional[List] = None,
@@ -94,6 +150,7 @@ class Server:
         self.span_chan: "queue.Queue" = queue.Queue(
             maxsize=config.span_channel_capacity)
         self._span_workers: List[threading.Thread] = []
+        self._span_sink_workers: List[_SpanSinkWorker] = []
         self.spans_dropped = 0
 
         self.forwarder: Optional[Callable[[ForwardableState], None]] = None
@@ -139,6 +196,9 @@ class Server:
         self.http_api = None  # set in start() when http_address
         self._listeners: List[networking.Listener] = []
         self._flush_lock = threading.Lock()
+        # last flush thread per sink: a sink whose previous flush is still
+        # running gets skipped (bounds leaked threads to one per hung sink)
+        self._sink_flush_threads: Dict[str, threading.Thread] = {}
         self._flush_thread: Optional[threading.Thread] = None
         self._watchdog_thread: Optional[threading.Thread] = None
         self._shutdown = threading.Event()
@@ -147,9 +207,10 @@ class Server:
         self.shutdown_complete = threading.Event()
         self.last_flush_unix = time.time()
         self.flush_count = 0
-        self.stats: Dict[str, float] = {
-            "packets_received": 0, "parse_errors": 0, "metrics_flushed": 0,
-        }
+        # locked counters: increments arrive from many reader threads
+        from veneur_tpu.util.stats import StatCounters
+        self.stats = StatCounters(
+            "packets_received", "parse_errors", "metrics_flushed")
 
     # -- identity --------------------------------------------------------
 
@@ -170,7 +231,7 @@ class Server:
         good = []
         for dgram in datagrams:
             if len(dgram) > self.config.metric_max_length:
-                self.stats["parse_errors"] += 1
+                self.stats.inc("parse_errors")
             else:
                 good.append(dgram)
         if good:
@@ -178,7 +239,7 @@ class Server:
 
     def handle_metric_packet(self, packet: bytes) -> None:
         """Dispatch one datagram/line (reference server.go:949-1000)."""
-        self.stats["packets_received"] += 1
+        self.stats.inc("packets_received")
         try:
             if packet.startswith(b"_sc"):
                 metric = self.parser.parse_service_check(packet)
@@ -190,13 +251,13 @@ class Server:
             else:
                 self.parser.parse_metric_fast(packet, self.ingest_metric)
         except ParseError as e:
-            self.stats["parse_errors"] += 1
+            self.stats.inc("parse_errors")
             logger.debug("could not parse packet %r: %s", packet[:100], e)
 
     def handle_packet_buffer(self, buf: bytes) -> None:
         """Newline-split a multi-metric datagram (server.go:1116-1140)."""
         if len(buf) > self.config.metric_max_length:
-            self.stats["parse_errors"] += 1
+            self.stats.inc("parse_errors")
             return
         for line in buf.split(b"\n"):
             if line:
@@ -217,11 +278,11 @@ class Server:
     def handle_ssf_packet(self, packet: bytes) -> None:
         """One unframed SSF datagram (reference server.go:1053-1100)."""
         from veneur_tpu import protocol
-        self.stats["packets_received"] += 1
+        self.stats.inc("packets_received")
         try:
             span = protocol.parse_ssf(packet)
         except Exception:
-            self.stats["parse_errors"] += 1
+            self.stats.inc("parse_errors")
             logger.debug("could not parse SSF packet (%d bytes)", len(packet))
             return
         self.ingest_span(span)
@@ -235,10 +296,12 @@ class Server:
             self.spans_dropped += 1
 
     def _span_worker_loop(self) -> None:
-        """Fan each span out to every span sink (worker.go:587-662).
-        On shutdown, drains queued spans (which sit ahead of the None
-        sentinels) before exiting; the timed get covers the case where a
-        full channel swallowed the sentinels."""
+        """Fan each span out to every span sink (worker.go:587-662):
+        metric extraction runs inline (internal, cannot hang); external
+        sinks receive the span through their isolation queues so one hung
+        sink can't stall the pipeline. On shutdown, drains queued spans
+        (which sit ahead of the None sentinels) before exiting; the timed
+        get covers the case where a full channel swallowed the sentinels."""
         while True:
             try:
                 span = self.span_chan.get(timeout=0.5)
@@ -248,12 +311,12 @@ class Server:
                 continue
             if span is None:
                 return
-            for sink in self.span_sinks:
-                try:
-                    sink.ingest(span)
-                except Exception:
-                    logger.exception("span sink %s ingest failed",
-                                     sink.name())
+            try:
+                self.metric_extraction.ingest(span)
+            except Exception:
+                logger.exception("span metric extraction failed")
+            for worker in self._span_sink_workers:
+                worker.submit(span)
 
     # -- lifecycle -------------------------------------------------------
 
@@ -261,6 +324,13 @@ class Server:
         from veneur_tpu.util.crash import guarded
         for sink in self.metric_sinks + self.span_sinks:
             sink.start(self)
+        for sink in self.span_sinks:
+            if sink is self.metric_extraction:
+                continue
+            worker = _SpanSinkWorker(
+                sink, self.config.span_channel_capacity)
+            worker.start()
+            self._span_sink_workers.append(worker)
         for i in range(max(1, self.config.num_span_workers)):
             t = threading.Thread(target=guarded(self._span_worker_loop),
                                  name=f"span-worker-{i}", daemon=True)
@@ -341,6 +411,8 @@ class Server:
         # let workers drain in-flight spans before the final flush
         for t in self._span_workers:
             t.join(timeout=2.0)
+        for worker in self._span_sink_workers:
+            worker.stop()
         if self.config.flush_on_shutdown:
             self.flush()
         for listener in self._listeners:
@@ -440,23 +512,39 @@ class Server:
                 logger.exception("sink %s flush_other_samples failed",
                                  sink.name())
 
+        # every per-sink flush (span and metric) runs in its own thread and
+        # the whole pass is bounded by one interval — the reference's
+        # context deadline (server.go:869, flusher.go:553-566). A sink
+        # whose previous flush is still running is skipped this interval,
+        # so a hung sink costs its own data, never the flush loop or
+        # another sink's.
+        threads: List[threading.Thread] = []
+
+        def _start_sink_thread(key: str, target, *args) -> None:
+            prev = self._sink_flush_threads.get(key)
+            if prev is not None and prev.is_alive():
+                logger.warning(
+                    "sink %s: previous flush still running; skipping", key)
+                self.statsd.count("flush.sink_skipped_total", 1,
+                                  tags=[f"sink:{key}"])
+                return
+            t = threading.Thread(target=target, args=args, daemon=True,
+                                 name=f"flush-{key}")
+            t.start()
+            self._sink_flush_threads[key] = t
+            threads.append(t)
+
         for sink in self.span_sinks:
-            try:
-                sink.flush()
-            except Exception:
-                logger.exception("span sink %s flush failed", sink.name())
+            _start_sink_thread(
+                f"span:{sink.name()}", self._flush_span_sink_safe, sink)
 
         final, fwd = flush_columnstore(
             self.store, self.is_local, self.percentiles, self.aggregates,
             collect_forward=self.forwarder is not None or self.is_local)
-        self.stats["metrics_flushed"] += len(final)
+        self.stats.inc("metrics_flushed", len(final))
 
-        threads = []
         if self.is_local and self.forwarder is not None and len(fwd):
-            t = threading.Thread(
-                target=self._forward_safe, args=(fwd,), daemon=True)
-            t.start()
-            threads.append(t)
+            _start_sink_thread("forward", self._forward_safe, fwd)
 
         if self._routing is not None:
             for metric in final:
@@ -467,16 +555,29 @@ class Server:
 
         if final:
             for sink in self.metric_sinks:
-                t = threading.Thread(
-                    target=self._flush_sink_safe, args=(sink, final),
-                    daemon=True)
-                t.start()
-                threads.append(t)
-        # block until every sink finishes, like the reference's wg.Wait()
-        # (flusher.go:79-121): a hung sink stalls flushes and, if
-        # configured, trips the flush watchdog rather than leaking threads
+                _start_sink_thread(
+                    f"metric:{sink.name()}", self._flush_sink_safe, sink,
+                    final)
+
+        # bounded wait: one interval from flush start, minus time already
+        # spent; stragglers keep running on their daemon threads and are
+        # skipped next interval if still alive. The shutdown flush gets a
+        # generous grace instead, so the final interval's metrics are
+        # delivered before daemon threads die with the process.
+        grace = (max(self.interval, 30.0) if self._shutdown.is_set()
+                 else self.interval)
+        deadline = flush_start + grace
         for t in threads:
-            t.join()
+            remaining = deadline - time.perf_counter()
+            if remaining <= 0:
+                break
+            t.join(remaining)
+        stuck = [t.name for t in threads if t.is_alive()]
+        if stuck:
+            logger.error(
+                "flush exceeded the %.1fs interval; still running: %s",
+                self.interval, ", ".join(stuck))
+            self.statsd.count("flush.timeout_total", len(stuck))
 
         flush_span.finish()
         duration = time.perf_counter() - flush_start
@@ -485,15 +586,22 @@ class Server:
         # cumulative process counters emit as gauges (they never reset)
         self.statsd.gauge("worker.metrics_processed_total",
                           int(self.stats["packets_received"]))
-        if self.spans_dropped:
+        span_sink_drops = sum(w.dropped for w in self._span_sink_workers)
+        if self.spans_dropped or span_sink_drops:
             self.statsd.gauge("worker.ssf.spans_dropped_total",
-                              self.spans_dropped)
+                              self.spans_dropped + span_sink_drops)
 
     def _forward_safe(self, fwd: ForwardableState) -> None:
         try:
             self.forwarder(fwd)
         except Exception:
             logger.exception("forward failed")
+
+    def _flush_span_sink_safe(self, sink) -> None:
+        try:
+            sink.flush()
+        except Exception:
+            logger.exception("span sink %s flush failed", sink.name())
 
     def _flush_sink_safe(self, sink, metrics: List[InterMetric]) -> None:
         try:

@@ -78,10 +78,10 @@ def _declare(lib) -> None:
     lib.vnt_parse.argtypes = [
         ctypes.c_void_p, ctypes.c_void_p, i64,
         i32p, f32p, f32p, i64, i64p,          # counters
-        i32p, f32p, i64, i64p,                # gauges
+        i32p, f32p, i32p, i64, i64p,          # gauges (+line index)
         i32p, f32p, f32p, i64, i64p,          # histos
         i32p, i32p, i32p, i64, i64p,          # sets
-        i64p, i64p, i64, i64p,                # unknown lines
+        i64p, i64p, i32p, i64, i64p,          # unknown lines (+line index)
         i64p,                                 # samples parsed
     ]
 
@@ -125,13 +125,14 @@ class ParseResult:
     their filled lengths and valid until the parser's next parse call."""
 
     __slots__ = ("lines", "samples", "c_rows", "c_vals", "c_rates",
-                 "g_rows", "g_vals", "h_rows", "h_vals", "h_wts",
-                 "s_rows", "s_idx", "s_rho", "unknown")
+                 "g_rows", "g_vals", "g_lines", "h_rows", "h_vals", "h_wts",
+                 "s_rows", "s_idx", "s_rho", "unknown", "unknown_lines")
 
     def __init__(self):
         self.lines = 0
         self.samples = 0
         self.unknown = []
+        self.unknown_lines = []
 
 
 def _ptr(arr: np.ndarray, ctype):
@@ -223,6 +224,7 @@ class NativeParser:
         self._c_rates = np.empty(cap, np.float32)
         self._g_rows = np.empty(cap, np.int32)
         self._g_vals = np.empty(cap, np.float32)
+        self._g_lines = np.empty(cap, np.int32)
         self._h_rows = np.empty(cap, np.int32)
         self._h_vals = np.empty(cap, np.float32)
         self._h_wts = np.empty(cap, np.float32)
@@ -231,6 +233,7 @@ class NativeParser:
         self._s_rho = np.empty(cap, np.int32)
         self._unk_off = np.empty(cap, np.int64)
         self._unk_len = np.empty(cap, np.int64)
+        self._unk_lines = np.empty(cap, np.int32)
         self._cap = cap
 
     def size(self) -> int:
@@ -261,13 +264,13 @@ class NativeParser:
             _ptr(self._c_rows, i32), _ptr(self._c_vals, f32),
             _ptr(self._c_rates, f32), cap, ctypes.byref(ns[0]),
             _ptr(self._g_rows, i32), _ptr(self._g_vals, f32),
-            cap, ctypes.byref(ns[1]),
+            _ptr(self._g_lines, i32), cap, ctypes.byref(ns[1]),
             _ptr(self._h_rows, i32), _ptr(self._h_vals, f32),
             _ptr(self._h_wts, f32), cap, ctypes.byref(ns[2]),
             _ptr(self._s_rows, i32), _ptr(self._s_idx, i32),
             _ptr(self._s_rho, i32), cap, ctypes.byref(ns[3]),
             _ptr(self._unk_off, i64), _ptr(self._unk_len, i64),
-            cap, ctypes.byref(ns[4]),
+            _ptr(self._unk_lines, i32), cap, ctypes.byref(ns[4]),
             ctypes.byref(ns[5]))
         res = ParseResult()
         res.lines = lines
@@ -278,6 +281,7 @@ class NativeParser:
         res.c_rates = self._c_rates[:cn]
         res.g_rows = self._g_rows[:gn]
         res.g_vals = self._g_vals[:gn]
+        res.g_lines = self._g_lines[:gn]
         res.h_rows = self._h_rows[:hn]
         res.h_vals = self._h_vals[:hn]
         res.h_wts = self._h_wts[:hn]
@@ -289,5 +293,6 @@ class NativeParser:
             ctypes.string_at(base + int(self._unk_off[i]),
                              int(self._unk_len[i]))
             for i in range(un)]
+        res.unknown_lines = self._unk_lines[:un]
         del keepalive
         return res

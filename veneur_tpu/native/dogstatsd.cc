@@ -169,6 +169,8 @@ struct Out {
   int64_t c_cap, c_n = 0;
   int32_t* g_rows;
   float* g_vals;
+  int32_t* g_lines;  // line index per gauge sample: last-write-wins needs
+                     // buffer order to survive the slow-path replay merge
   int64_t g_cap, g_n = 0;
   int32_t* h_rows;
   float* h_vals;
@@ -180,14 +182,17 @@ struct Out {
   int64_t s_cap, s_n = 0;
   int64_t* unk_off;
   int64_t* unk_len;
+  int32_t* unk_line;
   int64_t unk_cap, unk_n = 0;
   int64_t samples = 0;
+  int32_t line_no = 0;
 };
 
 inline bool push_unknown(Out* o, int64_t off, int64_t len) {
   if (o->unk_n >= o->unk_cap) return false;
   o->unk_off[o->unk_n] = off;
   o->unk_len[o->unk_n] = len;
+  o->unk_line[o->unk_n] = o->line_no;
   o->unk_n++;
   return true;
 }
@@ -276,6 +281,7 @@ inline bool parse_line(const Engine* e, const uint8_t* line, size_t len,
         if (o->g_n >= o->g_cap || !parse_float(e, seg, seg_len, &v)) break;
         o->g_rows[o->g_n] = ent.row;
         o->g_vals[o->g_n] = static_cast<float>(v);
+        o->g_lines[o->g_n] = o->line_no;
         o->g_n++;
         ok = true;
         break;
@@ -338,20 +344,22 @@ void vnt_register(void* ep, const uint8_t* key, int64_t keylen,
 int64_t vnt_parse(void* ep, const uint8_t* buf, int64_t buflen,
                   int32_t* c_rows, float* c_vals, float* c_rates,
                   int64_t c_cap, int64_t* c_n,
-                  int32_t* g_rows, float* g_vals, int64_t g_cap, int64_t* g_n,
+                  int32_t* g_rows, float* g_vals, int32_t* g_lines,
+                  int64_t g_cap, int64_t* g_n,
                   int32_t* h_rows, float* h_vals, float* h_wts,
                   int64_t h_cap, int64_t* h_n,
                   int32_t* s_rows, int32_t* s_idx, int32_t* s_rho,
                   int64_t s_cap, int64_t* s_n,
-                  int64_t* unk_off, int64_t* unk_len, int64_t unk_cap,
-                  int64_t* unk_n, int64_t* samples_out) {
+                  int64_t* unk_off, int64_t* unk_len, int32_t* unk_lines,
+                  int64_t unk_cap, int64_t* unk_n, int64_t* samples_out) {
   Engine* e = static_cast<Engine*>(ep);
   Out o;
   o.c_rows = c_rows; o.c_vals = c_vals; o.c_rates = c_rates; o.c_cap = c_cap;
-  o.g_rows = g_rows; o.g_vals = g_vals; o.g_cap = g_cap;
+  o.g_rows = g_rows; o.g_vals = g_vals; o.g_lines = g_lines; o.g_cap = g_cap;
   o.h_rows = h_rows; o.h_vals = h_vals; o.h_wts = h_wts; o.h_cap = h_cap;
   o.s_rows = s_rows; o.s_idx = s_idx; o.s_rho = s_rho; o.s_cap = s_cap;
-  o.unk_off = unk_off; o.unk_len = unk_len; o.unk_cap = unk_cap;
+  o.unk_off = unk_off; o.unk_len = unk_len; o.unk_line = unk_lines;
+  o.unk_cap = unk_cap;
 
   int64_t lines = 0;
   thread_local std::string keybuf;
@@ -363,6 +371,7 @@ int64_t vnt_parse(void* ep, const uint8_t* buf, int64_t buflen,
     int64_t line_len = (nl == nullptr) ? (buflen - pos)
                                        : (nl - (buf + pos));
     if (line_len > 0) {
+      o.line_no = static_cast<int32_t>(lines);
       lines++;
       if (!parse_line(e, buf + pos, line_len, keybuf, &o)) {
         push_unknown(&o, pos, line_len);

@@ -1,0 +1,46 @@
+"""Force-platform recipe for environments that pin a TPU plugin.
+
+Hosting environments may register an accelerator plugin via sitecustomize
+at interpreter startup AND pin `jax_platforms` programmatically, so
+selecting a platform requires overriding BOTH the environment and the jax
+config before any backend initializes. This module is the single home of
+that recipe (used by __graft_entry__.dryrun_multichip, bench.py's CPU
+fallback, and mirrored by tests/conftest.py, which must stay import-free
+of this package).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+
+def force_cpu(n_devices: int | None = None) -> None:
+    """Pin JAX to the host platform, optionally with `n_devices` virtual
+    devices (xla_force_host_platform_device_count). Must be called before
+    the first backend use; safe to call whether or not jax is imported."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    if n_devices is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        flags = re.sub(
+            r"--xla_force_host_platform_device_count=\d+", "", flags)
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def honor_env_platform() -> None:
+    """Re-assert JAX_PLATFORMS from the environment over any programmatic
+    pin the host's sitecustomize applied (env alone loses to
+    jax.config.update done at interpreter startup)."""
+    want = os.environ.get("JAX_PLATFORMS")
+    if not want:
+        return
+    import jax
+
+    jax.config.update("jax_platforms", want)

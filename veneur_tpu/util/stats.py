@@ -1,0 +1,56 @@
+"""Thread-safe telemetry counters.
+
+Stats increments are read-modify-write; with multiple reader threads,
+unlocked `dict[key] += n` loses counts. One small lock serializes all
+increments (the reference uses atomics, server.go:921-945); reads return
+a consistent snapshot.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+from typing import Dict
+
+
+class StatCounters:
+    """A locked counter map. Increment with `inc`; read with `[]` or
+    `snapshot()`. Supports seeding initial keys so snapshots always
+    include the canonical counters even when zero."""
+
+    def __init__(self, *seed_keys: str):
+        self._lock = threading.Lock()
+        self._counts: Dict[str, float] = defaultdict(float)
+        for key in seed_keys:
+            self._counts[key] = 0.0
+
+    def inc(self, key: str, n: float = 1) -> None:
+        with self._lock:
+            self._counts[key] += n
+
+    def __getitem__(self, key: str) -> float:
+        with self._lock:
+            return self._counts[key]
+
+    def keys(self):
+        with self._lock:
+            return list(self._counts.keys())
+
+    def items(self):
+        with self._lock:
+            return list(self._counts.items())
+
+    def __iter__(self):
+        return iter(self.keys())
+
+    def get(self, key: str, default: float = 0.0) -> float:
+        with self._lock:
+            return self._counts.get(key, default)
+
+    def __contains__(self, key: str) -> bool:
+        with self._lock:
+            return key in self._counts
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._counts)
