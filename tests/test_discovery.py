@@ -1,0 +1,209 @@
+"""Consul and Kubernetes discoverers against fake HTTP endpoints
+(reference discovery/consul/consul.go:30-47 and
+discovery/kubernetes/kubernetes.go:34-130), including the proxy ring
+following a mutating Consul health list."""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from veneur_tpu.proxy.discovery import ConsulDiscoverer, KubernetesDiscoverer
+
+
+def wait_until(pred, timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+class _JsonServer:
+    """Tiny fake API server; `routes` maps path-prefix -> callable
+    returning the JSON payload. Records request headers."""
+
+    def __init__(self):
+        self.routes = {}
+        self.headers = []
+        self.paths = []
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                outer.headers.append(dict(self.headers))
+                outer.paths.append(self.path)
+                for prefix, payload_fn in outer.routes.items():
+                    if self.path.startswith(prefix):
+                        body = json.dumps(payload_fn()).encode()
+                        self.send_response(200)
+                        self.send_header("Content-Type", "application/json")
+                        self.send_header("Content-Length", str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                        return
+                self.send_response(404)
+                self.end_headers()
+
+            def log_message(self, *a):
+                pass
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+
+    @property
+    def url(self):
+        host, port = self.httpd.server_address
+        return f"http://{host}:{port}"
+
+    def close(self):
+        self.httpd.shutdown()
+
+
+def consul_entry(node_addr, port):
+    return {"Node": {"Address": node_addr},
+            "Service": {"Address": "", "Port": port},
+            "Checks": [{"Status": "passing"}]}
+
+
+class TestConsul:
+    def test_healthy_hosts(self):
+        srv = _JsonServer()
+        srv.routes["/v1/health/service/veneur-global"] = lambda: [
+            consul_entry("10.0.0.1", 8128), consul_entry("10.0.0.2", 8128)]
+        try:
+            disc = ConsulDiscoverer(base_url=srv.url)
+            got = disc.get_destinations_for_service("veneur-global")
+            assert got == ["10.0.0.1:8128", "10.0.0.2:8128"]
+        finally:
+            srv.close()
+
+    def test_empty_is_error(self):
+        srv = _JsonServer()
+        srv.routes["/v1/health/service/"] = lambda: []
+        try:
+            disc = ConsulDiscoverer(base_url=srv.url)
+            with pytest.raises(RuntimeError, match="no hosts"):
+                disc.get_destinations_for_service("veneur-global")
+        finally:
+            srv.close()
+
+    def test_token_header_sent(self):
+        srv = _JsonServer()
+        srv.routes["/v1/health/service/"] = lambda: [
+            consul_entry("10.0.0.1", 1)]
+        try:
+            disc = ConsulDiscoverer(base_url=srv.url, token="secret-tok")
+            disc.get_destinations_for_service("svc")
+            assert srv.headers[-1].get("X-Consul-Token") == "secret-tok"
+        finally:
+            srv.close()
+
+    def test_proxy_ring_follows_mutating_health_list(self):
+        """The full elasticity loop: the discovery refresh re-polls the
+        fake Consul and the proxy's destination pool follows additions
+        and removals (reference proxy/proxy.go discovery loop)."""
+        from veneur_tpu.proxy.proxy import ProxyServer
+
+        healthy = [consul_entry("127.0.0.1", 11111)]
+        srv = _JsonServer()
+        srv.routes["/v1/health/service/"] = lambda: list(healthy)
+        proxy = None
+        try:
+            disc = ConsulDiscoverer(base_url=srv.url)
+            proxy = ProxyServer(disc, forward_service="veneur-global",
+                                listen_address="127.0.0.1:0",
+                                discovery_interval=0.1)
+            proxy.start()
+            assert wait_until(
+                lambda: set(proxy.destinations.addresses())
+                == {"127.0.0.1:11111"})
+            healthy.append(consul_entry("127.0.0.1", 11112))
+            assert wait_until(
+                lambda: set(proxy.destinations.addresses())
+                == {"127.0.0.1:11111", "127.0.0.1:11112"})
+            del healthy[0]
+            assert wait_until(
+                lambda: set(proxy.destinations.addresses())
+                == {"127.0.0.1:11112"})
+        finally:
+            if proxy is not None:
+                proxy.stop()
+            srv.close()
+
+
+def pod(name, ip, phase="Running", ports=({"name": "grpc",
+                                           "containerPort": 8128},)):
+    return {"metadata": {"name": name},
+            "status": {"phase": phase, "podIP": ip},
+            "spec": {"containers": [{"ports": list(ports)}]}}
+
+
+class TestKubernetes:
+    def test_grpc_ports_from_running_pods(self):
+        srv = _JsonServer()
+        srv.routes["/api/v1/pods"] = lambda: {"items": [
+            pod("a", "10.1.0.1"),
+            pod("b", "10.1.0.2"),
+            pod("c", "10.1.0.3", phase="Pending"),
+        ]}
+        try:
+            disc = KubernetesDiscoverer(api_base=srv.url, token="tok")
+            got = disc.get_destinations_for_service("ignored")
+            assert got == ["10.1.0.1:8128", "10.1.0.2:8128"]
+            assert srv.headers[-1].get("Authorization") == "Bearer tok"
+        finally:
+            srv.close()
+
+    def test_http_and_tcp_only_pods_skipped(self):
+        """The reference emitted http:// destinations for these (legacy
+        HTTP import); the gRPC-only forward plane skips them so they
+        never claim ring keyspace they can't serve."""
+        srv = _JsonServer()
+        srv.routes["/api/v1/pods"] = lambda: {"items": [
+            pod("h", "10.1.0.4",
+                ports=({"name": "http", "containerPort": 8127},)),
+            pod("t", "10.1.0.5",
+                ports=({"protocol": "TCP", "containerPort": 9000},)),
+            pod("g", "10.1.0.6",
+                ports=({"protocol": "TCP", "containerPort": 9000},
+                       {"name": "grpc", "containerPort": 8128},)),
+        ]}
+        try:
+            disc = KubernetesDiscoverer(api_base=srv.url, token="")
+            got = disc.get_destinations_for_service("ignored")
+            assert got == ["10.1.0.6:8128"]
+        finally:
+            srv.close()
+
+    def test_pod_without_port_or_ip_skipped(self):
+        srv = _JsonServer()
+        srv.routes["/api/v1/pods"] = lambda: {"items": [
+            pod("nop", "10.1.0.6", ports=()),
+            pod("noip", "", ports=({"name": "grpc",
+                                    "containerPort": 8128},)),
+        ]}
+        try:
+            disc = KubernetesDiscoverer(api_base=srv.url, token="")
+            assert disc.get_destinations_for_service("ignored") == []
+        finally:
+            srv.close()
+
+    def test_label_selector_in_query(self):
+        srv = _JsonServer()
+        srv.routes["/api/v1/pods"] = lambda: {"items": []}
+        try:
+            disc = KubernetesDiscoverer(api_base=srv.url, token="",
+                                        label_selector="app=custom")
+            disc.get_destinations_for_service("ignored")
+            assert "labelSelector=app%3Dcustom" in srv.paths[-1]
+        finally:
+            srv.close()
+
+    def test_outside_cluster_without_api_base_raises(self, monkeypatch):
+        monkeypatch.delenv("KUBERNETES_SERVICE_HOST", raising=False)
+        with pytest.raises(RuntimeError, match="KUBERNETES_SERVICE_HOST"):
+            KubernetesDiscoverer()

@@ -1,0 +1,163 @@
+"""Flush/ingest boundedness: a deliberately hung or slow sink must not
+stall the flush loop, kill the process, or starve other sinks — the
+TPU-build equivalent of the reference's flush context deadline
+(reference server.go:869, flusher.go:553-566) and per-span-sink ingest
+timeout (reference worker.go:588-656)."""
+
+import threading
+import time
+
+from veneur_tpu.config import Config
+from veneur_tpu.core.server import Server
+from veneur_tpu.sinks.channel import ChannelMetricSink
+
+
+def _config(**overrides) -> Config:
+    cfg = Config()
+    cfg.interval = 0.5
+    cfg.num_readers = 1
+    cfg.statsd_listen_addresses = []
+    cfg.tpu.counter_capacity = 128
+    cfg.tpu.gauge_capacity = 128
+    cfg.tpu.histo_capacity = 128
+    cfg.tpu.set_capacity = 64
+    cfg.tpu.batch_cap = 256
+    for k, v in overrides.items():
+        setattr(cfg, k, v)
+    return cfg.apply_defaults()
+
+
+class HungMetricSink:
+    """flush() blocks until released (a vendor API that never answers)."""
+
+    def __init__(self):
+        self.release = threading.Event()
+        self.calls = 0
+
+    def name(self):
+        return "hung"
+
+    def kind(self):
+        return "hung"
+
+    def start(self, server):
+        pass
+
+    def stop(self):
+        pass
+
+    def flush(self, metrics):
+        self.calls += 1
+        self.release.wait(30.0)
+
+    def flush_other_samples(self, samples):
+        pass
+
+
+class HungSpanSink:
+    """ingest() blocks forever; flush() blocks forever."""
+
+    def __init__(self):
+        self.release = threading.Event()
+
+    def name(self):
+        return "hung_span"
+
+    def kind(self):
+        return "hung_span"
+
+    def start(self, server):
+        pass
+
+    def stop(self):
+        pass
+
+    def ingest(self, span):
+        self.release.wait(30.0)
+
+    def flush(self):
+        self.release.wait(30.0)
+
+
+class TestFlushDeadline:
+    def test_hung_metric_sink_does_not_stall_flush(self):
+        hung = HungMetricSink()
+        observer = ChannelMetricSink()
+        server = Server(_config(), extra_metric_sinks=[observer, hung])
+        try:
+            server.handle_metric_packet(b"bound.count:1|c")
+            t0 = time.time()
+            server.flush()
+            assert time.time() - t0 < server.interval + 1.0
+            got = {m.name for m in observer.wait_flush()}
+            assert "bound.count" in got  # healthy sink still delivered
+        finally:
+            hung.release.set()
+
+    def test_hung_sink_skipped_on_next_flush(self):
+        hung = HungMetricSink()
+        observer = ChannelMetricSink()
+        server = Server(_config(), extra_metric_sinks=[observer, hung])
+        try:
+            server.handle_metric_packet(b"bound.a:1|c")
+            server.flush()
+            assert {m.name for m in observer.wait_flush()} == {"bound.a"}
+            assert hung.calls == 1
+            server.handle_metric_packet(b"bound.b:1|c")
+            t0 = time.time()
+            server.flush()
+            # previous hung flush still alive -> not re-entered
+            assert hung.calls == 1
+            assert time.time() - t0 < server.interval + 1.0
+            got = {m.name for m in observer.wait_flush()}
+            assert "bound.b" in got
+        finally:
+            hung.release.set()
+
+    def test_hung_span_sink_does_not_stall_span_pipeline(self):
+        from veneur_tpu import ssf
+
+        hung = HungSpanSink()
+        observer = ChannelMetricSink()
+        server = Server(_config(span_channel_capacity=1024),
+                        extra_metric_sinks=[observer],
+                        extra_span_sinks=[hung])
+        server.start()
+        try:
+            span = ssf.SSFSpan(id=1, trace_id=1, name="op", service="svc",
+                               start_timestamp=1, end_timestamp=2)
+            span.metrics.append(ssf.count("bound.span.c", 3))
+            # many spans: the hung sink's queue fills and drops, but the
+            # inline metric extraction keeps working for every span
+            for _ in range(200):
+                server.ingest_span(ssf.SSFSpan.FromString(
+                    span.SerializeToString()))
+            deadline = time.time() + 10
+            while (not server.span_chan.empty()
+                   and time.time() < deadline):
+                time.sleep(0.01)
+            server.store.apply_all_pending()
+            t0 = time.time()
+            server.flush()
+            assert time.time() - t0 < server.interval + 1.0
+            got = {m.name: m for m in observer.wait_flush()}
+            processed = 200 - server.spans_dropped
+            assert processed > 0
+            assert got["bound.span.c"].value == processed * 3.0
+        finally:
+            hung.release.set()
+            server.shutdown()
+
+    def test_flush_timeout_is_counted(self):
+        hung = HungMetricSink()
+        server = Server(_config(stats_address="internal"),
+                        extra_metric_sinks=[hung])
+        try:
+            server.handle_metric_packet(b"bound.c:1|c")
+            server.flush()
+            # the self-metric loops back into this server's own pipeline
+            server.store.apply_all_pending()
+            rows = [meta.name for meta in server.store.counters.meta]
+            assert "flush.timeout_total" in rows
+        finally:
+            hung.release.set()

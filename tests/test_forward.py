@@ -67,7 +67,12 @@ class TestForwardClient:
             d = lat.histogram.t_digest
             assert sum(c.weight for c in d.main_centroids) == pytest.approx(3)
             assert d.min == 1 and d.max == 3
-            assert len(by_name["fwd.users"].set.hyper_log_log) == 16384
+            # sets go out in the axiomhq binary format (dense, v1) so a
+            # Go global veneur can UnmarshalBinary+Merge them
+            from veneur_tpu.forward import hllwire
+            regs, p = hllwire.unmarshal(by_name["fwd.users"].set.hyper_log_log)
+            assert p == 14
+            assert (regs > 0).sum() > 0
             # mixed counters are NOT forwarded; they flush locally
             assert "fwd.local" not in by_name
             server.shutdown()

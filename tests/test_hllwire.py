@@ -1,0 +1,183 @@
+"""axiomhq HLL wire-format interop (reference samplers.go:299-311,
+vendor/github.com/axiomhq/hyperloglog): dense round trips, tailcut
+clamping, sparse decoding via encode/decode hash parity, and the forward
+plane accepting/emitting the format."""
+
+import numpy as np
+import pytest
+
+from veneur_tpu.forward import hllwire
+from veneur_tpu.ops import hll_ref
+
+
+class TestDense:
+    def test_round_trip_small_values(self):
+        rng = np.random.default_rng(7)
+        regs = rng.integers(0, 16, hll_ref.M).astype(np.uint8)
+        data = hllwire.marshal_dense(regs)
+        assert data[0] == 1 and data[1] == 14 and data[3] == 0
+        assert len(data) == 8 + hll_ref.M // 2
+        back, p = hllwire.unmarshal(data)
+        assert p == 14
+        np.testing.assert_array_equal(back, regs)
+
+    def test_clamps_above_tailcut_range(self):
+        regs = np.zeros(hll_ref.M, np.uint8)
+        regs[5] = 40  # rho can reach 51 at p=14; the wire caps at 15
+        back, _ = hllwire.unmarshal(hllwire.marshal_dense(regs))
+        assert back[5] == 15
+        assert back[4] == 0
+
+    def test_base_offset_round_trip(self):
+        # every register occupied and min > 0: marshal uses the base the
+        # way Go's rebase would, unmarshal adds it back
+        regs = np.full(hll_ref.M, 18, np.uint8)
+        regs[0] = 3
+        data = hllwire.marshal_dense(regs)
+        assert data[2] == 3  # base = min(minv, maxv - 15)
+        back, _ = hllwire.unmarshal(data)
+        assert back[0] == 3
+        assert back[1] == 18
+
+    def test_estimate_preserved(self):
+        h = hll_ref.HLL()
+        for i in range(5000):
+            h.insert(b"member-%d" % i)
+        back, _ = hllwire.unmarshal(hllwire.marshal_dense(h.regs))
+        est = hll_ref.estimate_from_registers(back.astype(np.int8))
+        assert est == pytest.approx(5000, rel=0.03)
+
+    def test_size_mismatch_rejected(self):
+        with pytest.raises(hllwire.HLLWireError):
+            hllwire.unmarshal(bytes([1, 14, 0, 0]) + b"\x00\x00\x00\x05" + b"x" * 5)
+
+
+class TestSparse:
+    def test_encode_decode_hash_parity(self):
+        rng = np.random.default_rng(11)
+        for _ in range(500):
+            x = int(rng.integers(0, 2**63)) << 1 | int(rng.integers(0, 2))
+            idx, rho = hll_ref.pos_val(x)
+            k = hllwire.encode_hash(x)
+            didx, drho = hllwire.decode_hash(k)
+            assert didx == idx
+            assert drho == rho  # the sparse encoding is exact
+
+    def test_sparse_payload_decodes(self):
+        """Hand-build a sparse sketch (tmpSet + compressed list) exactly as
+        the Go marshaller lays it out and check register parity."""
+        rng = np.random.default_rng(13)
+        hashes = [int(rng.integers(0, 2**63)) * 2 + 1 for _ in range(64)]
+        keys = sorted({hllwire.encode_hash(x) for x in hashes})
+        half = len(keys) // 2
+        tmp_set, listed = keys[:half], keys[half:]
+
+        payload = bytearray((1, 14, 0, 1))
+        payload += len(tmp_set).to_bytes(4, "big")
+        for k in tmp_set:
+            payload += k.to_bytes(4, "big")
+        # compressed list: count, last, varint deltas of the sorted keys
+        var = bytearray()
+        last = 0
+        for k in listed:
+            delta = k - last
+            while delta & ~0x7F:
+                var.append((delta & 0x7F) | 0x80)
+                delta >>= 7
+            var.append(delta)
+            last = k
+        payload += len(listed).to_bytes(4, "big")
+        payload += (listed[-1] if listed else 0).to_bytes(4, "big")
+        payload += len(var).to_bytes(4, "big")
+        payload += bytes(var)
+
+        regs, p = hllwire.unmarshal(bytes(payload))
+        assert p == 14
+        want = np.zeros(hll_ref.M, np.uint8)
+        for k in keys:
+            idx, r = hllwire.decode_hash(k)
+            want[idx] = max(want[idx], r)
+        np.testing.assert_array_equal(regs, want)
+
+
+class TestForwardPlane:
+    def test_import_server_accepts_axiomhq_payload(self):
+        from veneur_tpu.forward.server import _decode_hll
+
+        h = hll_ref.HLL()
+        for i in range(200):
+            h.insert(b"x%d" % i)
+        data = hllwire.marshal_dense(h.regs)
+        regs = _decode_hll(data)
+        assert regs is not None
+        est = hll_ref.estimate_from_registers(regs)
+        assert est == pytest.approx(200, rel=0.1)
+
+    def test_import_server_still_accepts_raw_dump(self):
+        from veneur_tpu.forward.server import _decode_hll
+
+        raw = np.zeros(hll_ref.M, np.int8)
+        raw[7] = 9
+        regs = _decode_hll(raw.tobytes())
+        np.testing.assert_array_equal(regs, raw)
+
+    def test_convert_emits_axiomhq(self):
+        from veneur_tpu.core.columnstore import RowMeta
+        from veneur_tpu.core.flusher import ForwardableState
+        from veneur_tpu.forward.convert import forwardable_to_protos
+        from veneur_tpu.samplers.metrics import MetricScope
+
+        regs = np.zeros(hll_ref.M, np.uint8)
+        regs[3] = 5
+        meta = RowMeta(name="s.x", tags=["a:b"], joined_tags="a:b",
+                       digest32=1, scope=MetricScope.MIXED, wire_type="set")
+        fwd = ForwardableState()
+        fwd.sets.append((meta, regs))
+        protos = forwardable_to_protos(fwd)
+        payload = protos[0].set.hyper_log_log
+        back, p = hllwire.unmarshal(payload)
+        assert p == 14
+        np.testing.assert_array_equal(back, regs)
+
+    def test_end_to_end_forward_merges_sets(self):
+        """Local -> import server -> global merge over the real gRPC plane
+        with the axiomhq payload on the wire."""
+        from veneur_tpu.config import Config
+        from veneur_tpu.core.server import Server
+        from veneur_tpu.forward.client import ForwardClient
+        from veneur_tpu.forward.server import ImportServer
+        from veneur_tpu.sinks.channel import ChannelMetricSink
+
+        def mk(**kw):
+            cfg = Config()
+            cfg.interval = 60.0
+            cfg.statsd_listen_addresses = []
+            cfg.tpu.counter_capacity = 64
+            cfg.tpu.gauge_capacity = 64
+            cfg.tpu.histo_capacity = 64
+            cfg.tpu.set_capacity = 64
+            cfg.tpu.batch_cap = 64
+            for k, v in kw.items():
+                setattr(cfg, k, v)
+            cfg.apply_defaults()
+            obs = ChannelMetricSink()
+            return Server(cfg, extra_metric_sinks=[obs]), obs
+
+        glob, gobs = mk()
+        imp = ImportServer(glob, "127.0.0.1:0")
+        imp.start()
+        try:
+            local, _ = mk(forward_address=imp.address)
+            client = ForwardClient(imp.address, deadline=10.0)
+            local.forwarder = client.forward
+            for i in range(120):
+                local.handle_metric_packet(b"fwd.hll.set:u%d|s" % (i % 97))
+            local.store.apply_all_pending()
+            local.flush()
+            client.close()
+            glob.store.apply_all_pending()
+            glob.flush()
+            got = {m.name: m for m in gobs.wait_flush()}
+            assert got["fwd.hll.set"].value == pytest.approx(97, rel=0.05)
+        finally:
+            imp.stop()

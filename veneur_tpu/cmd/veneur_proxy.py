@@ -46,7 +46,8 @@ def main(argv=None) -> int:
             raw = yaml.safe_load(f) or {}
 
     from veneur_tpu.config import parse_duration
-    from veneur_tpu.proxy.discovery import StaticDiscoverer
+    from veneur_tpu.proxy.discovery import (
+        ConsulDiscoverer, KubernetesDiscoverer, StaticDiscoverer)
     from veneur_tpu.proxy.proxy import ProxyServer
 
     destinations = [d for d in (
@@ -56,11 +57,27 @@ def main(argv=None) -> int:
     interval = parse_duration(
         raw.get("consul_refresh_interval", args.discovery_interval))
     listen = raw.get("grpc_address", args.listen)
+    forward_service = raw.get(
+        "consul_forward_service_name", args.forward_service)
 
-    discoverer = StaticDiscoverer(destinations)
+    # discoverer selection mirrors reference cmd/veneur-proxy/main.go:
+    # consul when a consul service name / address is configured,
+    # kubernetes when asked for, static destinations otherwise
+    if raw.get("consul_address") or raw.get("consul_forward_service_name"):
+        discoverer = ConsulDiscoverer(
+            base_url=raw.get("consul_address", "http://127.0.0.1:8500"),
+            token=raw.get("consul_token", ""))
+        log.info("using Consul discovery for %s", forward_service)
+    elif raw.get("forward_service_discovery") == "kubernetes":
+        discoverer = KubernetesDiscoverer(
+            label_selector=raw.get(
+                "kubernetes_label_selector", "app=veneur-global"))
+        log.info("using Kubernetes discovery")
+    else:
+        discoverer = StaticDiscoverer(destinations)
     proxy = ProxyServer(
         discoverer,
-        forward_service=args.forward_service,
+        forward_service=forward_service,
         listen_address=listen,
         discovery_interval=interval)
     proxy.start()

@@ -84,7 +84,9 @@ class TpuConfig:
     histo_capacity: int = 4096
     set_capacity: int = 1024
     batch_cap: int = 8192
-    # number of ingest shards for the multi-chip merge plane
+    # local devices to shard the HBM-heavy families (histograms, HLL
+    # sets) across; ingest round-robins batches, flush merges over ICI
+    # collectives (core.sharded_tables). 0/1 = single-device tables.
     shards: int = 1
     # force the pure-Python per-packet parser (the C++ batch parser is
     # used whenever it compiles; this is the escape hatch)

@@ -329,18 +329,17 @@ class GaugeTable(_BaseTable):
 class HistoTable(_BaseTable):
     """Histograms and timers, all scopes, one digest grid."""
 
-    # applied batches between slot-grid recompressions: ingestion is pure
-    # scatter-accumulate; a periodic recompress re-tightens slot means
-    RECOMPRESS_EVERY = 64
-
-    def _init_arrays(self):
-        self.state = batch_tdigest.init_state(self.capacity)
+    def _init_pending(self):
         self._prow = np.full(self.batch_cap, PAD_ROW, np.int32)
         self._pval = np.zeros(self.batch_cap, np.float32)
         self._pwt = np.zeros(self.batch_cap, np.float32)
         self._pcols = (self._prow, self._pval, self._pwt)
         self._n = 0
         self._applies = 0
+
+    def _init_arrays(self):
+        self._init_pending()
+        self.state = batch_tdigest.init_state(self.capacity)
 
     def _grow_arrays(self, new_cap):
         old = self.state
@@ -364,11 +363,11 @@ class HistoTable(_BaseTable):
                 self._dispatch_pending_locked()
 
     def _apply_cols(self, cols):
+        # apply_batch stages the batch and merges via the mean-sorted
+        # recompress, so the grid is always tight — no periodic pass
         rows, vals, wts = cols
         self.state = batch_tdigest.apply_batch(self.state, rows, vals, wts)
         self._applies += 1
-        if self._applies % self.RECOMPRESS_EVERY == 0:
-            self.state = batch_tdigest.recompress_state(self.state)
 
     def apply_pending(self):
         with self.lock:
@@ -412,14 +411,12 @@ class HistoTable(_BaseTable):
         try:
             if cols is not None:
                 self._apply_cols(cols)
-            # recompress before reading quantiles: scatter-accumulate
-            # ingest blurs slot means between periodic recompressions, so
-            # re-tighten the grid at read time to hold the one-k-unit
-            # invariant the t-digest error bound relies on
-            state = batch_tdigest.recompress_state(self.state)
-            out = batch_tdigest.flush_quantiles(state, tuple(percentiles))
+            # the grid is always tight: apply_batch and the merge paths
+            # end in the mean-sorted recompress, so flush reads directly
+            out = batch_tdigest.flush_quantiles(
+                self.state, tuple(percentiles))
             out = {k: np.asarray(v) for k, v in out.items()}
-            export = batch_tdigest.export_centroids(state)
+            export = batch_tdigest.export_centroids(self.state)
             self.state = batch_tdigest.init_state(self.capacity)
         finally:
             self.apply_lock.release()
@@ -430,13 +427,16 @@ class SetTable(_BaseTable):
     def __init__(self, capacity: int = 256, batch_cap: int = 8192):
         super().__init__(capacity, batch_cap)
 
-    def _init_arrays(self):
-        self.state = batch_hll.init_state(self.capacity)
+    def _init_pending(self):
         self._prow = np.full(self.batch_cap, PAD_ROW, np.int32)
         self._pidx = np.zeros(self.batch_cap, np.int32)
         self._prho = np.zeros(self.batch_cap, np.int32)
         self._pcols = (self._prow, self._pidx, self._prho)
         self._n = 0
+
+    def _init_arrays(self):
+        self._init_pending()
+        self.state = batch_hll.init_state(self.capacity)
 
     def _grow_arrays(self, new_cap):
         self.state = _pad_cap(self.state, new_cap)
@@ -544,14 +544,32 @@ class StatusTable(_BaseTable):
 
 
 class ColumnStore:
-    """All four device families plus host-side status checks."""
+    """All four device families plus host-side status checks.
+
+    With shard_devices > 1 the histogram and set families spread their
+    interval state across that many local devices (core.sharded_tables);
+    counters/gauges are (K,) scalars and always stay single-device."""
 
     def __init__(self, counter_capacity=1024, gauge_capacity=1024,
-                 histo_capacity=1024, set_capacity=256, batch_cap=8192):
+                 histo_capacity=1024, set_capacity=256, batch_cap=8192,
+                 shard_devices=0):
         self.counters = CounterTable(counter_capacity, batch_cap)
         self.gauges = GaugeTable(gauge_capacity, batch_cap)
-        self.histos = HistoTable(histo_capacity, batch_cap)
-        self.sets = SetTable(set_capacity, batch_cap)
+        devices = None
+        if shard_devices and shard_devices > 1:
+            from veneur_tpu.core import sharded_tables
+            devices = sharded_tables.local_shard_devices(shard_devices)
+            if len(devices) < 2:
+                devices = None
+        if devices is not None:
+            from veneur_tpu.core.sharded_tables import (
+                ShardedHistoTable, ShardedSetTable)
+            self.histos = ShardedHistoTable(
+                histo_capacity, batch_cap, devices)
+            self.sets = ShardedSetTable(set_capacity, batch_cap, devices)
+        else:
+            self.histos = HistoTable(histo_capacity, batch_cap)
+            self.sets = SetTable(set_capacity, batch_cap)
         self.statuses = StatusTable()
         self.processed = 0
         self._processed_lock = threading.Lock()

@@ -99,7 +99,8 @@ class Server:
             gauge_capacity=config.tpu.gauge_capacity,
             histo_capacity=config.tpu.histo_capacity,
             set_capacity=config.tpu.set_capacity,
-            batch_cap=config.tpu.batch_cap)
+            batch_cap=config.tpu.batch_cap,
+            shard_devices=config.tpu.shards)
         self.aggregates = HistogramAggregates.from_names(config.aggregates)
         self.percentiles = tuple(config.percentiles)
 
@@ -475,7 +476,8 @@ class Server:
                 gauge_capacity=cfg.tpu.gauge_capacity,
                 histo_capacity=cfg.tpu.histo_capacity,
                 set_capacity=cfg.tpu.set_capacity,
-                batch_cap=cfg.tpu.batch_cap)
+                batch_cap=cfg.tpu.batch_cap,
+                shard_devices=cfg.tpu.shards)
             flush_columnstore(
                 scratch, self.is_local, self.percentiles, self.aggregates,
                 collect_forward=False)

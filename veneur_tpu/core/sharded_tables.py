@@ -1,0 +1,267 @@
+"""Live multi-device sharding for the column store's HBM-heavy families.
+
+The reference scales its hot path by sharding metric keys across worker
+goroutines and re-merging forwarded state on a global instance (reference
+server.go:1016, worker.go:410-467, flusher.go:516-591). On a multi-chip
+host the TPU-native equivalent keeps ONE host intern table but spreads the
+interval state of the two big families across the local devices:
+
+  histograms  (K, C) slot grids      merge = centroid re-insertion
+  sets        (K, 16384) registers   merge = elementwise max
+
+Batches round-robin across per-device states during ingest (pure data
+parallelism — no communication), and the flush-time global merge runs as
+one jitted computation over a stacked array sharded on the device axis, so
+XLA SPMD lowers the merges to ICI collectives (all-reduce-max for HLL,
+all-gather + batched recompress for digests). Counters and gauges stay
+single-device: their state is (K,) scalars — too small to shard — and
+gauges additionally need cross-batch ordering that a round-robin split
+would destroy.
+
+Enable with config `tpu.shards: N` (0/1 = single-device tables).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from veneur_tpu.core.columnstore import HistoTable, SetTable
+from veneur_tpu.ops import batch_hll, batch_tdigest
+
+logger = logging.getLogger("veneur_tpu.sharded")
+
+SHARD_AXIS = "shard"
+
+
+def local_shard_devices(n: int) -> List:
+    """The n local devices to shard over; falls back to the virtual CPU
+    devices when the default platform is smaller (validation topologies)."""
+    devices = jax.local_devices()
+    if len(devices) < n:
+        try:
+            cpu = jax.devices("cpu")
+            if len(cpu) >= n:
+                logger.warning(
+                    "shard_devices=%d > %d local devices; using the "
+                    "virtual CPU mesh (validation only)", n, len(devices))
+                devices = cpu
+        except RuntimeError:
+            pass
+    if len(devices) < n:
+        logger.warning("shard_devices=%d > %d available; clamping",
+                       n, len(devices))
+        n = len(devices)
+    return list(devices[:n])
+
+
+def _stack_on_mesh(mesh: Mesh, leaves: List[jnp.ndarray]) -> jnp.ndarray:
+    """Assemble per-device arrays (one per mesh device, already resident)
+    into a single (n, ...) jax.Array sharded on the leading axis — no
+    host round-trip, no device copy."""
+    n = len(leaves)
+    shard_shape = (1,) + leaves[0].shape
+    global_shape = (n,) + leaves[0].shape
+    sharding = NamedSharding(mesh, P(SHARD_AXIS))
+    expanded = [leaf[None] for leaf in leaves]  # dispatched on-device
+    return jax.make_array_from_single_device_arrays(
+        global_shape, sharding, [x for x in expanded])
+
+
+@jax.jit
+def _merge_hll_stacked(stacked: jnp.ndarray) -> jnp.ndarray:
+    """(n, K, M) int8 sharded on axis 0 -> (K, M) register max. XLA SPMD
+    lowers the reduction over the sharded axis to an all-reduce-max."""
+    return jnp.max(stacked, axis=0)
+
+
+@jax.jit
+def _merge_histo_stacked(stacked: Dict[str, jnp.ndarray]
+                         ) -> Dict[str, jnp.ndarray]:
+    """Per-shard digest states stacked on axis 0 -> one merged state.
+    Mirrors parallel.mesh._merge_digest_allgather: concatenate every
+    shard's centroids per key and recompress once as a batched kernel
+    (the global veneur's re-insertion, reference worker.go:455-457);
+    scalar stats reduce with sum/min/max."""
+    w = stacked["weights"]                      # (n, K, C)
+    m = jnp.where(w > 0, stacked["wv"] / jnp.maximum(w, 1e-30), 0.0)
+    n, num_keys, c = w.shape
+    cat_m = jnp.moveaxis(m, 0, 1).reshape(num_keys, n * c)
+    cat_w = jnp.moveaxis(w, 0, 1).reshape(num_keys, n * c)
+    new_m, new_w = batch_tdigest._recompress(cat_m, cat_w, num_keys)
+    return {
+        "wv": new_m * new_w,
+        "weights": new_w,
+        "dmin": jnp.min(stacked["dmin"], axis=0),
+        "dmax": jnp.max(stacked["dmax"], axis=0),
+        "drecip": jnp.sum(stacked["drecip"], axis=0),
+        "lmin": jnp.min(stacked["lmin"], axis=0),
+        "lmax": jnp.max(stacked["lmax"], axis=0),
+        "lsum": jnp.sum(stacked["lsum"], axis=0),
+        "lweight": jnp.sum(stacked["lweight"], axis=0),
+        "lrecip": jnp.sum(stacked["lrecip"], axis=0),
+    }
+
+
+class ShardedHistoTable(HistoTable):
+    """HistoTable whose interval state lives round-robin across N local
+    devices; flush merges across the device axis with collectives."""
+
+    def __init__(self, capacity: int = 1024, batch_cap: int = 8192,
+                 devices: List = None):
+        self._devices = devices or local_shard_devices(2)
+        self._mesh = Mesh(np.asarray(self._devices), (SHARD_AXIS,))
+        self._next = 0
+        super().__init__(capacity, batch_cap)
+
+    def _init_arrays(self):
+        self._init_pending()
+        self.states = [
+            jax.device_put(batch_tdigest.init_state(self.capacity), d)
+            for d in self._devices]
+        self.state = None  # unused; all device state lives in .states
+
+    def _grow_arrays(self, new_cap):
+        grown = []
+        for dev, st in zip(self._devices, self.states):
+            new = batch_tdigest.init_state(new_cap)
+            g = {k: jax.lax.dynamic_update_slice(
+                    new[k], st[k], (0,) * new[k].ndim) for k in new}
+            grown.append(jax.device_put(g, dev))
+        self.states = grown
+
+    def _apply_cols(self, cols):
+        i = self._next
+        self._next = (i + 1) % len(self._devices)
+        dev = self._devices[i]
+        rows, vals, wts = (jax.device_put(c, dev) for c in cols)
+        self.states[i] = batch_tdigest.apply_batch(
+            self.states[i], rows, vals, wts)
+        self._applies += 1
+
+    def merge_batch(self, stubs, in_means, in_weights, in_min, in_max,
+                    in_recip) -> None:
+        """Import-path digest merge lands on one shard (digest merge is
+        commutative across shards)."""
+        with self.lock:
+            rows = np.fromiter(
+                (self.row_for(s) for s in stubs), np.int32, len(stubs))
+            self.touched[rows] = True
+            self.apply_lock.acquire()
+        try:
+            i = self._next
+            self._next = (i + 1) % len(self._devices)
+            dev = self._devices[i]
+            put = lambda a, t: jax.device_put(np.asarray(a, t), dev)
+            self.states[i] = batch_tdigest.merge_centroid_rows(
+                self.states[i], jax.device_put(rows, dev),
+                put(in_means, np.float32), put(in_weights, np.float32),
+                put(in_min, np.float32), put(in_max, np.float32),
+                put(in_recip, np.float32))
+        finally:
+            self.apply_lock.release()
+
+    def _merged_state(self) -> Dict[str, jnp.ndarray]:
+        stacked = {
+            k: _stack_on_mesh(self._mesh, [st[k] for st in self.states])
+            for k in self.states[0]}
+        return _merge_histo_stacked(stacked)
+
+    def snapshot_and_reset(self, percentiles: Tuple[float, ...]):
+        with self.lock:
+            cols = self._swap_locked()
+            self.apply_lock.acquire()
+            touched = self.touched.copy()
+            meta = list(self.meta)
+            self.touched[:] = False
+        try:
+            if cols is not None:
+                self._apply_cols(cols)
+            merged = self._merged_state()
+            out = batch_tdigest.flush_quantiles(merged, tuple(percentiles))
+            out = {k: np.asarray(v) for k, v in out.items()}
+            export = batch_tdigest.export_centroids(merged)
+            self.states = [
+                jax.device_put(batch_tdigest.init_state(self.capacity), d)
+                for d in self._devices]
+        finally:
+            self.apply_lock.release()
+        return out, export, touched, meta
+
+
+class ShardedSetTable(SetTable):
+    """SetTable whose HLL register banks live round-robin across N local
+    devices; flush merges registers with an all-reduce max."""
+
+    def __init__(self, capacity: int = 256, batch_cap: int = 8192,
+                 devices: List = None):
+        self._devices = devices or local_shard_devices(2)
+        self._mesh = Mesh(np.asarray(self._devices), (SHARD_AXIS,))
+        self._next = 0
+        super().__init__(capacity, batch_cap)
+
+    def _init_arrays(self):
+        self._init_pending()
+        self.states = [
+            jax.device_put(batch_hll.init_state(self.capacity), d)
+            for d in self._devices]
+        self.state = None
+
+    def _grow_arrays(self, new_cap):
+        self.states = [
+            jax.device_put(
+                jnp.pad(st, [(0, new_cap - st.shape[0]), (0, 0)]), dev)
+            for dev, st in zip(self._devices, self.states)]
+
+    def _apply_cols(self, cols):
+        i = self._next
+        self._next = (i + 1) % len(self._devices)
+        dev = self._devices[i]
+        rows, idxs, rhos = (jax.device_put(c, dev) for c in cols)
+        self.states[i] = batch_hll.apply_batch(
+            self.states[i], rows, idxs, rhos)
+
+    def merge_batch(self, stubs, in_regs) -> None:
+        with self.lock:
+            rows = np.fromiter(
+                (self.row_for(s) for s in stubs), np.int32, len(stubs))
+            self.touched[rows] = True
+            self.apply_lock.acquire()
+        try:
+            i = self._next
+            self._next = (i + 1) % len(self._devices)
+            dev = self._devices[i]
+            self.states[i] = batch_hll.merge_rows(
+                self.states[i], jax.device_put(rows, dev),
+                jax.device_put(np.asarray(in_regs, np.int8), dev))
+        finally:
+            self.apply_lock.release()
+
+    def _merged_state(self) -> jnp.ndarray:
+        stacked = _stack_on_mesh(self._mesh, self.states)
+        return _merge_hll_stacked(stacked)
+
+    def snapshot_and_reset(self):
+        with self.lock:
+            cols = self._swap_locked()
+            self.apply_lock.acquire()
+            touched = self.touched.copy()
+            meta = list(self.meta)
+            self.touched[:] = False
+        try:
+            if cols is not None:
+                self._apply_cols(cols)
+            merged = self._merged_state()
+            estimates = np.asarray(batch_hll.estimate(merged))
+            registers = np.asarray(merged)
+            self.states = [
+                jax.device_put(batch_hll.init_state(self.capacity), d)
+                for d in self._devices]
+        finally:
+            self.apply_lock.release()
+        return estimates, registers, touched, meta

@@ -1,0 +1,156 @@
+"""axiomhq/hyperloglog binary wire format (version 1).
+
+The reference serializes set state on the forward plane with the axiomhq
+sketch's MarshalBinary and merges imports via UnmarshalBinary (reference
+samplers/samplers.go:279-311, vendor/github.com/axiomhq/hyperloglog/
+hyperloglog.go:274-380). This module speaks that format so sets exchanged
+with a Go veneur merge instead of being dropped:
+
+  header:  [version=1, p, b, sparse?]
+  dense:   4-byte BE tailcut count, then count bytes; each byte packs two
+           4-bit registers (high nibble = even index) stored relative to
+           the base b (hyperloglog.go:167-182 insert, registers.go).
+  sparse:  tmpSet  = 4-byte BE count + count 4-byte BE encoded hashes,
+           then a compressed list = BE count, BE last, BE byte-size and
+           varint-encoded deltas of sorted encoded hashes (compressed.go,
+           sparse.go encodeHash/decodeHash with pp=25).
+
+Our own device tables hold plain per-register rho bytes, so marshalling
+always emits the dense form (valid input to any axiomhq Merge) and
+unmarshalling expands either form back to a flat register array.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+VERSION = 1
+PP = 25  # sparse precision (hyperloglog.go: pp)
+CAPACITY = 16  # 4-bit tailcut registers
+
+
+class HLLWireError(ValueError):
+    pass
+
+
+def _clz64(x: int) -> int:
+    return 64 - x.bit_length() if x else 64
+
+
+def _bextr32(v: int, start: int, length: int) -> int:
+    return (v >> start) & ((1 << length) - 1)
+
+
+def encode_hash(x: int, p: int = 14) -> int:
+    """Sparse-representation encoding of a 64-bit member hash
+    (sparse.go encodeHash)."""
+    idx = (x >> (64 - PP)) & ((1 << PP) - 1)
+    if (x >> (64 - PP)) & ((1 << (PP - p)) - 1) == 0:
+        w = (((x & ((1 << (64 - PP)) - 1)) << PP)
+             | (1 << (PP - 1))) & 0xFFFFFFFFFFFFFFFF
+        zeros = _clz64(w) + 1
+        return (idx << 7) | (zeros << 1) | 1
+    return idx << 1
+
+
+def decode_hash(k: int, p: int = 14) -> Tuple[int, int]:
+    """Sparse key -> (register index, rho) (sparse.go decodeHash)."""
+    if k & 1:
+        r = _bextr32(k, 1, 6) + PP - p
+        idx = _bextr32(k, 32 - p, p)
+    else:
+        # the Go shift happens in uint32 before widening, so it truncates
+        w = (k << (32 - PP + p - 1)) & 0xFFFFFFFF
+        r = _clz64(w) - 31
+        idx = _bextr32(k, PP - p + 1, p)
+    return idx, r
+
+
+def marshal_dense(regs: np.ndarray, p: int = 14) -> bytes:
+    """Flat rho registers -> dense axiomhq sketch bytes.
+
+    Values above the 4-bit tailcut range clamp exactly as the Go insert
+    path would have (val = min(r-b, 15), hyperloglog.go:176-181); the
+    base b only rises when every register is occupied, so it is derived
+    from the register minimum the same way rebase would."""
+    regs = np.asarray(regs).astype(np.int32) & 0xFF
+    m = regs.shape[0]
+    if m != (1 << p):
+        raise HLLWireError(f"register count {m} != 2^{p}")
+    b = 0
+    minv = int(regs.min()) if m else 0
+    maxv = int(regs.max()) if m else 0
+    if maxv >= CAPACITY and minv > 0:
+        b = min(minv, maxv - (CAPACITY - 1))
+    vals = np.clip(regs - b, 0, CAPACITY - 1).astype(np.uint8)
+    tailcuts = ((vals[0::2] << 4) | vals[1::2]).astype(np.uint8)
+    out = bytearray((VERSION, p, b, 0))
+    out += len(tailcuts).to_bytes(4, "big")
+    out += tailcuts.tobytes()
+    return bytes(out)
+
+
+def unmarshal(data: bytes) -> Tuple[np.ndarray, int]:
+    """Sketch bytes (dense or sparse) -> (flat registers, precision)."""
+    if len(data) < 8:
+        raise HLLWireError(f"short HLL payload ({len(data)} bytes)")
+    p = data[1]
+    if not 4 <= p <= 18:
+        raise HLLWireError(f"precision {p} out of range")
+    b = data[2]
+    m = 1 << p
+    regs = np.zeros(m, np.uint8)
+
+    if data[3] == 1:  # sparse
+        tssz = int.from_bytes(data[4:8], "big")
+        off = 8
+        end = off + 4 * tssz
+        if end > len(data):
+            raise HLLWireError("sparse tmpSet truncated")
+        keys = [int.from_bytes(data[i:i + 4], "big")
+                for i in range(off, end, 4)]
+        off = end
+        if off + 12 > len(data):
+            raise HLLWireError("sparse list header truncated")
+        # compressed list: count and last are redundant with the payload
+        off += 8
+        sz = int.from_bytes(data[off:off + 4], "big")
+        off += 4
+        if off + sz > len(data):
+            raise HLLWireError("sparse list truncated")
+        buf = data[off:off + sz]
+        i = 0
+        last = 0
+        n = len(buf)
+        while i < n:
+            x = 0
+            shift = 0
+            while buf[i] & 0x80:
+                x |= (buf[i] & 0x7F) << shift
+                shift += 7
+                i += 1
+                if i >= n:  # continuation bit on the final byte
+                    raise HLLWireError("truncated varint in sparse list")
+            x |= buf[i] << shift
+            i += 1
+            last += x
+            keys.append(last)
+        for k in keys:
+            idx, r = decode_hash(k, p)
+            if r > regs[idx]:
+                regs[idx] = r
+        return regs, p
+
+    sz = int.from_bytes(data[4:8], "big")
+    if sz != m // 2 or 8 + sz > len(data):
+        raise HLLWireError(f"dense payload size mismatch ({sz} tailcuts)")
+    tc = np.frombuffer(data[8:8 + sz], np.uint8)
+    regs[0::2] = tc >> 4
+    regs[1::2] = tc & 0x0F
+    if b:
+        # registers are stored relative to the base; Go's estimator adds
+        # the base back for every register (registers.go sumAndZeros)
+        regs = (regs + b).astype(np.uint8)
+    return regs, p

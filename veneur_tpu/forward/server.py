@@ -164,9 +164,21 @@ class ImportServer:
 
 
 def _decode_hll(data: bytes) -> Optional[np.ndarray]:
-    """Decode a forwarded HLL register dump. Our own format is the raw
-    16384-byte dense register array."""
+    """Decode a forwarded HLL payload: the axiomhq binary format a Go
+    veneur sends (sparse or dense, reference samplers.go:299-311), or the
+    raw 16384-byte register dump this framework's pre-interop versions
+    emitted."""
+    from veneur_tpu.forward import hllwire
     if len(data) == hll_ref.M:
         return np.frombuffer(data, np.int8)
-    logger.warning("unrecognized HLL payload of %d bytes dropped", len(data))
-    return None
+    try:
+        regs, p = hllwire.unmarshal(data)
+    except hllwire.HLLWireError as e:
+        logger.warning("undecodable HLL payload (%d bytes) dropped: %s",
+                       len(data), e)
+        return None
+    if p != hll_ref.P:
+        logger.warning("HLL precision %d != %d; payload dropped",
+                       p, hll_ref.P)
+        return None
+    return regs.astype(np.int8)

@@ -10,14 +10,17 @@ whole table of digests is three dense device arrays (means, weights of shape
   2. Per-row midpoint quantiles come from a segmented prefix-sum (cumsum +
      running-max trick over row starts).
   3. Each sample maps to a k-scale bucket (arcsine scale, parity with
-     merging_digest.go:259-262) and is scatter-added into the per-key slot
-     grid, stored as (weight, weight*value) accumulators so ingestion is
-     pure scatter-add — O(B log B) per batch, independent of table size.
-  4. Slot means blur slightly as batches with shifting distributions land
-     in the same k-bucket; a periodic `recompress_state` pass (sort by
-     slot mean, re-bucket by combined prefix weights, segment-reduce via a
-     one-hot matmul — the MXU path) re-tightens them. The import/collective
-     merge paths always recompress.
+     merging_digest.go:259-262) and is scatter-added into a FRESH staging
+     grid of (weight, weight*value) accumulators.
+  4. The staging grid merges into the main grid with the mean-sorted
+     recompress (sort [main | staging] slots by mean, re-bucket by
+     combined prefix weights, segment-reduce via a one-hot matmul — the
+     MXU path). This is the device analog of the reference's temp-buffer
+     sorted merge (merging_digest.go:140-224): distant values never share
+     a slot mean just because they shared a batch-local quantile. Cost is
+     one (K, 2C) sort + one (K, 2C, C) matmul per applied batch — linear
+     in table capacity, amortized across the thousands of samples a batch
+     carries. The import/collective merge paths recompress the same way.
 
 The same invariant as the reference holds: every slot spans at most one
 k-unit of its batch, so quantile error stays in the sequential algorithm's
@@ -141,23 +144,40 @@ def apply_batch(state, rows, values, weights):
     state["dmin"] = state["dmin"].at[rows].min(vmin, mode="drop")
     state["dmax"] = state["dmax"].at[rows].max(vmax, mode="drop")
 
-    # k-bucket each sample by its batch-local midpoint quantile, then
-    # scatter-accumulate straight into the slot grids
+    # k-bucket each sample by its batch-local midpoint quantile into a
+    # FRESH staging grid, then merge [main | staging] with the mean-sorted
+    # recompress. Scattering straight into the main grid would mix samples
+    # from different batches into one slot mean purely because they shared
+    # a batch-local quantile (distant values blur past the one-k-unit
+    # invariant); the staged merge is the device analog of the reference's
+    # temp-buffer sorted merge (merging_digest.go:140-224), keeping slots
+    # tight at a cost of one sort+matmul per applied batch.
     srows, svals, swts = jax.lax.sort(
         (rows, values, w_eff), num_keys=2, dimension=-1)
     bucket, _totals = _bucketize(srows, swts, num_keys)
-    state["weights"] = state["weights"].at[srows, bucket].add(
+    stage_w = jnp.zeros_like(state["weights"]).at[srows, bucket].add(
         swts, mode="drop")
-    state["wv"] = state["wv"].at[srows, bucket].add(
+    stage_wv = jnp.zeros_like(state["wv"]).at[srows, bucket].add(
         swts * svals, mode="drop")
+    main_w = state["weights"]
+    main_m = jnp.where(
+        main_w > 0, state["wv"] / jnp.maximum(main_w, 1e-30), 0.0)
+    stage_m = jnp.where(
+        stage_w > 0, stage_wv / jnp.maximum(stage_w, 1e-30), 0.0)
+    cat_m = jnp.concatenate([main_m, stage_m], axis=-1)
+    cat_w = jnp.concatenate([main_w, stage_w], axis=-1)
+    new_m, new_w = _recompress(cat_m, cat_w, num_keys)
+    state["weights"] = new_w
+    state["wv"] = new_m * new_w
     return state
 
 
 @jax.jit
 def recompress_state(state):
     """Re-tighten every row's slot grid: sort slots by mean and re-bucket
-    by combined prefix weights. Run periodically between batches (and by
-    every merge path); ingestion itself never needs it."""
+    by combined prefix weights. apply_batch and the merge paths keep the
+    grid tight on their own; this standalone pass exists for external
+    callers merging raw grids (e.g. the mesh collective plane)."""
     state = dict(state)
     w = state["weights"]
     m = jnp.where(w > 0, state["wv"] / jnp.maximum(w, 1e-30), 0.0)

@@ -149,6 +149,11 @@ class Destinations:
                         flush_interval=self._flush_interval)
                     self.ring.add(address)
 
+    def addresses(self) -> List[str]:
+        """Current pool membership (discovery/elasticity observability)."""
+        with self._lock:
+            return sorted(self._pool)
+
     def _remove_locked(self, address: str) -> None:
         dest = self._pool.pop(address, None)
         self.ring.remove(address)
