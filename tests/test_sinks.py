@@ -120,6 +120,24 @@ class TestDatadog:
         total = sum(len(json.loads(b)["series"]) for _, _, b in fake.requests)
         assert total == 5
 
+    def test_metric_name_prefix_drops(self, fake):
+        sink = self._sink(fake, metric_name_prefix_drops=["veneur."])
+        sink.flush([im("veneur.flush.total"), im("app.reqs")])
+        series = json.loads(fake.requests[0][2])["series"]
+        assert [s["metric"] for s in series] == ["app.reqs"]
+
+    def test_tag_exclusion_by_metric_prefix(self, fake):
+        sink = self._sink(
+            fake, excluded_tag_prefixes=["noisy"],
+            exclude_tags_prefix_by_prefix_metric={"db.": ["shard"]})
+        sink.flush([
+            im("db.queries", tags=["shard:3", "env:prod", "noisy:x"]),
+            im("web.hits", tags=["shard:3", "noisy:x"])])
+        series = {s["metric"]: s for s in
+                  json.loads(fake.requests[0][2])["series"]}
+        assert series["db.queries"]["tags"] == ["env:prod"]
+        assert series["web.hits"]["tags"] == ["shard:3"]
+
     def test_service_checks(self, fake):
         sink = self._sink(fake)
         sink.flush([im("check.up", 2.0, MetricType.STATUS,
@@ -253,6 +271,76 @@ class TestSignalFx:
         assert by_token["default-tok"]["gauge"][0]["metric"] == "g1"
         assert by_token["default-tok"]["gauge"][0]["dimensions"][
             "host"] == "h1"  # metric hostname wins over sink hostname
+
+    def test_status_checks_emit_as_gauges(self, fake):
+        from veneur_tpu.sinks.signalfx import SignalFxMetricSink
+        sink = SignalFxMetricSink("signalfx", api_key="t",
+                                  endpoint=fake.url, hostname="sh")
+        sink.flush([im("svc.up", 2, MetricType.STATUS)])
+        payload = json.loads(fake.requests[0][2])
+        assert payload["gauge"][0]["metric"] == "svc.up"
+        assert payload["gauge"][0]["value"] == 2
+
+    def test_drop_host_with_tag_key(self, fake):
+        from veneur_tpu.sinks.signalfx import SignalFxMetricSink
+        sink = SignalFxMetricSink(
+            "signalfx", api_key="t", endpoint=fake.url, hostname="sh",
+            drop_host_with_tag_key="multihost")
+        sink.flush([
+            im("c1", 1, MetricType.COUNTER, tags=["multihost:yes"]),
+            im("c2", 1, MetricType.COUNTER),
+            im("g1", 1, MetricType.GAUGE, tags=["multihost:yes"])])
+        payload = json.loads(fake.requests[0][2])
+        dims = {p["metric"]: p["dimensions"]
+                for kind in payload.values() for p in kind}
+        assert "host" not in dims["c1"]  # counter with the tag: dropped
+        assert dims["c2"]["host"] == "h1"  # counter without: kept
+        assert dims["g1"]["host"] == "h1"  # gauges never drop
+
+    def test_event_flush(self, fake):
+        from veneur_tpu.samplers.parser import Event
+        from veneur_tpu.samplers.parser import EVENT_IDENTIFIER_KEY
+        from veneur_tpu.sinks.signalfx import SignalFxMetricSink
+        sink = SignalFxMetricSink("signalfx", api_key="t",
+                                  endpoint=fake.url, hostname="sh")
+        ev = Event(name="deploy", message="%%% \nrolled out\n %%%",
+                   timestamp=1000,
+                   tags={EVENT_IDENTIFIER_KEY: "", "env": "prod"})
+        not_event = Event(name="no", message="x", timestamp=1,
+                          tags={"env": "prod"})
+        sink.flush_other_samples([ev, not_event])
+        path, _, body = fake.requests[0]
+        assert path == "/v2/event"
+        events = json.loads(body)
+        assert len(events) == 1  # non-event sample ignored
+        assert events[0]["eventType"] == "deploy"
+        assert events[0]["properties"]["description"] == "rolled out"
+        assert events[0]["dimensions"]["env"] == "prod"
+        assert EVENT_IDENTIFIER_KEY not in events[0]["dimensions"]
+
+    def test_event_truncation(self, fake):
+        from veneur_tpu.samplers.parser import Event
+        from veneur_tpu.samplers.parser import EVENT_IDENTIFIER_KEY
+        from veneur_tpu.sinks.signalfx import SignalFxMetricSink
+        sink = SignalFxMetricSink("signalfx", api_key="t",
+                                  endpoint=fake.url, hostname="sh")
+        ev = Event(name="n" * 400, message="m" * 400, timestamp=1,
+                   tags={EVENT_IDENTIFIER_KEY: ""})
+        sink.flush_other_samples([ev])
+        events = json.loads(fake.requests[0][2])
+        assert len(events[0]["eventType"]) == 256
+        assert len(events[0]["properties"]["description"]) == 256
+
+    def test_flush_max_per_body_chunks(self, fake):
+        from veneur_tpu.sinks.signalfx import SignalFxMetricSink
+        sink = SignalFxMetricSink("signalfx", api_key="t",
+                                  endpoint=fake.url, hostname="sh",
+                                  flush_max_per_body=3)
+        sink.flush([im(f"m{i}", i, MetricType.GAUGE) for i in range(8)])
+        assert len(fake.requests) == 3  # ceil(8/3)
+        total = sum(len(json.loads(b).get("gauge", []))
+                    for _, _, b in fake.requests)
+        assert total == 8
 
 
 class TestKafka:

@@ -34,6 +34,9 @@ class DatadogMetricSink(MetricSink):
     def __init__(self, name: str, api_key: str, api_url: str, hostname: str,
                  interval: float, flush_max_per_body: int = 25_000,
                  num_workers: int = 4, tags: Sequence[str] = (),
+                 metric_name_prefix_drops: Sequence[str] = (),
+                 excluded_tag_prefixes: Sequence[str] = (),
+                 exclude_tags_prefix_by_prefix_metric: Dict[str, Sequence[str]] = None,
                  timeout: float = 10.0):
         self._name = name
         self.api_key = api_key
@@ -43,6 +46,13 @@ class DatadogMetricSink(MetricSink):
         self.flush_max_per_body = flush_max_per_body
         self.num_workers = num_workers
         self.tags = list(tags)
+        # reference datadog.go:313-317: drop whole metrics by name prefix
+        self.metric_name_prefix_drops = list(metric_name_prefix_drops)
+        # reference datadog.go:345-352: drop tags by prefix, globally
+        self.excluded_tag_prefixes = list(excluded_tag_prefixes)
+        # reference datadog.go:323-331: per-metric-prefix tag exclusion
+        self.exclude_tags_prefix_by_prefix_metric = dict(
+            exclude_tags_prefix_by_prefix_metric or {})
         self.timeout = timeout
 
     def name(self) -> str:
@@ -57,11 +67,19 @@ class DatadogMetricSink(MetricSink):
         tags = list(self.tags)
         host = m.hostname or self.hostname
         device = ""
+        per_metric_excludes: Sequence[str] = ()
+        for prefix, excludes in self.exclude_tags_prefix_by_prefix_metric.items():
+            if m.name.startswith(prefix):
+                per_metric_excludes = excludes
+                break
         for t in m.tags:
             if t.startswith("host:"):
                 host = t[5:]
             elif t.startswith("device:"):
                 device = t[7:]
+            elif (any(t.startswith(p) for p in self.excluded_tag_prefixes)
+                  or any(t.startswith(p) for p in per_metric_excludes)):
+                continue
             else:
                 tags.append(t)
         if m.type == MetricType.COUNTER:
@@ -84,6 +102,10 @@ class DatadogMetricSink(MetricSink):
     # -- flush ------------------------------------------------------------
 
     def flush(self, metrics: List[InterMetric]) -> None:
+        if self.metric_name_prefix_drops:
+            metrics = [m for m in metrics
+                       if not any(m.name.startswith(p)
+                                  for p in self.metric_name_prefix_drops)]
         checks = [m for m in metrics if m.type == MetricType.STATUS]
         series = [self._dd_metric(m) for m in metrics
                   if m.type != MetricType.STATUS]
@@ -220,7 +242,14 @@ def _metric_factory(sink_config, server_config):
         flush_max_per_body=int(c.get("datadog_flush_max_per_body", 25_000)),
         num_workers=int(c.get("datadog_num_workers",
                               server_config.num_workers) or 4),
-        tags=c.get("tags", []) or [])
+        tags=c.get("tags", []) or [],
+        metric_name_prefix_drops=c.get(
+            "datadog_metric_name_prefix_drops", []) or [],
+        excluded_tag_prefixes=c.get("datadog_excluded_tags", []) or [],
+        exclude_tags_prefix_by_prefix_metric={
+            str(e.get("metric_prefix", "")): list(e.get("tags", []) or [])
+            for e in (c.get(
+                "datadog_exclude_tags_prefix_by_prefix_metric", []) or [])})
 
 
 @register_span_sink("datadog")

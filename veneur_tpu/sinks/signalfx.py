@@ -3,28 +3,41 @@
 Behavioral parity with reference sinks/signalfx/signalfx.go (681 LoC):
 InterMetrics become SignalFx datapoints with dimensions; a `vary_key_by`
 tag routes each metric to a per-token client (reference's dynamic
-per-token clients); counters are cumulative counts, gauges gauges.
-Datapoints POST to /v2/datapoint as JSON (the reference uses the sfx
-protobuf client; the JSON ingest API carries the same datapoint model).
+per-token clients, signalfx.go:491-588); counters are cumulative counts,
+gauges and status checks gauges (signalfx.go:573-582); counters can drop
+the hostname dimension when a configured tag is present
+(drop_host_with_tag_key, signalfx.go:566-571); batches chunk at
+flush_max_per_body (collection.submit, signalfx.go:96-141). DogStatsD
+events flush to /v2/event with name/description truncation and
+Datadog-markdown stripping (signalfx.go:601-681). Datapoints POST to
+/v2/datapoint as JSON (the reference uses the sfx protobuf client; the
+JSON ingest API carries the same datapoint model).
 """
 
 from __future__ import annotations
 
 import logging
-from typing import Dict, List, Sequence
+import threading
+from typing import Any, Dict, List, Sequence
 
 from veneur_tpu.samplers.metrics import InterMetric, MetricType
+from veneur_tpu.samplers.parser import EVENT_IDENTIFIER_KEY
 from veneur_tpu.sinks import MetricSink, register_metric_sink
 from veneur_tpu.util import http as vhttp
 
 logger = logging.getLogger("veneur_tpu.sinks.signalfx")
+
+EVENT_NAME_MAX_LENGTH = 256  # reference signalfx.go:30
+EVENT_DESCRIPTION_MAX_LENGTH = 256  # reference signalfx.go:31
 
 
 class SignalFxMetricSink(MetricSink):
     def __init__(self, name: str, api_key: str, endpoint: str,
                  hostname: str, hostname_tag: str = "host",
                  vary_key_by: str = "", per_tag_tokens: Dict[str, str] = None,
-                 excluded_tags: Sequence[str] = (), timeout: float = 10.0):
+                 excluded_tags: Sequence[str] = (),
+                 drop_host_with_tag_key: str = "",
+                 flush_max_per_body: int = 0, timeout: float = 10.0):
         self._name = name
         self.api_key = api_key
         self.endpoint = endpoint.rstrip("/")
@@ -33,6 +46,8 @@ class SignalFxMetricSink(MetricSink):
         self.vary_key_by = vary_key_by
         self.per_tag_tokens = per_tag_tokens or {}
         self.excluded_tags = set(excluded_tags)
+        self.drop_host_with_tag_key = drop_host_with_tag_key
+        self.flush_max_per_body = flush_max_per_body
         self.timeout = timeout
 
     def name(self) -> str:
@@ -45,8 +60,6 @@ class SignalFxMetricSink(MetricSink):
         # datapoints grouped by access token (vary_key_by routing)
         by_token: Dict[str, Dict[str, list]] = {}
         for m in metrics:
-            if m.type == MetricType.STATUS:
-                continue
             dims = {self.hostname_tag: m.hostname or self.hostname}
             token = self.api_key
             for t in m.tags:
@@ -56,6 +69,9 @@ class SignalFxMetricSink(MetricSink):
                 if self.vary_key_by and k == self.vary_key_by:
                     token = self.per_tag_tokens.get(v, self.api_key)
                 dims[k] = v
+            if (m.type == MetricType.COUNTER and self.drop_host_with_tag_key
+                    and self.drop_host_with_tag_key in dims):
+                dims.pop(self.hostname_tag, None)
             point = {
                 "metric": m.name,
                 "value": m.value,
@@ -66,16 +82,84 @@ class SignalFxMetricSink(MetricSink):
             if m.type == MetricType.COUNTER:
                 bucket["counter"].append(point)
             else:
+                # gauges and status checks both emit as gauges
+                # (signalfx.go:573-582)
                 bucket["gauge"].append(point)
+        threads = []
         for token, payload in by_token.items():
-            payload = {k: v for k, v in payload.items() if v}
-            try:
-                vhttp.post_json(
-                    f"{self.endpoint}/v2/datapoint", payload,
-                    headers={"X-SF-Token": token}, compress="gzip",
-                    timeout=self.timeout)
-            except Exception as e:
-                logger.error("signalfx POST failed: %s", e)
+            for chunk in self._chunk(payload):
+                t = threading.Thread(
+                    target=self._post_datapoints, args=(token, chunk),
+                    daemon=True)
+                t.start()
+                threads.append(t)
+        for t in threads:
+            t.join()
+
+    def _chunk(self, payload: Dict[str, list]) -> List[Dict[str, list]]:
+        """Split a token's datapoints at flush_max_per_body (the
+        reference's collection.submit batching)."""
+        per = self.flush_max_per_body
+        total = sum(len(v) for v in payload.values())
+        if not per or total <= per:
+            out = {k: v for k, v in payload.items() if v}
+            return [out] if out else []
+        flat = [(kind, p) for kind, pts in payload.items() for p in pts]
+        chunks = []
+        for i in range(0, len(flat), per):
+            chunk: Dict[str, list] = {}
+            for kind, p in flat[i:i + per]:
+                chunk.setdefault(kind, []).append(p)
+            chunks.append(chunk)
+        return chunks
+
+    def _post_datapoints(self, token: str, payload: Dict[str, list]) -> None:
+        try:
+            vhttp.post_json(
+                f"{self.endpoint}/v2/datapoint", payload,
+                headers={"X-SF-Token": token}, compress="gzip",
+                timeout=self.timeout)
+        except Exception as e:
+            logger.error("signalfx POST failed: %s", e)
+
+    def flush_other_samples(self, samples: Sequence[Any]) -> None:
+        """DogStatsD events -> SignalFx /v2/event (reference
+        signalfx.go:601-681 FlushOtherSamples/reportEvent); non-event
+        samples are ignored."""
+        events = []
+        for s in samples:
+            tags = dict(getattr(s, "tags", {}) or {})
+            if EVENT_IDENTIFIER_KEY not in tags:
+                continue
+            tags.pop(EVENT_IDENTIFIER_KEY, None)
+            dims = {self.hostname_tag: self.hostname}
+            for k, v in tags.items():
+                if k not in self.excluded_tags:
+                    dims[k] = v
+            name = getattr(s, "name", "")[:EVENT_NAME_MAX_LENGTH]
+            message = getattr(s, "message", "")
+            if len(message) > EVENT_DESCRIPTION_MAX_LENGTH:
+                message = message[:EVENT_DESCRIPTION_MAX_LENGTH]
+            # strip the Datadog markdown fences SignalFx has no use for
+            message = message.replace("%%% \n", "", 1)
+            message = message.replace("\n %%%", "", 1)
+            message = message.strip()
+            events.append({
+                "eventType": name,
+                "category": "USER_DEFINED",
+                "dimensions": dims,
+                "timestamp": getattr(s, "timestamp", 0) * 1000,
+                "properties": {"description": message},
+            })
+        if not events:
+            return
+        try:
+            vhttp.post_json(
+                f"{self.endpoint}/v2/event", events,
+                headers={"X-SF-Token": self.api_key}, compress="gzip",
+                timeout=self.timeout)
+        except Exception as e:
+            logger.error("signalfx event POST failed: %s", e)
 
 
 @register_metric_sink("signalfx")
@@ -91,4 +175,6 @@ def _factory(sink_config, server_config):
         hostname_tag=c.get("hostname_tag", "host"),
         vary_key_by=c.get("vary_key_by", ""),
         per_tag_tokens=per_tag,
-        excluded_tags=c.get("excluded_tags", []) or [])
+        excluded_tags=c.get("excluded_tags", []) or [],
+        drop_host_with_tag_key=c.get("drop_host_with_tag_key", ""),
+        flush_max_per_body=int(c.get("flush_max_per_body", 0)))

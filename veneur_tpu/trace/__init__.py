@@ -133,12 +133,19 @@ class UDPBackend:
 
 
 class StreamBackend:
-    """Framed spans over a UNIX or TCP stream, reconnecting on error
-    (reference trace/backend.go:120-230)."""
+    """Framed spans over a UNIX or TCP stream, reconnecting with capped
+    exponential backoff (reference trace/backend.go:46-230: failed sends
+    drop the connection and reconnect, waiting n*backoff up to the
+    maximal backoff between attempts)."""
 
-    def __init__(self, address, unix: bool = False):
+    def __init__(self, address, unix: bool = False,
+                 backoff: float = 0.02, max_backoff: float = 0.5,
+                 connect_budget: float = 2.0):
         self.address = address
         self.unix = unix
+        self.backoff = backoff
+        self.max_backoff = max_backoff
+        self.connect_budget = connect_budget
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
 
@@ -152,6 +159,22 @@ class StreamBackend:
             self._sock = s
         return self._sock
 
+    def _connect_with_backoff(self) -> socket.socket:
+        """Reconnect, sleeping a growing (capped) backoff between
+        attempts, bounded overall by connect_budget so the sender thread
+        can surface a drop instead of stalling forever."""
+        deadline = time.monotonic() + self.connect_budget
+        wait = self.backoff
+        while True:
+            try:
+                return self._connect()
+            except OSError:
+                self._drop()
+                if time.monotonic() + wait > deadline:
+                    raise
+                time.sleep(wait)
+                wait = min(wait * 2, self.max_backoff)
+
     def send(self, span: ssf.SSFSpan) -> None:
         # encode outside the retry: an over-size span raises FramingError
         # (an OSError subclass) and must not tear down a healthy socket
@@ -160,9 +183,9 @@ class StreamBackend:
             try:
                 self._connect().sendall(frame)
             except OSError:
-                # drop the connection; retry once on a fresh one
+                # drop the connection; retry on a fresh one with backoff
                 self._drop()
-                self._connect().sendall(frame)
+                self._connect_with_backoff().sendall(frame)
 
     def _drop(self) -> None:
         if self._sock is not None:
@@ -178,6 +201,46 @@ class StreamBackend:
     def close(self) -> None:
         with self._lock:
             self._drop()
+
+
+class BufferedBackend:
+    """Buffer spans in memory and write them in bursts — the reference's
+    flushable buffered backend (trace/backend.go:63-118): sends cost an
+    append; flush() (or a full buffer) drains the burst through the
+    wrapped backend, so one reconnect covers a whole burst and a dead
+    collector costs bounded memory."""
+
+    def __init__(self, inner, capacity: int = 1024):
+        self.inner = inner
+        self.capacity = capacity
+        self._buf: list = []
+        self._lock = threading.Lock()
+        self.dropped = 0
+
+    def send(self, span: ssf.SSFSpan) -> None:
+        with self._lock:
+            self._buf.append(span)
+            if len(self._buf) < self.capacity:
+                return
+            burst, self._buf = self._buf, []
+        self._send_burst(burst)
+
+    def _send_burst(self, burst) -> None:
+        for s in burst:
+            try:
+                self.inner.send(s)
+            except Exception:
+                self.dropped += 1
+
+    def flush(self) -> None:
+        with self._lock:
+            burst, self._buf = self._buf, []
+        self._send_burst(burst)
+        self.inner.flush()
+
+    def close(self) -> None:
+        self.flush()
+        self.inner.close()
 
 
 # -- client --------------------------------------------------------------

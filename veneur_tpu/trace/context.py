@@ -83,18 +83,24 @@ def start_span(name: str, service: str = "",
     return _ActiveSpan(span)
 
 
-def inject_headers(span: trace_mod.Span,
-                   headers: Optional[Dict[str, str]] = None
-                   ) -> Dict[str, str]:
-    """Write the span's lineage into HTTP headers (Envoy format, plus the
-    sampled flag the reference always sets)."""
+def headers_for(trace_id: int, span_id: int,
+                headers: Optional[Dict[str, str]] = None) -> Dict[str, str]:
+    """Write a (trace_id, span_id) lineage into HTTP headers (Envoy
+    format, plus the sampled flag the reference always sets)."""
     headers = headers if headers is not None else {}
     tid_key, sid_key, base = HEADER_FORMATS[0]
     fmt = (lambda v: format(v, "x")) if base == 16 else str
-    headers[tid_key] = fmt(span.trace_id)
-    headers[sid_key] = fmt(span.id)
+    headers[tid_key] = fmt(trace_id)
+    headers[sid_key] = fmt(span_id)
     headers["ot-tracer-sampled"] = "true"
     return headers
+
+
+def inject_headers(span: trace_mod.Span,
+                   headers: Optional[Dict[str, str]] = None
+                   ) -> Dict[str, str]:
+    """Write the span's lineage into HTTP headers."""
+    return headers_for(span.trace_id, span.id, headers)
 
 
 def extract_context(headers: Mapping[str, str]) -> Tuple[int, int]:

@@ -1,0 +1,260 @@
+"""OpenTracing compatibility layer.
+
+Parity with reference trace/opentracing.go (659 LoC): a Tracer whose
+StartSpan/Inject/Extract follow the OpenTracing API so instrumented code
+can report through this framework's SSF span pipeline. The `opentracing`
+PyPI package is not a dependency — the classes duck-type its interfaces
+(same method names and semantics), which is all the API requires.
+
+Mapping:
+  opentracing Span        -> wraps veneur_tpu.trace.Span (SSF proto)
+  SpanContext             -> (trace_id, span_id, baggage) triple;
+                             baggage keys mirror the reference's
+                             trace.trace_id/span.id items
+                             (opentracing.go:128-199)
+  Inject/Extract formats  -> TEXT_MAP and HTTP_HEADERS use the
+                             multi-format header scheme of
+                             trace/context.py (veneur/signalfx/
+                             brave/openzipkin groups); BINARY frames
+                             the SSF span like the Go layer's
+                             protobuf binary carrier
+                             (opentracing.go:416-470)
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Mapping, Optional
+
+from veneur_tpu import protocol, ssf
+from veneur_tpu import trace as trace_mod
+from veneur_tpu.trace import context as trace_ctx
+
+FORMAT_TEXT_MAP = "text_map"
+FORMAT_HTTP_HEADERS = "http_headers"
+FORMAT_BINARY = "binary"
+
+
+class UnsupportedFormatException(Exception):
+    pass
+
+
+class SpanContextCorruptedException(Exception):
+    pass
+
+
+class SpanContext:
+    """Propagated identity of a span: ids plus baggage
+    (reference opentracing.go:128-199)."""
+
+    def __init__(self, trace_id: int, span_id: int,
+                 baggage: Optional[Dict[str, str]] = None,
+                 resource: str = ""):
+        self.trace_id = int(trace_id)
+        self.span_id = int(span_id)
+        self.resource = resource
+        self._baggage = dict(baggage or {})
+
+    @property
+    def baggage(self) -> Dict[str, str]:
+        return dict(self._baggage)
+
+    def with_baggage_item(self, key: str, value: str) -> "SpanContext":
+        items = dict(self._baggage)
+        items[key] = value
+        return SpanContext(self.trace_id, self.span_id, items,
+                           self.resource)
+
+
+class child_of:  # noqa: N801 — opentracing-python reference style
+    def __init__(self, referenced_context):
+        self.referenced_context = referenced_context
+
+
+class follows_from(child_of):  # noqa: N801
+    """Treated like child_of, matching the Go layer (opentracing.go
+    handles FollowsFrom references identically for SSF lineage)."""
+
+
+class OTSpan:
+    """OpenTracing-shaped wrapper over an SSF span."""
+
+    def __init__(self, tracer: "Tracer", inner: trace_mod.Span,
+                 baggage: Optional[Dict[str, str]] = None):
+        self._tracer = tracer
+        self.inner = inner
+        self._baggage = dict(baggage or {})
+
+    # -- identity --------------------------------------------------------
+
+    def context(self) -> SpanContext:
+        return SpanContext(self.inner.trace_id, self.inner.id,
+                           self._baggage,
+                           resource=self.inner.proto.tags.get(
+                               "resource", ""))
+
+    def tracer(self) -> "Tracer":
+        return self._tracer
+
+    # -- mutation --------------------------------------------------------
+
+    def set_operation_name(self, name: str) -> "OTSpan":
+        self.inner.proto.name = name
+        return self
+
+    def set_tag(self, key: str, value: Any) -> "OTSpan":
+        if key == "error":
+            self.inner.error(bool(value))
+        else:
+            self.inner.set_tag(str(key), str(value))
+        return self
+
+    def set_baggage_item(self, key: str, value: str) -> "OTSpan":
+        self._baggage[str(key)] = str(value)
+        return self
+
+    def get_baggage_item(self, key: str) -> Optional[str]:
+        return self._baggage.get(key)
+
+    def log_kv(self, key_values: Mapping[str, Any],
+               timestamp: Optional[float] = None) -> "OTSpan":
+        """Logged fields become span tags (the Go layer's LogFields adds
+        them as samples/tags; tags are the lossless subset here)."""
+        for k, v in key_values.items():
+            self.inner.set_tag(f"log.{k}", str(v))
+        return self
+
+    # -- lifecycle -------------------------------------------------------
+
+    def finish(self, finish_time: Optional[float] = None) -> None:
+        if finish_time is not None:
+            self.inner.proto.end_timestamp = int(finish_time * 1e9)
+            self.inner._finished = True
+            if self.inner.client is not None:
+                self.inner.client.record(self.inner.proto)
+            return
+        self.inner.finish()
+
+    def __enter__(self) -> "OTSpan":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc_type is not None:
+            self.set_tag("error", True)
+        self.finish()
+
+
+class Tracer:
+    """Duck-typed opentracing.Tracer over the SSF trace client
+    (reference opentracing.go:330-470)."""
+
+    def __init__(self, client: Optional[trace_mod.Client] = None,
+                 service: str = "veneur-tpu"):
+        self._client = client
+        self.service = service
+
+    @property
+    def client(self) -> Optional[trace_mod.Client]:
+        return self._client if self._client is not None \
+            else trace_ctx.global_client()
+
+    def start_span(self, operation_name: str,
+                   child_of: Any = None,
+                   references: Any = None,
+                   tags: Optional[Mapping[str, Any]] = None,
+                   start_time: Optional[float] = None,
+                   ignore_active_span: bool = False) -> OTSpan:
+        parent_ctx: Optional[SpanContext] = None
+        if child_of is not None:
+            parent_ctx = (child_of.context() if isinstance(child_of, OTSpan)
+                          else child_of)
+        elif references:
+            refs = references if isinstance(references, (list, tuple)) \
+                else [references]
+            for ref in refs:
+                ctx = getattr(ref, "referenced_context", ref)
+                parent_ctx = (ctx.context() if isinstance(ctx, OTSpan)
+                              else ctx)
+                break
+        trace_id = parent_ctx.trace_id if parent_ctx else 0
+        parent_id = parent_ctx.span_id if parent_ctx else 0
+        inner = trace_mod.Span(
+            self.client, operation_name, self.service,
+            trace_id=trace_id, parent_id=parent_id)
+        if start_time is not None:
+            inner.proto.start_timestamp = int(start_time * 1e9)
+        span = OTSpan(self, inner,
+                      baggage=parent_ctx.baggage if parent_ctx else None)
+        for k, v in (tags or {}).items():
+            span.set_tag(k, v)
+        return span
+
+    def inject(self, span_context: SpanContext, format: str,
+               carrier: Any) -> None:
+        if isinstance(span_context, OTSpan):
+            span_context = span_context.context()
+        if format in (FORMAT_TEXT_MAP, FORMAT_HTTP_HEADERS):
+            headers = trace_ctx.headers_for(
+                span_context.trace_id, span_context.span_id)
+            for k, v in headers.items():
+                carrier[k] = v
+            for k, v in span_context.baggage.items():
+                carrier[f"baggage-{k}"] = v
+            return
+        if format == FORMAT_BINARY:
+            span = ssf.SSFSpan(id=span_context.span_id,
+                               trace_id=span_context.trace_id)
+            frame = protocol.frame_ssf(span)
+            if hasattr(carrier, "write"):
+                carrier.write(frame)
+            else:
+                carrier.extend(frame)
+            return
+        raise UnsupportedFormatException(format)
+
+    def extract(self, format: str, carrier: Any) -> SpanContext:
+        if format in (FORMAT_TEXT_MAP, FORMAT_HTTP_HEADERS):
+            trace_id, span_id = trace_ctx.extract_context(carrier)
+            if not trace_id:
+                raise SpanContextCorruptedException(
+                    "no trace headers in carrier")
+            baggage = {k[len("baggage-"):]: v for k, v in carrier.items()
+                       if k.lower().startswith("baggage-")}
+            return SpanContext(trace_id, span_id, baggage)
+        if format == FORMAT_BINARY:
+            import io
+            data = carrier.read() if hasattr(carrier, "read") else bytes(
+                carrier)
+            try:
+                span = protocol.read_ssf(io.BytesIO(data))
+            except Exception as e:
+                raise SpanContextCorruptedException(str(e)) from e
+            if span is None:
+                raise SpanContextCorruptedException("empty binary carrier")
+            return SpanContext(span.trace_id, span.id)
+        raise UnsupportedFormatException(format)
+
+
+_global_tracer = Tracer()
+
+
+def global_tracer() -> Tracer:
+    return _global_tracer
+
+
+def set_global_tracer(tracer: Tracer) -> None:
+    global _global_tracer
+    _global_tracer = tracer
+
+
+def start_span_from_headers(tracer: Tracer, operation_name: str,
+                            headers: Mapping[str, str],
+                            tags: Optional[Mapping[str, Any]] = None
+                            ) -> OTSpan:
+    """Server-side helper: continue a trace from incoming headers, or
+    start a fresh root when none are present."""
+    try:
+        parent = tracer.extract(FORMAT_HTTP_HEADERS, dict(headers))
+    except SpanContextCorruptedException:
+        parent = None
+    return tracer.start_span(operation_name, child_of=parent, tags=tags)
