@@ -15,10 +15,12 @@ import argparse
 import json
 import os
 import sys
+import threading
 import time
 import traceback
 
 BASELINE_SAMPLES_PER_SEC = 60_000.0
+_T0 = time.monotonic()
 
 # one authoritative name per scenario, shared by the success and the
 # error-path JSON so harnesses can key records by metric name
@@ -30,17 +32,73 @@ METRIC_NAMES = {
     "forward": "forwarded_digest_keys_per_sec",
     "ssf": "ssf_extracted_samples_per_sec",
     "device": "device_samples_per_sec",
+    "sustained": "sustained_samples_per_sec",
 }
 
+# accumulates fields as stages complete, so the deadline guard can emit a
+# partial-but-valid JSON line if a stage (usually an XLA compile on a cold
+# cache) runs long
+RESULT: dict = {}
+_EMIT_LOCK = threading.Lock()
+_EMITTED = False
 
-def emit(obj) -> None:
-    """Print the single benchmark JSON line (flushed immediately so it
-    survives even if teardown hangs afterwards)."""
-    print(json.dumps(obj), flush=True)
+
+def log(msg: str) -> None:
+    """Timestamped progress line to stderr — makes a driver-side timeout
+    tail diagnosable (which stage was running, how long it had been)."""
+    print(f"bench[{time.monotonic() - _T0:7.1f}s] {msg}",
+          file=sys.stderr, flush=True)
+
+
+def finalize() -> None:
+    """Emit THE one benchmark JSON line exactly once (normal completion
+    and the deadline guard race to call this)."""
+    global _EMITTED
+    with _EMIT_LOCK:
+        if _EMITTED:
+            return
+        _EMITTED = True
+        obj = dict(RESULT)
+        obj.setdefault("metric", "dogstatsd_samples_per_sec")
+        obj.setdefault("value", 0.0)
+        obj.setdefault("unit", "samples/s")
+        obj["vs_baseline"] = round(
+            float(obj["value"]) / BASELINE_SAMPLES_PER_SEC, 3)
+        print(json.dumps(obj), flush=True)
+
+
+def arm_deadline(seconds: float) -> None:
+    """Hard wall-clock budget: when it fires, whatever stages completed
+    are emitted (truncated=true) and the process exits 0 — a partial
+    number always beats a driver-side timeout with no number."""
+    def fire():
+        log(f"deadline ({seconds:.0f}s) reached; emitting partial result")
+        RESULT["truncated"] = True
+        finalize()
+        os._exit(0)
+
+    t = threading.Timer(seconds, fire)
+    t.daemon = True
+    t.start()
+
+
+def enable_compile_cache() -> None:
+    """Persistent XLA compilation cache: reruns (including the driver's
+    post-round run in this same workspace) skip the multi-minute serial
+    compiles that previously blew the wall-clock cap."""
+    import jax
+    cache_dir = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    except Exception as e:  # cache is an optimization, never a hard dep
+        log(f"compile cache unavailable: {e}")
 
 
 def initialize_backend(max_attempts: int = 2,
-                       probe_timeout: float = 150.0) -> str:
+                       probe_timeout: float = 60.0) -> str:
     """Bring up the JAX backend before constructing any pipeline object so
     a backend failure is visible up front (round-1 failure modes: axon TPU
     init raising UNAVAILABLE deep inside Server construction, or hanging
@@ -89,7 +147,11 @@ def initialize_backend(max_attempts: int = 2,
 
     devs = jax.devices()
     platform = jax.default_backend()
-    print(f"bench: backend={platform} devices={devs}", file=sys.stderr)
+    if platform != "cpu":
+        # TPU-only: CPU AOT cache entries embed machine features and can
+        # SIGILL when reloaded on a different host
+        enable_compile_cache()
+    log(f"backend={platform} devices={devs}")
     if fallback_reason is not None:
         return f"cpu-fallback({fallback_reason})"
     return platform
@@ -121,21 +183,14 @@ def make_packets(num_keys: int, values_per_packet: int = 8):
     return packets, samples
 
 
-def run_pipeline(duration_s: float, num_keys: int):
-    from veneur_tpu.config import Config
-    from veneur_tpu.core.server import Server
-
-    cfg = Config()
-    cfg.interval = 10.0
-    cfg.tpu.counter_capacity = max(4096, num_keys)
-    cfg.tpu.gauge_capacity = max(4096, num_keys)
-    cfg.tpu.histo_capacity = max(4096, num_keys)
-    cfg.tpu.set_capacity = max(1024, num_keys // 2)
-    cfg.tpu.batch_cap = 16384
-    cfg.apply_defaults()
-
-    from veneur_tpu.sinks.blackhole import BlackholeMetricSink
-    server = Server(cfg, extra_metric_sinks=[BlackholeMetricSink()])
+def run_pipeline_mt(duration_s: float, num_keys: int,
+                    thread_counts=(1, 2, 4, 8)):
+    """The headline scenario: N reader threads drive pre-rendered
+    datagram buffers through the GIL-releasing native batch parser into
+    one shared column store — the in-process equivalent of the
+    reference's num_readers SO_REUSEPORT fanout (reference
+    networking.go:54-107). Returns (best_rate, {threads: rate})."""
+    server = _mk_server(num_keys)
 
     packets, samples_per_round = make_packets(num_keys)
     # batch into datagram-sized buffers (~40 metrics each, like a client
@@ -145,6 +200,126 @@ def run_pipeline(duration_s: float, num_keys: int):
 
     # warmup: intern every key (first pass is the Python slow path) and
     # trigger every kernel compile path
+    log(f"mixed: warmup (intern {num_keys} keys + compile kernels)")
+    server.handle_packet_batch(datagrams)
+    server.store.apply_all_pending()
+    server.flush()
+    log("mixed: warmup done")
+
+    per_round = duration_s / max(1, len(thread_counts))
+    scaling = {}
+    for n in thread_counts:
+        counts = [0] * n
+        stop = threading.Event()
+
+        def worker(slot):
+            # stagger start points so threads do not convoy on one table
+            my = datagrams[slot::n] if n > 1 else datagrams
+            local = 0
+            while not stop.is_set():
+                server.handle_packet_batch(my)
+                local += 1
+            counts[slot] = local
+
+        threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+                   for i in range(n)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        time.sleep(per_round)
+        stop.set()
+        for t in threads:
+            t.join()
+        server.store.apply_all_pending()
+        elapsed = time.perf_counter() - t0
+        if n == 1:
+            total = counts[0] * samples_per_round
+        else:
+            # each slot covers ~1/n of the corpus per pass
+            total = sum(c * samples_per_round // n for c in counts)
+        rate = total / elapsed
+        scaling[str(n)] = round(rate, 1)
+        log(f"mixed: {n} thread(s) -> {rate:,.0f} samples/s")
+    server.flush()
+    best = max(scaling.values())
+    return best, scaling
+
+
+def run_scenario_sustained(num_keys: int = 100_000, interval_s: float = 5.0,
+                           intervals: int = 2, threads: int = 4):
+    """The north-star gate: a live server with a real flush ticker under
+    sustained multi-threaded load; reports per-interval flush wall time
+    (must stay under the interval — reference flusher.go:26-122's
+    one-interval deadline) and the sustained ingest rate."""
+    server = _mk_server(num_keys, interval=interval_s,
+                        synchronize_with_interval=False)
+    flush_times = []
+    orig_flush_locked = server._flush_locked
+
+    def timed_flush():
+        t0 = time.perf_counter()
+        orig_flush_locked()
+        flush_times.append(time.perf_counter() - t0)
+
+    server._flush_locked = timed_flush
+
+    packets, samples_per_round = make_packets(num_keys)
+    datagrams = [b"\n".join(packets[i:i + 40])
+                 for i in range(0, len(packets), 40)]
+    log(f"sustained: warmup ({num_keys} keys)")
+    server.handle_packet_batch(datagrams)
+    server.store.apply_all_pending()
+    server.flush()
+    flush_times.clear()
+    log("sustained: warmup done; starting ticker")
+
+    server.start()
+    stop = threading.Event()
+    counts = [0] * threads
+
+    def worker(slot):
+        my = datagrams[slot::threads]
+        while not stop.is_set():
+            server.handle_packet_batch(my)
+            counts[slot] += 1
+
+    ts = [threading.Thread(target=worker, args=(i,), daemon=True)
+          for i in range(threads)]
+    t0 = time.perf_counter()
+    for t in ts:
+        t.start()
+    deadline = t0 + intervals * interval_s + 0.5
+    while time.perf_counter() < deadline:
+        time.sleep(0.1)
+    stop.set()
+    for t in ts:
+        t.join()
+    elapsed = time.perf_counter() - t0
+    # drain whatever is still pending (counted: it was ingested in-window)
+    server.store.apply_all_pending()
+    server.shutdown()
+    total = sum(c * samples_per_round // threads for c in counts)
+    rate = total / elapsed
+    times = sorted(flush_times) or [0.0]
+    p50 = times[len(times) // 2]
+    p99 = times[min(len(times) - 1, int(len(times) * 0.99))]
+    log(f"sustained: {rate:,.0f} samples/s over {elapsed:.1f}s, "
+        f"{len(times)} flushes, p50={p50:.3f}s p99={p99:.3f}s")
+    return rate, {
+        "flush_p50_s": round(p50, 4),
+        "flush_p99_s": round(p99, 4),
+        "flush_count": len(times),
+        "interval_s": interval_s,
+        "sustained_keys": num_keys,
+    }
+
+
+def run_pipeline(duration_s: float, num_keys: int):
+    """Single-threaded host pipeline (kept for comparison runs)."""
+    server = _mk_server(num_keys)
+    packets, samples_per_round = make_packets(num_keys)
+    datagrams = [b"\n".join(packets[i:i + 40])
+                 for i in range(0, len(packets), 40)]
     server.handle_packet_batch(datagrams)
     server.store.apply_all_pending()
     server.flush()
@@ -314,9 +489,11 @@ def run_scenario_device(duration_s: float, num_keys: int = 100_000,
         "c_rates": np.ones(quarter, f32),
         "g_rows": rng.integers(0, num_keys, quarter).astype(np.int32),
         "g_vals": rng.random(quarter).astype(f32),
-        "h_rows": rng.integers(0, num_keys, quarter).astype(np.int32),
+        "h_rows": (h_rows := rng.integers(0, num_keys, quarter).astype(
+            np.int32)),
         "h_vals": rng.normal(100, 15, quarter).astype(f32),
         "h_wts": np.ones(quarter, f32),
+        "h_slots": batch_tdigest.host_ranks(h_rows),
         "s_rows": rng.integers(0, max(1, num_keys // 8), quarter).astype(
             np.int32),
         "s_idx": rng.integers(0, batch_hll.M, quarter).astype(np.int32),
@@ -330,7 +507,8 @@ def run_scenario_device(duration_s: float, num_keys: int = 100_000,
             counters, data["c_rows"], data["c_vals"], data["c_rates"])
         gauges = scalars.apply_gauges(gauges, data["g_rows"], data["g_vals"])
         histos = batch_tdigest.apply_batch(
-            histos, data["h_rows"], data["h_vals"], data["h_wts"])
+            histos, data["h_rows"], data["h_vals"], data["h_wts"],
+            data["h_slots"])
         sets = batch_hll.apply_batch(
             sets, data["s_rows"], data["s_idx"], data["s_rho"])
         return counters, gauges, histos, sets
@@ -396,26 +574,20 @@ def run_scenario_hll(duration_s: float, num_keys: int = 10_000,
     return total / (time.perf_counter() - t0)
 
 
-SCENARIOS = ["mixed", "counter", "timers", "hll", "forward", "ssf", "device"]
+SCENARIOS = ["default", "mixed", "single", "counter", "timers", "hll",
+             "forward", "ssf", "device", "sustained"]
 
 
 def run_one(scenario: str, duration: float, keys: int, on_tpu: bool = True):
     """Returns (metric_name, rate, extra_fields)."""
     extra = {}
-    metric = METRIC_NAMES[scenario]
+    metric = METRIC_NAMES.get(scenario, METRIC_NAMES["mixed"])
     if scenario == "mixed":
+        rate, scaling = run_pipeline_mt(duration, keys)
+        extra["threads"] = scaling
+    elif scenario == "single":
+        metric = METRIC_NAMES["mixed"]
         rate, _ = run_pipeline(duration, keys)
-        # companion device-only figure so host overhead and device
-        # throughput are separable in one headline run (scaled down on a
-        # CPU fallback, where the 100k-key grids are host-loop slow)
-        try:
-            dev_keys = max(keys, 100_000) if on_tpu else min(keys, 10_000)
-            drate, dflush = run_scenario_device(
-                min(duration, 5.0), dev_keys)
-            extra["device_samples_per_sec"] = round(drate, 1)
-            extra["device_flush_latency_s"] = round(dflush, 4)
-        except Exception as e:
-            extra["device_bench_error"] = f"{type(e).__name__}: {e}"
     elif scenario == "counter":
         rate = run_scenario_counter(duration)
     elif scenario == "timers":
@@ -428,6 +600,10 @@ def run_one(scenario: str, duration: float, keys: int, on_tpu: bool = True):
         dev_keys = max(keys, 100_000) if on_tpu else min(keys, 10_000)
         rate, dflush = run_scenario_device(duration, dev_keys)
         extra["flush_latency_s"] = round(dflush, 4)
+    elif scenario == "sustained":
+        s_keys = max(keys, 100_000) if on_tpu else min(keys, 10_000)
+        rate, extra = run_scenario_sustained(
+            s_keys, interval_s=5.0 if on_tpu else 2.0)
     else:
         rate = run_scenario_ssf(duration, keys)
     return metric, rate, extra
@@ -435,41 +611,61 @@ def run_one(scenario: str, duration: float, keys: int, on_tpu: bool = True):
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--duration", type=float, default=10.0)
+    ap.add_argument("--duration", type=float, default=8.0)
     ap.add_argument("--keys", type=int, default=10_000)
-    ap.add_argument("--scenario", default="mixed", choices=SCENARIOS,
-                    help="mixed is the headline metric; the rest mirror "
-                         "the BASELINE.json config suite")
+    ap.add_argument("--scenario", default="default", choices=SCENARIOS,
+                    help="default = mixed (multi-threaded headline) + "
+                         "sustained (live-ticker flush-latency gate); the "
+                         "rest mirror the BASELINE.json config suite")
+    ap.add_argument("--deadline", type=float,
+                    default=float(os.environ.get("BENCH_DEADLINE_S", 170)),
+                    help="hard wall-clock budget; partial JSON on expiry")
     args = ap.parse_args()
 
-    metric = METRIC_NAMES[args.scenario]
+    if args.deadline > 0:
+        arm_deadline(args.deadline)
+
+    RESULT["metric"] = METRIC_NAMES.get(
+        "mixed" if args.scenario == "default" else args.scenario,
+        METRIC_NAMES["mixed"])
     try:
         platform = initialize_backend()
     except Exception as e:
-        emit({"metric": metric, "value": 0.0, "unit": "samples/s",
-              "vs_baseline": 0.0,
-              "error": f"backend init failed: {type(e).__name__}: {e}"})
+        RESULT["error"] = f"backend init failed: {type(e).__name__}: {e}"
+        finalize()
         return 1
-
+    RESULT["platform"] = platform
     on_tpu = not platform.startswith("cpu")
+
     try:
-        metric, rate, extra = run_one(
-            args.scenario, args.duration, args.keys, on_tpu)
+        if args.scenario == "default":
+            log("stage 1/2: mixed multi-threaded host pipeline")
+            rate, scaling = run_pipeline_mt(args.duration, args.keys)
+            RESULT.update(metric=METRIC_NAMES["mixed"],
+                          value=round(rate, 1), unit="samples/s",
+                          threads=scaling)
+            log("stage 2/2: sustained live-ticker gate")
+            try:
+                s_keys = 100_000 if on_tpu else 10_000
+                srate, sextra = run_scenario_sustained(
+                    s_keys, interval_s=5.0 if on_tpu else 2.0)
+                RESULT["sustained_samples_per_sec"] = round(srate, 1)
+                RESULT.update(sextra)
+            except Exception as e:
+                traceback.print_exc()
+                RESULT["sustained_error"] = f"{type(e).__name__}: {e}"
+        else:
+            metric, rate, extra = run_one(
+                args.scenario, args.duration, args.keys, on_tpu)
+            RESULT.update(metric=metric, value=round(rate, 1),
+                          unit="samples/s", **extra)
     except Exception as e:
         traceback.print_exc()
-        emit({"metric": metric, "value": 0.0, "unit": "samples/s",
-              "vs_baseline": 0.0, "platform": platform,
-              "error": f"{type(e).__name__}: {e}"})
+        RESULT["error"] = f"{type(e).__name__}: {e}"
+        finalize()
         return 1
 
-    emit({
-        "metric": metric,
-        "value": round(rate, 1),
-        "unit": "samples/s",
-        "vs_baseline": round(rate / BASELINE_SAMPLES_PER_SEC, 3),
-        "platform": platform,
-        **extra,
-    })
+    finalize()
     return 0
 
 

@@ -111,3 +111,71 @@ class TestSnappy:
         tag = 0x01 | ((4 - 4) << 2) | (0 << 5)
         stream = bytes([8, 3 << 2]) + b"abcd" + bytes([tag, 4])
         assert vhttp.snappy_decode(stream) == b"abcdabcd"
+
+
+class TestProfilingEndpoints:
+    def _start(self, cfg=None, **kw):
+        api = HTTPApi(cfg or generate_config(), address="127.0.0.1:0", **kw)
+        api.start()
+        return api
+
+    def test_cpu_profile_request_scoped(self):
+        api = self._start()
+        try:
+            status, body = vhttp.get(
+                api_url(api, "/debug/profile/cpu?seconds=0.2"))
+            assert status == 200
+            assert b"cpu profile:" in body
+            assert b"flat%" in body and b"cum%" in body
+        finally:
+            api.stop()
+
+    def test_cpu_profile_continuous_sampler(self):
+        """enable_profiling starts a continuous sampler the endpoint
+        reads (reference server.go:1382-1390)."""
+        import time
+
+        cfg = generate_config()
+        cfg.enable_profiling = True
+        server, _observer = setup_server(cfg)
+        try:
+            server.start()
+            assert server.profiler is not None and server.profiler.running
+            time.sleep(0.3)  # let the 100 Hz sampler take some samples
+            samples, _flat, cum = server.profiler.snapshot()
+            assert samples > 0
+            assert len(cum) > 0  # other threads' stacks were captured
+            report = server.profiler.report()
+            assert "cpu profile:" in report
+        finally:
+            server.shutdown()
+        assert not server.profiler.running
+
+    def test_device_trace_endpoint(self):
+        """jax.profiler trace zip (TPU analog of /debug/pprof/profile)."""
+        import io
+        import zipfile
+
+        import jax
+        import jax.numpy as jnp
+
+        api = self._start()
+        try:
+            # give the trace something to record
+            import threading
+
+            def burn():
+                x = jnp.ones((256, 256))
+                for _ in range(5):
+                    x = (x @ x).block_until_ready()
+
+            t = threading.Thread(target=burn, daemon=True)
+            t.start()
+            status, body = vhttp.get(
+                api_url(api, "/debug/profile/device?seconds=0.3"))
+            t.join()
+            assert status == 200
+            zf = zipfile.ZipFile(io.BytesIO(body))
+            assert zf.namelist()  # non-empty trace directory
+        finally:
+            api.stop()

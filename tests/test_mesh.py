@@ -162,6 +162,11 @@ class TestDigestMerge:
         batches["h_rows"] = np.zeros((N_DEV, per), np.int32)
         batches["h_vals"] = data
         batches["h_wts"] = np.ones((N_DEV, per), np.float32)
+        batches["h_slots"] = np.stack([
+            batch_tdigest.batch_slots(
+                batches["h_rows"][i], batches["h_vals"][i],
+                batches["h_wts"][i], NUM_KEYS)
+            for i in range(N_DEV)])
         merged = _merged(mesh, state, batches)
 
         ps = (0.25, 0.5, 0.9, 0.99)
@@ -191,6 +196,11 @@ class TestDigestMerge:
         batches["h_rows"] = np.zeros((N_DEV, per), np.int32)
         batches["h_vals"] = data
         batches["h_wts"] = np.ones((N_DEV, per), np.float32)
+        batches["h_slots"] = np.stack([
+            batch_tdigest.batch_slots(
+                batches["h_rows"][i], batches["h_vals"][i],
+                batches["h_wts"][i], NUM_KEYS)
+            for i in range(N_DEV)])
         merged = _merged(mesh, state, batches)
 
         single = batch_tdigest.init_state(NUM_KEYS)

@@ -112,7 +112,9 @@ class TestBatchedDigest:
             vals = np.array([c[1] for c in chunk] + [0.0] * pad, np.float32)
             wts = np.array([c[2] for c in chunk] + [0.0] * pad, np.float32)
             state = btd.apply_batch(state, rows, vals, wts)
-        return state
+        # fold staged batches into the main grid, as the table does
+        # periodically and at every snapshot
+        return btd.compact(state)
 
     def test_matches_scalar_reference_uniform(self):
         rng = random.Random(11)
@@ -158,7 +160,9 @@ class TestBatchedDigest:
         state = self._ingest({0: [(rng.random(), 1.0) for _ in range(1000)]},
                              3, rng=rng)
         before = np.asarray(state["wv"]).copy()
-        # a batch touching only row 2 must leave row 0 bit-identical
+        # a batch touching only row 2 must leave rows 0/1 bit-identical:
+        # apply lands in staging, so main rows never move, and rows 0/1
+        # gain no staged weight
         rows = np.array([2] * 64, np.int32)
         vals = np.random.default_rng(0).random(64).astype(np.float32)
         wts = np.ones(64, np.float32)
@@ -166,6 +170,12 @@ class TestBatchedDigest:
         after = np.asarray(state["wv"])
         np.testing.assert_array_equal(before[0], after[0])
         np.testing.assert_array_equal(before[1], after[1])
+        stage_w = np.asarray(state["sweights"])
+        assert float(np.sum(stage_w[0])) == 0.0
+        assert float(np.sum(stage_w[1])) == 0.0
+        assert float(np.sum(stage_w[2])) == 64.0
+        # after compaction the staged weight lands in row 2's main grid
+        state = btd.compact(state)
         assert float(np.sum(np.asarray(state["weights"])[2])) == 64.0
 
     def test_centroid_budget(self):

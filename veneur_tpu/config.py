@@ -99,6 +99,9 @@ class Config:
     count_unique_timeseries: bool = False
     debug: bool = False
     enable_profiling: bool = False
+    # when set, jax.profiler.start_server(port) for live
+    # TensorBoard capture of device profiles
+    profile_server_port: int = 0
     extend_tags: List[str] = field(default_factory=list)
     features: Features = field(default_factory=Features)
     flush_on_shutdown: bool = False

@@ -327,7 +327,16 @@ class GaugeTable(_BaseTable):
 
 
 class HistoTable(_BaseTable):
-    """Histograms and timers, all scopes, one digest grid."""
+    """Histograms and timers, all scopes, one digest grid.
+
+    Batches rank-park raw samples into the digest staging grid (O(batch)
+    per apply, exact); the host tracks a conservative per-key staged
+    bound (sum of per-batch max row counts) and runs the mean-sorted
+    `compact` — the only capacity-proportional pass — before any key
+    could overflow its C staging slots, and always at snapshot. This
+    mirrors the reference's amortized temp-buffer merge
+    (merging_digest.go:115-140): sparse keys stage dozens of batches
+    per compact, dense keys compact about once per batch."""
 
     def _init_pending(self):
         self._prow = np.full(self.batch_cap, PAD_ROW, np.int32)
@@ -336,6 +345,9 @@ class HistoTable(_BaseTable):
         self._pcols = (self._prow, self._pval, self._pwt)
         self._n = 0
         self._applies = 0
+        # exact per-key staging-slot occupancy since the last compact
+        self._staged_counts = np.zeros(self.capacity, np.int32)
+
 
     def _init_arrays(self):
         self._init_pending()
@@ -349,6 +361,9 @@ class HistoTable(_BaseTable):
             grown[k] = jax.lax.dynamic_update_slice(
                 new[k], old[k], (0,) * new[k].ndim)
         self.state = grown
+        extended = np.zeros(new_cap, np.int32)
+        extended[: self._staged_counts.shape[0]] = self._staged_counts
+        self._staged_counts = extended
 
     def add(self, metric: UDPMetric):
         with self.lock:
@@ -363,10 +378,16 @@ class HistoTable(_BaseTable):
                 self._dispatch_pending_locked()
 
     def _apply_cols(self, cols):
-        # apply_batch stages the batch and merges via the mean-sorted
-        # recompress, so the grid is always tight — no periodic pass
         rows, vals, wts = cols
-        self.state = batch_tdigest.apply_batch(self.state, rows, vals, wts)
+        slots, overflow = batch_tdigest.host_slots(
+            rows, vals, wts, self._staged_counts)
+        if overflow:
+            self.state = batch_tdigest.compact(self.state)
+            self._staged_counts[:] = 0
+            slots, _ = batch_tdigest.host_slots(
+                rows, vals, wts, self._staged_counts)
+        self.state = batch_tdigest.apply_batch(
+            self.state, rows, vals, wts, slots)
         self._applies += 1
 
     def apply_pending(self):
@@ -396,6 +417,10 @@ class HistoTable(_BaseTable):
                 np.asarray(in_min, np.float32),
                 np.asarray(in_max, np.float32),
                 np.asarray(in_recip, np.float32))
+            # the merge folds staging for every row with staged weight
+            # (merge_centroid_rows touches staged rows too), so the whole
+            # occupancy map resets
+            self._staged_counts[:] = 0
         finally:
             self.apply_lock.release()
 
@@ -411,10 +436,12 @@ class HistoTable(_BaseTable):
         try:
             if cols is not None:
                 self._apply_cols(cols)
-            # the grid is always tight: apply_batch and the merge paths
-            # end in the mean-sorted recompress, so flush reads directly
+            # fold any staged batches so export sees the tight main grid
+            self.state = batch_tdigest.compact(self.state)
+            self._applies = 0
+            self._staged_counts[:] = 0
             out = batch_tdigest.flush_quantiles(
-                self.state, tuple(percentiles))
+                self.state, tuple(percentiles), fold_staging=False)
             out = {k: np.asarray(v) for k, v in out.items()}
             export = batch_tdigest.export_centroids(self.state)
             self.state = batch_tdigest.init_state(self.capacity)

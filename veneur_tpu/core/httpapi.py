@@ -75,6 +75,35 @@ class _Handler(BaseHTTPRequestHandler):
         elif path == "/debug/memory":
             self._send(200, _device_memory_report(),
                        "application/json")
+        elif path == "/debug/profile/cpu":
+            # reference server.go:1382-1390 enable_profiling CPU profile;
+            # continuous sampler when enable_profiling is on, else a
+            # request-scoped sample
+            from veneur_tpu.core import profiling
+            seconds = _query_float(self.path, "seconds", 2.0)
+            sampler = getattr(api.server, "profiler", None)
+            if sampler is not None and sampler.running:
+                body = sampler.report().encode()
+            else:
+                body = profiling.sample_for(seconds).encode()
+            self._send(200, body)
+        elif path == "/debug/profile/device":
+            # jax.profiler trace (TensorBoard-loadable zip) — the TPU
+            # analog of /debug/pprof/profile (reference http.go:53-63)
+            from veneur_tpu.core import profiling
+            seconds = _query_float(self.path, "seconds", 2.0)
+            try:
+                body = profiling.capture_device_trace(seconds)
+            except Exception as e:
+                self._send(500, f"trace failed: {e}\n".encode())
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", "application/zip")
+            self.send_header("Content-Disposition",
+                             'attachment; filename="device-trace.zip"')
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
         elif path == "/debug/threads":
             # faulthandler needs a real fd; format stacks directly instead
             import sys
@@ -97,6 +126,19 @@ class _Handler(BaseHTTPRequestHandler):
             threading.Thread(target=api.quit, daemon=True).start()
         else:
             self._send(404, b"not found\n")
+
+
+def _query_float(path: str, key: str, default: float,
+                 max_value: float = 60.0) -> float:
+    """Bounded query-param parse: profiling durations are clamped so one
+    request can't pin a sampler or hold the JAX trace open indefinitely."""
+    from urllib.parse import parse_qs, urlparse
+    try:
+        vals = parse_qs(urlparse(path).query).get(key)
+        val = float(vals[0]) if vals else default
+    except (TypeError, ValueError):
+        return default
+    return min(max(val, 0.0), max_value)
 
 
 def _device_memory_report() -> bytes:

@@ -1,0 +1,188 @@
+"""Profiling surface: the pprof-equivalent for a TPU-hosted pipeline.
+
+The reference always mounts Go pprof (reference http.go:53-63) and starts
+a CPU profile when `enable_profiling` is set (reference
+server.go:1382-1390). Python has no goroutine-style sampling profiler in
+the stdlib, so this module provides:
+
+  * StackSampler — a ~100 Hz all-threads stack sampler (the pprof CPU
+    profile analog): aggregates `sys._current_frames()` into flat and
+    cumulative hit counts per call site, reported as a text profile.
+  * capture_device_trace — a bounded `jax.profiler.trace` session whose
+    output directory is zipped and returned (open in TensorBoard /
+    xprof to see device timelines, XLA ops, and HBM traffic).
+  * start_profile_server — `jax.profiler.start_server` for live
+    TensorBoard capture, the idiomatic TPU profiling hook.
+
+Wired to config `enable_profiling` (continuous sampler from startup) and
+`profile_server_port`, and to the HTTP endpoints
+/debug/profile/cpu and /debug/profile/device (core.httpapi).
+"""
+
+from __future__ import annotations
+
+import collections
+import io
+import logging
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+import zipfile
+from typing import Dict, List, Optional, Tuple
+
+logger = logging.getLogger("veneur_tpu.profiling")
+
+
+class StackSampler:
+    """Samples every thread's Python stack at `hz` and aggregates call
+    sites. Flat hits = frames executing when sampled (self time);
+    cumulative hits = frames anywhere on a sampled stack."""
+
+    def __init__(self, hz: float = 100.0):
+        self.hz = hz
+        self._flat: collections.Counter = collections.Counter()
+        self._cum: collections.Counter = collections.Counter()
+        self._samples = 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._started_at = 0.0
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._started_at = time.time()
+        self._thread = threading.Thread(
+            target=self._run, name="stack-sampler", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._thread is None:
+            return
+        self._stop.set()
+        self._thread.join(timeout=2.0)
+        self._thread = None
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None
+
+    # -- sampling ---------------------------------------------------------
+
+    def _run(self) -> None:
+        period = 1.0 / self.hz
+        me = threading.get_ident()
+        while not self._stop.wait(period):
+            self._sample_once(me)
+
+    def _sample_once(self, skip_ident: int) -> None:
+        frames = sys._current_frames()
+        with self._lock:
+            self._samples += 1
+            for ident, frame in frames.items():
+                if ident == skip_ident:
+                    continue
+                seen = set()
+                top = True
+                while frame is not None:
+                    code = frame.f_code
+                    site = (code.co_filename, code.co_name,
+                            frame.f_lineno if top else code.co_firstlineno)
+                    if top:
+                        self._flat[site] += 1
+                        top = False
+                    if site not in seen:
+                        self._cum[site] += 1
+                        seen.add(site)
+                    frame = frame.f_back
+
+    # -- reporting --------------------------------------------------------
+
+    def snapshot(self) -> Tuple[int, List, List]:
+        with self._lock:
+            return (self._samples,
+                    self._flat.most_common(),
+                    self._cum.most_common())
+
+    def reset(self) -> None:
+        with self._lock:
+            self._flat.clear()
+            self._cum.clear()
+            self._samples = 0
+            self._started_at = time.time()
+
+    def report(self, top: int = 40) -> str:
+        """pprof-style text profile: flat% then cum% per call site."""
+        samples, flat, cum = self.snapshot()
+        lines = [
+            f"cpu profile: {samples} samples "
+            f"({time.time() - self._started_at:.1f}s at {self.hz:.0f} Hz)",
+            "",
+            f"{'flat%':>7} {'hits':>8}  site (self time)",
+        ]
+        for site, hits in flat[:top]:
+            pct = 100.0 * hits / max(1, samples)
+            lines.append(f"{pct:6.1f}% {hits:8d}  "
+                         f"{_short(site[0])}:{site[2]} {site[1]}")
+        lines += ["", f"{'cum%':>7} {'hits':>8}  site (cumulative)"]
+        for site, hits in cum[:top]:
+            pct = 100.0 * hits / max(1, samples)
+            lines.append(f"{pct:6.1f}% {hits:8d}  "
+                         f"{_short(site[0])}:{site[2]} {site[1]}")
+        return "\n".join(lines) + "\n"
+
+
+def _short(path: str) -> str:
+    parts = path.split(os.sep)
+    return os.sep.join(parts[-3:]) if len(parts) > 3 else path
+
+
+def sample_for(seconds: float, hz: float = 100.0, top: int = 40) -> str:
+    """One-shot profile: sample for `seconds`, return the text report
+    (the request-scoped mode when no continuous sampler is running)."""
+    sampler = StackSampler(hz=hz)
+    sampler.start()
+    time.sleep(max(0.01, seconds))
+    sampler.stop()
+    return sampler.report(top=top)
+
+
+def capture_device_trace(seconds: float) -> bytes:
+    """Run `jax.profiler.trace` for `seconds` and return the trace
+    directory zipped (TensorBoard/xprof-loadable). The trace records
+    device (TPU) timelines, XLA module executions, and host runtime."""
+    import jax
+
+    tmp = tempfile.mkdtemp(prefix="veneur-trace-")
+    try:
+        with jax.profiler.trace(tmp):
+            time.sleep(max(0.05, seconds))
+        buf = io.BytesIO()
+        with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+            for root, _dirs, files in os.walk(tmp):
+                for name in files:
+                    full = os.path.join(root, name)
+                    zf.write(full, os.path.relpath(full, tmp))
+        return buf.getvalue()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def start_profile_server(port: int) -> bool:
+    """Start jax's live profiling gRPC server (TensorBoard 'capture
+    profile' target). Returns False when unavailable."""
+    try:
+        import jax
+
+        jax.profiler.start_server(port)
+        logger.info("jax profiler server on port %d", port)
+        return True
+    except Exception:
+        logger.exception("could not start jax profiler server")
+        return False

@@ -195,6 +195,7 @@ class Server:
                           else BatchIngester.create(self))
 
         self.http_api = None  # set in start() when http_address
+        self.profiler = None  # set in start() when enable_profiling
         self._listeners: List[networking.Listener] = []
         self._flush_lock = threading.Lock()
         # last flush thread per sink: a sink whose previous flush is still
@@ -373,6 +374,15 @@ class Server:
                 self.config, server=self, address=self.config.http_address,
                 http_quit=self.config.http_quit, on_quit=self.shutdown)
             self.http_api.start()
+        if self.config.enable_profiling:
+            # continuous all-threads CPU sampler from startup (reference
+            # server.go:1382-1390), readable at /debug/profile/cpu
+            from veneur_tpu.core.profiling import StackSampler
+            self.profiler = StackSampler()
+            self.profiler.start()
+        if self.config.profile_server_port:
+            from veneur_tpu.core.profiling import start_profile_server
+            start_profile_server(self.config.profile_server_port)
         # pre-compile the flush kernels off the ticker path so the first
         # real flush isn't delayed by XLA compilation (~20-40s on TPU)
         threading.Thread(target=self._warmup, name="kernel-warmup",
@@ -425,6 +435,8 @@ class Server:
         if self.http_api is not None:
             self.http_api.stop()
             self.http_api = None
+        if self.profiler is not None:
+            self.profiler.stop()
         if self.forward_client is not None:
             self.forward_client.close()
         if self.diagnostics is not None:

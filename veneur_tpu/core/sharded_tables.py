@@ -90,13 +90,19 @@ def _merge_histo_stacked(stacked: Dict[str, jnp.ndarray]
     scalar stats reduce with sum/min/max."""
     w = stacked["weights"]                      # (n, K, C)
     m = jnp.where(w > 0, stacked["wv"] / jnp.maximum(w, 1e-30), 0.0)
+    sw = stacked["sweights"]                    # staged-but-uncompacted
+    sm = jnp.where(sw > 0, stacked["swv"] / jnp.maximum(sw, 1e-30), 0.0)
     n, num_keys, c = w.shape
-    cat_m = jnp.moveaxis(m, 0, 1).reshape(num_keys, n * c)
-    cat_w = jnp.moveaxis(w, 0, 1).reshape(num_keys, n * c)
+    cat_m = jnp.concatenate([m, sm], axis=-1)   # (n, K, 2C)
+    cat_w = jnp.concatenate([w, sw], axis=-1)
+    cat_m = jnp.moveaxis(cat_m, 0, 1).reshape(num_keys, n * 2 * c)
+    cat_w = jnp.moveaxis(cat_w, 0, 1).reshape(num_keys, n * 2 * c)
     new_m, new_w = batch_tdigest._recompress(cat_m, cat_w, num_keys)
     return {
         "wv": new_m * new_w,
         "weights": new_w,
+        "swv": jnp.zeros_like(new_w),
+        "sweights": jnp.zeros_like(new_w),
         "dmin": jnp.min(stacked["dmin"], axis=0),
         "dmax": jnp.max(stacked["dmax"], axis=0),
         "drecip": jnp.sum(stacked["drecip"], axis=0),
@@ -124,6 +130,8 @@ class ShardedHistoTable(HistoTable):
         self.states = [
             jax.device_put(batch_tdigest.init_state(self.capacity), d)
             for d in self._devices]
+        self._shard_counts = [np.zeros(self.capacity, np.int32)
+                              for _ in self._devices]
         self.state = None  # unused; all device state lives in .states
 
     def _grow_arrays(self, new_cap):
@@ -134,14 +142,27 @@ class ShardedHistoTable(HistoTable):
                     new[k], st[k], (0,) * new[k].ndim) for k in new}
             grown.append(jax.device_put(g, dev))
         self.states = grown
+        extended = []
+        for counts in self._shard_counts:
+            e = np.zeros(new_cap, np.int32)
+            e[: counts.shape[0]] = counts
+            extended.append(e)
+        self._shard_counts = extended
 
     def _apply_cols(self, cols):
         i = self._next
         self._next = (i + 1) % len(self._devices)
         dev = self._devices[i]
+        slots, overflow = batch_tdigest.host_slots(
+            cols[0], cols[1], cols[2], self._shard_counts[i])
+        if overflow:
+            self.states[i] = batch_tdigest.compact(self.states[i])
+            self._shard_counts[i][:] = 0
+            slots, _ = batch_tdigest.host_slots(
+                cols[0], cols[1], cols[2], self._shard_counts[i])
         rows, vals, wts = (jax.device_put(c, dev) for c in cols)
         self.states[i] = batch_tdigest.apply_batch(
-            self.states[i], rows, vals, wts)
+            self.states[i], rows, vals, wts, jax.device_put(slots, dev))
         self._applies += 1
 
     def merge_batch(self, stubs, in_means, in_weights, in_min, in_max,
@@ -163,6 +184,8 @@ class ShardedHistoTable(HistoTable):
                 put(in_means, np.float32), put(in_weights, np.float32),
                 put(in_min, np.float32), put(in_max, np.float32),
                 put(in_recip, np.float32))
+            # merge_centroid_rows folds every staged row on this shard
+            self._shard_counts[i][:] = 0
         finally:
             self.apply_lock.release()
 
@@ -183,12 +206,16 @@ class ShardedHistoTable(HistoTable):
             if cols is not None:
                 self._apply_cols(cols)
             merged = self._merged_state()
-            out = batch_tdigest.flush_quantiles(merged, tuple(percentiles))
+            # the stacked merge already folded every shard's staging
+            out = batch_tdigest.flush_quantiles(
+                merged, tuple(percentiles), fold_staging=False)
             out = {k: np.asarray(v) for k, v in out.items()}
             export = batch_tdigest.export_centroids(merged)
             self.states = [
                 jax.device_put(batch_tdigest.init_state(self.capacity), d)
                 for d in self._devices]
+            self._shard_counts = [np.zeros(self.capacity, np.int32)
+                                  for _ in self._devices]
         finally:
             self.apply_lock.release()
         return out, export, touched, meta

@@ -11,6 +11,8 @@ maxima. Estimation is the LogLog-Beta formula as two row reductions.
 
 from __future__ import annotations
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -24,7 +26,7 @@ def init_state(num_keys: int) -> jnp.ndarray:
     return jnp.zeros((num_keys, M), jnp.int8)
 
 
-@jax.jit
+@partial(jax.jit, donate_argnums=0)
 def apply_batch(regs, rows, reg_idx, rho):
     """Scatter-max a batch of hashed members. rows == K marks padding."""
     return regs.at[rows, reg_idx].max(rho.astype(jnp.int8), mode="drop")
@@ -35,7 +37,7 @@ def merge(regs_a, regs_b):
     return jnp.maximum(regs_a, regs_b)
 
 
-@jax.jit
+@partial(jax.jit, donate_argnums=0)
 def merge_rows(regs, rows, in_regs):
     """Merge whole incoming register rows (import path): per-key max."""
     num_keys = regs.shape[0]

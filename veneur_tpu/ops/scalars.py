@@ -8,6 +8,8 @@ samplers.go:160-162); merges overwrite.
 
 from __future__ import annotations
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 
@@ -29,7 +31,7 @@ def _kahan_add(state, partial):
     return {"sum": t, "comp": comp}
 
 
-@jax.jit
+@partial(jax.jit, donate_argnums=0)
 def apply_counters(state, rows, values, rates):
     """rows == K marks padding; contribution is trunc(value/rate)."""
     num_keys = state["sum"].shape[0]
@@ -50,7 +52,7 @@ def init_gauges(num_keys: int):
     }
 
 
-@jax.jit
+@partial(jax.jit, donate_argnums=0)
 def apply_gauges(state, rows, values):
     """Last-write-wins: for each row, keep the batch's last occurrence."""
     num_keys = state["value"].shape[0]
@@ -65,7 +67,7 @@ def apply_gauges(state, rows, values):
     }
 
 
-@jax.jit
+@partial(jax.jit, donate_argnums=0)
 def merge_gauges(state, rows, in_values):
     """Import-path merge: overwrite (reference samplers.go:200-202). Within
     one import batch the last value wins, matching the reference's

@@ -86,9 +86,9 @@ def _merge_digest_allgather(histo_state):
     Equivalent to the global veneur re-inserting each local digest's
     centroids (worker.go:455-457), done once as a batched kernel."""
     num_keys = histo_state["wv"].shape[0]
-    w = histo_state["weights"]
-    m = jnp.where(w > 0, histo_state["wv"] / jnp.maximum(w, 1e-30), 0.0)
-    g_means = jax.lax.all_gather(m, SHARD_AXIS)  # (n,K,C)
+    # fold each shard's staging grid into its gathered slot list
+    m, w = batch_tdigest._fold_grids(histo_state)  # (K, 2C)
+    g_means = jax.lax.all_gather(m, SHARD_AXIS)  # (n,K,2C)
     g_weights = jax.lax.all_gather(w, SHARD_AXIS)
     cat_m = jnp.moveaxis(g_means, 0, 1).reshape(num_keys, -1)
     cat_w = jnp.moveaxis(g_weights, 0, 1).reshape(num_keys, -1)
@@ -96,6 +96,8 @@ def _merge_digest_allgather(histo_state):
     return {
         "wv": new_m * new_w,
         "weights": new_w,
+        "swv": jnp.zeros_like(new_w),
+        "sweights": jnp.zeros_like(new_w),
         "dmin": jax.lax.pmin(histo_state["dmin"], SHARD_AXIS),
         "dmax": jax.lax.pmax(histo_state["dmax"], SHARD_AXIS),
         "drecip": jax.lax.psum(histo_state["drecip"], SHARD_AXIS),
@@ -159,7 +161,7 @@ def apply_shard_batches(state: Dict, batches: Dict) -> Dict:
             cstate, b["c_rows"], b["c_vals"], b["c_rates"])
         g = scalars.apply_gauges(gstate, b["g_rows"], b["g_vals"])
         h = batch_tdigest.apply_batch(
-            hstate, b["h_rows"], b["h_vals"], b["h_wts"])
+            hstate, b["h_rows"], b["h_vals"], b["h_wts"], b["h_slots"])
         s = batch_hll.apply_batch(
             sstate, b["s_rows"], b["s_idx"], b["s_rho"])
         return c, g, h, s
@@ -174,15 +176,22 @@ def make_shard_batches(n: int, num_keys: int, batch: int, seed: int = 0) -> Dict
     """Synthetic per-shard sample batches (for dryrun/bench)."""
     rng = np.random.default_rng(seed)
     f32 = np.float32
+    h_rows = rng.integers(0, num_keys, (n, batch)).astype(np.int32)
+    h_vals = rng.normal(100, 15, (n, batch)).astype(f32)
+    h_wts = np.ones((n, batch), f32)
+    h_slots = np.stack(
+        [batch_tdigest.batch_slots(h_rows[i], h_vals[i], h_wts[i], num_keys)
+         for i in range(n)])
     return {
         "c_rows": rng.integers(0, num_keys, (n, batch)).astype(np.int32),
         "c_vals": rng.random((n, batch)).astype(f32) * 10,
         "c_rates": np.ones((n, batch), f32),
         "g_rows": rng.integers(0, num_keys, (n, batch)).astype(np.int32),
         "g_vals": rng.random((n, batch)).astype(f32),
-        "h_rows": rng.integers(0, num_keys, (n, batch)).astype(np.int32),
-        "h_vals": rng.normal(100, 15, (n, batch)).astype(f32),
-        "h_wts": np.ones((n, batch), f32),
+        "h_rows": h_rows,
+        "h_vals": h_vals,
+        "h_wts": h_wts,
+        "h_slots": h_slots,
         "s_rows": rng.integers(0, num_keys, (n, batch)).astype(np.int32),
         "s_idx": rng.integers(0, batch_hll.M, (n, batch)).astype(np.int32),
         "s_rho": rng.integers(1, 30, (n, batch)).astype(np.int32),
