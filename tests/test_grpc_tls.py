@@ -1,0 +1,214 @@
+"""mTLS on the gRPC forward plane and per-RPC latency stats
+(reference proxy/proxy.go:33-120 TLS termination, proxy/grpcstats, and
+the testdata-cert pattern of server_test.go:561-1052)."""
+
+import os
+import time
+
+import grpc
+import pytest
+
+from veneur_tpu.config import Config
+from veneur_tpu.core.server import Server
+from veneur_tpu.forward.client import ForwardClient
+from veneur_tpu.forward.protos import metric_pb2
+from veneur_tpu.forward.server import ImportServer
+from veneur_tpu.proxy.proxy import create_static_proxy
+from veneur_tpu.sinks.channel import ChannelMetricSink
+from veneur_tpu.util.grpcstats import RpcStats
+from veneur_tpu.util.grpctls import GrpcTLS
+
+TESTDATA = os.path.join(os.path.dirname(__file__), "testdata")
+
+
+def tdpath(name):
+    return os.path.join(TESTDATA, name)
+
+
+SERVER_TLS = GrpcTLS(certificate=tdpath("server.pem"),
+                     key=tdpath("server.key"),
+                     authority=tdpath("ca.pem"))
+CLIENT_TLS = GrpcTLS(certificate=tdpath("client.pem"),
+                     key=tdpath("client.key"),
+                     authority=tdpath("ca.pem"))
+
+
+def make_global(**overrides):
+    cfg = Config()
+    cfg.interval = 10.0
+    cfg.hostname = "tls-test"
+    cfg.tpu.counter_capacity = 128
+    cfg.tpu.gauge_capacity = 128
+    cfg.tpu.histo_capacity = 128
+    cfg.tpu.set_capacity = 64
+    cfg.tpu.batch_cap = 512
+    for k, v in overrides.items():
+        setattr(cfg, k, v)
+    cfg.apply_defaults()
+    observer = ChannelMetricSink()
+    return Server(cfg, extra_metric_sinks=[observer]), observer
+
+
+def counter_proto(name, value):
+    pbm = metric_pb2.Metric()
+    pbm.name = name
+    pbm.type = metric_pb2.Counter
+    pbm.scope = metric_pb2.Global
+    pbm.counter.value = value
+    return pbm
+
+
+class TestForwardPlaneTLS:
+    def test_mutual_tls_forward_roundtrip(self):
+        server, _obs = make_global()
+        imp = ImportServer(server, "localhost:0", tls=SERVER_TLS)
+        imp.start()
+        try:
+            client = ForwardClient(f"localhost:{imp.port}", deadline=10.0,
+                                   tls=CLIENT_TLS)
+            n = client.send_protos([counter_proto("tls.fwd", 7)])
+            assert n == 1
+            deadline = time.time() + 5
+            while imp.imported_total < 1 and time.time() < deadline:
+                time.sleep(0.05)
+            assert imp.imported_total == 1
+            client.close()
+        finally:
+            imp.stop()
+
+    def test_client_without_certs_rejected(self):
+        server, _obs = make_global()
+        imp = ImportServer(server, "localhost:0", tls=SERVER_TLS)
+        imp.start()
+        try:
+            # CA only, no client cert: the server requires client auth
+            bare = ForwardClient(
+                f"localhost:{imp.port}", deadline=3.0,
+                tls=GrpcTLS(authority=tdpath("ca.pem")))
+            with pytest.raises(grpc.RpcError):
+                bare.send_protos([counter_proto("tls.nope", 1)])
+            bare.close()
+            assert imp.imported_total == 0
+        finally:
+            imp.stop()
+
+    def test_plaintext_client_rejected(self):
+        server, _obs = make_global()
+        imp = ImportServer(server, "localhost:0", tls=SERVER_TLS)
+        imp.start()
+        try:
+            plain = ForwardClient(f"localhost:{imp.port}", deadline=3.0)
+            with pytest.raises(grpc.RpcError):
+                plain.send_protos([counter_proto("tls.plain", 1)])
+            plain.close()
+            assert imp.imported_total == 0
+        finally:
+            imp.stop()
+
+
+class TestProxyTLS:
+    def test_proxy_terminates_tls_and_dials_tls(self):
+        """Client --mTLS--> proxy --mTLS--> global import server."""
+        server, _obs = make_global()
+        imp = ImportServer(server, "localhost:0", tls=SERVER_TLS)
+        imp.start()
+        proxy = create_static_proxy(
+            [f"localhost:{imp.port}"], listen_address="localhost:0",
+            tls=SERVER_TLS, destination_tls=CLIENT_TLS)
+        proxy.start()
+        try:
+            client = ForwardClient(f"localhost:{proxy.port}", deadline=10.0,
+                                   tls=CLIENT_TLS)
+            client.send_protos(
+                [counter_proto(f"tls.proxy.{i}", i) for i in range(10)])
+            client.close()
+            deadline = time.time() + 8
+            while imp.imported_total < 10 and time.time() < deadline:
+                time.sleep(0.05)
+            assert imp.imported_total == 10
+            assert proxy.stats["routed_total"] == 10
+            # per-RPC latency stats recorded (reference proxy/grpcstats)
+            snap = proxy.rpc_stats.snapshot()
+            assert snap["SendMetricsV2"]["count"] == 1
+            assert snap["SendMetricsV2"]["errors"] == 0
+            assert snap["SendMetricsV2"]["max_s"] > 0
+        finally:
+            proxy.stop()
+            imp.stop()
+
+
+class TestRpcStats:
+    def test_timed_records_success_and_error(self):
+        stats = RpcStats()
+        ok = stats.timed("M", lambda req, ctx: "done")
+        assert ok(None, None) == "done"
+        boom = stats.timed("M", lambda req, ctx: 1 / 0)
+        with pytest.raises(ZeroDivisionError):
+            boom(None, None)
+        snap = stats.snapshot()["M"]
+        assert snap["count"] == 2
+        assert snap["errors"] == 1
+        assert snap["total_s"] >= 0
+
+    def test_emit_surface(self):
+        calls = []
+
+        class FakeStatsd:
+            def count(self, name, value, tags=None):
+                calls.append(("count", name, value, tuple(tags or ())))
+
+            def gauge(self, name, value, tags=None):
+                calls.append(("gauge", name, value, tuple(tags or ())))
+
+        stats = RpcStats()
+        stats.record("SendMetricsV2", 0.01, ok=True)
+        stats.record("SendMetricsV2", 0.03, ok=False)
+        stats.emit(FakeStatsd(), prefix="import.rpc")
+        names = {c[1] for c in calls}
+        assert names == {"import.rpc.count", "import.rpc.errors",
+                         "import.rpc.avg_duration_ns",
+                         "import.rpc.max_duration_ns"}
+        by_name = {c[1]: c for c in calls}
+        assert by_name["import.rpc.count"][2] == 2
+        assert by_name["import.rpc.errors"][2] == 1
+        assert by_name["import.rpc.max_duration_ns"][2] == int(0.03 * 1e9)
+        assert by_name["import.rpc.count"][3] == ("method:SendMetricsV2",)
+
+
+class TestServerConfigTLS:
+    def test_import_server_tls_from_config(self):
+        """grpc_tls_* config terminates TLS on the import plane; the
+        local's forward_tls_* dial it with client certs."""
+        cfg_over = dict(
+            grpc_address="localhost:0",
+            grpc_tls_certificate=tdpath("server.pem"),
+            grpc_tls_authority_certificate=tdpath("ca.pem"),
+        )
+        glob, obs = make_global(**cfg_over)
+        from veneur_tpu.util.secret import StringSecret
+        glob.config.grpc_tls_key = StringSecret(tdpath("server.key"))
+        glob.start()
+        try:
+            addr = glob.import_server.address
+            local_cfg_over = dict(
+                forward_address=addr,
+                forward_tls_certificate=tdpath("client.pem"),
+                forward_tls_authority_certificate=tdpath("ca.pem"),
+            )
+            local, _ = make_global(**local_cfg_over)
+            local.config.forward_tls_key = StringSecret(tdpath("client.key"))
+            local.start()
+            try:
+                local.handle_metric_packet(b"cfg.tls:4|c|#veneurglobalonly")
+                local.flush()
+                deadline = time.time() + 8
+                while (glob.import_server.imported_total < 1
+                       and time.time() < deadline):
+                    time.sleep(0.05)
+                assert glob.import_server.imported_total == 1
+                snap = glob.import_server.rpc_stats.snapshot()
+                assert snap["SendMetricsV2"]["count"] == 1
+            finally:
+                local.shutdown()
+        finally:
+            glob.shutdown()

@@ -42,6 +42,7 @@ class CapturingHTTPServer:
                 self.wfile.write(b"{}")
 
             do_GET = do_POST  # noqa: N815
+            do_PUT = do_POST  # noqa: N815
 
         self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
         threading.Thread(target=self.httpd.serve_forever,
@@ -542,3 +543,79 @@ class TestRegistry:
         for kind in ("datadog", "kafka", "splunk", "xray", "falconer",
                      "lightstep", "newrelic"):
             assert kind in sinks_mod.SpanSinkTypes, kind
+
+
+class TestDatadogSpanDepth:
+    """Reference datadog.go:453-660 span-path semantics."""
+
+    def test_ring_overflow_accounting(self):
+        from veneur_tpu.sinks.datadog import DatadogSpanSink
+        sink = DatadogSpanSink("datadog", trace_api_url="http://x",
+                               hostname="dh", buffer_size=4)
+        for i in range(7):
+            sink.ingest(make_span(trace_id=1, span_id=i + 1))
+        assert len(sink.buffer) == 4  # oldest overwritten, never blocks
+        assert sink.overwritten_total == 3
+        ids = [s.id for s in sink.buffer]
+        assert ids == [4, 5, 6, 7]
+
+    def test_dd_span_shape(self, fake):
+        from veneur_tpu.sinks.datadog import DatadogSpanSink
+        sink = DatadogSpanSink("datadog", trace_api_url=fake.url,
+                               hostname="dh")
+        root = make_span(trace_id=9, span_id=1, parent_id=-1,
+                         tags={"resource": "GET /x", "env": "t"})
+        root.error = True
+        sink.ingest(root)
+        child = make_span(trace_id=9, span_id=2, parent_id=1)
+        child.name = ""
+        sink.ingest(child)
+        sink.flush()
+        path, headers, body = fake.requests[0]
+        assert path == "/v0.3/traces"
+        # the traces endpoint takes an uncompressed PUT
+        assert headers.get("Content-Encoding") is None
+        traces = json.loads(body)
+        assert len(traces) == 1
+        by_id = {s["span_id"]: s for s in traces[0]}
+        assert by_id[1]["parent_id"] == 0        # root clamps to 0
+        assert by_id[1]["resource"] == "GET /x"  # promoted out of meta
+        assert "resource" not in by_id[1]["meta"]
+        assert by_id[1]["error"] == 2
+        assert by_id[1]["type"] == "web"
+        assert by_id[2]["name"] == "unknown"
+        assert by_id[2]["resource"] == "unknown"
+
+    def test_flush_self_metrics_per_service(self, fake):
+        from veneur_tpu.sinks.datadog import DatadogSpanSink
+        calls = []
+
+        class FakeStatsd:
+            def count(self, name, value, tags=None):
+                calls.append((name, value, tuple(tags or ())))
+
+            def gauge(self, name, value, tags=None):
+                calls.append((name, value, tuple(tags or ())))
+
+        class FakeServer:
+            statsd = FakeStatsd()
+
+        sink = DatadogSpanSink("datadog", trace_api_url=fake.url,
+                               hostname="dh")
+        sink.start(FakeServer())
+        s1 = make_span(trace_id=1, span_id=1)
+        s1.service = "api"
+        s2 = make_span(trace_id=2, span_id=2)
+        s2.service = "api"
+        s3 = make_span(trace_id=3, span_id=3)
+        s3.service = "db"
+        for s in (s1, s2, s3):
+            sink.ingest(s)
+        sink.flush()
+        flushed = {c for c in calls if c[0] == "sink.spans_flushed_total"}
+        assert ("sink.spans_flushed_total", 2,
+                ("sink:datadog", "service:api")) in flushed
+        assert ("sink.spans_flushed_total", 1,
+                ("sink:datadog", "service:db")) in flushed
+        assert any(c[0] == "sink.span_flush_total_duration_ns"
+                   for c in calls)

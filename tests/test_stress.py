@@ -1,0 +1,255 @@
+"""Concurrency stress: the race-detector analog for the ingest hot path.
+
+The reference runs its whole suite under `go test -race`
+(reference .circleci/config.yml:68-72); Python has no race detector, so
+this suite hammers the lock choreography directly: N reader threads, a
+concurrent flush ticker, and an import stream all target ONE column
+store for a few seconds, then sample conservation is asserted — every
+counter increment sent must appear in exactly one flush, and the run
+must terminate (no deadlock) within the test timeout.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from veneur_tpu.config import Config
+from veneur_tpu.core.server import Server
+from veneur_tpu.forward.client import ForwardClient
+from veneur_tpu.forward.protos import metric_pb2
+from veneur_tpu.sinks.channel import ChannelMetricSink
+
+DURATION_S = 4.0
+READERS = 4
+
+
+def make_server(**overrides):
+    cfg = Config()
+    cfg.interval = 3600.0  # flushes are driven manually below
+    cfg.hostname = "stress"
+    cfg.tpu.counter_capacity = 1024
+    cfg.tpu.gauge_capacity = 1024
+    cfg.tpu.histo_capacity = 1024
+    cfg.tpu.set_capacity = 256
+    cfg.tpu.batch_cap = 1024
+    for k, v in overrides.items():
+        setattr(cfg, k, v)
+    cfg.apply_defaults()
+    observer = ChannelMetricSink()
+    return Server(cfg, extra_metric_sinks=[observer]), observer
+
+
+class TestIngestFlushRaces:
+    def test_sample_conservation_under_concurrent_flush(self):
+        """Readers + a fast flusher racing on one store: the sum of
+        flushed counter values equals exactly the samples ingested —
+        nothing lost in a buffer swap, nothing double-counted."""
+        server, observer = make_server()
+        n_keys = 64
+        datagrams = [
+            b"\n".join(b"race.c%d:1|c" % k for k in range(n_keys))
+            for _ in range(8)]
+        sent = [0] * READERS
+        stop = threading.Event()
+
+        def reader(slot):
+            while not stop.is_set():
+                server.handle_packet_batch(datagrams)
+                sent[slot] += len(datagrams) * n_keys
+
+        flushed = []
+
+        def flusher():
+            while not stop.is_set():
+                server.flush()
+                for metric in observer.drain():
+                    if metric.name.startswith("race.c"):
+                        flushed.append(metric.value)
+                time.sleep(0.02)
+
+        threads = [threading.Thread(target=reader, args=(i,), daemon=True)
+                   for i in range(READERS)]
+        threads.append(threading.Thread(target=flusher, daemon=True))
+        for t in threads:
+            t.start()
+        time.sleep(DURATION_S)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+            assert not t.is_alive(), "thread failed to stop (deadlock?)"
+
+        # final drain: apply whatever is still pending, flush once more
+        server.store.apply_all_pending()
+        server.flush()
+        for metric in observer.drain():
+            if metric.name.startswith("race.c"):
+                flushed.append(metric.value)
+
+        assert sum(flushed) == pytest.approx(sum(sent)), (
+            f"lost/duplicated samples: flushed {sum(flushed)} "
+            f"of {sum(sent)} sent")
+
+    def test_histo_weight_conservation_under_concurrent_flush(self):
+        """Timers under racing flushes: total flushed digest weight
+        (the .count aggregate) equals samples sent — exercises the
+        staging-grid swap + compact + snapshot path."""
+        server, observer = make_server(
+            aggregates=["count"], percentiles=[0.5])
+        rng = np.random.default_rng(0)
+        datagrams = [
+            b"\n".join(b"race.t%d:%.2f|ms" % (k, v)
+                       for k, v in enumerate(rng.normal(50, 5, 32)))
+            for _ in range(8)]
+        per_batch = 8 * 32
+        sent = [0] * READERS
+        stop = threading.Event()
+
+        def reader(slot):
+            while not stop.is_set():
+                server.handle_packet_batch(datagrams)
+                sent[slot] += per_batch
+
+        counts = []
+
+        def flusher():
+            while not stop.is_set():
+                server.flush()
+                for metric in observer.drain():
+                    if metric.name.endswith(".count"):
+                        counts.append(metric.value)
+                time.sleep(0.02)
+
+        threads = [threading.Thread(target=reader, args=(i,), daemon=True)
+                   for i in range(READERS)]
+        threads.append(threading.Thread(target=flusher, daemon=True))
+        for t in threads:
+            t.start()
+        time.sleep(DURATION_S)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+            assert not t.is_alive(), "thread failed to stop (deadlock?)"
+        server.store.apply_all_pending()
+        server.flush()
+        for metric in observer.drain():
+            if metric.name.endswith(".count"):
+                counts.append(metric.value)
+        # f32 weight accumulation: exact for these magnitudes
+        assert sum(counts) == pytest.approx(sum(sent), rel=1e-6)
+
+    def test_import_stream_races_readers_and_flusher(self):
+        """The global-side triple: local readers + forwarded imports +
+        flusher on one store; counter conservation across both planes."""
+        server, observer = make_server(grpc_address="127.0.0.1:0")
+        server.start()
+        try:
+            client = ForwardClient(server.import_server.address,
+                                   deadline=10.0)
+            datagrams = [b"\n".join(b"race.m%d:1|c" % k for k in range(32))]
+            local_sent = [0] * 2
+            import_sent = [0]
+            stop = threading.Event()
+
+            def reader(slot):
+                while not stop.is_set():
+                    server.handle_packet_batch(datagrams)
+                    local_sent[slot] += 32
+
+            def importer():
+                while not stop.is_set():
+                    protos = []
+                    for k in range(16):
+                        pbm = metric_pb2.Metric()
+                        pbm.name = f"race.g{k}"
+                        pbm.type = metric_pb2.Counter
+                        pbm.scope = metric_pb2.Global
+                        pbm.counter.value = 3
+                        protos.append(pbm)
+                    client.send_protos(protos)
+                    import_sent[0] += 16 * 3
+                    time.sleep(0.01)
+
+            flushed = []
+
+            def flusher():
+                while not stop.is_set():
+                    server.flush()
+                    for metric in observer.drain():
+                        if metric.name.startswith("race."):
+                            flushed.append(metric.value)
+                    time.sleep(0.02)
+
+            threads = [threading.Thread(target=reader, args=(i,),
+                                        daemon=True) for i in range(2)]
+            threads.append(threading.Thread(target=importer, daemon=True))
+            threads.append(threading.Thread(target=flusher, daemon=True))
+            for t in threads:
+                t.start()
+            time.sleep(DURATION_S)
+            stop.set()
+            for t in threads:
+                t.join(timeout=30)
+                assert not t.is_alive(), "thread failed to stop (deadlock?)"
+            client.close()
+            server.store.apply_all_pending()
+            server.flush()
+            for metric in observer.drain():
+                if metric.name.startswith("race."):
+                    flushed.append(metric.value)
+            want = sum(local_sent) + import_sent[0]
+            assert sum(flushed) == pytest.approx(want)
+        finally:
+            server.shutdown()
+
+    def test_capacity_growth_under_load(self):
+        """Interning new keys (forcing capacity doubles and device-state
+        re-layout) while other threads ingest and flush."""
+        server, observer = make_server()
+        stop = threading.Event()
+        sent_known = [0]
+        sent_new = [0]
+
+        def known_reader():
+            dgram = b"\n".join(b"grow.k%d:1|c" % k for k in range(16))
+            while not stop.is_set():
+                server.handle_packet_batch([dgram])
+                sent_known[0] += 16
+
+        def new_key_reader():
+            i = 0
+            while not stop.is_set():
+                batch = b"\n".join(
+                    b"grow.n%d:1|c" % (i + j) for j in range(64))
+                server.handle_packet_batch([batch])
+                sent_new[0] += 64
+                i += 64
+
+        flushed = []
+
+        def flusher():
+            while not stop.is_set():
+                server.flush()
+                for metric in observer.drain():
+                    if metric.name.startswith("grow."):
+                        flushed.append(metric.value)
+                time.sleep(0.05)
+
+        threads = [threading.Thread(target=known_reader, daemon=True),
+                   threading.Thread(target=new_key_reader, daemon=True),
+                   threading.Thread(target=flusher, daemon=True)]
+        for t in threads:
+            t.start()
+        time.sleep(DURATION_S)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+            assert not t.is_alive(), "thread failed to stop (deadlock?)"
+        server.store.apply_all_pending()
+        server.flush()
+        for metric in observer.drain():
+            if metric.name.startswith("grow."):
+                flushed.append(metric.value)
+        assert server.store.counters.capacity > 1024  # growth happened
+        assert sum(flushed) == pytest.approx(sent_known[0] + sent_new[0])

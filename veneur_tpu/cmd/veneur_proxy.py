@@ -32,6 +32,18 @@ def main(argv=None) -> int:
                     help="healthcheck HTTP address (host:port)")
     ap.add_argument("-discovery-interval", default="10s")
     ap.add_argument("-forward-service", default="veneur-global")
+    ap.add_argument("-tls-cert", default="",
+                    help="server TLS certificate (PEM or path)")
+    ap.add_argument("-tls-key", default="",
+                    help="server TLS private key (PEM or path)")
+    ap.add_argument("-tls-ca", default="",
+                    help="CA bundle; presence requires client certs (mTLS)")
+    ap.add_argument("-dest-tls-ca", default="",
+                    help="CA bundle for verifying destination servers")
+    ap.add_argument("-dest-tls-cert", default="",
+                    help="client certificate for dialing destinations")
+    ap.add_argument("-dest-tls-key", default="",
+                    help="client key for dialing destinations")
     ap.add_argument("-debug", action="store_true")
     args = ap.parse_args(argv)
 
@@ -75,11 +87,23 @@ def main(argv=None) -> int:
         log.info("using Kubernetes discovery")
     else:
         discoverer = StaticDiscoverer(destinations)
+    from veneur_tpu.util.grpctls import GrpcTLS
+    tls = GrpcTLS(certificate=raw.get("tls_certificate", args.tls_cert),
+                  key=raw.get("tls_key", args.tls_key),
+                  authority=raw.get("tls_authority_certificate",
+                                    args.tls_ca))
+    dest_tls = GrpcTLS(
+        certificate=raw.get("forward_tls_certificate", args.dest_tls_cert),
+        key=raw.get("forward_tls_key", args.dest_tls_key),
+        authority=raw.get("forward_tls_authority_certificate",
+                          args.dest_tls_ca))
     proxy = ProxyServer(
         discoverer,
         forward_service=forward_service,
         listen_address=listen,
-        discovery_interval=interval)
+        discovery_interval=interval,
+        tls=tls or None,
+        destination_tls=dest_tls or None)
     proxy.start()
     log.info("veneur-proxy listening on %s -> %s", proxy.address,
              destinations)

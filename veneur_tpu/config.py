@@ -137,6 +137,16 @@ class Config:
     tls_authority_certificate: str = ""
     tls_certificate: str = ""
     tls_key: StringSecret = field(default_factory=StringSecret)
+    # mTLS for the gRPC forward plane: grpc_tls_* terminate TLS on the
+    # import server (grpc_address); forward_tls_* are the client
+    # credentials used when dialing forward_address. Values are inline
+    # PEM or file paths, like the TCP tls_* fields.
+    grpc_tls_certificate: str = ""
+    grpc_tls_key: StringSecret = field(default_factory=StringSecret)
+    grpc_tls_authority_certificate: str = ""
+    forward_tls_certificate: str = ""
+    forward_tls_key: StringSecret = field(default_factory=StringSecret)
+    forward_tls_authority_certificate: str = ""
     trace_max_length_bytes: int = 16 * 1024 * 1024
     veneur_metrics_additional_tags: List[str] = field(default_factory=list)
     veneur_metrics_scopes: Dict[str, str] = field(default_factory=dict)

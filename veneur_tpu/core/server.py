@@ -347,8 +347,15 @@ class Server:
                 addr, self, rcvbuf=self.config.read_buffer_size_bytes))
         if self.config.forward_address and self.forwarder is None:
             from veneur_tpu.forward.client import ForwardClient
+            from veneur_tpu.util.grpctls import GrpcTLS
+            fwd_tls = GrpcTLS(
+                certificate=self.config.forward_tls_certificate,
+                key=(self.config.forward_tls_key.reveal()
+                     if self.config.forward_tls_key else ""),
+                authority=self.config.forward_tls_authority_certificate)
             self.forward_client = ForwardClient(
-                self.config.forward_address, deadline=self.interval)
+                self.config.forward_address, deadline=self.interval,
+                tls=fwd_tls or None)
             self.forwarder = self.forward_client.forward
         for addr in self.config.grpc_listen_addresses:
             from veneur_tpu.core.grpc_ingest import GrpcIngestServer
@@ -357,11 +364,18 @@ class Server:
             self.grpc_ingest_servers.append(gi)
         if self.config.grpc_address:
             from veneur_tpu.forward.server import ImportServer
+            from veneur_tpu.util.grpctls import GrpcTLS
             from veneur_tpu.util.matcher import TagMatcher
             ignored = [TagMatcher(kind="prefix", value=t)
                        for t in self.config.tags_exclude]
+            grpc_tls = GrpcTLS(
+                certificate=self.config.grpc_tls_certificate,
+                key=(self.config.grpc_tls_key.reveal()
+                     if self.config.grpc_tls_key else ""),
+                authority=self.config.grpc_tls_authority_certificate)
             self.import_server = ImportServer(
-                self, self.config.grpc_address, ignored_tags=ignored)
+                self, self.config.grpc_address, ignored_tags=ignored,
+                tls=grpc_tls or None)
             self.import_server.start()
         for source in self.sources:
             t = threading.Thread(target=source.start, args=(self,),
@@ -593,6 +607,9 @@ class Server:
                 self.interval, ", ".join(stuck))
             self.statsd.count("flush.timeout_total", len(stuck))
 
+        if self.import_server is not None:
+            # per-RPC latency/error aggregates (reference proxy/grpcstats)
+            self.import_server.rpc_stats.emit(self.statsd, prefix="import.rpc")
         flush_span.finish()
         duration = time.perf_counter() - flush_start
         self.statsd.gauge("flush.total_duration_ns", int(duration * 1e9))

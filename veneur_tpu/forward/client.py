@@ -16,6 +16,7 @@ import grpc
 from veneur_tpu.core.flusher import ForwardableState
 from veneur_tpu.forward.convert import forwardable_to_protos
 from veneur_tpu.forward.protos import metric_pb2
+from veneur_tpu.util.grpctls import GrpcTLS, secure_or_insecure_channel
 
 logger = logging.getLogger("veneur_tpu.forward.client")
 
@@ -27,10 +28,11 @@ class ForwardClient:
     API (no generated stubs needed)."""
 
     def __init__(self, address: str, deadline: float = 10.0,
-                 channel: Optional[grpc.Channel] = None):
+                 channel: Optional[grpc.Channel] = None,
+                 tls: Optional[GrpcTLS] = None):
         self.address = address
         self.deadline = deadline
-        self._channel = channel or grpc.insecure_channel(address)
+        self._channel = channel or secure_or_insecure_channel(address, tls)
         self._send_v2 = self._channel.stream_unary(
             "/forwardrpc.Forward/SendMetricsV2",
             request_serializer=metric_pb2.Metric.SerializeToString,

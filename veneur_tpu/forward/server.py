@@ -23,6 +23,8 @@ from veneur_tpu.forward.protos import forward_pb2, metric_pb2
 from veneur_tpu.ops import batch_tdigest, hll_ref
 from veneur_tpu.samplers import metrics as m
 from veneur_tpu.samplers.metrics import MetricScope, UDPMetric
+from veneur_tpu.util.grpcstats import RpcStats
+from veneur_tpu.util.grpctls import GrpcTLS
 from veneur_tpu.util.matcher import TagMatcher
 
 logger = logging.getLogger("veneur_tpu.forward.server")
@@ -33,23 +35,29 @@ _CHUNK = 512
 class ImportServer:
     def __init__(self, server, address: str = "127.0.0.1:0",
                  ignored_tags: Optional[List[TagMatcher]] = None,
-                 max_workers: int = 4):
+                 max_workers: int = 4,
+                 tls: Optional[GrpcTLS] = None):
         self._server = server
         self._ignored = list(ignored_tags or [])
+        self.rpc_stats = RpcStats()
         self._grpc = grpc.server(
             futures.ThreadPoolExecutor(max_workers=max_workers))
         handler = grpc.method_handlers_generic_handler("forwardrpc.Forward", {
             "SendMetricsV2": grpc.stream_unary_rpc_method_handler(
-                self._send_metrics_v2,
+                self.rpc_stats.timed("SendMetricsV2", self._send_metrics_v2),
                 request_deserializer=metric_pb2.Metric.FromString,
                 response_serializer=lambda _: b""),
             "SendMetrics": grpc.unary_unary_rpc_method_handler(
-                self._send_metrics_v1,
+                self.rpc_stats.timed("SendMetrics", self._send_metrics_v1),
                 request_deserializer=forward_pb2.MetricList.FromString,
                 response_serializer=lambda _: b""),
         })
         self._grpc.add_generic_rpc_handlers((handler,))
-        self.port = self._grpc.add_insecure_port(address)
+        if tls:
+            self.port = self._grpc.add_secure_port(
+                address, tls.server_credentials())
+        else:
+            self.port = self._grpc.add_insecure_port(address)
         if self.port == 0:
             raise RuntimeError(f"could not bind import server to {address}")
         self.imported_total = 0

@@ -171,19 +171,61 @@ def batch_slots(rows, values, weights, num_keys):
     return slots
 
 
-_REDUCE_CHUNK = 2048  # rows per one-hot matmul chunk (bounds workspace)
+# one-hot workspace budget per lax.map chunk: 2^25 f32 elements = 128 MB.
+# Rows per chunk derive from it, so a wide merge (J = shards x 2C) gets
+# proportionally fewer rows per chunk instead of a multi-GB workspace.
+_REDUCE_BUDGET_ELEMS = 1 << 25
 
 
 def _segment_reduce_sorted(bucket, sw, swv):
     """Per-row segment sums of `sw`/`swv` grouped by `bucket` (K, J) into
-    C buckets, as a one-hot batched matmul — the MXU segment-reduce.
-    Rows are processed in fixed chunks under `lax.map` so the (chunk, J,
-    C) one-hot workspace stays a few hundred MB at any table capacity.
-    (A gather-based prefix-sum formulation is asymptotically lighter but
-    per-row `take_along_axis` gathers are ~100x slower than MXU dots on
-    TPU — measured 1.65 s vs ~20 ms for K=100k, J=256.)"""
+    C buckets. Backend-adaptive at trace time: TPU uses a one-hot batched
+    matmul (the MXU segment-reduce — per-row `take_along_axis` gathers
+    measured ~100x slower there: 1.65 s vs ~20 ms for K=100k, J=256);
+    CPU (the virtual validation mesh) uses a binary-search prefix-sum
+    formulation, where the same matmul is ~50x slower than gathers."""
+    import jax as _jax
+
+    if _jax.default_backend() == "tpu":
+        return _segment_reduce_matmul(bucket, sw, swv)
+    return _segment_reduce_gather(bucket, sw, swv)
+
+
+def _segment_reduce_gather(bucket, sw, swv):
+    """Prefix sums + vectorized binary search for segment boundaries:
+    bucket is non-decreasing along J, so each bucket's sum is a
+    difference of prefix sums at its boundary. O(K·J) memory."""
     k_rows, j = bucket.shape
-    kc = min(_REDUCE_CHUNK, k_rows)
+    cumw = jnp.cumsum(sw, axis=-1)
+    cumwv = jnp.cumsum(swv, axis=-1)
+    # lo converges to #{j : bucket[k, j] <= c}; answer space [0, j] has
+    # j+1 candidates, and the lo<hi guard freezes converged lanes
+    lo = jnp.zeros((k_rows, C), jnp.int32)
+    hi = jnp.full((k_rows, C), j, jnp.int32)
+    targets = jnp.arange(C, dtype=jnp.int32)[None, :]
+    for _ in range(max(1, math.ceil(math.log2(j + 1)))):
+        active = lo < hi
+        mid = (lo + hi) >> 1
+        b_mid = jnp.take_along_axis(bucket, jnp.minimum(mid, j - 1), axis=1)
+        go_right = (b_mid <= targets) & active
+        lo = jnp.where(go_right, mid + 1, lo)
+        hi = jnp.where(go_right | ~active, hi, mid)
+    gather_at = jnp.maximum(lo - 1, 0)
+    gw = jnp.where(lo > 0,
+                   jnp.take_along_axis(cumw, gather_at, axis=1), 0.0)
+    gwv = jnp.where(lo > 0,
+                    jnp.take_along_axis(cumwv, gather_at, axis=1), 0.0)
+    zero_col = jnp.zeros((k_rows, 1), jnp.float32)
+    new_w = gw - jnp.concatenate([zero_col, gw[:, :-1]], axis=-1)
+    new_wv = gwv - jnp.concatenate([zero_col, gwv[:, :-1]], axis=-1)
+    return new_w, new_wv
+
+
+def _segment_reduce_matmul(bucket, sw, swv):
+    """One-hot batched matmul, chunked under `lax.map` so the (chunk, J,
+    C) one-hot workspace stays bounded at any table capacity."""
+    k_rows, j = bucket.shape
+    kc = max(1, min(k_rows, _REDUCE_BUDGET_ELEMS // (j * C)))
     pad = (-k_rows) % kc
     if pad:
         bucket = jnp.pad(bucket, ((0, pad), (0, 0)))

@@ -20,6 +20,7 @@ import grpc
 
 from veneur_tpu.forward.protos import metric_pb2
 from veneur_tpu.proxy.ring import ConsistentRing, EmptyRingError
+from veneur_tpu.util.grpctls import GrpcTLS, secure_or_insecure_channel
 
 logger = logging.getLogger("veneur_tpu.proxy.destinations")
 
@@ -31,7 +32,8 @@ class Destination:
                  on_close: Callable[["Destination"], None],
                  send_buffer: int = 4096, batch: int = 512,
                  flush_interval: float = 0.5,
-                 max_consecutive_failures: int = 3):
+                 max_consecutive_failures: int = 3,
+                 tls: Optional[GrpcTLS] = None):
         self.address = address
         self._on_close = on_close
         self._queue: "queue.Queue" = queue.Queue(maxsize=send_buffer)
@@ -42,7 +44,7 @@ class Destination:
         self.closed = threading.Event()
         self.sent_total = 0
         self.dropped_total = 0
-        self._channel = grpc.insecure_channel(address)
+        self._channel = secure_or_insecure_channel(address, tls)
         self._send_v2 = self._channel.stream_unary(
             "/forwardrpc.Forward/SendMetricsV2",
             request_serializer=metric_pb2.Metric.SerializeToString,
@@ -126,13 +128,15 @@ class Destinations:
     """The live pool: address -> Destination plus the ring."""
 
     def __init__(self, send_buffer: int = 4096, batch: int = 512,
-                 flush_interval: float = 0.5):
+                 flush_interval: float = 0.5,
+                 tls: Optional[GrpcTLS] = None):
         self._lock = threading.RLock()
         self._pool: Dict[str, Destination] = {}
         self.ring = ConsistentRing()
         self._send_buffer = send_buffer
         self._batch = batch
         self._flush_interval = flush_interval
+        self._tls = tls
 
     def set_destinations(self, addresses: List[str]) -> None:
         """Reconcile the pool with a fresh discovery result."""
@@ -146,7 +150,7 @@ class Destinations:
                     self._pool[address] = Destination(
                         address, self._on_destination_closed,
                         send_buffer=self._send_buffer, batch=self._batch,
-                        flush_interval=self._flush_interval)
+                        flush_interval=self._flush_interval, tls=self._tls)
                     self.ring.add(address)
 
     def addresses(self) -> List[str]:

@@ -23,6 +23,8 @@ from veneur_tpu.forward.protos import forward_pb2, metric_pb2
 from veneur_tpu.proxy.destinations import Destinations
 from veneur_tpu.proxy.discovery import Discoverer, StaticDiscoverer
 from veneur_tpu.proxy.ring import EmptyRingError
+from veneur_tpu.util.grpcstats import RpcStats
+from veneur_tpu.util.grpctls import GrpcTLS
 from veneur_tpu.util.matcher import TagMatcher
 
 logger = logging.getLogger("veneur_tpu.proxy")
@@ -35,13 +37,17 @@ class ProxyServer:
                  discovery_interval: float = 10.0,
                  ignore_tags: Optional[List[TagMatcher]] = None,
                  send_buffer: int = 4096, batch: int = 512,
-                 max_workers: int = 8):
+                 max_workers: int = 8,
+                 tls: Optional[GrpcTLS] = None,
+                 destination_tls: Optional[GrpcTLS] = None):
         self.discoverer = discoverer
         self.forward_service = forward_service
         self.discovery_interval = discovery_interval
         self._ignore = list(ignore_tags or [])
         self.destinations = Destinations(
-            send_buffer=send_buffer, batch=batch)
+            send_buffer=send_buffer, batch=batch, tls=destination_tls)
+        # per-RPC latency/error aggregates (reference proxy/grpcstats)
+        self.rpc_stats = RpcStats()
         self.stats: Dict[str, int] = {
             "received_total": 0, "routed_total": 0,
             "no_destination_total": 0, "dropped_total": 0,
@@ -56,16 +62,22 @@ class ProxyServer:
             futures.ThreadPoolExecutor(max_workers=max_workers))
         handler = grpc.method_handlers_generic_handler("forwardrpc.Forward", {
             "SendMetricsV2": grpc.stream_unary_rpc_method_handler(
-                self._send_metrics_v2,
+                self.rpc_stats.timed("SendMetricsV2", self._send_metrics_v2),
                 request_deserializer=metric_pb2.Metric.FromString,
                 response_serializer=lambda _: b""),
             "SendMetrics": grpc.unary_unary_rpc_method_handler(
-                self._send_metrics_v1,
+                self.rpc_stats.timed("SendMetrics", self._send_metrics_v1),
                 request_deserializer=forward_pb2.MetricList.FromString,
                 response_serializer=lambda _: b""),
         })
         self._grpc.add_generic_rpc_handlers((handler,))
-        self.port = self._grpc.add_insecure_port(listen_address)
+        if tls:
+            # the proxy terminates TLS on the forward plane (reference
+            # proxy/proxy.go:33-120); authority => mutual auth
+            self.port = self._grpc.add_secure_port(
+                listen_address, tls.server_credentials())
+        else:
+            self.port = self._grpc.add_insecure_port(listen_address)
         if self.port == 0:
             raise RuntimeError(f"could not bind proxy to {listen_address}")
         self._listen_host = listen_address.rpartition(":")[0]

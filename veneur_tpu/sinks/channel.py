@@ -27,6 +27,15 @@ class ChannelMetricSink(MetricSink):
     def wait_flush(self, timeout: float = 5.0) -> List[InterMetric]:
         return self.queue.get(timeout=timeout)
 
+    def drain(self) -> List[InterMetric]:
+        """Non-blocking: every metric from every flush delivered so far."""
+        out: List[InterMetric] = []
+        while True:
+            try:
+                out.extend(self.queue.get_nowait())
+            except queue.Empty:
+                return out
+
 
 class ChannelSpanSink(SpanSink):
     def __init__(self, name: str = "channel_span", q: Optional[queue.Queue] = None):

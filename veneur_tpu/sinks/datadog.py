@@ -176,9 +176,26 @@ class DatadogMetricSink(MetricSink):
             self._post_safe("/intake", {"events": {self._name: events}})
 
 
+# reference datadog.go:536-538 timestamp plausibility window (seconds /
+# microseconds since epoch): spans outside it count as timestamp errors
+_SPAN_TS_TOO_EARLY = 1497
+_SPAN_TS_TOO_LATE = 1497629343000000
+
+_DD_SPAN_TYPE = "web"  # reference datadog.go:31 datadogSpanType
+_DD_RESOURCE_KEY = "resource"  # datadog.go:27
+
+
 class DatadogSpanSink(SpanSink):
-    """Buffers spans in a bounded ring, flushes Datadog APM traces JSON
-    (reference datadog.go span path)."""
+    """Bounded span ring -> Datadog APM traces (reference datadog.go
+    span path, :453-660): the ring overwrites its oldest entry when full
+    (overflow is counted, not blocked on), flush converts each span to
+    the DD trace-span shape — resource tag promoted out of meta with an
+    "unknown" default, root spans get parent_id 0, errors map to code 2,
+    span type "web" — groups spans by trace id, and PUTs the
+    two-dimensional trace array uncompressed (the traces endpoint does
+    not accept compressed bodies). Flush self-metrics match the
+    reference sink keys: sink.spans_flushed_total (tagged per service)
+    and sink.span_flush_total_duration_ns."""
 
     def __init__(self, name: str, trace_api_url: str, hostname: str,
                  buffer_size: int = DATADOG_SPAN_BUFFER_CAP,
@@ -189,6 +206,9 @@ class DatadogSpanSink(SpanSink):
         self.buffer: "collections.deque" = collections.deque(maxlen=buffer_size)
         self.timeout = timeout
         self._lock = threading.Lock()
+        self.overwritten_total = 0  # ring overflow accounting
+        self.timestamp_errors = 0
+        self._statsd = None
 
     def name(self) -> str:
         return self._name
@@ -196,38 +216,72 @@ class DatadogSpanSink(SpanSink):
     def kind(self) -> str:
         return "datadog"
 
+    def start(self, server) -> None:
+        self._statsd = getattr(server, "statsd", None)
+
     def ingest(self, span) -> None:
         if not span.trace_id:
             return
         with self._lock:
+            if len(self.buffer) == self.buffer.maxlen:
+                # ring semantics: the append below evicts the oldest
+                self.overwritten_total += 1
             self.buffer.append(span)
 
+    def _to_dd_span(self, s) -> dict:
+        meta = dict(s.tags)
+        resource = meta.pop(_DD_RESOURCE_KEY, "") or "unknown"
+        if (s.start_timestamp < _SPAN_TS_TOO_EARLY
+                or s.start_timestamp > _SPAN_TS_TOO_LATE):
+            self.timestamp_errors += 1
+        return {
+            "trace_id": s.trace_id,
+            "span_id": s.id,
+            "parent_id": max(s.parent_id, 0),  # root spans -> 0
+            "service": s.service,
+            "name": s.name or "unknown",
+            "resource": resource,
+            "start": s.start_timestamp,
+            "duration": max(s.end_timestamp - s.start_timestamp, 0),
+            "type": _DD_SPAN_TYPE,
+            "error": 2 if s.error else 0,
+            "meta": meta,
+        }
+
     def flush(self) -> None:
+        import time as _time
+
+        flush_start = _time.perf_counter()
         with self._lock:
             spans, self.buffer = list(self.buffer), collections.deque(
                 maxlen=self.buffer.maxlen)
         if not spans:
             return
         traces: Dict[int, List[dict]] = {}
+        service_counts: Dict[str, int] = {}
         for s in spans:
-            traces.setdefault(s.trace_id, []).append({
-                "trace_id": s.trace_id,
-                "span_id": s.id,
-                "parent_id": s.parent_id,
-                "service": s.service,
-                "name": s.name,
-                "resource": dict(s.tags).get("resource", s.name),
-                "start": s.start_timestamp,
-                "duration": max(s.end_timestamp - s.start_timestamp, 0),
-                "error": 1 if s.error else 0,
-                "meta": dict(s.tags),
-            })
+            traces.setdefault(s.trace_id, []).append(self._to_dd_span(s))
+            service_counts[s.service] = service_counts.get(s.service, 0) + 1
         try:
-            vhttp.post_json(f"{self.trace_api_url}/v0.3/traces",
-                            list(traces.values()), compress="gzip",
-                            timeout=self.timeout)
+            vhttp.put_json(f"{self.trace_api_url}/v0.3/traces",
+                           list(traces.values()), timeout=self.timeout)
         except Exception as e:
-            logger.error("datadog trace POST failed: %s", e)
+            logger.error("datadog trace PUT failed: %s", e)
+            return
+        if self._statsd is not None:
+            for service, count in service_counts.items():
+                self._statsd.count(
+                    "sink.spans_flushed_total", count,
+                    tags=[f"sink:{self._name}", f"service:{service}"])
+            ts_errors, self.timestamp_errors = self.timestamp_errors, 0
+            if ts_errors:
+                self._statsd.count(
+                    "worker.trace.sink.timestamp_error", ts_errors,
+                    tags=[f"sink:{self._name}"])
+            self._statsd.gauge(
+                "sink.span_flush_total_duration_ns",
+                int((_time.perf_counter() - flush_start) * 1e9),
+                tags=[f"sink:{self._name}"])
 
 
 @register_metric_sink("datadog")

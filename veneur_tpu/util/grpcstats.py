@@ -1,0 +1,72 @@
+"""Per-RPC latency and error accounting for gRPC servers.
+
+Parity with reference proxy/grpcstats/server.go: every RPC is timed and
+counted by method and outcome, and the aggregates are emitted as
+self-metrics (rpc.count / rpc.duration_ns / rpc.errors in the reference).
+Handlers are wrapped explicitly (the servers here build their method
+handlers by hand), which keeps the recorder independent of grpc's
+interceptor API.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict
+
+
+class RpcStats:
+    """Thread-safe per-method RPC aggregates."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stats: Dict[str, Dict[str, float]] = {}
+
+    def record(self, method: str, duration_s: float, ok: bool) -> None:
+        with self._lock:
+            s = self._stats.setdefault(method, {
+                "count": 0, "errors": 0, "total_s": 0.0, "max_s": 0.0})
+            s["count"] += 1
+            if not ok:
+                s["errors"] += 1
+            s["total_s"] += duration_s
+            s["max_s"] = max(s["max_s"], duration_s)
+
+    def snapshot(self) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            return {k: dict(v) for k, v in self._stats.items()}
+
+    def drain(self) -> Dict[str, Dict[str, float]]:
+        """Snapshot-and-reset: the interval's deltas (so repeated emits
+        never re-count earlier RPCs)."""
+        with self._lock:
+            out, self._stats = self._stats, {}
+            return out
+
+    def emit(self, statsd, prefix: str = "rpc") -> None:
+        """Emit one interval's deltas through a scopedstatsd-style client
+        (gauge / count interface), tagged by method — the reference's
+        grpcstats metric surface. Resets the aggregates, so each flush
+        emits only what happened since the previous one."""
+        for method, s in self.drain().items():
+            tags = [f"method:{method}"]
+            statsd.count(f"{prefix}.count", int(s["count"]), tags=tags)
+            statsd.count(f"{prefix}.errors", int(s["errors"]), tags=tags)
+            avg_ns = (s["total_s"] / s["count"] * 1e9) if s["count"] else 0
+            statsd.gauge(f"{prefix}.avg_duration_ns", int(avg_ns), tags=tags)
+            statsd.gauge(f"{prefix}.max_duration_ns",
+                         int(s["max_s"] * 1e9), tags=tags)
+
+    def timed(self, method: str, behavior: Callable) -> Callable:
+        """Wrap a gRPC method behavior (request, context) -> response."""
+        def wrapped(request_or_iterator, context):
+            t0 = time.perf_counter()
+            try:
+                out = behavior(request_or_iterator, context)
+            except Exception:
+                self.record(method, time.perf_counter() - t0, ok=False)
+                raise
+            self.record(method, time.perf_counter() - t0, ok=True)
+            return out
+
+        return wrapped

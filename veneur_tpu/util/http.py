@@ -122,9 +122,10 @@ def post(url: str, body: bytes, *,
          content_type: str = "application/json",
          headers: Optional[Dict[str, str]] = None,
          compress: Optional[str] = None,
-         timeout: float = 10.0) -> Tuple[int, bytes]:
-    """POST `body`, optionally compressed ("gzip"/"deflate"), returning
-    (status, response body). Raises HTTPError on non-2xx."""
+         timeout: float = 10.0, method: str = "POST") -> Tuple[int, bytes]:
+    """Send `body` (POST by default), optionally compressed
+    ("gzip"/"deflate"), returning (status, response body). Raises
+    HTTPError on non-2xx."""
     hdrs = {"Content-Type": content_type}
     if compress == "gzip":
         body = gzip.compress(body, compresslevel=6)
@@ -134,7 +135,8 @@ def post(url: str, body: bytes, *,
         hdrs["Content-Encoding"] = "deflate"
     if headers:
         hdrs.update(headers)
-    req = urllib.request.Request(url, data=body, headers=hdrs, method="POST")
+    req = urllib.request.Request(url, data=body, headers=hdrs,
+                                 method=method)
     try:
         with urllib.request.urlopen(req, timeout=timeout) as resp:
             return resp.status, resp.read()
@@ -147,6 +149,16 @@ def post_json(url: str, obj: Any, *, headers: Optional[Dict[str, str]] = None,
               timeout: float = 10.0) -> Tuple[int, bytes]:
     return post(url, json.dumps(obj, separators=(",", ":")).encode(),
                 headers=headers, compress=compress, timeout=timeout)
+
+
+def put_json(url: str, obj: Any, *,
+             headers: Optional[Dict[str, str]] = None,
+             timeout: float = 10.0) -> Tuple[int, bytes]:
+    """Uncompressed JSON PUT (the Datadog traces endpoint rejects
+    compressed bodies, reference datadog.go:638-643)."""
+    return post(url, json.dumps(obj, separators=(",", ":")).encode(),
+                headers=headers, compress=None, timeout=timeout,
+                method="PUT")
 
 
 def get(url: str, *, headers: Optional[Dict[str, str]] = None,
