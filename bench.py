@@ -157,6 +157,13 @@ def initialize_backend(max_attempts: int = 2,
     return platform
 
 
+def make_datagrams(packets, per: int = 40):
+    """Batch packets into datagram-sized buffers (~`per` metrics each,
+    like a client pipelining into 1400-byte datagrams)."""
+    return [b"\n".join(packets[i:i + per])
+            for i in range(0, len(packets), per)]
+
+
 def make_packets(num_keys: int, values_per_packet: int = 8):
     """Pre-render a packet corpus: multi-value timers, counters, gauges and
     sets across num_keys unique keys (veneur-emit-style load)."""
@@ -195,8 +202,7 @@ def run_pipeline_mt(duration_s: float, num_keys: int,
     packets, samples_per_round = make_packets(num_keys)
     # batch into datagram-sized buffers (~40 metrics each, like a client
     # pipelining into 1400-byte datagrams) for the native batch path
-    datagrams = [b"\n".join(packets[i:i + 40])
-                 for i in range(0, len(packets), 40)]
+    datagrams = make_datagrams(packets)
 
     # warmup: intern every key (first pass is the Python slow path) and
     # trigger every kernel compile path
@@ -264,8 +270,7 @@ def run_scenario_sustained(num_keys: int = 100_000, interval_s: float = 5.0,
     server._flush_locked = timed_flush
 
     packets, samples_per_round = make_packets(num_keys)
-    datagrams = [b"\n".join(packets[i:i + 40])
-                 for i in range(0, len(packets), 40)]
+    datagrams = make_datagrams(packets)
     log(f"sustained: warmup ({num_keys} keys)")
     server.handle_packet_batch(datagrams)
     server.store.apply_all_pending()
@@ -292,23 +297,38 @@ def run_scenario_sustained(num_keys: int = 100_000, interval_s: float = 5.0,
     while time.perf_counter() < deadline:
         time.sleep(0.1)
     stop.set()
-    for t in ts:
-        t.join()
     elapsed = time.perf_counter() - t0
-    # drain whatever is still pending (counted: it was ingested in-window)
+    for t in ts:
+        t.join(timeout=60)
+    # let an in-flight ticker flush finish so its wall time is recorded
+    wait_deadline = time.perf_counter() + interval_s * 2
+    while (len(flush_times) < intervals
+           and time.perf_counter() < wait_deadline):
+        time.sleep(0.1)
+    # device-queue drain: how long until everything enqueued lands
+    drain_t0 = time.perf_counter()
     server.store.apply_all_pending()
+    import jax
+    jax.block_until_ready(server.store.counters.state)
+    drain_s = time.perf_counter() - drain_t0
+    ticker_flushes = len(flush_times)
+    # a final timed flush guarantees at least one real measurement of a
+    # full-table flush under post-load state
+    server.flush()
     server.shutdown()
     total = sum(c * samples_per_round // threads for c in counts)
     rate = total / elapsed
-    times = sorted(flush_times) or [0.0]
+    times = sorted(flush_times)
     p50 = times[len(times) // 2]
     p99 = times[min(len(times) - 1, int(len(times) * 0.99))]
     log(f"sustained: {rate:,.0f} samples/s over {elapsed:.1f}s, "
-        f"{len(times)} flushes, p50={p50:.3f}s p99={p99:.3f}s")
+        f"{len(times)} flushes, p50={p50:.3f}s p99={p99:.3f}s "
+        f"drain={drain_s:.2f}s")
     return rate, {
         "flush_p50_s": round(p50, 4),
         "flush_p99_s": round(p99, 4),
-        "flush_count": len(times),
+        "flush_count": ticker_flushes,
+        "queue_drain_s": round(drain_s, 3),
         "interval_s": interval_s,
         "sustained_keys": num_keys,
     }
@@ -318,8 +338,7 @@ def run_pipeline(duration_s: float, num_keys: int):
     """Single-threaded host pipeline (kept for comparison runs)."""
     server = _mk_server(num_keys)
     packets, samples_per_round = make_packets(num_keys)
-    datagrams = [b"\n".join(packets[i:i + 40])
-                 for i in range(0, len(packets), 40)]
+    datagrams = make_datagrams(packets)
     server.handle_packet_batch(datagrams)
     server.store.apply_all_pending()
     server.flush()
@@ -381,8 +400,7 @@ def run_scenario_timers(duration_s: float, num_keys: int = 1000):
     for i in range(num_keys):
         vals = b":".join(b"%.2f" % v for v in rng.normal(100, 15, 8))
         packets.append(b"bench.timer.%d:%s|ms" % (i, vals))
-    datagrams = [b"\n".join(packets[i:i + 40])
-                 for i in range(0, len(packets), 40)]
+    datagrams = make_datagrams(packets)
     server = _mk_server(num_keys * 2)
     server.handle_packet_batch(datagrams)
     server.store.apply_all_pending()
@@ -413,8 +431,7 @@ def run_scenario_forward(duration_s: float, num_keys: int = 50_000):
     packets = [b"bench.fwd.%d:%s|ms" % (
         i, b":".join(b"%.2f" % v for v in rng.normal(50, 9, 4)))
         for i in range(num_keys)]
-    datagrams = [b"\n".join(packets[i:i + 40])
-                 for i in range(0, len(packets), 40)]
+    datagrams = make_datagrams(packets)
     local.handle_packet_batch(datagrams)
     local.store.apply_all_pending()
     t0 = time.perf_counter()
@@ -558,8 +575,7 @@ def run_scenario_hll(duration_s: float, num_keys: int = 10_000,
             packets.append(
                 b"bench.hll.%d:user%d|s|#card:%d,env:bench"
                 % (i, rng.integers(0, 100_000), t))
-    datagrams = [b"\n".join(packets[i:i + 40])
-                 for i in range(0, len(packets), 40)]
+    datagrams = make_datagrams(packets)
     server = _mk_server(num_keys * 2)
     server.handle_packet_batch(datagrams)
     server.store.apply_all_pending()
@@ -670,4 +686,10 @@ def main():
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    rc = main()
+    # hard exit: daemon load threads and accelerator-client teardown can
+    # abort the interpreter after the JSON line is already out; the
+    # driver only needs the line and the return code
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(rc)

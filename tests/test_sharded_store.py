@@ -91,8 +91,10 @@ class TestShardedServerEquivalence:
         est1, regs1, touched1, _ = store1.sets.snapshot_and_reset()
         est8, regs8, touched8, _ = store8.sets.snapshot_and_reset()
         np.testing.assert_array_equal(touched1, touched8)
-        np.testing.assert_array_equal(
-            regs1[touched1[: regs1.shape[0]]], regs8[touched8[: regs8.shape[0]]])
+        # single-device registers come from the lazy per-row provider;
+        # sharded stays a dense array — compare row by row
+        for row in np.flatnonzero(touched1):
+            np.testing.assert_array_equal(regs1[row], regs8[row])
         np.testing.assert_allclose(
             est1[touched1[: est1.shape[0]]], est8[touched8[: est8.shape[0]]])
 

@@ -619,3 +619,18 @@ class TestDatadogSpanDepth:
                 ("sink:datadog", "service:db")) in flushed
         assert any(c[0] == "sink.span_flush_total_duration_ns"
                    for c in calls)
+
+
+class TestKafkaBackpressure:
+    def test_span_buffer_bound_drops_and_counts(self):
+        from veneur_tpu.sinks.kafka import InMemoryProducer, KafkaSpanSink
+        prod = InMemoryProducer()
+        sink = KafkaSpanSink("kafka", prod, span_topic="spans",
+                             max_buffered=3)
+        for i in range(5):
+            sink.ingest(make_span(trace_id=i + 1, span_id=1))
+        assert len(prod.messages) == 3
+        assert sink.dropped_total == 2
+        sink.flush()  # resets the per-interval bound
+        sink.ingest(make_span(trace_id=9, span_id=1))
+        assert len(prod.messages) == 4

@@ -88,15 +88,18 @@ def main(argv=None) -> int:
     else:
         discoverer = StaticDiscoverer(destinations)
     from veneur_tpu.util.grpctls import GrpcTLS
-    tls = GrpcTLS(certificate=raw.get("tls_certificate", args.tls_cert),
-                  key=raw.get("tls_key", args.tls_key),
-                  authority=raw.get("tls_authority_certificate",
-                                    args.tls_ca))
+    # `or`: an empty-string YAML value must not silently override an
+    # explicitly passed CLI flag (that would downgrade to plaintext)
+    tls = GrpcTLS(certificate=raw.get("tls_certificate") or args.tls_cert,
+                  key=raw.get("tls_key") or args.tls_key,
+                  authority=(raw.get("tls_authority_certificate")
+                             or args.tls_ca))
     dest_tls = GrpcTLS(
-        certificate=raw.get("forward_tls_certificate", args.dest_tls_cert),
-        key=raw.get("forward_tls_key", args.dest_tls_key),
-        authority=raw.get("forward_tls_authority_certificate",
-                          args.dest_tls_ca))
+        certificate=(raw.get("forward_tls_certificate")
+                     or args.dest_tls_cert),
+        key=raw.get("forward_tls_key") or args.dest_tls_key,
+        authority=(raw.get("forward_tls_authority_certificate")
+                   or args.dest_tls_ca))
     proxy = ProxyServer(
         discoverer,
         forward_service=forward_service,

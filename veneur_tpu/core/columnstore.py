@@ -450,8 +450,56 @@ class HistoTable(_BaseTable):
         return out, export, touched, meta
 
 
+class _SetRegisters:
+    """Lazy per-row dense register view over the hybrid set state:
+    promoted rows slice the (D, M) device readout; sparse rows
+    materialize 16 KB only when a caller (the forward exporter) actually
+    asks — the point of the sparse representation is that most rows
+    never do both."""
+
+    def __init__(self, dev_regs, slot_of, sparse_rows, sparse_idx,
+                 sparse_rho):
+        self._dev = dev_regs  # (nslots, M) int8 or None
+        self._slot_of = slot_of
+        # sparse COO sorted by row; boundaries found by searchsorted
+        self._rows = sparse_rows
+        self._idx = sparse_idx
+        self._rho = sparse_rho
+
+    def __getitem__(self, row: int) -> np.ndarray:
+        slot = int(self._slot_of[row]) if row < self._slot_of.shape[0] else -1
+        if slot >= 0 and self._dev is not None:
+            return self._dev[slot]
+        regs = np.zeros(batch_hll.M, np.int8)
+        lo = np.searchsorted(self._rows, row, side="left")
+        hi = np.searchsorted(self._rows, row, side="right")
+        if hi > lo:
+            np.maximum.at(regs, self._idx[lo:hi],
+                          self._rho[lo:hi].astype(np.int8))
+        return regs
+
+
 class SetTable(_BaseTable):
-    def __init__(self, capacity: int = 256, batch_cap: int = 8192):
+    """Sets with a two-tier HLL representation (the reference's vendored
+    hyperloglog likewise keeps small sets sparse, sparse.go): samples
+    for a key accumulate as host-side COO (register, rho) pairs until
+    the key crosses PROMOTE_SAMPLES within the interval, at which point
+    it is promoted to a row of the dense (D, 16384) device table and its
+    stream flows through the scatter-max kernel. At flush, promoted
+    rows' early backlog folds into the device table, small rows estimate
+    on host with the same LogLog-Beta math (vectorized over the sorted
+    COO), and registers materialize per row only on demand. A 100k-key
+    set workload with mostly small sets therefore costs megabytes of
+    host COO instead of 1.6 GB of device registers.
+
+    `sparse=False` (the sharded table) keeps the original all-dense
+    device path: every row maps 1:1 to a device slot."""
+
+    PROMOTE_SAMPLES = 2048
+
+    def __init__(self, capacity: int = 256, batch_cap: int = 8192,
+                 sparse: bool = True):
+        self._sparse = sparse
         super().__init__(capacity, batch_cap)
 
     def _init_pending(self):
@@ -463,10 +511,39 @@ class SetTable(_BaseTable):
 
     def _init_arrays(self):
         self._init_pending()
-        self.state = batch_hll.init_state(self.capacity)
+        if self._sparse:
+            self._dev_cap = min(256, self.capacity)
+            self._slot_of = np.full(self.capacity, -1, np.int32)
+            self._nslots = 0
+            self._slot_row: List[int] = []
+            self._counts = np.zeros(self.capacity, np.int32)
+            self._coo: List[tuple] = []
+            self._coo_scalar: tuple = ([], [], [])
+        else:
+            self._dev_cap = self.capacity
+        self.state = batch_hll.init_state(self._dev_cap)
 
     def _grow_arrays(self, new_cap):
-        self.state = _pad_cap(self.state, new_cap)
+        if self._sparse:
+            grown_slots = np.full(new_cap, -1, np.int32)
+            grown_slots[: self._slot_of.shape[0]] = self._slot_of
+            self._slot_of = grown_slots
+            grown_counts = np.zeros(new_cap, np.int32)
+            grown_counts[: self._counts.shape[0]] = self._counts
+            self._counts = grown_counts
+        else:
+            self._dev_cap = new_cap
+            self.state = _pad_cap(self.state, new_cap)
+
+    def _promote_locked(self, row: int) -> None:
+        """Assign a device slot (caller holds the buffer lock)."""
+        if self._nslots >= self._dev_cap:
+            with self.apply_lock:
+                self._dev_cap *= 2
+                self.state = _pad_cap(self.state, self._dev_cap)
+        self._slot_of[row] = self._nslots
+        self._slot_row.append(row)
+        self._nslots += 1
 
     def add(self, metric: UDPMetric):
         member = metric.value if isinstance(metric.value, bytes) else str(
@@ -476,6 +553,20 @@ class SetTable(_BaseTable):
         with self.lock:
             row = self.row_for(metric)
             self.touched[row] = True
+            if self._sparse:
+                self._counts[row] += 1
+                slot = self._slot_of[row]
+                if slot < 0 and self._counts[row] >= self.PROMOTE_SAMPLES:
+                    self._promote_locked(row)
+                    slot = self._slot_of[row]
+                if slot < 0:
+                    # per-sample sparse path: cheap list appends, turned
+                    # into COO arrays at snapshot
+                    self._coo_scalar[0].append(row)
+                    self._coo_scalar[1].append(idx)
+                    self._coo_scalar[2].append(rho)
+                    return
+                row = int(slot)
             n = self._n
             self._prow[n] = row
             self._pidx[n] = idx
@@ -493,24 +584,76 @@ class SetTable(_BaseTable):
             self._dispatch_pending_locked()
 
     def add_batch(self, rows, reg_idx, rho) -> None:
-        """Native-parser fast path: members already hashed to (idx, rho)."""
+        """Native-parser fast path: members already hashed to (idx, rho).
+        Routes each sample to its key's tier (device slot or host COO)."""
         with self.lock:
             self.touched[rows] = True
-            self._append_batch((rows, reg_idx, rho))
+            if not self._sparse:
+                self._append_batch((rows, reg_idx, rho))
+                return
+            self._counts += np.bincount(
+                rows, minlength=self._counts.shape[0]).astype(np.int32)
+            slots = self._slot_of[rows]
+            cold = slots < 0
+            hot_rows = np.unique(
+                rows[cold & (self._counts[rows] >= self.PROMOTE_SAMPLES)])
+            for r in hot_rows:
+                self._promote_locked(int(r))
+            if hot_rows.size:
+                slots = self._slot_of[rows]
+                cold = slots < 0
+            if (~cold).any():
+                self._append_batch((slots[~cold], reg_idx[~cold],
+                                    rho[~cold]))
+            if cold.any():
+                self._coo.append((rows[cold].copy(), reg_idx[cold].copy(),
+                                  rho[cold].copy()))
 
     def merge_batch(self, stubs: List[UDPMetric], in_regs) -> None:
-        """Import-path HLL merge (register max); interning atomic under
-        the buffer lock, state update ordered via the apply ticket."""
+        """Import-path HLL merge (register max); imported rows arrive
+        dense, so they promote immediately in sparse mode."""
         with self.lock:
             rows = np.fromiter(
                 (self.row_for(s) for s in stubs), np.int32, len(stubs))
             self.touched[rows] = True
+            if self._sparse:
+                for r in rows:
+                    if self._slot_of[r] < 0:
+                        self._promote_locked(int(r))
+                target = self._slot_of[rows]
+            else:
+                target = rows
             self.apply_lock.acquire()
         try:
             self.state = batch_hll.merge_rows(
-                self.state, rows, np.asarray(in_regs, np.int8))
+                self.state, target, np.asarray(in_regs, np.int8))
         finally:
             self.apply_lock.release()
+
+    def _host_estimates(self, rows, idx, rho):
+        """Vectorized LogLog-Beta over row-grouped COO pairs; returns
+        (unique_rows, estimates). Dedupe keeps the max rho per (row,
+        register), matching the device scatter-max."""
+        order = np.lexsort((rho, idx, rows))
+        r, i, q = rows[order], idx[order], rho[order]
+        last = np.ones(r.shape[0], bool)
+        last[:-1] = (r[:-1] != r[1:]) | (i[:-1] != i[1:])
+        r, i, q = r[last], i[last], q[last]
+        urows, start = np.unique(r, return_index=True)
+        nnz = np.diff(np.r_[start, r.shape[0]])
+        pow_sum = np.add.reduceat(np.power(2.0, -q.astype(np.float64)),
+                                  start)
+        ez = float(batch_hll.M) - nnz
+        s = ez + pow_sum  # zero registers contribute 2^0 each
+        # vectorized LogLog-Beta polynomial (hll_ref.beta14 per element)
+        zl = np.log(ez + 1.0)
+        beta = hll_ref._BETA14_EZ * ez
+        for k, c in enumerate(hll_ref._BETA14):
+            beta = beta + c * zl ** (k + 1)
+        est = np.floor(
+            hll_ref._ALPHA * batch_hll.M * (batch_hll.M - ez)
+            / (beta + s) + 1.0)
+        return urows, est.astype(np.float32)
 
     def snapshot_and_reset(self):
         with self.lock:
@@ -519,12 +662,70 @@ class SetTable(_BaseTable):
             touched = self.touched.copy()
             meta = list(self.meta)
             self.touched[:] = False
+            if self._sparse:
+                coo, self._coo = self._coo, []
+                sc, self._coo_scalar = self._coo_scalar, ([], [], [])
+                if sc[0]:
+                    coo.append((np.asarray(sc[0], np.int32),
+                                np.asarray(sc[1], np.int32),
+                                np.asarray(sc[2], np.int32)))
+                slot_of = self._slot_of
+                slot_row = self._slot_row
+                nslots = self._nslots
+                self._slot_of = np.full(self.capacity, -1, np.int32)
+                self._slot_row = []
+                self._nslots = 0
+                self._counts[:] = 0
         try:
             if cols is not None:
                 self._apply_cols(cols)
-            estimates = np.asarray(batch_hll.estimate(self.state))
-            registers = np.asarray(self.state)
-            self.state = batch_hll.init_state(self.capacity)
+            if not self._sparse:
+                estimates = np.asarray(batch_hll.estimate(self.state))
+                registers = np.asarray(self.state)
+                self.state = batch_hll.init_state(self._dev_cap)
+                return estimates, registers, touched, meta
+
+            # fold promoted rows' pre-promotion backlog into the device
+            # table, then split the remaining COO per sparse row
+            if coo:
+                rows_all = np.concatenate([c[0] for c in coo])
+                idx_all = np.concatenate([c[1] for c in coo])
+                rho_all = np.concatenate([c[2] for c in coo])
+            else:
+                rows_all = np.zeros(0, np.int32)
+                idx_all = rho_all = rows_all
+            pslots = slot_of[rows_all] if rows_all.size else rows_all
+            hot = pslots >= 0
+            hot_slots = pslots[hot]
+            hot_idx, hot_rho = idx_all[hot], rho_all[hot]
+            for i in range(0, hot_slots.shape[0], self.batch_cap):
+                sl = slice(i, i + self.batch_cap)
+                chunk_rows = hot_slots[sl]
+                pad = self.batch_cap - chunk_rows.shape[0]
+                self.state = batch_hll.apply_batch(
+                    self.state,
+                    np.concatenate([chunk_rows,
+                                    np.full(pad, PAD_ROW, np.int32)]),
+                    np.concatenate([hot_idx[sl], np.zeros(pad, np.int32)]),
+                    np.concatenate([hot_rho[sl], np.zeros(pad, np.int32)]))
+
+            estimates = np.zeros(self.capacity, np.float32)
+            dev_regs = None
+            if nslots:
+                dev_est = np.asarray(batch_hll.estimate(self.state))
+                dev_regs = np.asarray(self.state)
+                estimates[np.asarray(slot_row, np.int64)] = dev_est[:nslots]
+            s_rows = rows_all[~hot]
+            s_idx, s_rho = idx_all[~hot], rho_all[~hot]
+            if s_rows.size:
+                urows, est = self._host_estimates(s_rows, s_idx, s_rho)
+                estimates[urows] = est
+                order = np.argsort(s_rows, kind="stable")
+                s_rows, s_idx, s_rho = (s_rows[order], s_idx[order],
+                                        s_rho[order])
+            registers = _SetRegisters(dev_regs, slot_of, s_rows, s_idx,
+                                      s_rho)
+            self.state = batch_hll.init_state(self._dev_cap)
         finally:
             self.apply_lock.release()
         return estimates, registers, touched, meta

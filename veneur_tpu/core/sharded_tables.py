@@ -230,7 +230,9 @@ class ShardedSetTable(SetTable):
         self._devices = devices or local_shard_devices(2)
         self._mesh = Mesh(np.asarray(self._devices), (SHARD_AXIS,))
         self._next = 0
-        super().__init__(capacity, batch_cap)
+        # dense path: sharding already spreads register memory across
+        # devices, and the collective merge needs uniform dense rows
+        super().__init__(capacity, batch_cap, sparse=False)
 
     def _init_arrays(self):
         self._init_pending()

@@ -176,10 +176,11 @@ class DatadogMetricSink(MetricSink):
             self._post_safe("/intake", {"events": {self._name: events}})
 
 
-# reference datadog.go:536-538 timestamp plausibility window (seconds /
-# microseconds since epoch): spans outside it count as timestamp errors
-_SPAN_TS_TOO_EARLY = 1497
-_SPAN_TS_TOO_LATE = 1497629343000000
+# timestamp plausibility window, adapted to this pipeline's nanosecond
+# span timestamps (the reference's constants at datadog.go:536-538 target
+# second-scale values): spans outside 2001..2100 count as scale errors
+_SPAN_TS_TOO_EARLY = 978_307_200 * 10**9
+_SPAN_TS_TOO_LATE = 4_102_444_800 * 10**9
 
 _DD_SPAN_TYPE = "web"  # reference datadog.go:31 datadogSpanType
 _DD_RESOURCE_KEY = "resource"  # datadog.go:27

@@ -163,7 +163,7 @@ class KafkaSpanSink(SpanSink):
     def __init__(self, name: str, producer: Optional[Producer],
                  span_topic: str, encoding: str = "protobuf",
                  sample_rate_percent: float = 100.0,
-                 sample_tag: str = ""):
+                 sample_tag: str = "", max_buffered: int = 16384):
         self._name = name
         self.producer = producer
         self.span_topic = span_topic
@@ -174,6 +174,15 @@ class KafkaSpanSink(SpanSink):
         self.sample_threshold = int(sample_rate_percent * 100)
         self.sample_tag = sample_tag
         self._buffered = 0
+        # backpressure bound: the reference's sarama async producer has a
+        # bounded input channel; spans beyond the per-interval bound drop
+        # (and are counted) instead of growing the producer buffer
+        self.max_buffered = max_buffered
+        self.dropped_total = 0
+        self._statsd = None
+
+    def start(self, server) -> None:
+        self._statsd = getattr(server, "statsd", None)
 
     def name(self) -> str:
         return self._name
@@ -198,6 +207,9 @@ class KafkaSpanSink(SpanSink):
     def ingest(self, span) -> None:
         if self.producer is None or not self._sampled_in(span):
             return
+        if self._buffered >= self.max_buffered:
+            self.dropped_total += 1
+            return
         self.producer.send(self.span_topic,
                            str(span.trace_id).encode(), self.encode(span))
         self._buffered += 1
@@ -206,6 +218,10 @@ class KafkaSpanSink(SpanSink):
         if self.producer is not None and self._buffered:
             self.producer.flush()
             self._buffered = 0
+        if self._statsd is not None and self.dropped_total:
+            dropped, self.dropped_total = self.dropped_total, 0
+            self._statsd.count("sink.spans_dropped_total", dropped,
+                               tags=[f"sink:{self._name}"])
 
     def stop(self) -> None:
         if self.producer is not None:
@@ -241,4 +257,5 @@ def _span_factory(sink_config, server_config):
         span_topic=c.get("span_topic", "veneur_spans"),
         encoding=c.get("span_serialization_format", "protobuf"),
         sample_rate_percent=float(c.get("span_sample_rate_percent", 100.0)),
-        sample_tag=c.get("span_sample_tag", ""))
+        sample_tag=c.get("span_sample_tag", ""),
+        max_buffered=int(c.get("span_buffer_max", 16384)))
