@@ -634,3 +634,38 @@ class TestKafkaBackpressure:
         sink.flush()  # resets the per-interval bound
         sink.ingest(make_span(trace_id=9, span_id=1))
         assert len(prod.messages) == 4
+
+
+class TestFalconerDepth:
+    def test_validates_and_counts(self):
+        from veneur_tpu.sinks.falconer import FalconerSpanSink
+        sent = []
+        sink = FalconerSpanSink("falconer", sender=sent.append)
+        sink.ingest(make_span(trace_id=1, span_id=2))
+        sink.ingest(make_span(trace_id=0, span_id=2))  # invalid: no trace
+        sink.ingest(make_span(trace_id=3, span_id=0))  # invalid: no id
+        assert len(sent) == 1
+        assert sink.spans_handled == 1
+
+        def boom(span):
+            raise RuntimeError("conn reset")
+        sink.sender = boom
+        sink.ingest(make_span(trace_id=5, span_id=6))
+        assert sink.errors == 1
+
+    def test_grpc_route_parity(self):
+        from veneur_tpu.sinks.falconer import GrpcSpanSender
+        # reference generated client invokes /falconer.SpanSink/SendSpan
+        # (sinks/falconer/grpc_sink.pb.go:108)
+        assert GrpcSpanSender.METHOD == "/falconer.SpanSink/SendSpan"
+
+
+class TestNewRelicBackpressure:
+    def test_span_buffer_bound(self):
+        from veneur_tpu.sinks.newrelic import NewRelicSpanSink
+        sink = NewRelicSpanSink("nr", insert_key="k",
+                                trace_url="http://x", max_buffered=2)
+        for i in range(4):
+            sink.ingest(make_span(trace_id=i + 1, span_id=1))
+        assert len(sink._spans) == 2
+        assert sink.dropped_total == 2

@@ -18,9 +18,12 @@ logger = logging.getLogger("veneur_tpu.sinks.falconer")
 
 
 class GrpcSpanSender:
-    """Sends serialized SSFSpans over a grpc channel."""
+    """Sends serialized SSFSpans over a grpc channel (route parity with
+    the reference's generated client: /falconer.SpanSink/SendSpan,
+    reference sinks/falconer/grpc_sink.pb.go:108, with the trace id in
+    x-veneur-trace-id request metadata, falconer.go:134-138)."""
 
-    METHOD = "/falconer.Falconer/SendSpans"
+    METHOD = "/falconer.SpanSink/SendSpan"
 
     def __init__(self, target: str):
         import grpc
@@ -31,7 +34,8 @@ class GrpcSpanSender:
             response_deserializer=lambda b: b)
 
     def __call__(self, span) -> None:
-        self._send(span, timeout=5.0)
+        self._send(span, timeout=5.0, metadata=(
+            ("x-veneur-trace-id", format(span.trace_id, "x")),))
 
     def close(self) -> None:
         self._channel.close()
@@ -61,6 +65,11 @@ class FalconerSpanSink(SpanSink):
 
     def ingest(self, span) -> None:
         if self.sender is None:
+            return
+        from veneur_tpu.protocol import valid_trace
+        if not valid_trace(span):
+            # reference validates before sending (falconer.go:130-132,
+            # protocol/wire.go:82-88)
             return
         try:
             self.sender(span)

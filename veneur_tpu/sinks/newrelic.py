@@ -84,7 +84,8 @@ class NewRelicMetricSink(MetricSink):
 
 class NewRelicSpanSink(SpanSink):
     def __init__(self, name: str, insert_key: str, trace_url: str,
-                 common_tags: Sequence[str] = (), timeout: float = 10.0):
+                 common_tags: Sequence[str] = (), timeout: float = 10.0,
+                 max_buffered: int = 16384):
         self._name = name
         self.insert_key = insert_key
         self.trace_url = trace_url
@@ -92,6 +93,11 @@ class NewRelicSpanSink(SpanSink):
         self.timeout = timeout
         self._spans: List[dict] = []
         self._lock = threading.Lock()
+        # bounded between flushes; overflow drops (and counts) rather
+        # than growing without limit under sustained span load
+        self.max_buffered = max_buffered
+        self.dropped_total = 0
+        self._statsd = None
 
     def name(self) -> str:
         return self._name
@@ -99,7 +105,14 @@ class NewRelicSpanSink(SpanSink):
     def kind(self) -> str:
         return "newrelic"
 
+    def start(self, server) -> None:
+        self._statsd = getattr(server, "statsd", None)
+
     def ingest(self, span) -> None:
+        with self._lock:
+            if len(self._spans) >= self.max_buffered:
+                self.dropped_total += 1
+                return
         duration_ms = max(span.end_timestamp - span.start_timestamp, 0) / 1e6
         entry = {
             "id": format(span.id & ((1 << 64) - 1), "x"),
@@ -117,11 +130,20 @@ class NewRelicSpanSink(SpanSink):
             entry["attributes"]["parent.id"] = format(
                 span.parent_id & ((1 << 64) - 1), "x")
         with self._lock:
+            if len(self._spans) >= self.max_buffered:
+                self.dropped_total += 1
+                return
             self._spans.append(entry)
+        # (bound re-checked above after building the entry: another
+        # thread may have filled the buffer in between)
 
     def flush(self) -> None:
         with self._lock:
             spans, self._spans = self._spans, []
+            dropped, self.dropped_total = self.dropped_total, 0
+        if self._statsd is not None and dropped:
+            self._statsd.count("sink.spans_dropped_total", dropped,
+                               tags=[f"sink:{self._name}"])
         if not spans:
             return
         payload = [{"common": {"attributes": self.common_tags},
@@ -155,4 +177,5 @@ def _span_factory(sink_config, server_config):
         insert_key=str(c.get("insert_key", "")),
         trace_url=c.get("trace_url",
                         "https://trace-api.newrelic.com/trace/v1"),
-        common_tags=c.get("common_tags", []) or [])
+        common_tags=c.get("common_tags", []) or [],
+        max_buffered=int(c.get("span_buffer_max", 16384)))
