@@ -12,6 +12,7 @@ from __future__ import annotations
 import logging
 from typing import Callable, List, Optional
 
+from veneur_tpu.protocol import valid_trace
 from veneur_tpu.sinks import SpanSink, register_span_sink
 
 logger = logging.getLogger("veneur_tpu.sinks.falconer")
@@ -66,7 +67,6 @@ class FalconerSpanSink(SpanSink):
     def ingest(self, span) -> None:
         if self.sender is None:
             return
-        from veneur_tpu.protocol import valid_trace
         if not valid_trace(span):
             # reference validates before sending (falconer.go:130-132,
             # protocol/wire.go:82-88)

@@ -140,10 +140,13 @@ class NewRelicSpanSink(SpanSink):
     def flush(self) -> None:
         with self._lock:
             spans, self._spans = self._spans, []
-            dropped, self.dropped_total = self.dropped_total, 0
-        if self._statsd is not None and dropped:
-            self._statsd.count("sink.spans_dropped_total", dropped,
-                               tags=[f"sink:{self._name}"])
+            # reset only once the count can actually be reported, so an
+            # operator inspecting dropped_total without a statsd client
+            # still sees the cumulative number
+            if self._statsd is not None and self.dropped_total:
+                dropped, self.dropped_total = self.dropped_total, 0
+                self._statsd.count("sink.spans_dropped_total", dropped,
+                                   tags=[f"sink:{self._name}"])
         if not spans:
             return
         payload = [{"common": {"attributes": self.common_tags},
