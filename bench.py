@@ -42,10 +42,16 @@ RESULT: dict = {}
 _EMIT_LOCK = threading.Lock()
 _EMITTED = False
 
-# device batch size: larger batches amortize the per-dispatch transfer
-# overhead on TPU; on the CPU fallback the kernels compete with the host
-# pipeline for the same core, so smaller batches keep latency sane
+# device batch size by platform: larger batches amortize the
+# per-dispatch transfer overhead on TPU; on the CPU fallback the kernels
+# compete with the host pipeline for the same core, so smaller batches
+# keep latency sane. Set once by main() after backend detection; module
+# importers (tests) get the CPU value.
 BATCH_CAP = [16384]
+
+
+def set_batch_cap_for(platform: str) -> None:
+    BATCH_CAP[0] = 32768 if not platform.startswith("cpu") else 16384
 
 
 def log(msg: str) -> None:
@@ -658,17 +664,16 @@ def main():
     RESULT["platform"] = platform
     RESULT["host_cpus"] = os.cpu_count()
     on_tpu = not platform.startswith("cpu")
-    if on_tpu:
-        BATCH_CAP[0] = 32768
+    set_batch_cap_for(platform)
 
     try:
         if args.scenario == "default":
-            log("stage 1/2: mixed multi-threaded host pipeline")
+            log("stage 1/3: mixed multi-threaded host pipeline")
             rate, scaling = run_pipeline_mt(args.duration, args.keys)
             RESULT.update(metric=METRIC_NAMES["mixed"],
                           value=round(rate, 1), unit="samples/s",
                           threads=scaling)
-            log("stage 2/2: sustained live-ticker gate")
+            log("stage 2/3: sustained live-ticker gate")
             try:
                 s_keys = 100_000 if on_tpu else 10_000
                 srate, sextra = run_scenario_sustained(
@@ -678,6 +683,16 @@ def main():
             except Exception as e:
                 traceback.print_exc()
                 RESULT["sustained_error"] = f"{type(e).__name__}: {e}"
+            log("stage 3/3: device-only kernel throughput")
+            try:
+                _m, drate, dextra = run_one(
+                    "device", 3.0 if on_tpu else 2.0, args.keys, on_tpu)
+                RESULT["device_samples_per_sec"] = round(drate, 1)
+                RESULT["device_flush_latency_s"] = dextra.get(
+                    "flush_latency_s")
+            except Exception as e:
+                traceback.print_exc()
+                RESULT["device_error"] = f"{type(e).__name__}: {e}"
         else:
             metric, rate, extra = run_one(
                 args.scenario, args.duration, args.keys, on_tpu)

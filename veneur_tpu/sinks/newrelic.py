@@ -138,6 +138,7 @@ class NewRelicSpanSink(SpanSink):
         # thread may have filled the buffer in between)
 
     def flush(self) -> None:
+        dropped = 0
         with self._lock:
             spans, self._spans = self._spans, []
             # reset only once the count can actually be reported, so an
@@ -145,8 +146,10 @@ class NewRelicSpanSink(SpanSink):
             # still sees the cumulative number
             if self._statsd is not None and self.dropped_total:
                 dropped, self.dropped_total = self.dropped_total, 0
-                self._statsd.count("sink.spans_dropped_total", dropped,
-                                   tags=[f"sink:{self._name}"])
+        if dropped:
+            # network I/O stays off the lock so ingest() never stalls
+            self._statsd.count("sink.spans_dropped_total", dropped,
+                               tags=[f"sink:{self._name}"])
         if not spans:
             return
         payload = [{"common": {"attributes": self.common_tags},
