@@ -669,3 +669,51 @@ class TestNewRelicBackpressure:
             sink.ingest(make_span(trace_id=i + 1, span_id=1))
         assert len(sink._spans) == 2
         assert sink.dropped_total == 2
+
+
+class TestSpanFlushSelfMetrics:
+    """Uniform span-sink flush self-metrics (reference sinks.go:58-67)."""
+
+    class FakeStatsd:
+        def __init__(self):
+            self.calls = []
+
+        def count(self, name, value, tags=None):
+            self.calls.append((name, value, tuple(tags or ())))
+
+        def gauge(self, name, value, tags=None):
+            self.calls.append((name, value, tuple(tags or ())))
+
+    class FakeServer:
+        def __init__(self, statsd):
+            self.statsd = statsd
+
+    def test_splunk_emits_flush_keys(self, fake):
+        from veneur_tpu.sinks.splunk import SplunkSpanSink
+        statsd = self.FakeStatsd()
+        sink = SplunkSpanSink("splunk", hec_address=fake.url, token="t",
+                              hostname="h", max_buffer=2)
+        sink.start(self.FakeServer(statsd))
+        for i in range(4):
+            sink.ingest(make_span(trace_id=i + 1, span_id=1))
+        sink.flush()
+        names = {c[0] for c in statsd.calls}
+        assert "sink.spans_flushed_total" in names
+        assert "sink.spans_dropped_total" in names
+        assert "sink.span_flush_total_duration_ns" in names
+        by = {c[0]: c for c in statsd.calls}
+        assert by["sink.spans_flushed_total"][1] == 2
+        assert by["sink.spans_dropped_total"][1] == 2
+        assert by["sink.spans_flushed_total"][2] == ("sink:splunk",)
+
+    def test_lightstep_emits_flush_keys(self, fake):
+        from veneur_tpu.sinks.lightstep import LightStepSpanSink
+        statsd = self.FakeStatsd()
+        sink = LightStepSpanSink("lightstep", collector_url=fake.url,
+                                 access_token="t")
+        sink.start(self.FakeServer(statsd))
+        sink.ingest(make_span(trace_id=1, span_id=1))
+        sink.ingest(make_span(trace_id=2, span_id=2))
+        sink.flush()
+        by = {c[0]: c for c in statsd.calls}
+        assert by["sink.spans_flushed_total"][1] == 2

@@ -44,7 +44,28 @@ class SpanSink(abc.ABC):
         return self.name()
 
     def start(self, server) -> None:  # noqa: B027
-        pass
+        # default: bind the server's self-metrics client so flush() can
+        # emit the standard span-sink keys (reference sinks.go:58-67)
+        self._statsd = getattr(server, "statsd", None)
+
+    def emit_flush_self_metrics(self, flushed: int, flush_start: float,
+                                dropped: int = 0) -> None:
+        """Standard per-sink flush self-metrics (reference sinks.go:58-67:
+        sink.spans_flushed_total / sink.span_flush_total_duration_ns,
+        plus drop accounting), tagged with the sink name."""
+        import time as _time
+
+        statsd = getattr(self, "_statsd", None)
+        if statsd is None or (not flushed and not dropped):
+            return
+        tags = [f"sink:{self.name()}"]
+        if flushed:
+            statsd.count("sink.spans_flushed_total", flushed, tags=tags)
+        if dropped:
+            statsd.count("sink.spans_dropped_total", dropped, tags=tags)
+        statsd.gauge(
+            "sink.span_flush_total_duration_ns",
+            int((_time.perf_counter() - flush_start) * 1e9), tags=tags)
 
     @abc.abstractmethod
     def ingest(self, span) -> None: ...

@@ -209,16 +209,12 @@ class DatadogSpanSink(SpanSink):
         self._lock = threading.Lock()
         self.overwritten_total = 0  # ring overflow accounting
         self.timestamp_errors = 0
-        self._statsd = None
 
     def name(self) -> str:
         return self._name
 
     def kind(self) -> str:
         return "datadog"
-
-    def start(self, server) -> None:
-        self._statsd = getattr(server, "statsd", None)
 
     def ingest(self, span) -> None:
         if not span.trace_id:
@@ -269,17 +265,25 @@ class DatadogSpanSink(SpanSink):
         except Exception as e:
             logger.error("datadog trace PUT failed: %s", e)
             return
-        if self._statsd is not None:
+        statsd = getattr(self, "_statsd", None)
+        if statsd is not None:
+            # per-service flushed counts are datadog-specific (reference
+            # datadog.go:654); duration + ring-overwrite drops go through
+            # the shared helper
             for service, count in service_counts.items():
-                self._statsd.count(
+                statsd.count(
                     "sink.spans_flushed_total", count,
                     tags=[f"sink:{self._name}", f"service:{service}"])
             ts_errors, self.timestamp_errors = self.timestamp_errors, 0
             if ts_errors:
-                self._statsd.count(
+                statsd.count(
                     "worker.trace.sink.timestamp_error", ts_errors,
                     tags=[f"sink:{self._name}"])
-            self._statsd.gauge(
+            dropped, self.overwritten_total = self.overwritten_total, 0
+            if dropped:
+                statsd.count("sink.spans_dropped_total", dropped,
+                             tags=[f"sink:{self._name}"])
+            statsd.gauge(
                 "sink.span_flush_total_duration_ns",
                 int((_time.perf_counter() - flush_start) * 1e9),
                 tags=[f"sink:{self._name}"])

@@ -179,10 +179,6 @@ class KafkaSpanSink(SpanSink):
         # (and are counted) instead of growing the producer buffer
         self.max_buffered = max_buffered
         self.dropped_total = 0
-        self._statsd = None
-
-    def start(self, server) -> None:
-        self._statsd = getattr(server, "statsd", None)
 
     def name(self) -> str:
         return self._name
@@ -215,13 +211,17 @@ class KafkaSpanSink(SpanSink):
         self._buffered += 1
 
     def flush(self) -> None:
+        import time as _time
+
+        flush_start = _time.perf_counter()
+        flushed = 0
         if self.producer is not None and self._buffered:
             self.producer.flush()
-            self._buffered = 0
-        if self._statsd is not None and self.dropped_total:
+            flushed, self._buffered = self._buffered, 0
+        dropped = 0
+        if getattr(self, "_statsd", None) is not None and self.dropped_total:
             dropped, self.dropped_total = self.dropped_total, 0
-            self._statsd.count("sink.spans_dropped_total", dropped,
-                               tags=[f"sink:{self._name}"])
+        self.emit_flush_self_metrics(flushed, flush_start, dropped)
 
     def stop(self) -> None:
         if self.producer is not None:

@@ -60,9 +60,14 @@ class LightStepSpanSink(SpanSink):
             self.spans_handled += 1
 
     def flush(self) -> None:
+        import time as _time
+
+        flush_start = _time.perf_counter()
         with self._lock:
             buffers = self._buffers
             self._buffers = [[] for _ in range(self.num_clients)]
+        sent = 0
+        total = sum(len(spans) for spans in buffers)
         for spans in buffers:
             if not spans or not self.collector_url:
                 continue
@@ -72,8 +77,11 @@ class LightStepSpanSink(SpanSink):
                 vhttp.post_json(f"{self.collector_url}/api/v0/reports",
                                 payload, compress="gzip",
                                 timeout=self.timeout)
+                sent += len(spans)
             except Exception as e:
                 logger.error("lightstep report failed: %s", e)
+        # spans swapped out but not delivered are gone: count as drops
+        self.emit_flush_self_metrics(sent, flush_start, total - sent)
 
 
 @register_span_sink("lightstep")

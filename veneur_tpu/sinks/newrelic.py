@@ -97,16 +97,12 @@ class NewRelicSpanSink(SpanSink):
         # than growing without limit under sustained span load
         self.max_buffered = max_buffered
         self.dropped_total = 0
-        self._statsd = None
 
     def name(self) -> str:
         return self._name
 
     def kind(self) -> str:
         return "newrelic"
-
-    def start(self, server) -> None:
-        self._statsd = getattr(server, "statsd", None)
 
     def ingest(self, span) -> None:
         with self._lock:
@@ -138,19 +134,19 @@ class NewRelicSpanSink(SpanSink):
         # thread may have filled the buffer in between)
 
     def flush(self) -> None:
+        import time as _time
+
+        flush_start = _time.perf_counter()
         dropped = 0
         with self._lock:
             spans, self._spans = self._spans, []
             # reset only once the count can actually be reported, so an
             # operator inspecting dropped_total without a statsd client
             # still sees the cumulative number
-            if self._statsd is not None and self.dropped_total:
+            if getattr(self, "_statsd", None) is not None                     and self.dropped_total:
                 dropped, self.dropped_total = self.dropped_total, 0
-        if dropped:
-            # network I/O stays off the lock so ingest() never stalls
-            self._statsd.count("sink.spans_dropped_total", dropped,
-                               tags=[f"sink:{self._name}"])
         if not spans:
+            self.emit_flush_self_metrics(0, flush_start, dropped)
             return
         payload = [{"common": {"attributes": self.common_tags},
                     "spans": spans}]
@@ -160,6 +156,10 @@ class NewRelicSpanSink(SpanSink):
                             compress="gzip", timeout=self.timeout)
         except Exception as e:
             logger.error("newrelic trace POST failed: %s", e)
+            self.emit_flush_self_metrics(0, flush_start,
+                                         dropped + len(spans))
+            return
+        self.emit_flush_self_metrics(len(spans), flush_start, dropped)
 
 
 @register_metric_sink("newrelic")

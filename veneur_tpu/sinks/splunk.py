@@ -80,9 +80,19 @@ class SplunkSpanSink(SpanSink):
             self._events.append(event)
 
     def flush(self) -> None:
+        import time as _time
+
+        flush_start = _time.perf_counter()
+        reportable = getattr(self, "_statsd", None) is not None
         with self._lock:
             events, self._events = self._events, []
+            # reset only when the count can actually be reported, so an
+            # unreportable interval's drops aren't silently discarded
+            dropped = 0
+            if reportable and self.dropped:
+                dropped, self.dropped = self.dropped, 0
         if not events:
+            self.emit_flush_self_metrics(0, flush_start, dropped)
             return
         body = "\n".join(json.dumps(e, separators=(",", ":"))
                          for e in events).encode()
@@ -93,6 +103,11 @@ class SplunkSpanSink(SpanSink):
                        timeout=self.timeout)
         except Exception as e:
             logger.error("splunk HEC POST failed: %s", e)
+            # the swapped-out events are gone too: count them as drops
+            self.emit_flush_self_metrics(0, flush_start,
+                                         dropped + len(events))
+            return
+        self.emit_flush_self_metrics(len(events), flush_start, dropped)
 
 
 @register_span_sink("splunk")
