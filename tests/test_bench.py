@@ -6,10 +6,9 @@ tests pin the resilience behaviors that fixed that."""
 
 import json
 import os
+import re
 import subprocess
 import sys
-
-import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -17,6 +16,15 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 def run_bench(*args, timeout=180):
     env = {**os.environ, "JAX_PLATFORMS": "cpu"}
     env.pop("PALLAS_AXON_POOL_IPS", None)
+    # conftest pins an 8-virtual-device mesh for the in-process suite;
+    # the bench subprocess must see the topology the driver's standalone
+    # `python bench.py` run sees
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   env.get("XLA_FLAGS", "")).strip()
+    if flags:
+        env["XLA_FLAGS"] = flags
+    else:
+        env.pop("XLA_FLAGS", None)
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py"), *args],
         capture_output=True, text=True, timeout=timeout, env=env, cwd=REPO)
@@ -30,9 +38,18 @@ def last_json_line(stdout: str) -> dict:
 
 
 class TestBenchContract:
+    _single = None
+
+    @classmethod
+    def single_run(cls):
+        if cls._single is None:
+            cls._single = run_bench(
+                "--scenario", "single", "--duration", "1",
+                "--keys", "500", "--deadline", "150")
+        return cls._single
+
     def test_single_scenario_emits_contract_keys(self):
-        proc = run_bench("--scenario", "single", "--duration", "1",
-                         "--keys", "500", "--deadline", "150")
+        proc = self.single_run()
         assert proc.returncode == 0, proc.stderr[-2000:]
         obj = last_json_line(proc.stdout)
         for key in ("metric", "value", "unit", "vs_baseline"):
@@ -54,7 +71,6 @@ class TestBenchContract:
     def test_progress_lines_on_stderr(self):
         """Timestamped stage lines make a driver-side timeout tail
         diagnosable."""
-        proc = run_bench("--scenario", "single", "--duration", "1",
-                         "--keys", "500", "--deadline", "150")
+        proc = self.single_run()
         assert "bench[" in proc.stderr
         assert "backend=" in proc.stderr
