@@ -10,6 +10,8 @@ import re
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -37,19 +39,15 @@ def last_json_line(stdout: str) -> dict:
     return json.loads(lines[0])
 
 
+@pytest.fixture(scope="module")
+def single_proc():
+    return run_bench("--scenario", "single", "--duration", "1",
+                     "--keys", "500", "--deadline", "150")
+
+
 class TestBenchContract:
-    _single = None
-
-    @classmethod
-    def single_run(cls):
-        if cls._single is None:
-            cls._single = run_bench(
-                "--scenario", "single", "--duration", "1",
-                "--keys", "500", "--deadline", "150")
-        return cls._single
-
-    def test_single_scenario_emits_contract_keys(self):
-        proc = self.single_run()
+    def test_single_scenario_emits_contract_keys(self, single_proc):
+        proc = single_proc
         assert proc.returncode == 0, proc.stderr[-2000:]
         obj = last_json_line(proc.stdout)
         for key in ("metric", "value", "unit", "vs_baseline"):
@@ -68,9 +66,9 @@ class TestBenchContract:
         assert obj.get("truncated") is True
         assert "metric" in obj and "vs_baseline" in obj
 
-    def test_progress_lines_on_stderr(self):
+    def test_progress_lines_on_stderr(self, single_proc):
         """Timestamped stage lines make a driver-side timeout tail
         diagnosable."""
-        proc = self.single_run()
+        proc = single_proc
         assert "bench[" in proc.stderr
         assert "backend=" in proc.stderr
