@@ -717,3 +717,30 @@ class TestSpanFlushSelfMetrics:
         sink.flush()
         by = {c[0]: c for c in statsd.calls}
         assert by["sink.spans_flushed_total"][1] == 2
+
+
+class TestXRayTraceId:
+    def test_same_trace_same_id_across_seconds(self):
+        """Without root_start_timestamp, spans of one trace agree via the
+        256 s bucket of their own starts (reference xray.go:290-306 —
+        probabilistic: only spans within one bucket agree, so the test
+        places both starts inside a single bucket)."""
+        from veneur_tpu.sinks.xray import xray_trace_id
+        a = make_span(trace_id=77, span_id=1)
+        b = make_span(trace_id=77, span_id=2)
+        base = 1_700_000_000 * 10**9  # 256-aligned epoch: bucket start
+        a.start_timestamp = base
+        b.start_timestamp = base + 5 * 10**9  # 5 s later, same bucket
+        assert xray_trace_id(a) == xray_trace_id(b)
+        # straddling a bucket boundary splits (documented reference
+        # behavior); root_start_timestamp is the robust path
+        c = make_span(trace_id=77, span_id=3)
+        c.start_timestamp = base - 10**9
+        assert xray_trace_id(c) != xray_trace_id(a)
+
+    def test_root_timestamp_preferred(self):
+        from veneur_tpu.sinks.xray import xray_trace_id
+        s = make_span(trace_id=5, span_id=1)
+        s.start_timestamp = 1_700_000_999 * 10**9
+        s.root_start_timestamp = 1_700_000_000 * 10**9
+        assert xray_trace_id(s).split("-")[1] == f"{1_700_000_000:08x}"

@@ -23,7 +23,20 @@ HEADER = b'{"format": "json", "version": 1}\n'
 
 
 def xray_trace_id(span) -> str:
-    epoch = span.start_timestamp // 10**9
+    """X-Ray trace id: 1-{epoch:8hex}-{traceid:24hex}. Segments only
+    assemble into one trace when their ids match, so the timestamp
+    component comes from the root span when the client sent one
+    (exact, like the reference), else from a ~4-minute bucket of the
+    span's own start (low byte of the epoch seconds cleared). Exact
+    parity with reference xray.go:290-306, including its caveats:
+    bucketing is probabilistic (spans straddling a 256 s boundary split)
+    and a trace whose root lacks root_start_timestamp while children
+    carry it splits — clients fix both by always setting the field."""
+    root_ns = getattr(span, "root_start_timestamp", 0)
+    if root_ns:
+        epoch = root_ns // 10**9
+    else:
+        epoch = (span.start_timestamp // 10**9) & 0xFFFFFFFFFFFF00
     tid = span.trace_id & ((1 << 96) - 1)
     return f"1-{epoch & 0xFFFFFFFF:08x}-{tid:024x}"
 
