@@ -424,9 +424,17 @@ class HistoTable(_BaseTable):
         finally:
             self.apply_lock.release()
 
-    def snapshot_and_reset(self, percentiles: Tuple[float, ...]):
+    def snapshot_and_reset(self, percentiles: Tuple[float, ...],
+                           need_export: bool = True):
         """Returns (flush outputs dict of np arrays, centroid export,
-        touched, meta)."""
+        touched, meta).
+
+        need_export=False (a global server: nothing downstream consumes
+        the serialized digests) skips the centroid export entirely — the
+        (K, C) weight/mean tables never cross the device link and the
+        pre-export compact is elided (flush_quantiles folds staging
+        itself); the flush then transfers a single packed (K, P+10)
+        array instead of ~50 MB of centroids at K=100k."""
         with self.lock:
             cols = self._swap_locked()
             self.apply_lock.acquire()
@@ -436,14 +444,20 @@ class HistoTable(_BaseTable):
         try:
             if cols is not None:
                 self._apply_cols(cols)
-            # fold any staged batches so export sees the tight main grid
-            self.state = batch_tdigest.compact(self.state)
+            ps = tuple(percentiles)
+            if need_export:
+                # fold any staged batches so export sees the tight main grid
+                self.state = batch_tdigest.compact(self.state)
+                packed = batch_tdigest.flush_quantiles_packed(
+                    self.state, ps, fold_staging=False)
+                export = batch_tdigest.export_centroids(self.state)
+            else:
+                packed = batch_tdigest.flush_quantiles_packed(
+                    self.state, ps, fold_staging=True)
+                export = None
             self._applies = 0
             self._staged_counts[:] = 0
-            out = batch_tdigest.flush_quantiles(
-                self.state, tuple(percentiles), fold_staging=False)
-            out = {k: np.asarray(v) for k, v in out.items()}
-            export = batch_tdigest.export_centroids(self.state)
+            out = batch_tdigest.unpack_flush(packed, len(ps))
             self.state = batch_tdigest.init_state(self.capacity)
         finally:
             self.apply_lock.release()

@@ -69,31 +69,29 @@ def flush_columnstore(
     fwd = ForwardableState()
 
     # ---- counters & gauges --------------------------------------------
-    c_vals, c_touched, c_meta = store.counters.snapshot_and_reset()
-    for row, meta in enumerate(c_meta):
-        if not c_touched[row]:
-            continue
-        if meta.scope == MetricScope.GLOBAL_ONLY:
-            if is_local:
+    # hot-loop shape: bulk-convert the touched rows of each device
+    # snapshot to Python lists once (numpy scalar indexing and enum
+    # bit-ops per row are what made a 100k-key flush burn seconds of
+    # GIL time)
+    def _flush_scalar_rows(vals, touched, meta_list, fwd_list, mtype):
+        rows = np.flatnonzero(touched)
+        vlist = np.asarray(vals, np.float64)[rows].tolist()
+        for i, row in enumerate(rows.tolist()):
+            meta = meta_list[row]
+            if meta.scope == MetricScope.GLOBAL_ONLY and is_local:
                 if collect_forward:
-                    fwd.counters.append((meta, float(c_vals[row])))
+                    fwd_list.append((meta, vlist[i]))
                 continue
-        final.append(InterMetric(
-            name=meta.name, timestamp=now, value=float(c_vals[row]),
-            tags=list(meta.tags), type=MetricType.COUNTER))
+            final.append(InterMetric(
+                name=meta.name, timestamp=now, value=vlist[i],
+                tags=list(meta.tags), type=mtype))
 
+    c_vals, c_touched, c_meta = store.counters.snapshot_and_reset()
+    _flush_scalar_rows(c_vals, c_touched, c_meta, fwd.counters,
+                       MetricType.COUNTER)
     g_vals, g_touched, g_meta = store.gauges.snapshot_and_reset()
-    for row, meta in enumerate(g_meta):
-        if not g_touched[row]:
-            continue
-        if meta.scope == MetricScope.GLOBAL_ONLY:
-            if is_local:
-                if collect_forward:
-                    fwd.gauges.append((meta, float(g_vals[row])))
-                continue
-        final.append(InterMetric(
-            name=meta.name, timestamp=now, value=float(g_vals[row]),
-            tags=list(meta.tags), type=MetricType.GAUGE))
+    _flush_scalar_rows(g_vals, g_touched, g_meta, fwd.gauges,
+                       MetricType.GAUGE)
 
     # ---- histograms & timers ------------------------------------------
     # full percentile list is always used for local-only rows
@@ -105,40 +103,51 @@ def flush_columnstore(
     server_ps = () if is_local else full_ps
     server_aggs = aggregates
     all_ps = tuple(sorted(set(full_ps) | {0.5}))  # median always computable
-    out, export, h_touched, h_meta = store.histos.snapshot_and_reset(all_ps)
+    need_export = is_local and collect_forward
+    out, export, h_touched, h_meta = store.histos.snapshot_and_reset(
+        all_ps, need_export=need_export)
     ps_index = {p: i for i, p in enumerate(all_ps)}
-    exp_means, exp_weights, exp_min, exp_max, exp_recip = export
+    if export is not None:
+        exp_means, exp_weights, exp_min, exp_max, exp_recip = export
 
-    for row, meta in enumerate(h_meta):
-        if not h_touched[row]:
-            continue
+    h_rows = np.flatnonzero(h_touched)
+    cols = {k: np.asarray(out[k], np.float64)[h_rows].tolist()
+            for k in ("lmin", "lmax", "lsum", "lweight", "lrecip",
+                      "min", "max", "sum", "count", "hmean")}
+    quants = np.asarray(out["quantiles"], np.float64)[h_rows].tolist()
+    server_agg_bits = int(server_aggs.value)
+    full_agg_bits = int(aggregates.value)
+
+    for i, row in enumerate(h_rows.tolist()):
+        meta = h_meta[row]
         scope = meta.scope
         if scope == MetricScope.MIXED:
-            ps, aggs, use_global = server_ps, server_aggs, False
+            ps, agg_bits, use_global = server_ps, server_agg_bits, False
         elif scope == MetricScope.LOCAL_ONLY:
-            ps, aggs, use_global = full_ps, aggregates, False
+            ps, agg_bits, use_global = full_ps, full_agg_bits, False
         else:  # GLOBAL_ONLY
             if is_local:
-                ps = ()
-                aggs, use_global = HistogramAggregates(), False
+                ps, agg_bits, use_global = (), 0, False
             else:
-                ps, aggs, use_global = full_ps, aggregates, True
-        if is_local and collect_forward and scope != MetricScope.LOCAL_ONLY:
+                ps, agg_bits, use_global = full_ps, full_agg_bits, True
+        if need_export and scope != MetricScope.LOCAL_ONLY:
             fwd.histograms.append((
                 meta, exp_means[row].copy(), exp_weights[row].copy(),
                 float(exp_min[row]), float(exp_max[row]),
                 float(exp_recip[row])))
         final.extend(_flush_histo_row(
-            meta, row, out, ps_index, now, ps, aggs, use_global))
+            meta, i, cols, quants[i], ps_index, now, ps, agg_bits,
+            use_global))
 
     # ---- sets ----------------------------------------------------------
     estimates, registers, s_touched, s_meta = store.sets.snapshot_and_reset()
-    for row, meta in enumerate(s_meta):
-        if not s_touched[row]:
-            continue
+    s_rows = np.flatnonzero(s_touched)
+    e_list = np.asarray(estimates, np.float64)[s_rows].tolist()
+    for i, row in enumerate(s_rows.tolist()):
+        meta = s_meta[row]
         if meta.scope == MetricScope.LOCAL_ONLY:
             final.append(InterMetric(
-                name=meta.name, timestamp=now, value=float(estimates[row]),
+                name=meta.name, timestamp=now, value=e_list[i],
                 tags=list(meta.tags), type=MetricType.GAUGE))
             continue
         if is_local:
@@ -146,14 +155,13 @@ def flush_columnstore(
                 fwd.sets.append((meta, registers[row].copy()))
             continue
         final.append(InterMetric(
-            name=meta.name, timestamp=now, value=float(estimates[row]),
+            name=meta.name, timestamp=now, value=e_list[i],
             tags=list(meta.tags), type=MetricType.GAUGE))
 
     # ---- status checks -------------------------------------------------
     st_vals, st_touched, st_meta = store.statuses.snapshot_and_reset()
-    for row, meta in enumerate(st_meta):
-        if not st_touched[row]:
-            continue
+    for row in np.flatnonzero(st_touched).tolist():
+        meta = st_meta[row]
         entry = st_vals[row]
         final.append(InterMetric(
             name=meta.name, timestamp=now, value=entry.value,
@@ -163,47 +171,58 @@ def flush_columnstore(
     return final, fwd
 
 
+# plain-int aggregate masks: IntFlag's __and__ allocates an enum member
+# per test, which at 100k keys x 7 aggregates is real GIL time
+_A_MIN = int(Aggregate.MIN)
+_A_MAX = int(Aggregate.MAX)
+_A_MEDIAN = int(Aggregate.MEDIAN)
+_A_AVERAGE = int(Aggregate.AVERAGE)
+_A_COUNT = int(Aggregate.COUNT)
+_A_SUM = int(Aggregate.SUM)
+_A_HMEAN = int(Aggregate.HARMONIC_MEAN)
+
+
 def _flush_histo_row(
-    meta: RowMeta, row: int, out: Dict[str, np.ndarray],
+    meta: RowMeta, row: int, cols: Dict[str, list], qrow: list,
     ps_index: Dict[float, int], now: int,
-    percentiles: Sequence[float], aggregates: HistogramAggregates,
+    percentiles: Sequence[float], agg_bits: int,
     use_global: bool,
 ) -> List[InterMetric]:
     """Emit aggregate + percentile metrics for one histogram row; condition
     and value-selection parity with reference samplers.go:359-514."""
     ms: List[InterMetric] = []
-    a = aggregates.value
-    lmin, lmax = float(out["lmin"][row]), float(out["lmax"][row])
-    lsum, lweight = float(out["lsum"][row]), float(out["lweight"][row])
-    lrecip = float(out["lrecip"][row])
-    dmin, dmax = float(out["min"][row]), float(out["max"][row])
-    dsum, dcount = float(out["sum"][row]), float(out["count"][row])
-    drecip_hmean = float(out["hmean"][row])
+    a = agg_bits
+    lmin, lmax = cols["lmin"][row], cols["lmax"][row]
+    lsum, lweight = cols["lsum"][row], cols["lweight"][row]
+    lrecip = cols["lrecip"][row]
+    dmin, dmax = cols["min"][row], cols["max"][row]
+    dsum, dcount = cols["sum"][row], cols["count"][row]
+    drecip_hmean = cols["hmean"][row]
 
     def emit(suffix, value, mtype=MetricType.GAUGE):
         ms.append(InterMetric(
             name=f"{meta.name}.{suffix}", timestamp=now, value=value,
             tags=list(meta.tags), type=mtype))
 
-    if (a & Aggregate.MAX) and (not math.isinf(lmax) or use_global):
+    if (a & _A_MAX) and (not math.isinf(lmax) or use_global):
         emit("max", dmax if use_global else lmax)
-    if (a & Aggregate.MIN) and (not math.isinf(lmin) or use_global):
+    if (a & _A_MIN) and (not math.isinf(lmin) or use_global):
         emit("min", dmin if use_global else lmin)
-    if (a & Aggregate.SUM) and (lsum != 0 or use_global):
+    if (a & _A_SUM) and (lsum != 0 or use_global):
         emit("sum", dsum if use_global else lsum)
-    if (a & Aggregate.AVERAGE) and (use_global or (lsum != 0 and lweight != 0)):
+    if (a & _A_AVERAGE) and (use_global or (lsum != 0 and lweight != 0)):
         emit("avg", (dsum / dcount) if use_global else (lsum / lweight))
-    if (a & Aggregate.COUNT) and (lweight != 0 or use_global):
+    if (a & _A_COUNT) and (lweight != 0 or use_global):
         emit("count", dcount if use_global else lweight, MetricType.COUNTER)
-    if a & Aggregate.MEDIAN:
-        emit("median", float(out["quantiles"][row, ps_index[0.5]]))
-    if (a & Aggregate.HARMONIC_MEAN) and (
+    if a & _A_MEDIAN:
+        emit("median", qrow[ps_index[0.5]])
+    if (a & _A_HMEAN) and (
             use_global or (lrecip != 0 and lweight != 0)):
         emit("hmean", drecip_hmean if use_global else (lweight / lrecip))
 
     for p in percentiles:
         ms.append(InterMetric(
             name=_percentile_name(meta.name, p), timestamp=now,
-            value=float(out["quantiles"][row, ps_index[p]]),
+            value=qrow[ps_index[p]],
             tags=list(meta.tags), type=MetricType.GAUGE))
     return ms

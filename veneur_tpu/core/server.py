@@ -504,9 +504,13 @@ class Server:
                 set_capacity=cfg.tpu.set_capacity,
                 batch_cap=cfg.tpu.batch_cap,
                 shard_devices=cfg.tpu.shards)
+            # collect_forward must match the live flush's value: need_export
+            # selects between two distinct JIT specializations (fold_staging
+            # is a static arg), and warming the wrong one would leave the
+            # first real flush paying the full compile
             flush_columnstore(
                 scratch, self.is_local, self.percentiles, self.aggregates,
-                collect_forward=False)
+                collect_forward=self.forwarder is not None)
         except Exception:
             logger.exception("kernel warmup failed")
 
@@ -568,7 +572,7 @@ class Server:
 
         final, fwd = flush_columnstore(
             self.store, self.is_local, self.percentiles, self.aggregates,
-            collect_forward=self.forwarder is not None or self.is_local)
+            collect_forward=self.forwarder is not None)
         self.stats.inc("metrics_flushed", len(final))
 
         if self.is_local and self.forwarder is not None and len(fwd):

@@ -195,7 +195,8 @@ class ShardedHistoTable(HistoTable):
             for k in self.states[0]}
         return _merge_histo_stacked(stacked)
 
-    def snapshot_and_reset(self, percentiles: Tuple[float, ...]):
+    def snapshot_and_reset(self, percentiles: Tuple[float, ...],
+                           need_export: bool = True):
         with self.lock:
             cols = self._swap_locked()
             self.apply_lock.acquire()
@@ -206,11 +207,13 @@ class ShardedHistoTable(HistoTable):
             if cols is not None:
                 self._apply_cols(cols)
             merged = self._merged_state()
+            ps = tuple(percentiles)
             # the stacked merge already folded every shard's staging
-            out = batch_tdigest.flush_quantiles(
-                merged, tuple(percentiles), fold_staging=False)
-            out = {k: np.asarray(v) for k, v in out.items()}
-            export = batch_tdigest.export_centroids(merged)
+            packed = batch_tdigest.flush_quantiles_packed(
+                merged, ps, fold_staging=False)
+            out = batch_tdigest.unpack_flush(packed, len(ps))
+            export = (batch_tdigest.export_centroids(merged)
+                      if need_export else None)
             self.states = [
                 jax.device_put(batch_tdigest.init_state(self.capacity), d)
                 for d in self._devices]

@@ -411,16 +411,8 @@ def merge_centroid_rows(state, rows, in_means, in_weights, in_min, in_max,
     return state
 
 
-@partial(jax.jit, static_argnums=(1, 2))
-def flush_quantiles(state, percentiles: Sequence[float],
-                    fold_staging: bool = True):
-    """Compute per-key digest outputs: quantiles (K, P), plus digest count,
-    sum, min, max, hmean. Interpolation parity with merging_digest.go:302-332
-    (uniform within centroid, bounds at neighbor midpoints, min/max ends).
-    By default staged-but-uncompacted slots are folded into the sort, so
-    callers need not compact first (export_centroids does require it);
-    callers that just compacted pass fold_staging=False to halve the sort
-    width."""
+def _flush_quantiles_impl(state, percentiles: Sequence[float],
+                          fold_staging: bool):
     if fold_staging:
         means, weights = _fold_grids(state)
     else:
@@ -472,6 +464,48 @@ def flush_quantiles(state, percentiles: Sequence[float],
         "lweight": state["lweight"],
         "lrecip": state["lrecip"],
     }
+
+
+@partial(jax.jit, static_argnums=(1, 2))
+def flush_quantiles(state, percentiles: Sequence[float],
+                    fold_staging: bool = True):
+    """Compute per-key digest outputs: quantiles (K, P), plus digest count,
+    sum, min, max, hmean. Interpolation parity with merging_digest.go:302-332
+    (uniform within centroid, bounds at neighbor midpoints, min/max ends).
+    By default staged-but-uncompacted slots are folded into the sort, so
+    callers need not compact first (export_centroids does require it);
+    callers that just compacted pass fold_staging=False to halve the sort
+    width."""
+    return _flush_quantiles_impl(state, percentiles, fold_staging)
+
+
+# column order of the scalar tail in flush_quantiles_packed
+FLUSH_SCALARS = ("count", "sum", "min", "max", "hmean",
+                 "lmin", "lmax", "lsum", "lweight", "lrecip")
+
+
+@partial(jax.jit, static_argnums=(1, 2))
+def flush_quantiles_packed(state, percentiles: Sequence[float],
+                           fold_staging: bool = True):
+    """flush_quantiles concatenated into one (K, P+10) float32 array.
+
+    A flush over a remote device link (PCIe, or the axon tunnel) pays a
+    round-trip per array it pulls to host; packing the 11 outputs into a
+    single device array makes the whole digest flush one transfer.
+    Unpack host-side with unpack_flush."""
+    out = _flush_quantiles_impl(state, percentiles, fold_staging)
+    cols = [out["quantiles"]] + [out[k][:, None] for k in FLUSH_SCALARS]
+    return jnp.concatenate(cols, axis=-1)
+
+
+def unpack_flush(packed, num_percentiles: int):
+    """Host-side inverse of flush_quantiles_packed: one np.asarray transfer,
+    then views. Returns the same dict shape flush_quantiles produces."""
+    packed = np.asarray(packed)
+    out = {"quantiles": packed[:, :num_percentiles]}
+    for i, k in enumerate(FLUSH_SCALARS):
+        out[k] = packed[:, num_percentiles + i]
+    return out
 
 
 def pack_centroids(means, weights, cap: int = C):
