@@ -54,6 +54,11 @@ class RowMeta:
     digest32: int
     scope: MetricScope
     wire_type: str  # counter/gauge/histogram/timer/set/status
+    # per-row cache of rendered flush-metric names ("x.max",
+    # "x.99percentile", ...): metas persist across intervals, so the
+    # flusher's hot loop renders each name once per key lifetime instead
+    # of once per flush
+    flush_names: dict = None
 
 
 class _BaseTable:

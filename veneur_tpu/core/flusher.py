@@ -199,9 +199,16 @@ def _flush_histo_row(
     dsum, dcount = cols["sum"][row], cols["count"][row]
     drecip_hmean = cols["hmean"][row]
 
+    names = meta.flush_names
+    if names is None:
+        names = meta.flush_names = {}
+
     def emit(suffix, value, mtype=MetricType.GAUGE):
+        nm = names.get(suffix)
+        if nm is None:
+            nm = names[suffix] = f"{meta.name}.{suffix}"
         ms.append(InterMetric(
-            name=f"{meta.name}.{suffix}", timestamp=now, value=value,
+            name=nm, timestamp=now, value=value,
             tags=list(meta.tags), type=mtype))
 
     if (a & _A_MAX) and (not math.isinf(lmax) or use_global):
@@ -221,8 +228,10 @@ def _flush_histo_row(
         emit("hmean", drecip_hmean if use_global else (lweight / lrecip))
 
     for p in percentiles:
+        nm = names.get(p)
+        if nm is None:
+            nm = names[p] = _percentile_name(meta.name, p)
         ms.append(InterMetric(
-            name=_percentile_name(meta.name, p), timestamp=now,
-            value=qrow[ps_index[p]],
+            name=nm, timestamp=now, value=qrow[ps_index[p]],
             tags=list(meta.tags), type=MetricType.GAUGE))
     return ms

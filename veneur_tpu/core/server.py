@@ -196,6 +196,7 @@ class Server:
 
         self.http_api = None  # set in start() when http_address
         self.profiler = None  # set in start() when enable_profiling
+        self._warmup_thread = None  # set in start()
         self._listeners: List[networking.Listener] = []
         self._flush_lock = threading.Lock()
         # last flush thread per sink: a sink whose previous flush is still
@@ -398,9 +399,12 @@ class Server:
             from veneur_tpu.core.profiling import start_profile_server
             start_profile_server(self.config.profile_server_port)
         # pre-compile the flush kernels off the ticker path so the first
-        # real flush isn't delayed by XLA compilation (~20-40s on TPU)
-        threading.Thread(target=self._warmup, name="kernel-warmup",
-                         daemon=True).start()
+        # real flush isn't delayed by XLA compilation (~20-40s on TPU);
+        # kept as an attribute so callers that pre-load the store (bench,
+        # tests) can join it before measuring
+        self._warmup_thread = threading.Thread(
+            target=self._warmup, name="kernel-warmup", daemon=True)
+        self._warmup_thread.start()
         if self.diagnostics is not None:
             self.diagnostics.start()
         self._flush_thread = threading.Thread(

@@ -150,10 +150,12 @@ def update_tags(
 RouteInformation = Optional[set]
 
 
-@dataclass
+@dataclass(slots=True)
 class InterMetric:
     """A completed metric ready for flushing by sinks
-    (reference samplers.go:34-47)."""
+    (reference samplers.go:34-47). Slotted: a 100k-key flush creates
+    hundreds of thousands of these per interval and the __dict__-free
+    layout measurably cuts that loop's GIL time."""
 
     name: str
     timestamp: int
