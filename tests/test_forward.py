@@ -194,3 +194,33 @@ class TestLocalGlobalEndToEnd:
             client.close()
         finally:
             gserver.shutdown()
+
+
+class TestForwardOnly:
+    def test_forward_only_promotes_default_scope(self):
+        """forward_only makes undeclared-scope metrics global-only, so a
+        local server forwards everything and flushes nothing for them
+        (reference server.go:547-552, worker.go:353-354)."""
+        received = []
+        ft = ForwardTestServer(received.extend)
+        ft.start()
+        try:
+            cfg = make_config(forward_address=ft.address, forward_only=True)
+            sink = ChannelMetricSink()
+            server = Server(cfg, extra_metric_sinks=[sink])
+            server.start()
+            server.handle_metric_packet(b"fo.plain:7|c")  # no scope tag
+            server.handle_metric_packet(b"fo.pinned:1|c|#veneurlocalonly")
+            server.flush()
+            assert wait_until(lambda: len(received) >= 1)
+            by = {p.name: p for p in received}
+            assert by["fo.plain"].counter.value == 7
+            assert by["fo.plain"].scope == metric_pb2.Global
+            # an explicit local pin still beats the forward_only default
+            assert "fo.pinned" not in by
+            local = {m.name for m in sink.drain()}
+            assert "fo.pinned" in local
+            assert "fo.plain" not in local
+            server.shutdown()
+        finally:
+            ft.stop()

@@ -298,3 +298,42 @@ class TestTraceClient:
         assert got.name == "udp-span"
         client.close()
         rx.close()
+
+
+class TestTraceMaxLength:
+    def test_config_cap_closes_oversized_frame_stream(self):
+        """trace_max_length_bytes bounds accepted SSF frames (reference
+        server.go:498): an oversized frame is a framing error and the
+        stream closes without the span being ingested."""
+        cfg = generate_config()
+        cfg.ssf_listen_addresses = ["tcp://127.0.0.1:0"]
+        cfg.trace_max_length_bytes = 32
+        server, _ = setup_server(cfg)
+        server.start()
+        try:
+            addr = server.local_addr("ssf-tcp")
+            sock = socket.create_connection(addr)
+            f = sock.makefile("wb")
+            big = mkspan(id=21)
+            big.tags["pad"] = "x" * 128  # encodes well past 32 bytes
+            protocol.write_ssf(f, big)
+            f.flush()
+            # server must hang up on the framing violation
+            sock.settimeout(5)
+            assert sock.recv(1) == b""
+            assert server.metric_extraction.spans_processed == 0
+            sock.close()
+
+            # frames under the cap still flow on a new connection
+            sock2 = socket.create_connection(addr)
+            f2 = sock2.makefile("wb")
+            protocol.write_ssf(f2, mkspan(id=22))
+            f2.flush()
+            deadline = time.time() + 5
+            while (server.metric_extraction.spans_processed < 1
+                   and time.time() < deadline):
+                time.sleep(0.01)
+            assert server.metric_extraction.spans_processed == 1
+            sock2.close()
+        finally:
+            server.shutdown()

@@ -165,6 +165,8 @@ class Config:
             self.read_buffer_size_bytes = 2 * 1024 * 1024
         if self.span_channel_capacity <= 0:
             self.span_channel_capacity = 100
+        if self.trace_max_length_bytes <= 0:
+            self.trace_max_length_bytes = 16 * 1024 * 1024
         return self
 
     @property

@@ -305,11 +305,16 @@ def _start_ssf_udp(u, server, rcvbuf: int) -> Listener:
     sock = _new_udp_socket(host, u.port or 0, rcvbuf, reuseport=False)
     threads: List[threading.Thread] = []
     listener = Listener("ssf-udp", sock.getsockname(), sock, threads)
+    # per-read buffer size follows trace_max_length_bytes (reference
+    # server.go:498's packetPool), clamped to the UDP datagram ceiling
+    max_read = min(max(
+        int(getattr(server.config, "trace_max_length_bytes", _MAX_DGRAM)),
+        1), _MAX_DGRAM)
 
     def read_loop():
         while not listener.closed:
             try:
-                buf = sock.recv(_MAX_DGRAM)
+                buf = sock.recv(max_read)
             except OSError:
                 return
             if buf:
@@ -364,20 +369,33 @@ def _read_ssf_frames(conn, server, listener: Listener) -> None:
     """Framed stream read loop (reference server.go:1200-1237): framing
     errors are fatal to the stream, decode-level errors are not."""
     from veneur_tpu import protocol
+    max_len = int(getattr(server.config, "trace_max_length_bytes",
+                          protocol.MAX_SSF_PACKET_LENGTH))
     stream = conn.makefile("rb")
-    with conn:
-        while not listener.closed:
-            try:
-                span = protocol.read_ssf(stream)
-            except protocol.SSFDecodeError as e:
-                # frame boundary is intact; skip the bad span, keep reading
-                logger.debug("dropping undecodable SSF span: %s", e)
-                continue
-            except protocol.FramingError as e:
-                logger.warning("closing SSF stream on framing error: %s", e)
-                return
-            except OSError:
-                return
-            if span is None:
-                return
-            server.ingest_span(span)
+    # explicit close in a finally: the makefile holds a reference on the
+    # socket fd, so `with conn` alone leaves the connection half-open (no
+    # FIN to the peer) until the stream object happens to be collected
+    try:
+        with conn:
+            while not listener.closed:
+                try:
+                    span = protocol.read_ssf(stream, max_length=max_len)
+                except protocol.SSFDecodeError as e:
+                    # frame boundary is intact; skip the bad span, keep
+                    # reading
+                    logger.debug("dropping undecodable SSF span: %s", e)
+                    continue
+                except protocol.FramingError as e:
+                    logger.warning(
+                        "closing SSF stream on framing error: %s", e)
+                    return
+                except OSError:
+                    return
+                if span is None:
+                    return
+                server.ingest_span(span)
+    finally:
+        try:
+            stream.close()
+        except OSError:
+            pass

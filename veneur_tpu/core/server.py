@@ -93,7 +93,13 @@ class Server:
                  extra_span_sinks: Optional[List] = None):
         self.config = config
         self.interval = config.interval
-        self.parser = Parser(extend_tags=config.extend_tags)
+        # forward_only: metrics that don't declare a scope become
+        # global-only, so a local server aggregates nothing itself and
+        # forwards everything (reference server.go:547-552)
+        self.parser = Parser(
+            extend_tags=config.extend_tags,
+            default_scope=(MetricScope.GLOBAL_ONLY if config.forward_only
+                           else MetricScope.MIXED))
         self.store = ColumnStore(
             counter_capacity=config.tpu.counter_capacity,
             gauge_capacity=config.tpu.gauge_capacity,

@@ -85,9 +85,13 @@ def _read_exact(stream: BinaryIO, n: int) -> Optional[bytes]:
     return bytes(buf)
 
 
-def read_ssf(stream: BinaryIO) -> Optional[ssf_pb2.SSFSpan]:
+def read_ssf(stream: BinaryIO,
+             max_length: int = MAX_SSF_PACKET_LENGTH,
+             ) -> Optional[ssf_pb2.SSFSpan]:
     """Read one framed span. Returns None on clean EOF at a frame
-    boundary; raises FramingError on any mid-frame or header corruption."""
+    boundary; raises FramingError on any mid-frame or header corruption.
+    max_length caps the accepted frame body (config
+    trace_max_length_bytes, reference server.go:498)."""
     first = stream.read(1)
     if not first:
         return None  # clean hang-up between messages
@@ -98,9 +102,9 @@ def read_ssf(stream: BinaryIO) -> Optional[ssf_pb2.SSFSpan]:
     if hdr is None:
         raise FramingError("EOF inside SSF frame header")
     (length,) = struct.unpack(">I", hdr)
-    if length > MAX_SSF_PACKET_LENGTH:
+    if length > max_length:
         raise FramingError(f"SSF frame length {length} exceeds "
-                           f"{MAX_SSF_PACKET_LENGTH}")
+                           f"{max_length}")
     body = _read_exact(stream, length)
     if body is None:
         raise FramingError("EOF inside SSF frame body")

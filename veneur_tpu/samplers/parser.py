@@ -83,8 +83,14 @@ class Event:
 
 class Parser:
     def __init__(self, extend_tags: Optional[Sequence[str]] = None,
-                 cache_size: int = 1 << 16):
+                 cache_size: int = 1 << 16,
+                 default_scope: MetricScope = MetricScope.MIXED):
         self.extend_tags = tagging.ExtendTags(extend_tags or ())
+        # scope given to metrics that don't declare one; forward_only
+        # servers pass GLOBAL_ONLY so every metric forwards (reference
+        # server.go:547-552, worker.go:353-354). Explicit
+        # veneurlocalonly/veneurglobalonly tags still win.
+        self.default_scope = default_scope
         # metadata cache: everything except the value chunk parses once per
         # unique timeseries; steady-state traffic repeats keys, so the hot
         # path becomes one dict hit + value conversion
@@ -169,7 +175,7 @@ class Parser:
         sample_rate = 1.0
         found_sample_rate = False
         temp_tags: Optional[List[str]] = None
-        scope = MetricScope.MIXED
+        scope = self.default_scope
 
         # metadata sections after the type, each at most once
         while tags_start < len(packet):
@@ -354,7 +360,7 @@ class Parser:
         timestamp = int(time.time())
         hostname = ""
         message = ""
-        scope = MetricScope.MIXED
+        scope = self.default_scope
         temp_tags: Optional[List[str]] = None
         seen = set()
         found_message = False
@@ -431,7 +437,7 @@ class Parser:
         else:
             value = float(sample.value)
 
-        scope = MetricScope.MIXED
+        scope = self.default_scope
         if sample.scope == 1:
             scope = MetricScope.LOCAL_ONLY
         elif sample.scope == 2:
