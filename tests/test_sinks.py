@@ -744,3 +744,241 @@ class TestXRayTraceId:
         s.start_timestamp = 1_700_000_999 * 10**9
         s.root_start_timestamp = 1_700_000_000 * 10**9
         assert xray_trace_id(s).split("-")[1] == f"{1_700_000_000:08x}"
+
+
+class TestSignalFxRoutingExtras:
+    def test_metric_tag_prefix_drops(self, fake):
+        from veneur_tpu.sinks.signalfx import SignalFxMetricSink
+        sink = SignalFxMetricSink(
+            "signalfx", api_key="t", endpoint=fake.url, hostname="sh",
+            metric_tag_prefix_drops=["internal."])
+        sink.flush([
+            im("kept", 1, MetricType.GAUGE, tags=["env:prod"]),
+            im("dropped", 1, MetricType.GAUGE,
+               tags=["internal.debug:yes"])])
+        payload = json.loads(fake.requests[0][2])
+        names = {p["metric"] for kind in payload.values() for p in kind}
+        assert names == {"kept"}
+        assert sink.skipped_total == 1
+
+    def test_preferred_vary_key_beats_vary_key(self, fake):
+        from veneur_tpu.sinks.signalfx import SignalFxMetricSink
+        sink = SignalFxMetricSink(
+            "signalfx", api_key="default-tok", endpoint=fake.url,
+            hostname="sh", vary_key_by="customer",
+            preferred_vary_key_by="team",
+            per_tag_tokens={"acme": "acme-tok", "infra": "infra-tok"})
+        sink.flush([im("m1", 1, MetricType.GAUGE,
+                       tags=["customer:acme", "team:infra"])])
+        tok = next(v for k, v in fake.requests[0][1].items()
+                   if k.lower() == "x-sf-token")
+        assert tok == "infra-tok"
+
+    def test_excluded_tag_still_routes_token(self, fake):
+        """Token selection sees the full dimension set; excluded tags are
+        removed only afterwards (signalfx.go:534-564)."""
+        from veneur_tpu.sinks.signalfx import SignalFxMetricSink
+        sink = SignalFxMetricSink(
+            "signalfx", api_key="default-tok", endpoint=fake.url,
+            hostname="sh", vary_key_by="customer",
+            excluded_tags=["customer"],
+            per_tag_tokens={"acme": "acme-tok"})
+        sink.flush([im("m1", 1, MetricType.GAUGE, tags=["customer:acme"])])
+        _, headers, body = fake.requests[0]
+        tok = next(v for k, v in headers.items()
+                   if k.lower() == "x-sf-token")
+        assert tok == "acme-tok"
+        dims = json.loads(body)["gauge"][0]["dimensions"]
+        assert "customer" not in dims
+
+    def test_fetch_api_keys_paginates(self):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+        from urllib.parse import parse_qs, urlparse
+
+        from veneur_tpu.sinks.signalfx import fetch_api_keys
+
+        pages = {
+            0: [{"name": "a", "secret": "s-a"},
+                {"name": "b", "secret": "s-b"}],
+            200: [{"name": "c", "secret": "s-c"}],
+            400: [],
+        }
+        seen_tokens = []
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_GET(self):  # noqa: N802
+                q = parse_qs(urlparse(self.path).query)
+                seen_tokens.append(self.headers.get("X-SF-Token"))
+                body = json.dumps(
+                    {"results": pages[int(q["offset"][0])]}).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        try:
+            url = f"http://127.0.0.1:{httpd.server_address[1]}"
+            tokens = fetch_api_keys(url, "api-tok")
+            assert tokens == {"a": "s-a", "b": "s-b", "c": "s-c"}
+            assert set(seen_tokens) == {"api-tok"}
+        finally:
+            httpd.shutdown()
+
+    def test_dynamic_keys_require_refresh_period(self):
+        from veneur_tpu.config import Config, SinkConfig
+        from veneur_tpu.sinks import MetricSinkTypes, register_builtin_sinks
+        register_builtin_sinks()
+        cfg = Config()
+        cfg.apply_defaults()
+        sc = SinkConfig(kind="signalfx", name="sfx", config={
+            "dynamic_per_tag_api_keys_enable": True})
+        with pytest.raises(ValueError, match="refresh period is unset"):
+            MetricSinkTypes["signalfx"](sc, cfg)
+
+
+class TestKafkaProducerConfig:
+    def test_ack_and_partitioner_mapping(self):
+        from veneur_tpu.sinks.kafka import ProducerConfig
+        kw = ProducerConfig(require_acks="local").kafka_python_kwargs()
+        assert kw["acks"] == 1
+        kw = ProducerConfig(require_acks="none").kafka_python_kwargs()
+        assert kw["acks"] == 0
+        # unknown ack level falls back to all (kafka.go:155-158)
+        kw = ProducerConfig(require_acks="bogus").kafka_python_kwargs()
+        assert kw["acks"] == "all"
+        kw = ProducerConfig(partitioner="random").kafka_python_kwargs()
+        assert callable(kw["partitioner"])
+        assert kw["partitioner"](b"k", [0, 1, 2], [1, 2]) in (1, 2)
+
+    def test_from_config_reads_reference_keys(self):
+        from veneur_tpu.sinks.kafka import ProducerConfig
+        pc = ProducerConfig.from_config({
+            "metric_require_acks": "local",
+            "partitioner": "random",
+            "retry_max": 7,
+            "metric_buffer_bytes": 1024,
+            "metric_buffer_messages": 50,
+            "metric_buffer_frequency": "500ms",
+        }, "metric")
+        assert pc.require_acks == "local"
+        assert pc.partitioner == "random"
+        assert pc.retry_max == 7
+        kw = pc.kafka_python_kwargs()
+        assert kw["batch_size"] == 1024
+        assert kw["linger_ms"] == 500
+        assert kw["retries"] == 7
+        # the reference misspells span_buffer_mesages; both spellings work
+        pc2 = ProducerConfig.from_config({"span_buffer_mesages": 9}, "span")
+        assert pc2.buffer_messages == 9
+
+
+class TestCortexMonotonic:
+    def test_counters_accumulate_across_flushes(self, fake):
+        from veneur_tpu.sinks.cortex import (
+            CortexMetricSink, decode_write_request)
+        sink = CortexMetricSink("cortex", url=fake.url, hostname="ch",
+                                convert_counters_to_monotonic=True)
+        sink.flush([im("req", 3, MetricType.COUNTER, tags=["a:b"]),
+                    im("g", 1, MetricType.GAUGE)])
+        sink.flush([im("req", 4, MetricType.COUNTER, tags=["a:b"])])
+        first = decode_write_request(
+            vhttp.snappy_decode(fake.requests[0][2]))
+        second = decode_write_request(
+            vhttp.snappy_decode(fake.requests[1][2]))
+        by_name_1 = {l["__name__"]: v for l, v, _ in first}
+        by_name_2 = {l["__name__"]: v for l, v, _ in second}
+        assert by_name_1["req"] == 3  # running total after first flush
+        assert by_name_1["g"] == 1  # gauges pass through untouched
+        assert by_name_2["req"] == 7  # 3 + 4: monotonic, not per-interval
+
+
+class TestCloudWatchUnitTag:
+    def test_unit_tag_sets_unit_and_drops_dimension(self, fake):
+        from veneur_tpu.sinks.cloudwatch import CloudWatchMetricSink
+        sink = CloudWatchMetricSink("cloudwatch", endpoint=fake.url + "/",
+                                    namespace="ns")
+        sink.flush([im("cw.t", 1.0, MetricType.GAUGE,
+                       tags=["cloudwatch_standard_unit:Seconds",
+                             "az:us-1a", "illegal-no-colon"])])
+        params = dict(urllib.parse.parse_qsl(fake.requests[0][2].decode()))
+        assert params["MetricData.member.1.Unit"] == "Seconds"
+        dims = {v for k, v in params.items() if "Dimensions" in k}
+        assert "cloudwatch_standard_unit" not in dims
+        assert "illegal-no-colon" not in dims
+        assert params["MetricData.member.1.Dimensions.member.1.Name"] == "az"
+
+
+class TestSplunkBatching:
+    def test_batch_size_splits_bodies(self, fake):
+        from veneur_tpu.sinks.splunk import SplunkSpanSink
+        sink = SplunkSpanSink("splunk", hec_address=fake.url, token="t",
+                              hostname="h", batch_size=2,
+                              submission_workers=3)
+        for tid in range(1, 6):
+            sink.ingest(make_span(trace_id=tid))
+        sink.flush()
+        assert len(fake.requests) == 3  # ceil(5/2)
+        total = sum(len(b.splitlines()) for _, _, b in fake.requests)
+        assert total == 5
+
+
+class TestLightstepMaxSpans:
+    def test_maximum_spans_bounds_buffer(self, fake):
+        from veneur_tpu.sinks.lightstep import LightStepSpanSink
+        sink = LightStepSpanSink("ls", access_token="t",
+                                 collector_url=fake.url,
+                                 maximum_spans=3)
+        for sid in range(10):
+            sink.ingest(make_span(trace_id=1, span_id=sid + 1))
+        assert sink.dropped_total == 7
+        sink.flush()
+        payload = json.loads(fake.requests[0][2])
+        assert len(payload["span_records"]) == 3
+
+
+class TestNewRelicEvents:
+    def test_service_checks_become_custom_events(self, fake):
+        from veneur_tpu.sinks.newrelic import NewRelicMetricSink
+        sink = NewRelicMetricSink(
+            "nr", insert_key="k", hostname="nh", interval=10.0,
+            metric_url=fake.url + "/metric", account_id=42,
+            event_url=fake.url + "/events")
+        sink.flush([im("svc.up", 2, MetricType.STATUS, tags=["env:prod"]),
+                    im("g", 1, MetricType.GAUGE)])
+        by_path = {p: json.loads(b) for p, _, b in fake.requests}
+        events = by_path["/events"]
+        assert events[0]["eventType"] == "veneurCheck"
+        assert events[0]["status"] == "CRITICAL"
+        assert events[0]["statusCode"] == 2
+        assert events[0]["env"] == "prod"
+        metrics = by_path["/metric"][0]["metrics"]
+        assert [m["name"] for m in metrics] == ["g"]
+
+    def test_dogstatsd_events_flush_with_event_type(self, fake):
+        from veneur_tpu.samplers.parser import Event
+        from veneur_tpu.sinks.newrelic import NewRelicMetricSink
+        sink = NewRelicMetricSink(
+            "nr", insert_key="k", hostname="nh", interval=10.0,
+            metric_url=fake.url + "/metric", event_type="myEvents",
+            event_url=fake.url + "/events")
+        sink.flush_other_samples([
+            Event(name="deploy", message="done", timestamp=5,
+                  tags={"env": "prod"})])
+        events = json.loads(fake.requests[0][2])
+        assert events[0]["eventType"] == "myEvents"
+        assert events[0]["name"] == "deploy"
+        assert events[0]["env"] == "prod"
+
+    def test_events_dropped_without_account(self, fake):
+        from veneur_tpu.sinks.newrelic import NewRelicMetricSink
+        sink = NewRelicMetricSink(
+            "nr", insert_key="k", hostname="nh", interval=10.0,
+            metric_url=fake.url + "/metric")
+        sink.flush([im("svc.up", 0, MetricType.STATUS)])
+        # no event endpoint configured: nothing POSTed anywhere
+        assert fake.requests == []

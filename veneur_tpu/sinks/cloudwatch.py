@@ -65,35 +65,58 @@ def sigv4_headers(method: str, url: str, body: bytes, region: str,
     }
 
 
+DEFAULT_STANDARD_UNIT_TAG = "cloudwatch_standard_unit"  # cloudwatch.go:24
+
+
 def datum_params(index: int, m: InterMetric,
-                 standard_unit: str = "None") -> Dict[str, str]:
-    """Flatten one MetricDatum into Query-API form params."""
+                 standard_unit_tag: str = DEFAULT_STANDARD_UNIT_TAG,
+                 default_unit: str = "None") -> Dict[str, str]:
+    """Flatten one MetricDatum into Query-API form params. A tag named
+    `standard_unit_tag` supplies the datum's Unit (falling back to
+    `default_unit`) and is excluded from dimensions; tags without a
+    colon are dropped as illegal (reference cloudwatch.go:137-152)."""
+    unit = default_unit
+    dims = []
+    for tag in m.tags:
+        k, sep, v = tag.partition(":")
+        if not sep:
+            continue  # drop illegal tag
+        if k == standard_unit_tag:
+            unit = v or default_unit
+            continue
+        # the API rejects empty dimension values; valued-but-empty tags
+        # keep the historical "true" placeholder
+        dims.append((k, v or "true"))
     p = {f"MetricData.member.{index}.MetricName": m.name,
          f"MetricData.member.{index}.Value": repr(float(m.value)),
-         f"MetricData.member.{index}.Unit": standard_unit,
+         f"MetricData.member.{index}.Unit": unit,
          f"MetricData.member.{index}.Timestamp":
              datetime.datetime.fromtimestamp(
                  m.timestamp, datetime.timezone.utc).strftime(
                  "%Y-%m-%dT%H:%M:%SZ")}
-    for di, tag in enumerate(m.tags[:30], start=1):  # API cap: 30 dims
-        k, _, v = tag.partition(":")
+    for di, (k, v) in enumerate(dims[:30], start=1):  # API cap: 30 dims
         p[f"MetricData.member.{index}.Dimensions.member.{di}.Name"] = k
-        p[f"MetricData.member.{index}.Dimensions.member.{di}.Value"] = \
-            v or "true"
+        p[f"MetricData.member.{index}.Dimensions.member.{di}.Value"] = v
     return p
 
 
 class CloudWatchMetricSink(MetricSink):
     def __init__(self, name: str, endpoint: str, namespace: str,
                  region: str = "", credentials: Tuple[str, str] = ("", ""),
-                 standard_unit: str = "None", timeout: float = 10.0):
+                 standard_unit_tag: str = DEFAULT_STANDARD_UNIT_TAG,
+                 default_unit: str = "None",
+                 timeout: float = 10.0, disable_retries: bool = False):
         self._name = name
         self.endpoint = endpoint
         self.namespace = namespace
         self.region = region
         self.credentials = credentials
-        self.standard_unit = standard_unit
+        self.standard_unit_tag = standard_unit_tag
+        self.default_unit = default_unit
         self.timeout = timeout
+        # aws_disable_retries maps to the SDK's NopRetryer
+        # (cloudwatch.go:123-125); default is one retry pass
+        self.max_attempts = 1 if disable_retries else 3
 
     def name(self) -> str:
         return self._name
@@ -108,19 +131,25 @@ class CloudWatchMetricSink(MetricSink):
             params = {"Action": "PutMetricData", "Version": "2010-08-01",
                       "Namespace": self.namespace}
             for j, m in enumerate(chunk, start=1):
-                params.update(datum_params(j, m, self.standard_unit))
+                params.update(datum_params(
+                    j, m, self.standard_unit_tag, self.default_unit))
             body = urllib.parse.urlencode(params).encode()
             headers = {}
             if self.credentials[0]:
                 headers = sigv4_headers(
                     "POST", self.endpoint, body, self.region,
                     *self.credentials)
-            try:
-                vhttp.post(self.endpoint, body,
-                           content_type="application/x-www-form-urlencoded",
-                           headers=headers, timeout=self.timeout)
-            except Exception as e:
-                logger.error("cloudwatch PutMetricData failed: %s", e)
+            for attempt in range(1, self.max_attempts + 1):
+                try:
+                    vhttp.post(
+                        self.endpoint, body,
+                        content_type="application/x-www-form-urlencoded",
+                        headers=headers, timeout=self.timeout)
+                    break
+                except Exception as e:
+                    if attempt == self.max_attempts:
+                        logger.error(
+                            "cloudwatch PutMetricData failed: %s", e)
 
 
 @register_metric_sink("cloudwatch")
@@ -129,10 +158,15 @@ def _factory(sink_config, server_config):
     region = c.get("aws_region", "us-east-1")
     return CloudWatchMetricSink(
         sink_config.name or "cloudwatch",
-        endpoint=c.get("aws_endpoint",
-                       f"https://monitoring.{region}.amazonaws.com/"),
+        endpoint=(c.get("cloudwatch_endpoint", "")
+                  or c.get("aws_endpoint",
+                           f"https://monitoring.{region}.amazonaws.com/")),
         namespace=c.get("cloudwatch_namespace", "veneur"),
         region=region,
         credentials=(str(c.get("aws_access_key_id", "")),
                      str(c.get("aws_secret_access_key", ""))),
-        standard_unit=c.get("cloudwatch_standard_unit", "None"))
+        standard_unit_tag=c.get("cloudwatch_standard_unit_tag_name",
+                                DEFAULT_STANDARD_UNIT_TAG),
+        default_unit=c.get("cloudwatch_standard_unit", "None"),
+        timeout=float(c.get("remote_timeout", 10.0)),
+        disable_retries=bool(c.get("aws_disable_retries", False)))

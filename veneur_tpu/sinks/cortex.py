@@ -153,13 +153,24 @@ class CortexMetricSink(MetricSink):
     def __init__(self, name: str, url: str, hostname: str,
                  auth_token: str = "", basic_auth: Tuple[str, str] = ("", ""),
                  batch_write_size: int = 0, timeout: float = 30.0,
-                 excluded_tags: Sequence[str] = ()):
+                 excluded_tags: Sequence[str] = (),
+                 proxy_url: str = "",
+                 convert_counters_to_monotonic: bool = False):
         self._name = name
         self.url = url
         self.hostname = hostname
         self.timeout = timeout
         self.batch_write_size = batch_write_size
         self.excluded_tags = set(excluded_tags)
+        # HTTP(S) proxy for the remote-write transport (cortex.go:176-183)
+        self.proxy_url = proxy_url
+        # monotonic mode: counter deltas accumulate across flushes per
+        # (name, sorted tags, hostname) and every flush re-emits the
+        # running totals as Prometheus-style monotonic series
+        # (cortex.go:337-363; like the reference, entries live for the
+        # process lifetime — high-churn tag sets grow the map)
+        self.convert_counters_to_monotonic = convert_counters_to_monotonic
+        self._monotonic: Dict[Tuple[str, Tuple[str, ...], str], float] = {}
         self.headers = {
             "Content-Encoding": "snappy",
             "X-Prometheus-Remote-Write-Version": "0.1.0",
@@ -191,8 +202,26 @@ class CortexMetricSink(MetricSink):
         return ordered, float(m.value), m.timestamp * 1000
 
     def flush(self, metrics: List[InterMetric]) -> None:
-        series = [self._series(m) for m in metrics
-                  if m.type != MetricType.STATUS]
+        import time as _time
+
+        series = []
+        for m in metrics:
+            if m.type == MetricType.STATUS:
+                continue
+            if (m.type == MetricType.COUNTER
+                    and self.convert_counters_to_monotonic):
+                key = (m.name, tuple(sorted(m.tags)), m.hostname)
+                self._monotonic[key] = (
+                    self._monotonic.get(key, 0.0) + float(m.value))
+                continue
+            series.append(self._series(m))
+        if self.convert_counters_to_monotonic:
+            now = int(_time.time())
+            for (mname, tags, mhost), total in self._monotonic.items():
+                series.append(self._series(InterMetric(
+                    name=mname, timestamp=now, value=total,
+                    tags=list(tags), type=MetricType.COUNTER,
+                    hostname=mhost)))
         if not series:
             return
         batch = self.batch_write_size or len(series)
@@ -202,7 +231,8 @@ class CortexMetricSink(MetricSink):
             try:
                 vhttp.post(self.url, body,
                            content_type="application/x-protobuf",
-                           headers=self.headers, timeout=self.timeout)
+                           headers=self.headers, timeout=self.timeout,
+                           proxy_url=self.proxy_url)
             except Exception as e:
                 logger.error("cortex remote write failed: %s", e)
 
@@ -221,4 +251,7 @@ def _factory(sink_config, server_config):
                     str(basic.get("password", ""))),
         batch_write_size=int(c.get("batch_write_size", 0)),
         timeout=float(c.get("remote_timeout", 30.0)),
-        excluded_tags=c.get("excluded_tags", []) or [])
+        excluded_tags=c.get("excluded_tags", []) or [],
+        proxy_url=c.get("proxy_url", ""),
+        convert_counters_to_monotonic=bool(
+            c.get("convert_counters_to_monotonic", False)))

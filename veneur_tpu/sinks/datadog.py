@@ -294,8 +294,10 @@ def _metric_factory(sink_config, server_config):
     c = sink_config.config
     return DatadogMetricSink(
         sink_config.name or "datadog",
-        api_key=str(c.get("datadog_api_key", "")),
-        api_url=c.get("datadog_api_hostname", "https://app.datadoghq.com"),
+        api_key=str(c.get("datadog_api_key", c.get("api_key", ""))),
+        api_url=c.get("datadog_api_hostname",
+                      c.get("api_hostname",
+                            "https://app.datadoghq.com")),
         hostname=server_config.hostname,
         interval=server_config.interval,
         flush_max_per_body=int(c.get("datadog_flush_max_per_body", 25_000)),

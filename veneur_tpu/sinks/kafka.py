@@ -51,27 +51,105 @@ class InMemoryProducer(Producer):
             self.messages.append((topic, key, value))
 
 
+class ProducerConfig:
+    """Producer tuning with the reference's sarama semantics
+    (sinks/kafka/kafka.go:142-187): ack level all/none/local,
+    hash-or-random partitioning, bounded retries, and byte/message/time
+    flush triggers."""
+
+    def __init__(self, require_acks: str = "all", partitioner: str = "hash",
+                 retry_max: int = 3, buffer_bytes: int = 0,
+                 buffer_messages: int = 0, buffer_frequency_s: float = 0.0):
+        if require_acks not in ("all", "none", "local"):
+            logger.warning("unknown ack requirement %r, defaulting to all",
+                           require_acks)
+            require_acks = "all"
+        if partitioner not in ("hash", "random"):
+            partitioner = "hash"
+        self.require_acks = require_acks
+        self.partitioner = partitioner
+        self.retry_max = retry_max
+        self.buffer_bytes = buffer_bytes
+        self.buffer_messages = buffer_messages
+        self.buffer_frequency_s = buffer_frequency_s
+
+    @classmethod
+    def from_config(cls, c: dict, prefix: str) -> "ProducerConfig":
+        """Reads the reference's yaml keys: metric_require_acks /
+        span_require_acks, partitioner, retry_max, metric_buffer_bytes /
+        metric_buffer_messages / metric_buffer_frequency and the span_
+        equivalents (span_buffer_bytes, span_buffer_frequency,
+        span_buffer_mesages — the reference's spelling)."""
+        from veneur_tpu.config import parse_duration
+        freq = c.get(f"{prefix}_buffer_frequency", 0)
+        return cls(
+            require_acks=c.get(f"{prefix}_require_acks", "all"),
+            partitioner=c.get("partitioner", "hash"),
+            retry_max=int(c.get("retry_max", c.get("retries", 3))),
+            buffer_bytes=int(c.get(f"{prefix}_buffer_bytes", 0)),
+            buffer_messages=int(c.get(f"{prefix}_buffer_messages",
+                                      # reference spells this one
+                                      # "span_buffer_mesages" (sic)
+                                      c.get(f"{prefix}_buffer_mesages", 0))),
+            buffer_frequency_s=parse_duration(freq) if freq else 0.0)
+
+    def kafka_python_kwargs(self) -> dict:
+        kw: dict = {
+            "acks": {"all": "all", "none": 0, "local": 1}[self.require_acks],
+            "retries": self.retry_max,
+        }
+        if self.buffer_bytes:
+            kw["batch_size"] = self.buffer_bytes
+        if self.buffer_frequency_s:
+            kw["linger_ms"] = int(self.buffer_frequency_s * 1000)
+        if self.partitioner == "random":
+            import random
+
+            def _random_partitioner(key, all_parts, available):
+                return random.choice(available or all_parts)
+
+            kw["partitioner"] = _random_partitioner
+        return kw
+
+
 class KafkaPythonProducer(Producer):
     """Real transport via kafka-python, when available."""
 
-    def __init__(self, brokers: str, retries: int = 3):
+    def __init__(self, brokers: str, config: Optional[ProducerConfig] = None):
         from kafka import KafkaProducer  # gated import
+        self._cfg = config or ProducerConfig()
         self._p = KafkaProducer(bootstrap_servers=brokers.split(","),
-                                retries=retries)
+                                **self._cfg.kafka_python_kwargs())
+        self._since_flush = 0
 
     def send(self, topic: str, key: bytes, value: bytes) -> None:
         self._p.send(topic, key=key or None, value=value)
+        # kafka-python has no message-count flush trigger; approximate
+        # sarama's (async) Flush.Messages with a short bounded flush so
+        # a slow broker can't stall the ingest path for the full
+        # delivery timeout
+        if self._cfg.buffer_messages:
+            self._since_flush += 1
+            if self._since_flush >= self._cfg.buffer_messages:
+                try:
+                    self._p.flush(timeout=0.1)
+                except Exception:
+                    pass  # still queued; the interval flush delivers it
+                self._since_flush = 0
 
     def flush(self) -> None:
         self._p.flush(timeout=10)
+        self._since_flush = 0
 
     def close(self) -> None:
         self._p.close()
 
 
-def make_producer(brokers: str, retries: int = 3) -> Optional[Producer]:
+def make_producer(brokers: str,
+                  config: Optional[ProducerConfig] = None,
+                  ) -> Optional[Producer]:
     try:
-        return KafkaPythonProducer(brokers, retries)
+        return KafkaPythonProducer(brokers, config)
     except ImportError:
         logger.error("kafka-python not installed; kafka sink will drop "
                      "(configure an explicit producer for tests)")
@@ -234,7 +312,7 @@ def _metric_factory(sink_config, server_config):
     producer: Any = c.get("producer")  # tests inject one
     if producer is None:
         producer = make_producer(c.get("broker", "localhost:9092"),
-                                 int(c.get("retries", 3)))
+                                 ProducerConfig.from_config(c, "metric"))
     return KafkaMetricSink(
         sink_config.name or "kafka",
         producer=producer,
@@ -250,7 +328,7 @@ def _span_factory(sink_config, server_config):
     producer: Any = c.get("producer")
     if producer is None:
         producer = make_producer(c.get("broker", "localhost:9092"),
-                                 int(c.get("retries", 3)))
+                                 ProducerConfig.from_config(c, "span"))
     return KafkaSpanSink(
         sink_config.name or "kafka",
         producer=producer,

@@ -21,7 +21,8 @@ logger = logging.getLogger("veneur_tpu.sinks.lightstep")
 
 class LightStepSpanSink(SpanSink):
     def __init__(self, name: str, access_token: str, collector_url: str,
-                 num_clients: int = 1, timeout: float = 10.0):
+                 num_clients: int = 1, timeout: float = 10.0,
+                 maximum_spans: int = 0):
         self._name = name
         self.access_token = access_token
         # one buffer per "client" stripe, keyed by trace id, mirroring the
@@ -32,6 +33,10 @@ class LightStepSpanSink(SpanSink):
         self._buffers: List[List[dict]] = [[] for _ in range(self.num_clients)]
         self._lock = threading.Lock()
         self.spans_handled = 0
+        # lightstep_maximum_spans -> the tracer's MaxBufferedSpans
+        # (lightstep.go:117); enforced per client stripe between flushes
+        self.maximum_spans = maximum_spans
+        self.dropped_total = 0
 
     def name(self) -> str:
         return self._name
@@ -56,7 +61,11 @@ class LightStepSpanSink(SpanSink):
                 {"Key": "parent_span_guid",
                  "Value": format(span.parent_id & ((1 << 64) - 1), "x")})
         with self._lock:
-            self._buffers[span.trace_id % self.num_clients].append(report)
+            buf = self._buffers[span.trace_id % self.num_clients]
+            if self.maximum_spans and len(buf) >= self.maximum_spans:
+                self.dropped_total += 1
+                return
+            buf.append(report)
             self.spans_handled += 1
 
     def flush(self) -> None:
@@ -80,15 +89,27 @@ class LightStepSpanSink(SpanSink):
                 sent += len(spans)
             except Exception as e:
                 logger.error("lightstep report failed: %s", e)
-        # spans swapped out but not delivered are gone: count as drops
-        self.emit_flush_self_metrics(sent, flush_start, total - sent)
+        # spans swapped out but not delivered are gone: count as drops,
+        # along with ingest-side maximum_spans overflow
+        with self._lock:
+            overflow, self.dropped_total = self.dropped_total, 0
+        self.emit_flush_self_metrics(
+            sent, flush_start, (total - sent) + overflow)
 
 
 @register_span_sink("lightstep")
 def _factory(sink_config, server_config):
     c = sink_config.config
+    # lightstep_reconnect_period tunes the reference tracer's transport
+    # recycling; this HTTP reporter opens a fresh connection per flush,
+    # so the knob is accepted for config compatibility and has nothing
+    # to recycle
     return LightStepSpanSink(
         sink_config.name or "lightstep",
-        access_token=str(c.get("access_token", "")),
-        collector_url=c.get("collector_host", ""),
-        num_clients=int(c.get("num_clients", 1)))
+        access_token=str(c.get("lightstep_access_token",
+                               c.get("access_token", ""))),
+        collector_url=c.get("lightstep_collector_host",
+                            c.get("collector_host", "")),
+        num_clients=int(c.get("lightstep_num_clients",
+                              c.get("num_clients", 1))),
+        maximum_spans=int(c.get("lightstep_maximum_spans", 0)))

@@ -29,9 +29,16 @@ class Uploader:
 
 
 class Boto3Uploader(Uploader):
-    def __init__(self, region: str = ""):
+    def __init__(self, region: str = "", access_key_id: str = "",
+                 secret_access_key: str = ""):
         import boto3  # gated import
-        self._client = boto3.client("s3", region_name=region or None)
+        # explicit static credentials when configured (reference
+        # s3.go:67-75), else the SDK's default chain
+        kw = {}
+        if access_key_id:
+            kw = {"aws_access_key_id": access_key_id,
+                  "aws_secret_access_key": secret_access_key}
+        self._client = boto3.client("s3", region_name=region or None, **kw)
 
     def upload(self, bucket: str, key: str, body: bytes) -> None:
         self._client.put_object(Bucket=bucket, Key=key, Body=body)
@@ -92,13 +99,16 @@ def _factory(sink_config, server_config):
     uploader = c.get("uploader")  # tests inject one
     if uploader is None:
         try:
-            uploader = Boto3Uploader(c.get("region", ""))
+            uploader = Boto3Uploader(
+                c.get("region", ""),
+                access_key_id=str(c.get("access_key_id", "")),
+                secret_access_key=str(c.get("secret_access_key", "")))
         except Exception as e:
             logger.error("s3 uploader unavailable: %s", e)
             uploader = None
     return S3MetricSink(
         sink_config.name or "s3",
         uploader=uploader,
-        bucket=c.get("bucket", ""),
+        bucket=c.get("s3_bucket", "") or c.get("bucket", ""),
         hostname=server_config.hostname,
         interval=server_config.interval)

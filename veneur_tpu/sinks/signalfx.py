@@ -20,6 +20,7 @@ import logging
 import threading
 from typing import Any, Dict, List, Sequence
 
+from veneur_tpu.config import parse_duration
 from veneur_tpu.samplers.metrics import InterMetric, MetricType
 from veneur_tpu.samplers.parser import EVENT_IDENTIFIER_KEY
 from veneur_tpu.sinks import MetricSink, register_metric_sink
@@ -37,7 +38,12 @@ class SignalFxMetricSink(MetricSink):
                  vary_key_by: str = "", per_tag_tokens: Dict[str, str] = None,
                  excluded_tags: Sequence[str] = (),
                  drop_host_with_tag_key: str = "",
-                 flush_max_per_body: int = 0, timeout: float = 10.0):
+                 flush_max_per_body: int = 0, timeout: float = 10.0,
+                 metric_tag_prefix_drops: Sequence[str] = (),
+                 preferred_vary_key_by: str = "",
+                 api_endpoint: str = "https://api.signalfx.com",
+                 dynamic_per_tag_tokens: bool = False,
+                 dynamic_refresh_period_s: float = 0.0):
         self._name = name
         self.api_key = api_key
         self.endpoint = endpoint.rstrip("/")
@@ -49,6 +55,39 @@ class SignalFxMetricSink(MetricSink):
         self.drop_host_with_tag_key = drop_host_with_tag_key
         self.flush_max_per_body = flush_max_per_body
         self.timeout = timeout
+        # metrics carrying a tag with any of these prefixes are skipped
+        # outright (signalfx.go:510-518)
+        self.metric_tag_prefix_drops = tuple(metric_tag_prefix_drops or ())
+        # token-routing dimension that beats vary_key_by when both are
+        # present on a metric (signalfx.go:543-560; the reference also
+        # parses vary_key_by_favor_common_dimensions but never reads it,
+        # so it is accepted-and-ignored here too)
+        self.preferred_vary_key_by = preferred_vary_key_by
+        self.skipped_total = 0
+        # dynamic per-tag tokens: a refresher polls the SignalFx org
+        # token API and swaps the routing table (signalfx.go:352-445)
+        self.api_endpoint = api_endpoint.rstrip("/")
+        self._tokens_lock = threading.Lock()
+        self._refresher: threading.Thread = None
+        if dynamic_per_tag_tokens and dynamic_refresh_period_s > 0:
+            self._refresher = threading.Thread(
+                target=self._refresh_tokens_loop,
+                args=(dynamic_refresh_period_s,),
+                name=f"sfx-token-refresh-{name}", daemon=True)
+            self._refresher.start()
+
+    def _refresh_tokens_loop(self, period_s: float) -> None:
+        import time as _time
+        while True:
+            _time.sleep(period_s)
+            try:
+                tokens = fetch_api_keys(
+                    self.api_endpoint, self.api_key, timeout=self.timeout)
+            except Exception as e:
+                logger.warning("failed to fetch tokens from SignalFx: %s", e)
+                continue
+            with self._tokens_lock:
+                self.per_tag_tokens.update(tokens)
 
     def name(self) -> str:
         return self._name
@@ -59,16 +98,29 @@ class SignalFxMetricSink(MetricSink):
     def flush(self, metrics: List[InterMetric]) -> None:
         # datapoints grouped by access token (vary_key_by routing)
         by_token: Dict[str, Dict[str, list]] = {}
+        prefix_drops = self.metric_tag_prefix_drops
         for m in metrics:
+            if prefix_drops and any(
+                    t.startswith(p) for p in prefix_drops for t in m.tags):
+                self.skipped_total += 1
+                continue
             dims = {self.hostname_tag: m.hostname or self.hostname}
-            token = self.api_key
             for t in m.tags:
                 k, _, v = t.partition(":")
-                if k in self.excluded_tags:
-                    continue
-                if self.vary_key_by and k == self.vary_key_by:
-                    token = self.per_tag_tokens.get(v, self.api_key)
                 dims[k] = v
+            # preferred_vary_key_by beats vary_key_by when its dimension
+            # is present; routing sees the full dimension set — excluded
+            # tags are deleted only after key selection
+            # (signalfx.go:534-564)
+            vary_val = ""
+            if self.preferred_vary_key_by:
+                vary_val = dims.get(self.preferred_vary_key_by, "")
+            if not vary_val and self.vary_key_by:
+                vary_val = dims.get(self.vary_key_by, "")
+            with self._tokens_lock:
+                token = self.per_tag_tokens.get(vary_val, self.api_key)
+            for k in self.excluded_tags:
+                dims.pop(k, None)
             if (m.type == MetricType.COUNTER and self.drop_host_with_tag_key
                     and self.drop_host_with_tag_key in dims):
                 dims.pop(self.hostname_tag, None)
@@ -162,9 +214,45 @@ class SignalFxMetricSink(MetricSink):
             logger.error("signalfx event POST failed: %s", e)
 
 
+def fetch_api_keys(api_endpoint: str, api_token: str,
+                   timeout: float = 10.0) -> Dict[str, str]:
+    """Page through the SignalFx org-token API and return {name: secret}
+    (reference signalfx.go:422-445 fetchAPIKeys: limit-200 pages from
+    /v2/token until an empty page)."""
+    import json as _json
+
+    tokens: Dict[str, str] = {}
+    offset = 0
+    while True:
+        status, body = vhttp.get(
+            f"{api_endpoint}/v2/token?limit=200&name=&offset={offset}",
+            headers={"X-SF-Token": api_token,
+                     "Content-Type": "application/json"},
+            timeout=timeout)
+        if status != 200:
+            raise RuntimeError(
+                f"signalfx api returned unknown response code: {status}")
+        results = _json.loads(body).get("results")
+        if not isinstance(results, list):
+            raise RuntimeError(
+                "unknown results structure returned from signalfx api")
+        for r in results:
+            if not isinstance(r, dict) or "name" not in r or "secret" not in r:
+                raise RuntimeError("failed to extract token from result")
+            tokens[str(r["name"])] = str(r["secret"])
+        if not results:
+            return tokens
+        offset += 200
+
+
 @register_metric_sink("signalfx")
 def _factory(sink_config, server_config):
     c = sink_config.config
+    if (c.get("dynamic_per_tag_api_keys_enable")
+            and not c.get("dynamic_per_tag_api_keys_refresh_period")):
+        # reference signalfx.go:286-291 refuses this combination
+        raise ValueError(
+            "per tag API keys are enabled, but the refresh period is unset")
     per_tag = {str(i.get("value", "")): str(i.get("api_key", ""))
                for i in (c.get("per_tag_api_keys", []) or [])}
     return SignalFxMetricSink(
@@ -177,4 +265,11 @@ def _factory(sink_config, server_config):
         per_tag_tokens=per_tag,
         excluded_tags=c.get("excluded_tags", []) or [],
         drop_host_with_tag_key=c.get("drop_host_with_tag_key", ""),
-        flush_max_per_body=int(c.get("flush_max_per_body", 0)))
+        flush_max_per_body=int(c.get("flush_max_per_body", 0)),
+        metric_tag_prefix_drops=c.get("metric_tag_prefix_drops", []) or [],
+        preferred_vary_key_by=c.get("preferred_vary_key_by", ""),
+        api_endpoint=c.get("endpoint_api", "https://api.signalfx.com"),
+        dynamic_per_tag_tokens=bool(
+            c.get("dynamic_per_tag_api_keys_enable", False)),
+        dynamic_refresh_period_s=parse_duration(
+            c.get("dynamic_per_tag_api_keys_refresh_period", 0) or 0))

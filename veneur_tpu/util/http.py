@@ -122,10 +122,12 @@ def post(url: str, body: bytes, *,
          content_type: str = "application/json",
          headers: Optional[Dict[str, str]] = None,
          compress: Optional[str] = None,
-         timeout: float = 10.0, method: str = "POST") -> Tuple[int, bytes]:
+         timeout: float = 10.0, method: str = "POST",
+         proxy_url: str = "") -> Tuple[int, bytes]:
     """Send `body` (POST by default), optionally compressed
     ("gzip"/"deflate"), returning (status, response body). Raises
-    HTTPError on non-2xx."""
+    HTTPError on non-2xx. proxy_url routes the request through an
+    explicit HTTP(S) proxy, overriding environment proxies."""
     hdrs = {"Content-Type": content_type}
     if compress == "gzip":
         body = gzip.compress(body, compresslevel=6)
@@ -137,8 +139,12 @@ def post(url: str, body: bytes, *,
         hdrs.update(headers)
     req = urllib.request.Request(url, data=body, headers=hdrs,
                                  method=method)
+    opener = urllib.request.urlopen
+    if proxy_url:
+        opener = urllib.request.build_opener(urllib.request.ProxyHandler(
+            {"http": proxy_url, "https": proxy_url})).open
     try:
-        with urllib.request.urlopen(req, timeout=timeout) as resp:
+        with opener(req, timeout=timeout) as resp:
             return resp.status, resp.read()
     except urllib.error.HTTPError as e:
         raise HTTPError(e.code, e.read()) from e
