@@ -187,3 +187,70 @@ class TestOpenMetricsSource:
                 raise AssertionError("scraped gauge never flushed")
         finally:
             server.shutdown()
+
+
+class TestLabelFiltersAndRenames:
+    def test_ignored_and_renamed_labels(self, fake_prom):
+        src = OpenMetricsSource(
+            "om", url=fake_prom.url, scrape_interval=60,
+            ignored_labels=["^ro"], rename_labels={"room": "zone"})
+        ingest = CollectingIngest()
+        src.scrape_once(ingest)
+        temp = ingest.by_name()["temperature"][0]
+        # "room" matches the ignored regex, so neither the original nor
+        # the renamed label survives
+        assert all(not t.startswith("room:") and not t.startswith("zone:")
+                   for t in temp.tags)
+
+        src2 = OpenMetricsSource(
+            "om", url=fake_prom.url, scrape_interval=60,
+            rename_labels={"room": "zone"})
+        ingest2 = CollectingIngest()
+        src2.scrape_once(ingest2)
+        temp2 = ingest2.by_name()["temperature"][0]
+        assert "zone:a" in temp2.tags
+        assert not any(t.startswith("room:") for t in temp2.tags)
+
+    def test_prometheus_cli_flag_parsing(self, fake_prom, monkeypatch):
+        """The reference's short flags (-h/-s/-i/-p/-a/-r/-d) parse and
+        build a working source (cmd/veneur-prometheus/main.go:14-28)."""
+        import socket as socket_mod
+
+        from veneur_tpu.cmd import veneur_prometheus as vp
+
+        recv = socket_mod.socket(socket_mod.AF_INET, socket_mod.SOCK_DGRAM)
+        recv.bind(("127.0.0.1", 0))
+        recv.settimeout(5.0)
+        port = recv.getsockname()[1]
+
+        started = {}
+
+        def fake_start(self, ingest):
+            started["source"] = self
+            self.scrape_once(ingest)  # gauges emit on first scrape
+            raise KeyboardInterrupt
+
+        monkeypatch.setattr(OpenMetricsSource, "start", fake_start)
+        rc = vp.main([
+            "-h", fake_prom.url, "-s", f"127.0.0.1:{port}",
+            "-i", "1s", "-p", "pre.", "-a", "dc=east",
+            "-r", "room=zone", "-ignored-metrics", "^rpc_,untyped",
+        ])
+        assert rc == 0
+        src = started["source"]
+        assert src.rename_labels == {"room": "zone"}
+        assert src.deny.pattern == "^rpc_|untyped"
+        # collect whatever the single scrape emitted (counters only
+        # prime the cache, denied families are skipped)
+        chunks = []
+        recv.settimeout(2.0)
+        try:
+            while True:
+                chunks.append(recv.recvfrom(65536)[0])
+        except TimeoutError:
+            pass
+        joined = b" ".join(chunks)
+        assert joined.startswith(b"pre.")
+        assert b"zone:a" in joined
+        assert b"dc:east" in joined
+        recv.close()

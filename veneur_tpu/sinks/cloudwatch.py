@@ -18,6 +18,7 @@ import logging
 import urllib.parse
 from typing import Dict, List, Optional, Tuple
 
+from veneur_tpu.config import parse_duration
 from veneur_tpu.samplers.metrics import InterMetric, MetricType
 from veneur_tpu.sinks import MetricSink, register_metric_sink
 from veneur_tpu.util import http as vhttp
@@ -146,6 +147,16 @@ class CloudWatchMetricSink(MetricSink):
                         content_type="application/x-www-form-urlencoded",
                         headers=headers, timeout=self.timeout)
                     break
+                except vhttp.HTTPError as e:
+                    if 400 <= e.status < 500:
+                        # non-retryable: an identical resend is doomed
+                        logger.error(
+                            "cloudwatch PutMetricData rejected (%d): %s",
+                            e.status, e)
+                        break
+                    if attempt == self.max_attempts:
+                        logger.error(
+                            "cloudwatch PutMetricData failed: %s", e)
                 except Exception as e:
                     if attempt == self.max_attempts:
                         logger.error(
@@ -168,5 +179,5 @@ def _factory(sink_config, server_config):
         standard_unit_tag=c.get("cloudwatch_standard_unit_tag_name",
                                 DEFAULT_STANDARD_UNIT_TAG),
         default_unit=c.get("cloudwatch_standard_unit", "None"),
-        timeout=float(c.get("remote_timeout", 10.0)),
+        timeout=parse_duration(c.get("remote_timeout", 0) or 0) or 10.0,
         disable_retries=bool(c.get("aws_disable_retries", False)))

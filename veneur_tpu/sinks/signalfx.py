@@ -117,8 +117,11 @@ class SignalFxMetricSink(MetricSink):
                 vary_val = dims.get(self.preferred_vary_key_by, "")
             if not vary_val and self.vary_key_by:
                 vary_val = dims.get(self.vary_key_by, "")
-            with self._tokens_lock:
-                token = self.per_tag_tokens.get(vary_val, self.api_key)
+            if vary_val:
+                with self._tokens_lock:
+                    token = self.per_tag_tokens.get(vary_val, self.api_key)
+            else:
+                token = self.api_key
             for k in self.excluded_tags:
                 dims.pop(k, None)
             if (m.type == MetricType.COUNTER and self.drop_host_with_tag_key

@@ -122,23 +122,23 @@ class SplunkSpanSink(SpanSink):
                     failed[0] += len(batch)
 
         if self.submission_workers > 1 and len(batches) > 1:
-            threads = []
-            for batch in batches:
-                while sum(t.is_alive() for t in threads) \
-                        >= self.submission_workers:
-                    _time.sleep(0.01)
-                t = threading.Thread(target=submit, args=(batch,),
-                                     daemon=True)
-                t.start()
-                threads.append(t)
-            deadline = _time.monotonic() + self.timeout * 2
-            for t in threads:
-                t.join(timeout=max(0.0, deadline - _time.monotonic()))
-            hung = sum(t.is_alive() for t in threads)
-            if hung:
-                logger.warning(
-                    "%d splunk HEC submissions still in flight at "
-                    "flush accounting time", hung)
+            from concurrent.futures import ThreadPoolExecutor, wait
+
+            ex = ThreadPoolExecutor(
+                max_workers=self.submission_workers,
+                thread_name_prefix=f"splunk-hec-{self._name}")
+            try:
+                futures = [ex.submit(submit, b) for b in batches]
+                _, pending = wait(futures, timeout=self.timeout * 2)
+                if pending:
+                    logger.warning(
+                        "%d splunk HEC submissions still in flight at "
+                        "flush accounting time", len(pending))
+                    for f in pending:
+                        f.cancel()
+            finally:
+                # wait=False: a hung POST must not also hang the flush
+                ex.shutdown(wait=False)
         else:
             for batch in batches:
                 submit(batch)

@@ -75,7 +75,10 @@ class OpenMetricsSource(Source):
                  allowlist: Optional[str] = None,
                  denylist: Optional[str] = None,
                  scope: MetricScope = MetricScope.MIXED,
-                 timeout: float = 10.0):
+                 timeout: float = 10.0,
+                 ignored_labels: Optional[List[str]] = None,
+                 rename_labels: Optional[Dict[str, str]] = None,
+                 ssl_context=None):
         self._name = name
         self.url = url
         self.scrape_interval = scrape_interval
@@ -84,6 +87,14 @@ class OpenMetricsSource(Source):
         self.deny = re.compile(denylist) if denylist else None
         self.scope = scope
         self.timeout = timeout
+        # label filters/renames mirroring veneur-prometheus's
+        # -ignored-labels / -r flags (reference
+        # cmd/veneur-prometheus/main.go:17-21)
+        self.ignored_labels = [re.compile(p)
+                               for p in (ignored_labels or [])]
+        self.rename_labels = dict(rename_labels or {})
+        # client-cert scrape transport (reference main.go:25-27 mTLS)
+        self.ssl_context = ssl_context
         self._stop = threading.Event()
         # cumulative-counter cache: (name, tag-string) -> last value
         self._counter_cache: Dict[Tuple[str, str], float] = {}
@@ -124,13 +135,19 @@ class OpenMetricsSource(Source):
         return value - prev
 
     def scrape_once(self, ingest: Ingest) -> int:
-        status, body = vhttp.get(self.url, timeout=self.timeout)
+        status, body = vhttp.get(self.url, timeout=self.timeout,
+                                 ssl_context=self.ssl_context)
         count = 0
         for ftype, name, labels, value in parse_exposition(body.decode()):
             if self.allow and not self.allow.search(name):
                 continue
             if self.deny and self.deny.search(name):
                 continue
+            if self.ignored_labels or self.rename_labels:
+                labels = {
+                    self.rename_labels.get(k, k): v
+                    for k, v in labels.items()
+                    if not any(p.search(k) for p in self.ignored_labels)}
             tags = _tags(labels, self.tags)
             if ftype == "counter":
                 delta = self._counter_delta(name, tags, value)

@@ -168,10 +168,11 @@ def put_json(url: str, obj: Any, *,
 
 
 def get(url: str, *, headers: Optional[Dict[str, str]] = None,
-        timeout: float = 10.0) -> Tuple[int, bytes]:
+        timeout: float = 10.0, ssl_context=None) -> Tuple[int, bytes]:
     req = urllib.request.Request(url, headers=headers or {}, method="GET")
     try:
-        with urllib.request.urlopen(req, timeout=timeout) as resp:
+        with urllib.request.urlopen(req, timeout=timeout,
+                                    context=ssl_context) as resp:
             return resp.status, resp.read()
     except urllib.error.HTTPError as e:
         raise HTTPError(e.code, e.read()) from e
