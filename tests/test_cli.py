@@ -156,3 +156,25 @@ class TestVeneurPrometheus:
         data, _ = recv.recvfrom(65536)
         assert data == b"pfx.up:1.0|g|#a:b"
         recv.close()
+
+
+class TestExampleConfigs:
+    def test_shipped_examples_validate(self):
+        """The annotated example configs must stay loadable — they are
+        the documented starting points (reference example*.yaml)."""
+        import os
+        from veneur_tpu.cmd.veneur import main as veneur_main
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        for name in ("example.yaml", "example_host.yaml"):
+            path = os.path.join(root, "examples", name)
+            assert veneur_main(["-f", path, "-validate-config"]) == 0, name
+
+    def test_proxy_example_parses(self):
+        import os
+
+        import yaml
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        raw = yaml.safe_load(
+            open(os.path.join(root, "examples", "example_proxy.yaml")))
+        assert raw["grpc_address"]
+        assert "forward_address" in raw

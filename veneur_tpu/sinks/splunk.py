@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import json
 import logging
+import queue
 import threading
 from typing import List
 
@@ -60,12 +61,31 @@ class SplunkSpanSink(SpanSink):
         self.timeout = timeout
         # hec_batch_size splits a flush into bodies of at most N events;
         # hec_submission_workers POST those bodies in parallel (reference
-        # splunk.go:183-196's worker pool)
+        # splunk.go:183-196's worker pool). The pool is persistent daemon
+        # threads: per-flush executors would churn threads, and
+        # non-daemon workers would block interpreter exit behind a hung
+        # POST.
         self.batch_size = batch_size
         self.submission_workers = max(1, submission_workers)
         self._events: List[dict] = []
         self._lock = threading.Lock()
         self.dropped = 0
+        self._work_q: queue.Queue = queue.Queue()
+        if self.submission_workers > 1:
+            for i in range(self.submission_workers):
+                threading.Thread(
+                    target=self._worker_loop, daemon=True,
+                    name=f"splunk-hec-{name}-{i}").start()
+
+    def _worker_loop(self) -> None:
+        while True:
+            fn = self._work_q.get()
+            try:
+                fn()
+            except Exception:
+                logger.exception("splunk HEC worker task failed")
+            finally:
+                self._work_q.task_done()
 
     def name(self) -> str:
         return self._name
@@ -122,23 +142,29 @@ class SplunkSpanSink(SpanSink):
                     failed[0] += len(batch)
 
         if self.submission_workers > 1 and len(batches) > 1:
-            from concurrent.futures import ThreadPoolExecutor, wait
+            done = threading.Event()
+            finished = [0]
 
-            ex = ThreadPoolExecutor(
-                max_workers=self.submission_workers,
-                thread_name_prefix=f"splunk-hec-{self._name}")
-            try:
-                futures = [ex.submit(submit, b) for b in batches]
-                _, pending = wait(futures, timeout=self.timeout * 2)
-                if pending:
-                    logger.warning(
-                        "%d splunk HEC submissions still in flight at "
-                        "flush accounting time", len(pending))
-                    for f in pending:
-                        f.cancel()
-            finally:
-                # wait=False: a hung POST must not also hang the flush
-                ex.shutdown(wait=False)
+            def task(batch: List[dict]):
+                def run() -> None:
+                    try:
+                        submit(batch)
+                    finally:
+                        with sent_lock:
+                            finished[0] += 1
+                            if finished[0] == len(batches):
+                                done.set()
+                return run
+
+            for batch in batches:
+                self._work_q.put(task(batch))
+            # bounded wait: a hung POST must not also hang the flush
+            if not done.wait(timeout=self.timeout * 2):
+                with sent_lock:
+                    pending = len(batches) - finished[0]
+                logger.warning(
+                    "%d splunk HEC submissions still in flight at "
+                    "flush accounting time", pending)
         else:
             for batch in batches:
                 submit(batch)
