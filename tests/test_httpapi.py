@@ -28,6 +28,7 @@ class TestHTTPApi:
         api = self._start()
         try:
             assert vhttp.get(api_url(api, "/healthcheck"))[0] == 200
+            assert vhttp.get(api_url(api, "/healthcheck/tracing"))[0] == 200
             status, body = vhttp.get(api_url(api, "/version"))
             assert status == 200
             assert body.decode() == veneur_tpu.__version__

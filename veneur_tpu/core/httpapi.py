@@ -57,6 +57,10 @@ class _Handler(BaseHTTPRequestHandler):
         path = self.path.split("?", 1)[0]
         if path == "/healthcheck":
             self._send(200, b"ok\n")
+        elif path == "/healthcheck/tracing":
+            # reference http.go:45-47: tracing plane liveness (mounted
+            # whenever the API is up, like the reference)
+            self._send(200, b"ok\n")
         elif path == "/healthcheck/ready":
             ready = api.server is None or api.server.flush_count > 0 \
                 or not api.require_flush_for_ready
