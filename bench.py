@@ -121,7 +121,7 @@ def enable_compile_cache() -> None:
 
 
 def initialize_backend(max_attempts: int = 2,
-                       probe_timeout: float = 60.0) -> str:
+                       probe_timeout: float = 40.0) -> str:
     """Bring up the JAX backend before constructing any pipeline object so
     a backend failure is visible up front (round-1 failure modes: axon TPU
     init raising UNAVAILABLE deep inside Server construction, or hanging
@@ -133,7 +133,12 @@ def initialize_backend(max_attempts: int = 2,
     import subprocess
 
     fallback_reason = None
-    if "JAX_PLATFORMS" not in os.environ:
+    env_platform = os.environ.get("JAX_PLATFORMS", "")
+    # Probe for ANY accelerator target — including one pinned via
+    # JAX_PLATFORMS=axon in the environment. Skipping the probe when the
+    # env var was set meant a wedged TPU tunnel hung the main process at
+    # first backend use, with no number and no diagnostics.
+    if not env_platform.startswith("cpu"):
         for attempt in range(1, max_attempts + 1):
             try:
                 probe = subprocess.run(
