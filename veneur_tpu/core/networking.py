@@ -307,9 +307,8 @@ def _start_ssf_udp(u, server, rcvbuf: int) -> Listener:
     listener = Listener("ssf-udp", sock.getsockname(), sock, threads)
     # per-read buffer size follows trace_max_length_bytes (reference
     # server.go:498's packetPool), clamped to the UDP datagram ceiling
-    max_read = min(max(
-        int(getattr(server.config, "trace_max_length_bytes", _MAX_DGRAM)),
-        1), _MAX_DGRAM)
+    max_read = min(max(int(server.config.trace_max_length_bytes), 1),
+                   _MAX_DGRAM)
 
     def read_loop():
         while not listener.closed:
@@ -369,8 +368,7 @@ def _read_ssf_frames(conn, server, listener: Listener) -> None:
     """Framed stream read loop (reference server.go:1200-1237): framing
     errors are fatal to the stream, decode-level errors are not."""
     from veneur_tpu import protocol
-    max_len = int(getattr(server.config, "trace_max_length_bytes",
-                          protocol.MAX_SSF_PACKET_LENGTH))
+    max_len = int(server.config.trace_max_length_bytes)
     stream = conn.makefile("rb")
     # explicit close in a finally: the makefile holds a reference on the
     # socket fd, so `with conn` alone leaves the connection half-open (no

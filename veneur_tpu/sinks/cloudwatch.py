@@ -147,17 +147,14 @@ class CloudWatchMetricSink(MetricSink):
                         content_type="application/x-www-form-urlencoded",
                         headers=headers, timeout=self.timeout)
                     break
-                except vhttp.HTTPError as e:
-                    if 400 <= e.status < 500:
+                except Exception as e:
+                    if (isinstance(e, vhttp.HTTPError)
+                            and 400 <= e.status < 500):
                         # non-retryable: an identical resend is doomed
                         logger.error(
                             "cloudwatch PutMetricData rejected (%d): %s",
                             e.status, e)
                         break
-                    if attempt == self.max_attempts:
-                        logger.error(
-                            "cloudwatch PutMetricData failed: %s", e)
-                except Exception as e:
                     if attempt == self.max_attempts:
                         logger.error(
                             "cloudwatch PutMetricData failed: %s", e)

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import json
 import logging
+import random
 import threading
 from typing import Any, List, Optional
 
@@ -103,8 +104,6 @@ class ProducerConfig:
         if self.buffer_frequency_s:
             kw["linger_ms"] = int(self.buffer_frequency_s * 1000)
         if self.partitioner == "random":
-            import random
-
             def _random_partitioner(key, all_parts, available):
                 return random.choice(available or all_parts)
 
