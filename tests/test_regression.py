@@ -63,3 +63,37 @@ class TestHLLWireFixture:
         blob = fixture("hll_dense_v1.bin")
         regs, _ = hllwire.unmarshal(blob)
         assert hllwire.marshal_dense(regs.astype(np.uint8)) == blob
+
+
+class TestMetricPBFixtures:
+    def test_timer_digest_fixture_imports(self):
+        """A committed forwardrpc Metric with a t-digest payload (what a
+        Go local veneur would send) keys and imports identically across
+        refactors."""
+        from veneur_tpu.forward import convert
+        from veneur_tpu.forward.protos import metric_pb2
+        from veneur_tpu.samplers.metrics import MetricScope
+
+        pbm = metric_pb2.Metric()
+        pbm.ParseFromString(fixture("metricpb_timer.pb"))
+        assert pbm.name == "fixture.timer"
+        assert list(pbm.tags) == ["env:prod", "svc:api"]
+        assert pbm.type == metric_pb2.Timer
+        assert convert.import_scope(pbm) == MetricScope.MIXED
+        key, h32, h64, tags = convert.metric_key_of_proto(pbm)
+        assert key.name == "fixture.timer" and key.type == "timer"
+        assert h32 != 0 and h64 != 0
+        d = pbm.histogram.t_digest
+        assert d.min == 1.5 and d.max == 42.0
+        assert sum(c.weight for c in d.main_centroids) == 10.0
+        assert d.reciprocalSum == 0.75
+
+    def test_counter_fixture_scope(self):
+        from veneur_tpu.forward import convert
+        from veneur_tpu.forward.protos import metric_pb2
+        from veneur_tpu.samplers.metrics import MetricScope
+
+        pbm = metric_pb2.Metric()
+        pbm.ParseFromString(fixture("metricpb_counter.pb"))
+        assert pbm.counter.value == 99
+        assert convert.import_scope(pbm) == MetricScope.GLOBAL_ONLY
