@@ -180,3 +180,30 @@ class TestNativeParser:
         assert res.lines == 2
         assert res.samples == 0
         assert res.unknown == [b"a:1|c", b"b:2|g"]
+
+
+class TestGarbageFuzz:
+    def test_byte_soup_never_crashes_and_parsers_agree(self):
+        """Random byte soup (printable garbage, truncated metrics,
+        embedded pipes/colons/NULs, invalid UTF-8) must never crash
+        either pipeline, and the native batch path must produce exactly
+        the same flushed metrics and error counts as the Python path."""
+        rng = random.Random(99)
+        alphabet = (b"abc:|#@.,0159 \xff\x00\xc3()_-=+"
+                    b"gcmsh\n")
+        batches = []
+        for _ in range(3):
+            lines = []
+            for _ in range(300):
+                n = rng.randrange(1, 40)
+                lines.append(bytes(rng.choice(alphabet) for _ in range(n)))
+            # mix in near-valid prefixes of real metrics
+            for base in (b"ok.metric:1|c|#a:b", b"t:3.5|ms|@0.5"):
+                for cut in (3, 7, len(base) - 1, len(base)):
+                    lines.append(base[:cut])
+            rng.shuffle(lines)
+            batches.append([b"\n".join(lines[i:i + 20])
+                            for i in range(0, len(lines), 20)])
+        (nat, nat_stats), (py, py_stats) = run_both(batches)
+        assert nat == py
+        assert nat_stats == py_stats
