@@ -3,7 +3,7 @@
 Validates veneur_tpu.parallel.mesh — the ICI collective equivalent of the
 reference's forward/import merge semantics (reference worker.go:410-467):
 counter psum exactness, gauge last-set-wins, HLL register pmax against the
-scalar oracle, and t-digest all_gather+recompress quantile accuracy within
+scalar oracle, and t-digest key-sharded all_to_all+recompress quantile accuracy within
 the reference's own test tolerance (reference tdigest/histo_test.go:95-176,
 epsilon 0.02 in uniform-value space).
 """
@@ -149,7 +149,7 @@ class TestHLLMerge:
 
 
 class TestDigestMerge:
-    def test_allgather_recompress_quantiles(self, mesh):
+    def test_keysharded_recompress_quantiles(self, mesh):
         """Uniform samples split across shards: merged quantiles within
         the reference's 0.02 uniform-space tolerance of the true values
         and of a scalar reference digest fed all samples."""

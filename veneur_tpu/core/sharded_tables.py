@@ -84,7 +84,7 @@ def _merge_hll_stacked(stacked: jnp.ndarray) -> jnp.ndarray:
 def _merge_histo_stacked(stacked: Dict[str, jnp.ndarray]
                          ) -> Dict[str, jnp.ndarray]:
     """Per-shard digest states stacked on axis 0 -> one merged state.
-    Mirrors parallel.mesh._merge_digest_allgather: concatenate every
+    Mirrors parallel.mesh._merge_digest_keysharded: concatenate every
     shard's centroids per key and recompress once as a batched kernel
     (the global veneur's re-insertion, reference worker.go:455-457);
     scalar stats reduce with sum/min/max."""

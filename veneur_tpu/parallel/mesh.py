@@ -10,8 +10,9 @@ of collectives over ICI:
   counters  -> psum            (merge = addition, samplers.go:143-145)
   gauges    -> last-set-wins   (merge = overwrite, samplers.go:200-202)
   HLL       -> pmax            (merge = register max, samplers.go:299-311)
-  t-digest  -> all_gather centroids + batched recompress
-               (merge = centroid re-insertion, merging_digest.go:374-389)
+  t-digest  -> all_to_all key-sharded recompress + all_gather
+               (merge = centroid re-insertion, merging_digest.go:374-389;
+               each chip recompresses only its K/n key block)
 
 Cross-host (DCN) hops between tiers use the gRPC forward plane
 (veneur_tpu.forward); this module covers the intra-mesh collective path.
@@ -81,18 +82,43 @@ def init_sharded_state(mesh: Mesh, num_keys: int) -> Dict:
     }
 
 
-def _merge_digest_allgather(histo_state):
-    """Inside shard_map: gather every shard's centroid grid and recompress.
-    Equivalent to the global veneur re-inserting each local digest's
-    centroids (worker.go:455-457), done once as a batched kernel."""
+def _merge_digest_keysharded(histo_state, n: int):
+    """Inside shard_map: merge every shard's centroid grids, equivalent
+    to the global veneur re-inserting each local digest's centroids
+    (worker.go:455-457), done as one batched kernel.
+
+    Layout: rather than all_gather-ing all n grids onto every device and
+    recompressing all K rows redundantly on each (n*K*2C received and
+    K-row sort per device), the key dimension is scattered with an
+    all_to_all so each device receives only its K/n key block from every
+    shard (K*2C received) and recompresses K/n rows; the compact results
+    are then all_gather-ed back to the replicated view. Same collective
+    bytes as a reduce_scatter+all_gather pair, n-fold less compute and
+    peak memory per device."""
     num_keys = histo_state["wv"].shape[0]
-    # fold each shard's staging grid into its gathered slot list
+    # fold each shard's staging grid into its slot list
     m, w = batch_tdigest._fold_grids(histo_state)  # (K, 2C)
-    g_means = jax.lax.all_gather(m, SHARD_AXIS)  # (n,K,2C)
-    g_weights = jax.lax.all_gather(w, SHARD_AXIS)
-    cat_m = jnp.moveaxis(g_means, 0, 1).reshape(num_keys, -1)
-    cat_w = jnp.moveaxis(g_weights, 0, 1).reshape(num_keys, -1)
-    new_m, new_w = batch_tdigest._recompress(cat_m, cat_w, num_keys)
+    pad = (-num_keys) % n
+    if pad:
+        m = jnp.pad(m, ((0, pad), (0, 0)))
+        w = jnp.pad(w, ((0, pad), (0, 0)))
+    kp = m.shape[0] // n  # keys per device after scatter
+    # (n, kp, 2C) blocks; all_to_all sends block j to device j, so the
+    # leading axis afterwards indexes the SOURCE shard for THIS device's
+    # key block
+    m_all = jax.lax.all_to_all(m.reshape(n, kp, -1), SHARD_AXIS,
+                               split_axis=0, concat_axis=0, tiled=False)
+    w_all = jax.lax.all_to_all(w.reshape(n, kp, -1), SHARD_AXIS,
+                               split_axis=0, concat_axis=0, tiled=False)
+    cat_m = jnp.moveaxis(m_all, 0, 1).reshape(kp, -1)  # (kp, n*2C)
+    cat_w = jnp.moveaxis(w_all, 0, 1).reshape(kp, -1)
+    local_m, local_w = batch_tdigest._recompress(cat_m, cat_w, kp)
+    # gather the compact per-block results back into the replicated view;
+    # device order == key-block order by construction
+    g_m = jax.lax.all_gather(local_m, SHARD_AXIS)  # (n, kp, C)
+    g_w = jax.lax.all_gather(local_w, SHARD_AXIS)
+    new_m = g_m.reshape(-1, g_m.shape[-1])[:num_keys]
+    new_w = g_w.reshape(-1, g_w.shape[-1])[:num_keys]
     return {
         "wv": new_m * new_w,
         "weights": new_w,
@@ -128,7 +154,8 @@ def _merge_shards_local(state):
 
     sets = jax.lax.pmax(state["sets"].astype(jnp.int32), SHARD_AXIS).astype(
         jnp.int8)
-    histos = _merge_digest_allgather(state["histos"])
+    n = jax.lax.axis_size(SHARD_AXIS)
+    histos = _merge_digest_keysharded(state["histos"], n)
     return {
         "counters": counters,
         "gauges": {"value": gauges_val, "set": gauges_set},
