@@ -2,6 +2,7 @@
 round-trips used by the cortex sink."""
 
 import json
+import threading
 
 import yaml
 
@@ -178,5 +179,103 @@ class TestProfilingEndpoints:
             assert status == 200
             zf = zipfile.ZipFile(io.BytesIO(body))
             assert zf.namelist()  # non-empty trace directory
+        finally:
+            api.stop()
+
+
+def _read_varint(buf, pos):
+    """Returns (value, new_pos)."""
+    v = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        v |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return v, pos
+        shift += 7
+
+
+class TestPprofEndpoint:
+    @staticmethod
+    def _decode(buf):
+        """Minimal protobuf reader: yields (tag, wire, value)."""
+        pos = 0
+        while pos < len(buf):
+            key, pos = _read_varint(buf, pos)
+            tag, wire = key >> 3, key & 7
+            if wire == 2:
+                ln, pos = _read_varint(buf, pos)
+                yield tag, wire, buf[pos:pos + ln]
+                pos += ln
+            elif wire == 0:
+                v, pos = _read_varint(buf, pos)
+                yield tag, wire, v
+            else:
+                raise AssertionError(f"unexpected wire type {wire}")
+
+    def test_pprof_profile_decodes(self):
+        """/debug/pprof/profile returns a structurally valid gzipped
+        pprof Profile: sample types, samples referencing locations that
+        reference functions, and a string table resolving names."""
+        import gzip
+
+        from veneur_tpu.core import profiling
+
+        # busy thread so the sampler sees stacks
+        stop = threading.Event()
+
+        def spin():
+            while not stop.is_set():
+                sum(range(1000))
+
+        t = threading.Thread(target=spin, daemon=True)
+        t.start()
+        try:
+            body = profiling.pprof_for(0.3)
+        finally:
+            stop.set()
+        raw = gzip.decompress(body)
+        fields = list(self._decode(raw))
+        strings = [v.decode() for tag, _, v in fields if tag == 6]
+        assert strings[0] == ""
+        assert "samples" in strings and "count" in strings
+        assert "cpu" in strings and "nanoseconds" in strings
+        samples = [v for tag, _, v in fields if tag == 2]
+        locations = [v for tag, _, v in fields if tag == 4]
+        functions = [v for tag, _, v in fields if tag == 5]
+        assert samples and locations and functions
+        # every function's name/filename index resolves in the table
+        for fn in functions:
+            sub = dict((t2, v2) for t2, _, v2 in self._decode(fn))
+            assert 0 < sub[2] < len(strings)  # name
+            assert 0 < sub[4] < len(strings)  # filename
+        # this test file's spin() must appear in the profile
+        assert any("spin" == strings[dict(
+            (t2, v2) for t2, _, v2 in self._decode(fn))[2]]
+            for fn in functions)
+        # sample values: hits and hits*period, packed pairs
+        sub = list(self._decode(samples[0]))
+        packed_vals = [v for t2, w2, v in sub if t2 == 2][0]
+        nums = []
+        pos = 0
+        while pos < len(packed_vals):
+            n, pos = _read_varint(packed_vals, pos)
+            nums.append(n)
+        assert len(nums) == 2 and nums[1] == nums[0] * 10_000_000
+
+    def test_http_route_serves_pprof(self):
+        import gzip
+        cfg = generate_config()
+        api = HTTPApi(cfg, server=None, address="127.0.0.1:0")
+        api.start()
+        try:
+            status, body = vhttp.get(
+                api_url(api, "/debug/pprof/profile?seconds=0.2"),
+                timeout=30)
+            assert status == 200
+            assert gzip.decompress(body)  # valid gzip payload
+            status, listing = vhttp.get(api_url(api, "/debug/pprof/"))
+            assert status == 200 and b"pprof CPU profile" in listing
         finally:
             api.stop()

@@ -91,6 +91,23 @@ class _Handler(BaseHTTPRequestHandler):
             else:
                 body = profiling.sample_for(seconds).encode()
             self._send(200, body)
+        elif path == "/debug/pprof/profile":
+            # real pprof wire format (reference http.go:53-63 mounts Go
+            # pprof here): block for ?seconds=N, return gzipped proto —
+            # `go tool pprof http://host/debug/pprof/profile` works
+            from veneur_tpu.core import profiling
+            seconds = _query_float(self.path, "seconds", 5.0,
+                                   max_value=120.0)
+            body = profiling.pprof_for(seconds)
+            self._send(200, body, "application/octet-stream")
+        elif path == "/debug/pprof/" or path == "/debug/pprof":
+            self._send(200, (
+                b"veneur-tpu profiles:\n"
+                b"  /debug/pprof/profile?seconds=N  pprof CPU profile\n"
+                b"  /debug/profile/cpu?seconds=N    text CPU profile\n"
+                b"  /debug/profile/device?seconds=N xprof device trace\n"
+                b"  /debug/memory                   device memory JSON\n"
+                b"  /debug/threads                  all-thread stacks\n"))
         elif path == "/debug/profile/device":
             # jax.profiler trace (TensorBoard-loadable zip) — the TPU
             # analog of /debug/pprof/profile (reference http.go:53-63)

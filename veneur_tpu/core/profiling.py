@@ -41,10 +41,18 @@ class StackSampler:
     sites. Flat hits = frames executing when sampled (self time);
     cumulative hits = frames anywhere on a sampled stack."""
 
-    def __init__(self, hz: float = 100.0):
+    MAX_STACK_DEPTH = 64
+
+    def __init__(self, hz: float = 100.0, collect_stacks: bool = False):
         self.hz = hz
         self._flat: collections.Counter = collections.Counter()
         self._cum: collections.Counter = collections.Counter()
+        # full leaf-to-root stacks -> hits, for the pprof export. Only
+        # request-scoped samplers collect these: leaf sites key on
+        # f_lineno, so a continuous sampler would mint unbounded unique
+        # stack tuples over a long-running server's lifetime
+        self.collect_stacks = collect_stacks
+        self._stacks: collections.Counter = collections.Counter()
         self._samples = 0
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -90,6 +98,7 @@ class StackSampler:
                     continue
                 seen = set()
                 top = True
+                stack = []
                 while frame is not None:
                     code = frame.f_code
                     site = (code.co_filename, code.co_name,
@@ -100,7 +109,12 @@ class StackSampler:
                     if site not in seen:
                         self._cum[site] += 1
                         seen.add(site)
+                    if (self.collect_stacks
+                            and len(stack) < self.MAX_STACK_DEPTH):
+                        stack.append(site)
                     frame = frame.f_back
+                if self.collect_stacks:
+                    self._stacks[tuple(stack)] += 1
 
     # -- reporting --------------------------------------------------------
 
@@ -114,6 +128,7 @@ class StackSampler:
         with self._lock:
             self._flat.clear()
             self._cum.clear()
+            self._stacks.clear()
             self._samples = 0
             self._started_at = time.time()
 
@@ -151,6 +166,118 @@ def sample_for(seconds: float, hz: float = 100.0, top: int = 40) -> str:
     time.sleep(max(0.01, seconds))
     sampler.stop()
     return sampler.report(top=top)
+
+
+# -- pprof wire format ------------------------------------------------------
+# Hand-encoded https://github.com/google/pprof profile.proto (the schema
+# is small and stable), so `go tool pprof` / speedscope / pyroscope read
+# our CPU profiles directly — the reference serves real pprof at
+# /debug/pprof/profile (http.go:53-63).
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    while n >= 0x80:
+        out.append((n & 0x7F) | 0x80)
+        n >>= 7
+    out.append(n)
+    return bytes(out)
+
+
+def _field_bytes(tag: int, payload: bytes) -> bytes:
+    return _varint((tag << 3) | 2) + _varint(len(payload)) + payload
+
+
+def _field_varint(tag: int, value: int) -> bytes:
+    return _varint(tag << 3) + _varint(value)
+
+
+def _packed(tag: int, values) -> bytes:
+    body = b"".join(_varint(v) for v in values)
+    return _field_bytes(tag, body)
+
+
+def sampler_to_pprof(sampler: StackSampler) -> bytes:
+    """Encode the sampler's aggregated stacks as a gzipped pprof
+    Profile. Sample types: samples/count and cpu/nanoseconds (the shape
+    Go's CPU profile uses); one Location+Function per unique call site,
+    leaf-first location lists per stack."""
+    import gzip
+
+    with sampler._lock:
+        stacks = dict(sampler._stacks)
+        started = sampler._started_at
+    period_ns = int(1e9 / sampler.hz)
+
+    strings: Dict[str, int] = {"": 0}
+
+    def sid(s: str) -> int:
+        i = strings.get(s)
+        if i is None:
+            i = strings[s] = len(strings)
+        return i
+
+    func_ids: Dict[Tuple[str, str], int] = {}
+    functions: List[bytes] = []
+    loc_ids: Dict[Tuple[str, str, int], int] = {}
+    locations: List[bytes] = []
+
+    def loc_id(site: Tuple[str, str, int]) -> int:
+        i = loc_ids.get(site)
+        if i is not None:
+            return i
+        filename, name, line = site
+        fkey = (filename, name)
+        fid = func_ids.get(fkey)
+        if fid is None:
+            fid = func_ids[fkey] = len(functions) + 1
+            functions.append(
+                _field_varint(1, fid)
+                + _field_varint(2, sid(name))
+                + _field_varint(3, sid(name))
+                + _field_varint(4, sid(filename)))
+        i = loc_ids[site] = len(locations) + 1
+        line_msg = _field_varint(1, fid) + _field_varint(2, line)
+        locations.append(
+            _field_varint(1, i) + _field_bytes(4, line_msg))
+        return i
+
+    samples: List[bytes] = []
+    for stack, hits in stacks.items():
+        ids = [loc_id(site) for site in stack]  # already leaf-first
+        samples.append(
+            _packed(1, ids)
+            + _packed(2, [hits, hits * period_ns]))
+
+    def value_type(type_s: str, unit_s: str) -> bytes:
+        return (_field_varint(1, sid(type_s))
+                + _field_varint(2, sid(unit_s)))
+
+    out = bytearray()
+    out += _field_bytes(1, value_type("samples", "count"))
+    out += _field_bytes(1, value_type("cpu", "nanoseconds"))
+    for s in samples:
+        out += _field_bytes(2, s)
+    for loc in locations:
+        out += _field_bytes(4, loc)
+    for fn in functions:
+        out += _field_bytes(5, fn)
+    for s in sorted(strings, key=strings.get):
+        out += _field_bytes(6, s.encode())
+    out += _field_varint(9, int(started * 1e9))
+    out += _field_varint(10, int((time.time() - started) * 1e9))
+    out += _field_bytes(11, value_type("cpu", "nanoseconds"))
+    out += _field_varint(12, period_ns)
+    return gzip.compress(bytes(out))
+
+
+def pprof_for(seconds: float, hz: float = 100.0) -> bytes:
+    """One-shot pprof-format CPU profile (the /debug/pprof/profile
+    contract: block for `seconds`, then return the gzipped proto)."""
+    sampler = StackSampler(hz=hz, collect_stacks=True)
+    sampler.start()
+    time.sleep(max(0.01, seconds))
+    sampler.stop()
+    return sampler_to_pprof(sampler)
 
 
 def capture_device_trace(seconds: float) -> bytes:
