@@ -260,3 +260,17 @@ class TestConfig:
         assert parse_duration(3) == 3.0
         with pytest.raises(ValueError):
             parse_duration("10 parsecs")
+
+
+class TestNestedEnvOverlay:
+    def test_tpu_fields_from_env(self, tmp_path):
+        p = tmp_path / "cfg.yaml"
+        p.write_text("interval: 5s\n")
+        cfg = read_config(str(p), env={
+            "VENEUR_TPU_HISTO_CAPACITY": "12345",
+            "VENEUR_TPU_DISABLE_NATIVE_PARSER": "true",
+            "VENEUR_INTERVAL": "20s",
+        })
+        assert cfg.tpu.histo_capacity == 12345
+        assert cfg.tpu.disable_native_parser is True
+        assert cfg.interval == 20.0

@@ -256,4 +256,17 @@ def read_config(path: Optional[str] = None, overrides: Optional[dict] = None,
                     v = [float(x) for x in v]
             setattr(cfg, key, _coerce(key, v))
 
+    # nested device-sizing fields: VENEUR_TPU_<FIELD> (e.g.
+    # VENEUR_TPU_HISTO_CAPACITY) overlays cfg.tpu.<field>
+    for key in cfg.tpu.__dataclass_fields__:
+        env_key = "VENEUR_TPU_" + key.upper()
+        if env_key in env:
+            current = getattr(cfg.tpu, key)
+            v = env[env_key]
+            if isinstance(current, bool):
+                v = str(v).lower() in ("1", "true", "yes", "on")
+            else:
+                v = int(v)
+            setattr(cfg.tpu, key, v)
+
     return cfg.apply_defaults()
