@@ -279,3 +279,46 @@ class TestPprofEndpoint:
             assert status == 200 and b"pprof CPU profile" in listing
         finally:
             api.stop()
+
+
+class TestHeapPprof:
+    def teardown_method(self):
+        # heap_pprof arms tracemalloc; leaving it on would slow every
+        # later test in this process
+        import tracemalloc
+        if tracemalloc.is_tracing():
+            tracemalloc.stop()
+
+    def test_heap_profile_decodes(self):
+        import gzip
+
+        from veneur_tpu.core import profiling
+
+        # first call arms tracemalloc; allocate between calls so the
+        # second snapshot has content attributable to this file
+        profiling.heap_pprof()
+        keepalive = [bytearray(4096) for _ in range(200)]
+        body = profiling.heap_pprof()
+        assert keepalive  # hold the allocations through the snapshot
+        raw = gzip.decompress(body)
+        fields = list(TestPprofEndpoint._decode(raw))
+        strings = [v.decode() for tag, _, v in fields if tag == 6]
+        assert "objects" in strings and "space" in strings
+        assert "bytes" in strings
+        samples = [v for tag, _, v in fields if tag == 2]
+        assert samples
+        # this test file shows up as an allocation site
+        assert any("test_httpapi" in s for s in strings)
+
+    def test_http_route_serves_heap(self):
+        import gzip
+        cfg = generate_config()
+        api = HTTPApi(cfg, server=None, address="127.0.0.1:0")
+        api.start()
+        try:
+            status, body = vhttp.get(api_url(api, "/debug/pprof/heap"),
+                                     timeout=30)
+            assert status == 200
+            assert gzip.decompress(body)
+        finally:
+            api.stop()

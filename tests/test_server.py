@@ -274,3 +274,9 @@ class TestNestedEnvOverlay:
         assert cfg.tpu.histo_capacity == 12345
         assert cfg.tpu.disable_native_parser is True
         assert cfg.interval == 20.0
+
+    def test_empty_tpu_section_tolerated(self, tmp_path):
+        p = tmp_path / "cfg.yaml"
+        p.write_text("interval: 5s\ntpu:\n")  # empty section -> None
+        cfg = read_config(str(p), env={"VENEUR_TPU_SET_CAPACITY": "777"})
+        assert cfg.tpu.set_capacity == 777

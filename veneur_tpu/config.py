@@ -238,35 +238,37 @@ def read_config(path: Optional[str] = None, overrides: Optional[dict] = None,
             continue
         setattr(cfg, key, _coerce(key, value))
 
+    def _env_value(raw: str, current: Any, key: str) -> Any:
+        """Coerce an env string by the type of the current value."""
+        if isinstance(current, bool):
+            return str(raw).lower() in ("1", "true", "yes", "on")
+        if isinstance(current, int):
+            return int(raw)
+        if isinstance(current, float) and key not in _DURATION_FIELDS:
+            return float(raw)
+        if isinstance(current, list):
+            vals = [s for s in str(raw).split(",") if s]
+            if key == "percentiles":
+                return [float(x) for x in vals]
+            return vals
+        return raw
+
     env = os.environ if env is None else env
     for key in known:
         env_key = "VENEUR_" + key.upper().replace(".", "_")
         if env_key in env:
-            v: Any = env[env_key]
-            current = getattr(cfg, key)
-            if isinstance(current, bool):
-                v = str(v).lower() in ("1", "true", "yes", "on")
-            elif isinstance(current, int) and not isinstance(current, bool):
-                v = int(v)
-            elif isinstance(current, float) and key not in _DURATION_FIELDS:
-                v = float(v)
-            elif isinstance(current, list):
-                v = [s for s in str(v).split(",") if s]
-                if key == "percentiles":
-                    v = [float(x) for x in v]
+            v = _env_value(env[env_key], getattr(cfg, key), key)
             setattr(cfg, key, _coerce(key, v))
 
+    # an empty/omitted `tpu:` YAML section must still take env overrides
+    if not isinstance(cfg.tpu, TpuConfig):
+        cfg.tpu = TpuConfig()
     # nested device-sizing fields: VENEUR_TPU_<FIELD> (e.g.
     # VENEUR_TPU_HISTO_CAPACITY) overlays cfg.tpu.<field>
-    for key in cfg.tpu.__dataclass_fields__:
+    for key in TpuConfig.__dataclass_fields__:
         env_key = "VENEUR_TPU_" + key.upper()
         if env_key in env:
-            current = getattr(cfg.tpu, key)
-            v = env[env_key]
-            if isinstance(current, bool):
-                v = str(v).lower() in ("1", "true", "yes", "on")
-            else:
-                v = int(v)
-            setattr(cfg.tpu, key, v)
+            setattr(cfg.tpu, key, _env_value(
+                env[env_key], getattr(cfg.tpu, key), key))
 
     return cfg.apply_defaults()

@@ -100,10 +100,17 @@ class _Handler(BaseHTTPRequestHandler):
                                    max_value=120.0)
             body = profiling.pprof_for(seconds)
             self._send(200, body, "application/octet-stream")
+        elif path == "/debug/pprof/heap":
+            # pprof heap profile backed by tracemalloc; the first request
+            # arms tracing, later requests see allocations since
+            from veneur_tpu.core import profiling
+            self._send(200, profiling.heap_pprof(),
+                       "application/octet-stream")
         elif path == "/debug/pprof/" or path == "/debug/pprof":
             self._send(200, (
                 b"veneur-tpu profiles:\n"
                 b"  /debug/pprof/profile?seconds=N  pprof CPU profile\n"
+                b"  /debug/pprof/heap               pprof heap profile\n"
                 b"  /debug/profile/cpu?seconds=N    text CPU profile\n"
                 b"  /debug/profile/device?seconds=N xprof device trace\n"
                 b"  /debug/memory                   device memory JSON\n"

@@ -196,17 +196,15 @@ def _packed(tag: int, values) -> bytes:
     return _field_bytes(tag, body)
 
 
-def sampler_to_pprof(sampler: StackSampler) -> bytes:
-    """Encode the sampler's aggregated stacks as a gzipped pprof
-    Profile. Sample types: samples/count and cpu/nanoseconds (the shape
-    Go's CPU profile uses); one Location+Function per unique call site,
-    leaf-first location lists per stack."""
-    import gzip
+def encode_pprof(stacks, sample_types, period_type, period: int,
+                 started: float) -> bytes:
+    """Encode aggregated stacks as a gzipped pprof Profile.
 
-    with sampler._lock:
-        stacks = dict(sampler._stacks)
-        started = sampler._started_at
-    period_ns = int(1e9 / sampler.hz)
+    stacks: {leaf-first tuple of (filename, name, line): [values...]}
+    with one value per entry in sample_types ([(type, unit), ...]);
+    period_type is the (type, unit) of `period`. One Location+Function
+    is emitted per unique call site."""
+    import gzip
 
     strings: Dict[str, int] = {"": 0}
 
@@ -242,19 +240,17 @@ def sampler_to_pprof(sampler: StackSampler) -> bytes:
         return i
 
     samples: List[bytes] = []
-    for stack, hits in stacks.items():
+    for stack, values in stacks.items():
         ids = [loc_id(site) for site in stack]  # already leaf-first
-        samples.append(
-            _packed(1, ids)
-            + _packed(2, [hits, hits * period_ns]))
+        samples.append(_packed(1, ids) + _packed(2, values))
 
     def value_type(type_s: str, unit_s: str) -> bytes:
         return (_field_varint(1, sid(type_s))
                 + _field_varint(2, sid(unit_s)))
 
     out = bytearray()
-    out += _field_bytes(1, value_type("samples", "count"))
-    out += _field_bytes(1, value_type("cpu", "nanoseconds"))
+    for type_s, unit_s in sample_types:
+        out += _field_bytes(1, value_type(type_s, unit_s))
     for s in samples:
         out += _field_bytes(2, s)
     for loc in locations:
@@ -265,9 +261,55 @@ def sampler_to_pprof(sampler: StackSampler) -> bytes:
         out += _field_bytes(6, s.encode())
     out += _field_varint(9, int(started * 1e9))
     out += _field_varint(10, int((time.time() - started) * 1e9))
-    out += _field_bytes(11, value_type("cpu", "nanoseconds"))
-    out += _field_varint(12, period_ns)
+    out += _field_bytes(11, value_type(*period_type))
+    out += _field_varint(12, period)
     return gzip.compress(bytes(out))
+
+
+def sampler_to_pprof(sampler: StackSampler) -> bytes:
+    """CPU profile: samples/count + cpu/nanoseconds (the shape Go's CPU
+    profile uses)."""
+    with sampler._lock:
+        raw = dict(sampler._stacks)
+        started = sampler._started_at
+    period_ns = int(1e9 / sampler.hz)
+    stacks = {stack: [hits, hits * period_ns] for stack, hits in raw.items()}
+    return encode_pprof(stacks, [("samples", "count"),
+                                 ("cpu", "nanoseconds")],
+                        ("cpu", "nanoseconds"), period_ns, started)
+
+
+_heap_traced_since = [0.0]
+
+
+def heap_pprof(limit: int = 10_000) -> bytes:
+    """Heap profile at /debug/pprof/heap: a tracemalloc snapshot encoded
+    as pprof with objects/count + space/bytes sample types. tracemalloc
+    starts on first call (CPython can't reconstruct allocations made
+    before tracing began, so the first request arms the profiler and
+    later requests see everything allocated since)."""
+    import tracemalloc
+
+    if not tracemalloc.is_tracing():
+        tracemalloc.start(25)
+        _heap_traced_since[0] = time.time()
+    snap = tracemalloc.take_snapshot()
+    stats = sorted(snap.statistics("traceback"),
+                   key=lambda s: s.size, reverse=True)[:limit]
+    stacks = {}
+    for st in stats:
+        stack = tuple(
+            (fr.filename, os.path.basename(fr.filename), fr.lineno)
+            for fr in reversed(st.traceback))  # leaf-first
+        prev = stacks.get(stack)
+        if prev is None:
+            stacks[stack] = [st.count, st.size]
+        else:
+            prev[0] += st.count
+            prev[1] += st.size
+    return encode_pprof(stacks, [("objects", "count"), ("space", "bytes")],
+                        ("space", "bytes"), 1,
+                        _heap_traced_since[0] or time.time())
 
 
 def pprof_for(seconds: float, hz: float = 100.0) -> bytes:
