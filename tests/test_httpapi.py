@@ -322,3 +322,28 @@ class TestHeapPprof:
             assert gzip.decompress(body)
         finally:
             api.stop()
+
+
+class TestGoroutinePprof:
+    def test_thread_stacks_profile(self):
+        import gzip
+
+        from veneur_tpu.core import profiling
+        body = profiling.threads_pprof()
+        raw = gzip.decompress(body)
+        fields = list(TestPprofEndpoint._decode(raw))
+        strings = [v.decode() for tag, _, v in fields if tag == 6]
+        assert "threads" in strings and "count" in strings
+        samples = [v for tag, _, v in fields if tag == 2]
+        assert samples  # at least this thread
+
+    def test_http_route(self):
+        import gzip
+        api = HTTPApi(generate_config(), server=None, address="127.0.0.1:0")
+        api.start()
+        try:
+            status, body = vhttp.get(
+                api_url(api, "/debug/pprof/goroutine"), timeout=30)
+            assert status == 200 and gzip.decompress(body)
+        finally:
+            api.stop()

@@ -106,11 +106,18 @@ class _Handler(BaseHTTPRequestHandler):
             from veneur_tpu.core import profiling
             self._send(200, profiling.heap_pprof(),
                        "application/octet-stream")
+        elif path == "/debug/pprof/goroutine":
+            # thread stacks in pprof form (Go names this route goroutine;
+            # tooling hardcodes the path)
+            from veneur_tpu.core import profiling
+            self._send(200, profiling.threads_pprof(),
+                       "application/octet-stream")
         elif path == "/debug/pprof/" or path == "/debug/pprof":
             self._send(200, (
                 b"veneur-tpu profiles:\n"
                 b"  /debug/pprof/profile?seconds=N  pprof CPU profile\n"
                 b"  /debug/pprof/heap               pprof heap profile\n"
+                b"  /debug/pprof/goroutine          thread stacks (pprof)\n"
                 b"  /debug/profile/cpu?seconds=N    text CPU profile\n"
                 b"  /debug/profile/device?seconds=N xprof device trace\n"
                 b"  /debug/memory                   device memory JSON\n"

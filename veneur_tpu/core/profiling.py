@@ -279,6 +279,26 @@ def sampler_to_pprof(sampler: StackSampler) -> bytes:
                         ("cpu", "nanoseconds"), period_ns, started)
 
 
+def threads_pprof() -> bytes:
+    """All live thread stacks as a pprof profile (the goroutine-profile
+    analog: one sample per thread, value 1)."""
+    stacks: Dict[tuple, list] = {}
+    for frame in sys._current_frames().values():
+        stack = []
+        while frame is not None and len(stack) < StackSampler.MAX_STACK_DEPTH:
+            code = frame.f_code
+            stack.append((code.co_filename, code.co_name, frame.f_lineno))
+            frame = frame.f_back
+        key = tuple(stack)
+        prev = stacks.get(key)
+        if prev is None:
+            stacks[key] = [1]
+        else:
+            prev[0] += 1
+    return encode_pprof(stacks, [("threads", "count")],
+                        ("threads", "count"), 1, time.time())
+
+
 _heap_traced_since = [0.0]
 
 
