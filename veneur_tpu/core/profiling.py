@@ -8,6 +8,10 @@ the stdlib, so this module provides:
   * StackSampler — a ~100 Hz all-threads stack sampler (the pprof CPU
     profile analog): aggregates `sys._current_frames()` into flat and
     cumulative hit counts per call site, reported as a text profile.
+  * encode_pprof + sampler_to_pprof / heap_pprof / threads_pprof — real
+    pprof wire format (hand-encoded profile.proto) for CPU, tracemalloc
+    heap, and live-thread profiles; `go tool pprof` and speedscope read
+    them directly.
   * capture_device_trace — a bounded `jax.profiler.trace` session whose
     output directory is zipped and returned (open in TensorBoard /
     xprof to see device timelines, XLA ops, and HBM traffic).
@@ -15,8 +19,9 @@ the stdlib, so this module provides:
     TensorBoard capture, the idiomatic TPU profiling hook.
 
 Wired to config `enable_profiling` (continuous sampler from startup) and
-`profile_server_port`, and to the HTTP endpoints
-/debug/profile/cpu and /debug/profile/device (core.httpapi).
+`profile_server_port`, and to the HTTP endpoints /debug/pprof/{profile,
+heap,goroutine}, /debug/profile/cpu, and /debug/profile/device
+(core.httpapi).
 """
 
 from __future__ import annotations
