@@ -253,3 +253,61 @@ class TestIngestFlushRaces:
                 flushed.append(metric.value)
         assert server.store.counters.capacity > 1024  # growth happened
         assert sum(flushed) == pytest.approx(sent_known[0] + sent_new[0])
+
+
+class TestSetPromotionRaces:
+    def test_every_set_key_emitted_under_concurrent_flush(self):
+        """Sets under racing flushes, with keys hot enough to cross the
+        sparse->dense promotion threshold mid-interval: every key ever
+        sent must appear in at least one flush (a key whose samples
+        land in state without a surviving touched flag — or at a stale
+        device slot — would vanish instead)."""
+        server, observer = make_server()
+        stop = threading.Event()
+        sent_keys = set()
+        lock = threading.Lock()
+
+        def reader(slot):
+            gen = 0
+            while not stop.is_set():
+                names = [b"srace.s%d_%d" % (slot, gen + g) for g in range(4)]
+                # enough members per key to cross PROMOTE_SAMPLES after
+                # a few batches of re-sends; datagram-sized buffers
+                # (oversized buffers are dropped by metric_max_length)
+                lines = [b"%s:m%d|s" % (nm, i)
+                         for nm in names for i in range(64)]
+                batch = [b"\n".join(lines[j:j + 40])
+                         for j in range(0, len(lines), 40)]
+                for _ in range(3):
+                    server.handle_packet_batch(batch)
+                with lock:
+                    sent_keys.update(n.decode() for n in names)
+                gen += 4
+
+        emitted = set()
+
+        def flusher():
+            while not stop.is_set():
+                server.flush()
+                for metric in observer.drain():
+                    if metric.name.startswith("srace."):
+                        emitted.add(metric.name)
+                time.sleep(0.02)
+
+        threads = [threading.Thread(target=reader, args=(i,), daemon=True)
+                   for i in range(READERS)]
+        threads.append(threading.Thread(target=flusher, daemon=True))
+        for t in threads:
+            t.start()
+        time.sleep(DURATION_S)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+            assert not t.is_alive(), "thread failed to stop (deadlock?)"
+        server.store.apply_all_pending()
+        server.flush()
+        for metric in observer.drain():
+            if metric.name.startswith("srace."):
+                emitted.add(metric.name)
+        missing = sent_keys - emitted
+        assert not missing, f"{len(missing)} set keys never emitted"

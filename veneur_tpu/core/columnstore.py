@@ -155,16 +155,30 @@ class _BaseTable:
             self._grow_arrays(new_cap)
         self.capacity = new_cap
 
-    def _append_batch(self, columns) -> None:
+    def _append_batch(self, columns, touch_rows=None) -> None:
         """Vectorized append of parallel sample columns into the typed
         pending buffers (the native-parser fast path), dispatching whenever
-        full. Caller holds self.lock; rows must already be interned."""
+        full. Caller holds self.lock; rows must already be interned.
+
+        Touched flags are set PER CHUNK, in the same lock hold that puts
+        the chunk into the pending buffer. Marking all rows up front
+        would race the dispatch below: it releases the lock while
+        applying a full buffer, and a concurrent snapshot then clears
+        the flags of samples not yet buffered — their values later land
+        in the next interval's state untouched and are reset without
+        ever being emitted (observed as lost samples under the
+        concurrency stress suite). touch_rows defaults to the row
+        column; tables whose buffers carry device slots (the set table)
+        pass the table rows explicitly."""
+        if touch_rows is None:
+            touch_rows = columns[0]
         n = len(columns[0])
         i = 0
         while i < n:
             take = min(self.batch_cap - self._n, n - i)
             for buf, data in zip(self._pcols, columns):
                 buf[self._n:self._n + take] = data[i:i + take]
+            self.touched[touch_rows[i:i + take]] = True
             self._n += take
             i += take
             if self._n >= self.batch_cap:
@@ -219,7 +233,6 @@ class CounterTable(_BaseTable):
     def add_batch(self, rows, vals, rates) -> None:
         """Native-parser fast path: pre-interned rows, parallel columns."""
         with self.lock:
-            self.touched[rows] = True
             self._append_batch((rows, vals, rates))
 
     def merge_batch(self, stubs: List[UDPMetric], values) -> None:
@@ -296,7 +309,6 @@ class GaugeTable(_BaseTable):
     def add_batch(self, rows, vals) -> None:
         """Native-parser fast path; buffer order preserves last-write-wins."""
         with self.lock:
-            self.touched[rows] = True
             self._append_batch((rows, vals))
 
     def merge_batch(self, stubs: List[UDPMetric], values) -> None:
@@ -402,7 +414,6 @@ class HistoTable(_BaseTable):
     def add_batch(self, rows, vals, weights) -> None:
         """Native-parser fast path: weights are 1/sample_rate."""
         with self.lock:
-            self.touched[rows] = True
             self._append_batch((rows, vals, weights))
 
     def merge_batch(self, stubs: List[UDPMetric], in_means, in_weights,
@@ -606,27 +617,48 @@ class SetTable(_BaseTable):
         """Native-parser fast path: members already hashed to (idx, rho).
         Routes each sample to its key's tier (device slot or host COO)."""
         with self.lock:
-            self.touched[rows] = True
             if not self._sparse:
-                self._append_batch((rows, reg_idx, rho))
+                self._append_batch((rows, reg_idx, rho), touch_rows=rows)
                 return
-            self._counts += np.bincount(
-                rows, minlength=self._counts.shape[0]).astype(np.int32)
-            slots = self._slot_of[rows]
-            cold = slots < 0
-            hot_rows = np.unique(
-                rows[cold & (self._counts[rows] >= self.PROMOTE_SAMPLES)])
-            for r in hot_rows:
-                self._promote_locked(int(r))
-            if hot_rows.size:
-                slots = self._slot_of[rows]
+            # Route in buffer-sized chunks, re-deriving the slot map for
+            # every chunk under the CURRENT lock hold: a dispatch below
+            # releases the lock while applying, and a concurrent snapshot
+            # resets the slot assignment — slot ids captured before that
+            # window would write into the fresh interval's state at
+            # stale positions (lost or cross-credited samples).
+            start = 0
+            total = rows.shape[0]
+            while start < total:
+                free = self.batch_cap - self._n
+                if free <= 0:
+                    self._dispatch_pending_locked()  # may release lock
+                    continue
+                sl = slice(start, start + free)
+                r, ix, rh = rows[sl], reg_idx[sl], rho[sl]
+                start += r.shape[0]
+                self._counts += np.bincount(
+                    r, minlength=self._counts.shape[0]).astype(np.int32)
+                slots = self._slot_of[r]
                 cold = slots < 0
-            if (~cold).any():
-                self._append_batch((slots[~cold], reg_idx[~cold],
-                                    rho[~cold]))
-            if cold.any():
-                self._coo.append((rows[cold].copy(), reg_idx[cold].copy(),
-                                  rho[cold].copy()))
+                hot_rows = np.unique(
+                    r[cold & (self._counts[r] >= self.PROMOTE_SAMPLES)])
+                for hr in hot_rows:
+                    self._promote_locked(int(hr))
+                if hot_rows.size:
+                    slots = self._slot_of[r]
+                    cold = slots < 0
+                # COO append + touched in the same hold, BEFORE the
+                # dense append below can release the lock mid-dispatch
+                if cold.any():
+                    self.touched[r[cold]] = True
+                    self._coo.append((r[cold].copy(), ix[cold].copy(),
+                                      rh[cold].copy()))
+                if (~cold).any():
+                    # fits in the free space by construction, so the
+                    # only possible dispatch happens after the chunk is
+                    # fully buffered and touched
+                    self._append_batch((slots[~cold], ix[~cold],
+                                        rh[~cold]), touch_rows=r[~cold])
 
     def merge_batch(self, stubs: List[UDPMetric], in_regs) -> None:
         """Import-path HLL merge (register max); imported rows arrive
