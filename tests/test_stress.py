@@ -9,6 +9,7 @@ counter increment sent must appear in exactly one flush, and the run
 must terminate (no deadlock) within the test timeout.
 """
 
+import os
 import threading
 import time
 
@@ -21,7 +22,9 @@ from veneur_tpu.forward.client import ForwardClient
 from veneur_tpu.forward.protos import metric_pb2
 from veneur_tpu.sinks.channel import ChannelMetricSink
 
-DURATION_S = 4.0
+# STRESS_DURATION_S=60 turns this into a long-soak hammer (found the
+# round-3 lost-sample race at ~1-in-5 four-second runs)
+DURATION_S = float(os.environ.get("STRESS_DURATION_S", 4.0))
 READERS = 4
 
 
