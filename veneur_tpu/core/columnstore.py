@@ -78,6 +78,13 @@ class _BaseTable:
         kernel dispatch, so readers filling the fresh buffer never block
         on a device call.
       * Order: ``lock`` then ``apply_lock``; never the reverse.
+
+    Invariant: a row's touched flag may only be set in the same ``lock``
+    hold that makes its value visible to a flush (appended to a pending
+    buffer, or applied to state while ``apply_lock`` was acquired under
+    ``lock``). Setting it earlier lets a concurrent snapshot clear the
+    flag before the value exists (the value is later reset un-emitted);
+    setting it later lets a snapshot emit a touched-but-valueless row.
     """
 
     def __init__(self, capacity: int = 1024, batch_cap: int = 8192):
