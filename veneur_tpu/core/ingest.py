@@ -182,3 +182,68 @@ class BatchIngester:
     @property
     def interned_keys(self) -> int:
         return self._engine.size()
+
+    # ---- C++-resident pump ------------------------------------------------
+
+    def start_pump(self, socks) -> Optional["native.Pump"]:
+        """Build a native pump over the listener's sockets: the whole
+        socket->parse->accumulate loop runs in C++ reader threads (one per
+        socket, GIL-free), and Python touches a chunk of ~tens of
+        thousands of samples at a time instead of one 512-datagram buffer.
+        Returns None when the native pump cannot start."""
+        try:
+            max_len = self.server.config.metric_max_length
+            return native.Pump(
+                self._engine, [s.fileno() for s in socks],
+                max_dgram=max_len + 1, max_len=max_len)
+        except Exception:
+            logger.exception("native pump unavailable")
+            return None
+
+    def run_pump_dispatch(self, pump, listener) -> None:
+        """Dispatcher thread body: drain sealed chunks into the column
+        store until the listener closes, then stop the readers and flush
+        whatever they sealed on the way out."""
+        server = self.server
+        while not listener.closed:
+            self._dispatch_one(pump, server, timeout_ms=200)
+        # readers may be blocked waiting for a free chunk: keep draining
+        # while they wind down so their partial chunks (and the samples in
+        # them) make it into the store before the final flush
+        pump.signal_stop()
+        while pump.live_readers() > 0:
+            self._dispatch_one(pump, server, timeout_ms=50)
+        pump.stop()  # join (Listener.close may be doing the same)
+        while self._dispatch_one(pump, server, timeout_ms=0):
+            pass
+        lost = pump.lost_lines()
+        if lost:
+            logger.warning("pump discarded %d in-flight lines at shutdown",
+                           lost)
+            server.stats.inc("parse_errors", lost)
+        # native memory is freed by Pump.__del__ once the listener drops
+        # its reference: freeing here would race Listener.close()'s own
+        # concurrent stop() call
+
+    def _dispatch_one(self, pump, server, timeout_ms: int) -> bool:
+        chunk = pump.next(timeout_ms)
+        if chunk is None:
+            return False
+        try:
+            if chunk.dropped:
+                # oversized datagrams, dropped in C++ (metric_max_length
+                # parity with handle_packet_buffer)
+                server.stats.inc("parse_errors", chunk.dropped)
+            self._ingest(chunk)
+        except Exception:
+            logger.exception("pump chunk dispatch failed")
+        finally:
+            pump.release(chunk)
+        # surface reader backpressure (kernel-buffer loss risk) as a
+        # self-metric so operators can tell it apart from network loss
+        stalls = pump.stalls()
+        seen = getattr(pump, "_stalls_seen", 0)
+        if stalls != seen:
+            server.stats.inc("ingest_pump_stalls", stalls - seen)
+            pump._stalls_seen = stalls
+        return True

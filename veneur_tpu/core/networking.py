@@ -29,12 +29,18 @@ class Listener:
         self._socks = [sock] if sock is not None else []
         self._threads = threads
         self.closed = False
+        self.pump = None  # set when the C++ ingest pump owns the sockets
 
     def add_socket(self, sock):
         self._socks.append(sock)
 
     def close(self):
         self.closed = True
+        if self.pump is not None:
+            # joins the native reader threads BEFORE the fds close: a
+            # closed-and-reused fd number would otherwise let a reader
+            # poll someone else's socket
+            self.pump.stop()
         for sock in self._socks:
             try:
                 sock.close()
@@ -137,6 +143,20 @@ def _start_statsd_udp(u, server, num_readers: int, rcvbuf: int) -> Listener:
         sock = _new_udp_socket(host, bound_port, rcvbuf, reuseport=True)
         listener.add_socket(sock)
         socks.append(sock)
+    ing = getattr(server, "_ingester", None)
+    if ing is not None and not os.environ.get("VENEUR_TPU_DISABLE_PUMP"):
+        pump = ing.start_pump(socks)
+        if pump is not None:
+            t = threading.Thread(
+                target=ing.run_pump_dispatch, args=(pump, listener),
+                name="statsd-udp-pump-dispatch", daemon=True)
+            t.start()
+            threads.append(t)
+            listener.pump = pump
+            logger.info(
+                "listening for statsd on UDP %s (%d native readers, "
+                "C++ pump)", listener.address, len(socks))
+            return listener
     for i, sock in enumerate(socks):
         t = threading.Thread(
             target=_read_metric_socket, args=(sock, server, listener),

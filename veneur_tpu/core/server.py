@@ -436,6 +436,18 @@ class Server:
             source.stop()
         for t in self._source_threads:
             t.join(timeout=2.0)
+        # close listeners BEFORE the final flush so everything received
+        # up to the moment of shutdown is aggregated and flushed: close()
+        # joins the native pump readers, and the bounded thread joins
+        # below let the pump dispatcher / Python readers drain their
+        # in-flight buffers into the column store
+        for listener in self._listeners:
+            listener.close()
+        for listener in self._listeners:
+            for t in listener._threads:
+                # generous bound: a pump-dispatcher drain can hit a cold
+                # XLA compile; normal exit is well under a second
+                t.join(timeout=15.0)
         # sentinels wake idle workers promptly; a full channel is fine —
         # workers also poll the shutdown event every 0.5s
         for _ in self._span_workers:
@@ -450,8 +462,6 @@ class Server:
             worker.stop()
         if self.config.flush_on_shutdown:
             self.flush()
-        for listener in self._listeners:
-            listener.close()
         if self.import_server is not None:
             self.import_server.stop()
         for gi in self.grpc_ingest_servers:

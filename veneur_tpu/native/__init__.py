@@ -34,6 +34,27 @@ FAM_HISTO = 2
 FAM_SET = 3
 
 
+class ChunkDesc(ctypes.Structure):
+    """Mirror of dogstatsd.cc ChunkDesc: one sealed pump chunk's array
+    pointers and counts."""
+
+    _fields_ = [
+        ("c_rows", ctypes.c_void_p), ("c_vals", ctypes.c_void_p),
+        ("c_rates", ctypes.c_void_p), ("c_n", ctypes.c_int64),
+        ("g_rows", ctypes.c_void_p), ("g_vals", ctypes.c_void_p),
+        ("g_lines", ctypes.c_void_p), ("g_n", ctypes.c_int64),
+        ("h_rows", ctypes.c_void_p), ("h_vals", ctypes.c_void_p),
+        ("h_wts", ctypes.c_void_p), ("h_n", ctypes.c_int64),
+        ("s_rows", ctypes.c_void_p), ("s_idx", ctypes.c_void_p),
+        ("s_rho", ctypes.c_void_p), ("s_n", ctypes.c_int64),
+        ("arena", ctypes.c_void_p), ("unk_off", ctypes.c_void_p),
+        ("unk_len", ctypes.c_void_p), ("unk_line", ctypes.c_void_p),
+        ("unk_n", ctypes.c_int64),
+        ("lines", ctypes.c_int64), ("samples", ctypes.c_int64),
+        ("dgrams", ctypes.c_int64), ("dropped", ctypes.c_int64),
+    ]
+
+
 def _build_lib_path() -> str:
     with open(_SRC, "rb") as f:
         digest = hashlib.sha256(f.read()).hexdigest()[:16]
@@ -84,6 +105,35 @@ def _declare(lib) -> None:
         i64p, i64p, i32p, i64, i64p,          # unknown lines (+line index)
         i64p,                                 # samples parsed
     ]
+    lib.vnt_pump_new.restype = ctypes.c_void_p
+    lib.vnt_pump_new.argtypes = [
+        ctypes.c_void_p, i32p, ctypes.c_int32, ctypes.c_int32, i64, i64,
+        i64, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32]
+    lib.vnt_pump_next.restype = ctypes.c_void_p
+    lib.vnt_pump_next.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, ctypes.POINTER(ChunkDesc)]
+    lib.vnt_pump_release.restype = None
+    lib.vnt_pump_release.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+    lib.vnt_pump_stalls.restype = i64
+    lib.vnt_pump_stalls.argtypes = [ctypes.c_void_p]
+    lib.vnt_pump_signal_stop.restype = None
+    lib.vnt_pump_signal_stop.argtypes = [ctypes.c_void_p]
+    lib.vnt_pump_live.restype = ctypes.c_int32
+    lib.vnt_pump_live.argtypes = [ctypes.c_void_p]
+    lib.vnt_pump_lost_lines.restype = i64
+    lib.vnt_pump_lost_lines.argtypes = [ctypes.c_void_p]
+    lib.vnt_pump_stop.restype = None
+    lib.vnt_pump_stop.argtypes = [ctypes.c_void_p]
+    lib.vnt_pump_free.restype = None
+    lib.vnt_pump_free.argtypes = [ctypes.c_void_p]
+    lib.vnt_blast_new.restype = ctypes.c_void_p
+    lib.vnt_blast_new.argtypes = [ctypes.c_void_p, i64, i64p, i64p, i64]
+    lib.vnt_blast_free.restype = None
+    lib.vnt_blast_free.argtypes = [ctypes.c_void_p]
+    lib.vnt_blast_run.restype = i64
+    lib.vnt_blast_run.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, ctypes.POINTER(ctypes.c_int32),
+        i64, ctypes.c_int32, ctypes.c_double, i64]
 
 
 def load():
@@ -296,3 +346,168 @@ class NativeParser:
         res.unknown_lines = self._unk_lines[:un]
         del keepalive
         return res
+
+
+def _view(addr: int, n: int, dtype):
+    """Zero-copy numpy view over `n` elements of chunk memory at `addr`;
+    valid until the chunk is released back to the pump."""
+    if n == 0 or addr is None:
+        return np.empty(0, dtype)
+    nbytes = n * np.dtype(dtype).itemsize
+    buf = (ctypes.c_char * nbytes).from_address(addr)
+    return np.frombuffer(buf, dtype=dtype)
+
+
+class PumpChunk:
+    """One sealed chunk: trimmed zero-copy views plus counters, shaped
+    like ParseResult so BatchIngester._ingest consumes either."""
+
+    __slots__ = ("handle", "lines", "samples", "dgrams", "dropped",
+                 "c_rows", "c_vals", "c_rates",
+                 "g_rows", "g_vals", "g_lines", "h_rows", "h_vals", "h_wts",
+                 "s_rows", "s_idx", "s_rho", "unknown", "unknown_lines")
+
+
+class Blaster:
+    """Native UDP load generator: pre-rendered datagrams sent in
+    sendmmsg bursts, GIL-free (the veneur-emit-style benchmark driver;
+    reference cmd/veneur-emit). Run one `run()` per Python thread — each
+    call releases the GIL for its whole duration."""
+
+    def __init__(self, datagrams, lib=None):
+        self._lib = lib if lib is not None else load()
+        if self._lib is None:
+            raise RuntimeError(f"native blaster unavailable: {_lib_err}")
+        corpus = b"".join(datagrams)
+        offs = np.zeros(len(datagrams), np.int64)
+        lens = np.array([len(d) for d in datagrams], np.int64)
+        if len(datagrams) > 1:
+            np.cumsum(lens[:-1], out=offs[1:])
+        self._b = self._lib.vnt_blast_new(
+            ctypes.cast(ctypes.c_char_p(corpus), ctypes.c_void_p),
+            len(corpus), _ptr(offs, ctypes.c_int64),
+            _ptr(lens, ctypes.c_int64), len(datagrams))
+        self.stop_flag = ctypes.c_int32(0)
+
+    def run(self, fd: int, max_dgrams: int = 0, burst: int = 64,
+            pace_pps: float = 0.0, phase: int = 0) -> int:
+        """Blocks (GIL released) until stopped or max_dgrams sent;
+        returns datagrams handed to the kernel."""
+        return self._lib.vnt_blast_run(
+            self._b, fd, ctypes.byref(self.stop_flag), max_dgrams, burst,
+            pace_pps, phase)
+
+    def stop(self) -> None:
+        self.stop_flag.value = 1
+
+    def reset(self) -> None:
+        self.stop_flag.value = 0
+
+    def close(self) -> None:
+        if self._b:
+            self._lib.vnt_blast_free(self._b)
+            self._b = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class Pump:
+    """The C++-resident ingest loop: one native reader thread per socket
+    runs poll -> recvmmsg -> parse -> accumulate without ever taking the
+    GIL; Python calls `next()` (GIL released while blocking) to receive
+    sealed multi-thousand-sample chunks for device dispatch.
+
+    Lifecycle: next()/release() from one dispatcher thread; stop() (any
+    thread) halts the readers and unblocks next(); close() frees the
+    native pump once the dispatcher is done.
+    """
+
+    def __init__(self, engine: "Engine", fds, max_msgs: int = 512,
+                 max_dgram: int = 65536, max_len: int = 65535,
+                 chunk_cap: int = 65536, nchunks: int = 8,
+                 seal_age_ms: int = 100, poll_ms: int = 50, lib=None):
+        self._lib = lib if lib is not None else load()
+        if self._lib is None:
+            raise RuntimeError(f"native pump unavailable: {_lib_err}")
+        self.engine = engine  # keepalive: pump threads read the C table
+        fd_arr = (ctypes.c_int32 * len(fds))(*fds)
+        self._p = self._lib.vnt_pump_new(
+            engine.ptr, fd_arr, len(fds), max_msgs, max_dgram, max_len,
+            chunk_cap, nchunks, seal_age_ms, poll_ms)
+        self._desc = ChunkDesc()
+
+    def next(self, timeout_ms: int = 200) -> "PumpChunk | None":
+        """Blocks up to timeout_ms for a sealed chunk. The returned
+        chunk's arrays alias pump memory: call release() when done."""
+        handle = self._lib.vnt_pump_next(
+            self._p, timeout_ms, ctypes.byref(self._desc))
+        if not handle:
+            return None
+        d = self._desc
+        res = PumpChunk()
+        res.handle = handle
+        res.lines = d.lines
+        res.samples = d.samples
+        res.dgrams = d.dgrams
+        res.dropped = d.dropped
+        res.c_rows = _view(d.c_rows, d.c_n, np.int32)
+        res.c_vals = _view(d.c_vals, d.c_n, np.float32)
+        res.c_rates = _view(d.c_rates, d.c_n, np.float32)
+        res.g_rows = _view(d.g_rows, d.g_n, np.int32)
+        res.g_vals = _view(d.g_vals, d.g_n, np.float32)
+        res.g_lines = _view(d.g_lines, d.g_n, np.int32)
+        res.h_rows = _view(d.h_rows, d.h_n, np.int32)
+        res.h_vals = _view(d.h_vals, d.h_n, np.float32)
+        res.h_wts = _view(d.h_wts, d.h_n, np.float32)
+        res.s_rows = _view(d.s_rows, d.s_n, np.int32)
+        res.s_idx = _view(d.s_idx, d.s_n, np.int32)
+        res.s_rho = _view(d.s_rho, d.s_n, np.int32)
+        if d.unk_n:
+            offs = _view(d.unk_off, d.unk_n, np.int64)
+            lens = _view(d.unk_len, d.unk_n, np.int64)
+            res.unknown = [
+                ctypes.string_at(d.arena + int(offs[i]), int(lens[i]))
+                for i in range(d.unk_n)]
+            res.unknown_lines = _view(d.unk_line, d.unk_n, np.int32)
+        else:
+            res.unknown = []
+            res.unknown_lines = np.empty(0, np.int32)
+        return res
+
+    def release(self, chunk: PumpChunk) -> None:
+        self._lib.vnt_pump_release(self._p, chunk.handle)
+        chunk.handle = None
+
+    def stalls(self) -> int:
+        return self._lib.vnt_pump_stalls(self._p)
+
+    def live_readers(self) -> int:
+        return self._lib.vnt_pump_live(self._p)
+
+    def lost_lines(self) -> int:
+        return self._lib.vnt_pump_lost_lines(self._p)
+
+    def signal_stop(self) -> None:
+        """Sets the stop flag without joining, so the dispatcher can keep
+        draining while the readers seal their partial chunks and exit."""
+        if self._p:
+            self._lib.vnt_pump_signal_stop(self._p)
+
+    def stop(self) -> None:
+        if self._p:
+            self._lib.vnt_pump_stop(self._p)
+
+    def close(self) -> None:
+        if self._p:
+            self._lib.vnt_pump_free(self._p)
+            self._p = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

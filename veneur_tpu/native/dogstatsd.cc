@@ -21,12 +21,16 @@
 // registered here; after that the line never touches Python again.
 
 #include <atomic>
+#include <chrono>
+#include <condition_variable>
 #include <cstdint>
 #include <cstring>
+#include <deque>
 #include <mutex>
 #include <shared_mutex>
 #include <string>
 #include <string_view>
+#include <thread>
 #include <unordered_map>
 
 #include <locale.h>
@@ -37,6 +41,7 @@
 #include <poll.h>
 #include <sys/socket.h>
 #include <sys/uio.h>
+#include <time.h>
 
 #include <vector>
 
@@ -476,6 +481,448 @@ int64_t vnt_reader_read(void* rp, int32_t fd, int64_t max_len,
   }
   if (pos > 0) pos--;  // trailing separator
   return pos;
+}
+
+}  // extern "C"
+
+// ---- C++-resident ingest pump ---------------------------------------------
+//
+// The round-4 hot loop: per-socket reader threads run the whole
+// poll -> recvmmsg -> parse -> accumulate cycle in native code, free of the
+// GIL, filling large per-chunk COO sample buffers. Python is woken only
+// when a sealed chunk (tens of thousands of samples, i.e. hundreds of
+// joined datagram buffers) is ready to dispatch to the device column
+// store. This replaces the per-buffer Python round trip of the previous
+// design (reference analog: the compiled-Go read loop of
+// server.go:1103-1140, which likewise never leaves native code between
+// the socket and the sampler).
+
+namespace {
+
+inline int64_t now_ms() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return static_cast<int64_t>(ts.tv_sec) * 1000 + ts.tv_nsec / 1000000;
+}
+
+struct Chunk {
+  int64_t cap;        // per-family sample capacity
+  int64_t unk_cap;    // max deferred lines
+  int64_t arena_cap;  // deferred-line byte arena
+  std::vector<int32_t> c_rows;
+  std::vector<float> c_vals, c_rates;
+  std::vector<int32_t> g_rows;
+  std::vector<float> g_vals;
+  std::vector<int32_t> g_lines;
+  std::vector<int32_t> h_rows;
+  std::vector<float> h_vals, h_wts;
+  std::vector<int32_t> s_rows, s_idx, s_rho;
+  std::vector<uint8_t> arena;
+  std::vector<int64_t> unk_off, unk_len;
+  std::vector<int32_t> unk_line;
+  Out o;
+  int64_t arena_n = 0;
+  int64_t lines = 0;
+  int64_t dgrams = 0;
+  int64_t dropped = 0;
+  int64_t first_ms = 0;  // when the first sample landed (seal aging)
+
+  explicit Chunk(int64_t sample_cap, int64_t max_line)
+      : cap(sample_cap),
+        unk_cap(sample_cap),
+        arena_cap(sample_cap < 4 * max_line ? 4 * max_line : sample_cap),
+        c_rows(cap), c_vals(cap), c_rates(cap),
+        g_rows(cap), g_vals(cap), g_lines(cap),
+        h_rows(cap), h_vals(cap), h_wts(cap),
+        s_rows(cap), s_idx(cap), s_rho(cap),
+        arena(arena_cap),
+        unk_off(unk_cap), unk_len(unk_cap), unk_line(unk_cap) {
+    reset();
+  }
+
+  void reset() {
+    o = Out();
+    o.c_rows = c_rows.data(); o.c_vals = c_vals.data();
+    o.c_rates = c_rates.data(); o.c_cap = cap;
+    o.g_rows = g_rows.data(); o.g_vals = g_vals.data();
+    o.g_lines = g_lines.data(); o.g_cap = cap;
+    o.h_rows = h_rows.data(); o.h_vals = h_vals.data();
+    o.h_wts = h_wts.data(); o.h_cap = cap;
+    o.s_rows = s_rows.data(); o.s_idx = s_idx.data();
+    o.s_rho = s_rho.data(); o.s_cap = cap;
+    o.unk_off = unk_off.data(); o.unk_len = unk_len.data();
+    o.unk_line = unk_line.data(); o.unk_cap = unk_cap;
+    arena_n = 0;
+    lines = 0;
+    dgrams = 0;
+    dropped = 0;
+    first_ms = 0;
+  }
+
+  bool empty() const {
+    return lines == 0 && dropped == 0 && dgrams == 0;
+  }
+};
+
+struct ChunkDesc {
+  int32_t* c_rows; float* c_vals; float* c_rates; int64_t c_n;
+  int32_t* g_rows; float* g_vals; int32_t* g_lines; int64_t g_n;
+  int32_t* h_rows; float* h_vals; float* h_wts; int64_t h_n;
+  int32_t* s_rows; int32_t* s_idx; int32_t* s_rho; int64_t s_n;
+  uint8_t* arena; int64_t* unk_off; int64_t* unk_len; int32_t* unk_line;
+  int64_t unk_n;
+  int64_t lines; int64_t samples; int64_t dgrams; int64_t dropped;
+};
+
+struct Pump {
+  Engine* engine;
+  std::vector<int> fds;
+  int32_t max_msgs;
+  int64_t max_dgram;
+  int64_t max_len;
+  int64_t chunk_cap;
+  int32_t seal_age_ms;
+  int32_t poll_ms;
+
+  std::mutex mu;
+  std::condition_variable cv_free, cv_ready;
+  std::deque<Chunk*> free_list;
+  std::deque<Chunk*> ready;
+  std::vector<Chunk*> all;
+  std::vector<std::thread> threads;
+  std::mutex stop_mu;  // vnt_pump_stop is callable from several threads
+  std::atomic<bool> stop{false};
+  std::atomic<int32_t> live{0};        // reader threads still running
+  std::atomic<int64_t> stalls{0};      // times a reader waited for a chunk
+  std::atomic<int64_t> lost_lines{0};  // lines discarded at shutdown
+
+  ~Pump() {
+    for (Chunk* c : all) delete c;
+  }
+};
+
+// Moves a full/aged chunk to the ready queue and wakes the dispatcher.
+inline void pump_seal(Pump* p, Chunk* c) {
+  std::lock_guard<std::mutex> lock(p->mu);
+  p->ready.push_back(c);
+  p->cv_ready.notify_one();
+}
+
+// Blocks until a fresh chunk is available (dispatcher backpressure: while
+// a reader waits here it is not draining its socket, so the kernel buffer
+// absorbs or drops — standard UDP semantics). During stop the dispatcher
+// keeps draining, so freed chunks still arrive; only after a bounded wait
+// (dispatcher dead?) does this give up and return nullptr.
+inline Chunk* pump_take_free(Pump* p) {
+  std::unique_lock<std::mutex> lock(p->mu);
+  if (p->free_list.empty()) p->stalls.fetch_add(1);
+  for (int waited_ms = 0;;) {
+    if (!p->free_list.empty()) break;
+    if (p->stop && waited_ms >= 5000) return nullptr;
+    p->cv_free.wait_for(lock, std::chrono::milliseconds(100));
+    waited_ms += 100;
+    if (!p->stop) waited_ms = 0;  // unbounded while running
+  }
+  Chunk* c = p->free_list.front();
+  p->free_list.pop_front();
+  return c;
+}
+
+// Parses one joined buffer into the reader's current chunk, sealing and
+// swapping chunks mid-buffer whenever capacity could run out. Returns the
+// (possibly new) current chunk, or nullptr on stop.
+inline Chunk* pump_parse(Pump* p, Chunk* cur, const uint8_t* buf,
+                         int64_t buflen, std::string& keybuf, int64_t now) {
+  std::shared_lock lock(p->engine->mu);
+  int64_t pos = 0;
+  while (pos < buflen) {
+    const uint8_t* nl = static_cast<const uint8_t*>(
+        memchr(buf + pos, '\n', buflen - pos));
+    int64_t line_len = (nl == nullptr) ? (buflen - pos) : (nl - (buf + pos));
+    if (line_len > 0) {
+      // worst case this line emits line_len/2+1 samples into one family
+      int64_t need = line_len / 2 + 1;
+      int64_t fill = cur->o.c_n;
+      if (cur->o.g_n > fill) fill = cur->o.g_n;
+      if (cur->o.h_n > fill) fill = cur->o.h_n;
+      if (cur->o.s_n > fill) fill = cur->o.s_n;
+      if (fill + need > cur->cap || cur->o.unk_n + 1 > cur->unk_cap ||
+          cur->arena_n + line_len > cur->arena_cap) {
+        lock.unlock();
+        pump_seal(p, cur);
+        cur = pump_take_free(p);
+        if (cur == nullptr) {
+          // shutdown with a dead dispatcher: account for what this
+          // buffer still held so the loss is at least visible
+          int64_t lost = 0;
+          for (int64_t q = pos; q < buflen;) {
+            const uint8_t* qnl = static_cast<const uint8_t*>(
+                memchr(buf + q, '\n', buflen - q));
+            int64_t ll = (qnl == nullptr) ? (buflen - q) : (qnl - (buf + q));
+            if (ll > 0) lost++;
+            q += ll + 1;
+          }
+          p->lost_lines.fetch_add(lost);
+          return nullptr;
+        }
+        cur->first_ms = now;
+        lock.lock();
+      }
+      cur->o.line_no = static_cast<int32_t>(cur->lines);
+      cur->lines++;
+      if (!parse_line(p->engine, buf + pos, line_len, keybuf, &cur->o)) {
+        // deferred lines outlive the joined buffer: copy into the arena
+        memcpy(cur->arena.data() + cur->arena_n, buf + pos, line_len);
+        push_unknown(&cur->o, cur->arena_n, line_len);
+        cur->arena_n += line_len;
+      }
+    }
+    pos += line_len + 1;
+  }
+  return cur;
+}
+
+void pump_reader(Pump* p, int fd) {
+  struct Live {
+    Pump* p;
+    ~Live() { p->live.fetch_sub(1); }
+  } live{p};
+  Reader r(p->max_msgs, p->max_dgram);
+  std::string keybuf;
+  Chunk* cur = pump_take_free(p);
+  if (cur == nullptr) return;
+  while (!p->stop.load(std::memory_order_relaxed)) {
+    int32_t nd = 0, ndrop = 0;
+    int64_t len = vnt_reader_read(&r, fd, p->max_len, p->poll_ms, &nd,
+                                  &ndrop);
+    int64_t now = now_ms();
+    if (len < 0) break;
+    if (ndrop || len > 0) {
+      if (cur->empty()) cur->first_ms = now;
+      cur->dropped += ndrop;
+    }
+    if (len > 0) {
+      cur->dgrams += nd;
+      cur = pump_parse(p, cur, r.joined.data(), len, keybuf, now);
+      if (cur == nullptr) return;
+    }
+    // aging: never sit on samples longer than seal_age_ms, whether the
+    // socket is quiet (poll timeout) or steadily trickling
+    if (!cur->empty() && now - cur->first_ms >= p->seal_age_ms) {
+      pump_seal(p, cur);
+      cur = pump_take_free(p);
+      if (cur == nullptr) return;
+    }
+  }
+  if (!cur->empty()) {
+    pump_seal(p, cur);  // drain on shutdown
+  } else {
+    std::lock_guard<std::mutex> lock(p->mu);
+    p->free_list.push_back(cur);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* vnt_pump_new(void* ep, const int32_t* fds, int32_t nfds,
+                   int32_t max_msgs, int64_t max_dgram, int64_t max_len,
+                   int64_t chunk_cap, int32_t nchunks, int32_t seal_age_ms,
+                   int32_t poll_ms) {
+  Pump* p = new Pump();
+  p->engine = static_cast<Engine*>(ep);
+  p->fds.assign(fds, fds + nfds);
+  p->max_msgs = max_msgs;
+  p->max_dgram = max_dgram;
+  p->max_len = max_len;
+  p->chunk_cap = chunk_cap;
+  p->seal_age_ms = seal_age_ms;
+  p->poll_ms = poll_ms;
+  // enough chunks that every reader can fill one while the dispatcher
+  // holds one and a couple queue up behind it
+  if (nchunks < nfds + 2) nchunks = nfds + 2;
+  for (int32_t i = 0; i < nchunks; i++) {
+    Chunk* c = new Chunk(chunk_cap, max_dgram);
+    p->all.push_back(c);
+    p->free_list.push_back(c);
+  }
+  for (int fd : p->fds) {
+    p->live.fetch_add(1);
+    p->threads.emplace_back(pump_reader, p, fd);
+  }
+  return p;
+}
+
+// Sets the stop flag without joining, so the caller (the dispatcher) can
+// keep draining sealed chunks while the readers wind down and seal their
+// partial chunks.
+void vnt_pump_signal_stop(void* pp) {
+  Pump* p = static_cast<Pump*>(pp);
+  p->stop = true;
+  p->cv_free.notify_all();
+}
+
+int32_t vnt_pump_live(void* pp) {
+  return static_cast<Pump*>(pp)->live.load();
+}
+
+int64_t vnt_pump_lost_lines(void* pp) {
+  return static_cast<Pump*>(pp)->lost_lines.load();
+}
+
+// Waits up to timeout_ms for a sealed chunk; fills *out and returns the
+// chunk handle (release it with vnt_pump_release), or nullptr on timeout.
+void* vnt_pump_next(void* pp, int32_t timeout_ms, ChunkDesc* out) {
+  Pump* p = static_cast<Pump*>(pp);
+  std::unique_lock<std::mutex> lock(p->mu);
+  if (!p->cv_ready.wait_for(lock, std::chrono::milliseconds(timeout_ms),
+                            [p] { return !p->ready.empty(); })) {
+    return nullptr;
+  }
+  Chunk* c = p->ready.front();
+  p->ready.pop_front();
+  lock.unlock();
+  out->c_rows = c->c_rows.data(); out->c_vals = c->c_vals.data();
+  out->c_rates = c->c_rates.data(); out->c_n = c->o.c_n;
+  out->g_rows = c->g_rows.data(); out->g_vals = c->g_vals.data();
+  out->g_lines = c->g_lines.data(); out->g_n = c->o.g_n;
+  out->h_rows = c->h_rows.data(); out->h_vals = c->h_vals.data();
+  out->h_wts = c->h_wts.data(); out->h_n = c->o.h_n;
+  out->s_rows = c->s_rows.data(); out->s_idx = c->s_idx.data();
+  out->s_rho = c->s_rho.data(); out->s_n = c->o.s_n;
+  out->arena = c->arena.data();
+  out->unk_off = c->unk_off.data(); out->unk_len = c->unk_len.data();
+  out->unk_line = c->unk_line.data(); out->unk_n = c->o.unk_n;
+  out->lines = c->lines;
+  out->samples = c->o.samples;
+  out->dgrams = c->dgrams;
+  out->dropped = c->dropped;
+  return c;
+}
+
+void vnt_pump_release(void* pp, void* cp) {
+  Pump* p = static_cast<Pump*>(pp);
+  Chunk* c = static_cast<Chunk*>(cp);
+  c->reset();
+  std::lock_guard<std::mutex> lock(p->mu);
+  p->free_list.push_back(c);
+  p->cv_free.notify_one();
+}
+
+int64_t vnt_pump_stalls(void* pp) {
+  return static_cast<Pump*>(pp)->stalls.load();
+}
+
+// Stops the reader threads and wakes the dispatcher. Idempotent and safe
+// to call from several threads (the listener's close and the dispatcher's
+// shutdown both call it). Sealed chunks still queued can be drained with
+// vnt_pump_next afterwards.
+void vnt_pump_stop(void* pp) {
+  Pump* p = static_cast<Pump*>(pp);
+  p->stop = true;
+  p->cv_free.notify_all();
+  {
+    std::lock_guard<std::mutex> lock(p->stop_mu);
+    for (auto& t : p->threads) {
+      if (t.joinable()) t.join();
+    }
+    p->threads.clear();
+  }
+  p->cv_ready.notify_all();
+}
+
+void vnt_pump_free(void* pp) {
+  Pump* p = static_cast<Pump*>(pp);
+  vnt_pump_stop(p);
+  delete p;
+}
+
+// ---- native load blaster (sendmmsg) ---------------------------------------
+//
+// The benchmark-driver half of the story (the veneur-emit equivalent,
+// reference cmd/veneur-emit/main.go:169): pre-rendered datagrams are sent
+// to a connected UDP socket in sendmmsg bursts from native threads, so
+// load generation never competes with the server for the GIL. Used by
+// bench.py; not part of the serving path.
+
+namespace {
+
+struct Blast {
+  std::vector<uint8_t> corpus;
+  std::vector<int64_t> offs, lens;
+};
+
+}  // namespace
+
+void* vnt_blast_new(const uint8_t* data, int64_t datalen,
+                    const int64_t* offs, const int64_t* lens, int64_t n) {
+  Blast* b = new Blast();
+  b->corpus.assign(data, data + datalen);
+  b->offs.assign(offs, offs + n);
+  b->lens.assign(lens, lens + n);
+  return b;
+}
+
+void vnt_blast_free(void* bp) { delete static_cast<Blast*>(bp); }
+
+// Sends datagrams round-robin (starting at `phase`) until *stop becomes
+// nonzero or max_dgrams have been sent. pace_pps > 0 paces the send rate;
+// 0 sends flat out. Returns the number of datagrams handed to the kernel.
+int64_t vnt_blast_run(void* bp, int32_t fd, volatile int32_t* stop,
+                      int64_t max_dgrams, int32_t burst, double pace_pps,
+                      int64_t phase) {
+  Blast* b = static_cast<Blast*>(bp);
+  int64_t n = static_cast<int64_t>(b->offs.size());
+  if (n == 0 || burst <= 0) return 0;
+  if (burst > 1024) burst = 1024;
+  std::vector<mmsghdr> hdrs(burst);
+  std::vector<iovec> iovs(burst);
+  memset(hdrs.data(), 0, sizeof(mmsghdr) * burst);
+  for (int32_t i = 0; i < burst; i++) {
+    hdrs[i].msg_hdr.msg_iov = &iovs[i];
+    hdrs[i].msg_hdr.msg_iovlen = 1;
+  }
+  int64_t sent = 0;
+  int64_t pos = ((phase % n) + n) % n;
+  int64_t t0 = 0;
+  if (pace_pps > 0) t0 = now_ms();
+  while (!*stop && (max_dgrams <= 0 || sent < max_dgrams)) {
+    int32_t take = burst;
+    if (max_dgrams > 0 && max_dgrams - sent < take) {
+      take = static_cast<int32_t>(max_dgrams - sent);
+    }
+    for (int32_t i = 0; i < take; i++) {
+      iovs[i].iov_base = b->corpus.data() + b->offs[pos];
+      iovs[i].iov_len = static_cast<size_t>(b->lens[pos]);
+      pos++;
+      if (pos >= n) pos = 0;
+    }
+    int got = sendmmsg(fd, hdrs.data(), take, 0);
+    if (got < 0) {
+      if (errno == EAGAIN || errno == EWOULDBLOCK || errno == ENOBUFS ||
+          errno == EINTR) {
+        struct timespec ts = {0, 200000};  // 200us backoff
+        nanosleep(&ts, nullptr);
+        continue;
+      }
+      break;
+    }
+    sent += got;
+    if (pace_pps > 0) {
+      // keep the cumulative rate at pace_pps without drifting
+      int64_t due_ms = t0 + static_cast<int64_t>(sent * 1000.0 / pace_pps);
+      int64_t now = now_ms();
+      if (now < due_ms) {
+        struct timespec ts = {0, 0};
+        int64_t wait = due_ms - now;
+        ts.tv_sec = wait / 1000;
+        ts.tv_nsec = (wait % 1000) * 1000000;
+        nanosleep(&ts, nullptr);
+      }
+    }
+  }
+  return sent;
 }
 
 }  // extern "C"
