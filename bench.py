@@ -120,8 +120,7 @@ def enable_compile_cache() -> None:
         log(f"compile cache unavailable: {e}")
 
 
-def initialize_backend(max_attempts: int = 2,
-                       probe_timeout: float = 40.0) -> str:
+def initialize_backend(probe_timeouts=None) -> str:
     """Bring up the JAX backend before constructing any pipeline object so
     a backend failure is visible up front (round-1 failure modes: axon TPU
     init raising UNAVAILABLE deep inside Server construction, or hanging
@@ -132,14 +131,25 @@ def initialize_backend(max_attempts: int = 2,
     platform field in the JSON line records the fallback)."""
     import subprocess
 
+    if probe_timeouts is None:
+        raw = os.environ.get("BENCH_PROBE_TIMEOUTS", "45,75")
+        probe_timeouts = [float(x) for x in raw.split(",") if x.strip()]
+
     fallback_reason = None
     env_platform = os.environ.get("JAX_PLATFORMS", "")
     # Probe for ANY accelerator target — including one pinned via
     # JAX_PLATFORMS=axon in the environment. Skipping the probe when the
     # env var was set meant a wedged TPU tunnel hung the main process at
-    # first backend use, with no number and no diagnostics.
+    # first backend use, with no number and no diagnostics. Each attempt
+    # is a fresh subprocess, i.e. a full backend re-init from scratch —
+    # staged backoff with growing timeouts rides out a transient tunnel
+    # wedge without eating the whole wall-clock budget.
     if not env_platform.startswith("cpu"):
-        for attempt in range(1, max_attempts + 1):
+        for attempt, probe_timeout in enumerate(probe_timeouts, 1):
+            if time_left() < probe_timeout + 45:
+                fallback_reason = fallback_reason or "probe budget exhausted"
+                log(f"probe attempt {attempt} skipped: deadline too close")
+                break
             try:
                 probe = subprocess.run(
                     [sys.executable, "-c",
@@ -150,6 +160,7 @@ def initialize_backend(max_attempts: int = 2,
                 fallback_reason = f"probe timeout ({probe_timeout:.0f}s)"
                 print(f"bench: backend probe attempt {attempt} timed out",
                       file=sys.stderr)
+                time.sleep(5)
                 continue
             if probe.returncode == 0:
                 fallback_reason = None
@@ -218,87 +229,183 @@ def make_packets(num_keys: int, values_per_packet: int = 8):
     return packets, samples
 
 
-def run_pipeline_mt(duration_s: float, num_keys: int,
-                    thread_counts=None):
-    """The headline scenario: N reader threads drive pre-rendered
-    datagram buffers through the GIL-releasing native batch parser into
-    one shared column store — the in-process equivalent of the
-    reference's num_readers SO_REUSEPORT fanout (reference
-    networking.go:54-107). Returns (best_rate, {threads: rate}).
+class UdpRig:
+    """A live UDP server plus the native blaster pointed at it: the
+    benchmark's end-to-end rig (C++ sendmmsg senders -> kernel loopback ->
+    C++ pump readers -> Python chunk dispatch -> device column store).
+    This replaces the old in-process handle_packet_batch drive: load
+    generation and ingest both run GIL-free, so the measurement reflects
+    the server's pipeline, not the Python emitter's."""
 
-    The sweep stops at 2x the host's cores (always covering 1 and 2):
-    oversubscribed configs on a small host only measure GIL convoying
-    and burn wall-clock the later stages need."""
-    if thread_counts is None:
-        cap = max(2, 2 * (os.cpu_count() or 1))
-        thread_counts = tuple(n for n in (1, 2, 4, 8) if n <= cap)
+    def __init__(self, num_keys: int, datagrams, samples_per_dgram: float,
+                 **cfg_overrides):
+        import socket
+
+        from veneur_tpu import native
+
+        # blaster first: if the native lib is unavailable this raises
+        # before a server (ticker thread, sockets) exists to leak
+        self.blaster = native.Blaster(datagrams)
+        self.spd = samples_per_dgram
+        self.datagrams = datagrams
+        self.server = _mk_server(
+            num_keys, statsd_listen_addresses=["udp://127.0.0.1:0"],
+            **cfg_overrides)
+        self.server.start()
+        addr = self.server.local_addr("udp")
+        self.pump = self.server._listeners[0].pump
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self.sock.connect(addr)
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 4 << 20)
+
+    def warmup(self, join_warmup_thread: bool = True):
+        """Intern every key (slow path) + compile every kernel path."""
+        server = self.server
+        server.handle_packet_batch(self.datagrams)
+        server.store.apply_all_pending()
+        server.flush()
+        if join_warmup_thread and server._warmup_thread is not None:
+            server._warmup_thread.join(timeout=120)
+        with server._flush_lock:  # let an in-flight ticker flush drain
+            pass
+
+    def blast(self, seconds: float, offered_samples_per_s: float = 0.0,
+              senders: int = 1, drain_s: float = 2.0):
+        """Offer load for `seconds`; returns (offered_rate, processed_rate,
+        elapsed). offered==0 blasts flat out. drain_s bounds the
+        post-window wait for in-flight chunks to settle."""
+        blaster, server = self.blaster, self.server
+        blaster.reset()
+        pace = (offered_samples_per_s / self.spd / senders
+                if offered_samples_per_s else 0.0)
+        sent = [0] * senders
+        fd = self.sock.fileno()
+
+        def run(slot):
+            sent[slot] = blaster.run(fd, burst=64, pace_pps=pace,
+                                     phase=slot * 997)
+
+        ts = [threading.Thread(target=run, args=(i,), daemon=True)
+              for i in range(senders)]
+        p0 = server.store.processed
+        t0 = time.perf_counter()
+        for t in ts:
+            t.start()
+        time.sleep(seconds)
+        blaster.stop()
+        for t in ts:
+            t.join(timeout=30)
+        # drain until the processed counter stabilizes so one window's
+        # in-flight chunks don't bleed into the next measurement
+        last = server.store.processed
+        drain_deadline = time.perf_counter() + drain_s
+        while time.perf_counter() < drain_deadline:
+            time.sleep(0.15)
+            cur = server.store.processed
+            if cur == last:
+                break
+            last = cur
+        elapsed = time.perf_counter() - t0
+        processed = server.store.processed - p0
+        return (sum(sent) * self.spd / elapsed, processed / elapsed,
+                elapsed)
+
+    def close(self):
+        self.server.shutdown()
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+# offered-load ladder for the knee search, in samples/s (0 = unpaced)
+LADDER = (2e6, 4e6, 8e6, 16e6, 0)
+
+
+def run_pipeline_mt(duration_s: float, num_keys: int, rig: UdpRig = None,
+                    ladder=LADDER):
+    """The headline scenario: end-to-end UDP at increasing offered load.
+    On a small host an unpaced sender starves the pipeline of CPU, so the
+    ladder sweeps offered rates and reports the knee (best processed
+    rate). Returns (best_rate, {offered_label: processed_rate})."""
+    from veneur_tpu import native
+
+    if not native.available():
+        return _run_pipeline_inproc(duration_s, num_keys)
+    own_rig = rig is None
+    if own_rig:
+        packets, samples = make_packets(num_keys)
+        datagrams = make_datagrams(packets)
+        rig = UdpRig(num_keys, datagrams, samples / len(datagrams),
+                     interval=3600.0)
+        log(f"mixed: warmup (intern {num_keys} keys + compile kernels)")
+        rig.warmup()
+        log("mixed: warmup done")
+    per = max(1.2, duration_s / max(1, len(ladder)))
+    sweep = {}
+    try:
+        for offered in ladder:
+            if time_left() < per + 8:
+                log("mixed: ladder truncated by deadline")
+                break
+            off_rate, rate, _ = rig.blast(per, offered)
+            label = "unpaced" if not offered else f"{offered / 1e6:g}M"
+            sweep[label] = round(rate, 1)
+            log(f"mixed: offered {off_rate:,.0f}/s -> processed "
+                f"{rate:,.0f} samples/s")
+    finally:
+        if own_rig:
+            rig.close()
+    best = max(sweep.values()) if sweep else 0.0
+    return best, sweep
+
+
+def _run_pipeline_inproc(duration_s: float, num_keys: int):
+    """Fallback when the native library is unavailable: the old
+    in-process drive through handle_packet_batch."""
     server = _mk_server(num_keys)
-
     packets, samples_per_round = make_packets(num_keys)
-    # batch into datagram-sized buffers (~40 metrics each, like a client
-    # pipelining into 1400-byte datagrams) for the native batch path
     datagrams = make_datagrams(packets)
-
-    # warmup: intern every key (first pass is the Python slow path) and
-    # trigger every kernel compile path
-    log(f"mixed: warmup (intern {num_keys} keys + compile kernels)")
     server.handle_packet_batch(datagrams)
     server.store.apply_all_pending()
     server.flush()
-    log("mixed: warmup done")
-
-    per_round = duration_s / max(1, len(thread_counts))
-    scaling = {}
-    for n in thread_counts:
-        counts = [0] * n
-        stop = threading.Event()
-
-        def worker(slot):
-            # stagger start points so threads do not convoy on one table
-            my = datagrams[slot::n] if n > 1 else datagrams
-            local = 0
-            while not stop.is_set():
-                server.handle_packet_batch(my)
-                local += 1
-            counts[slot] = local
-
-        threads = [threading.Thread(target=worker, args=(i,), daemon=True)
-                   for i in range(n)]
-        t0 = time.perf_counter()
-        for t in threads:
-            t.start()
-        time.sleep(per_round)
-        stop.set()
-        for t in threads:
-            t.join()
-        server.store.apply_all_pending()
-        elapsed = time.perf_counter() - t0
-        if n == 1:
-            total = counts[0] * samples_per_round
-        else:
-            # each slot covers ~1/n of the corpus per pass
-            total = sum(c * samples_per_round // n for c in counts)
-        rate = total / elapsed
-        scaling[str(n)] = round(rate, 1)
-        log(f"mixed: {n} thread(s) -> {rate:,.0f} samples/s")
+    t0 = time.perf_counter()
+    rounds = 0
+    while time.perf_counter() - t0 < duration_s:
+        server.handle_packet_batch(datagrams)
+        rounds += 1
+    server.store.apply_all_pending()
+    elapsed = time.perf_counter() - t0
     server.flush()
-    best = max(scaling.values())
-    return best, scaling
+    return rounds * samples_per_round / elapsed, {"inproc": True}
 
 
 def run_scenario_sustained(num_keys: int = 100_000, interval_s: float = 10.0,
-                           intervals: int = 2, threads: int = None):
-    """The north-star gate: a live server with a real flush ticker under
-    sustained multi-threaded load; reports per-interval flush wall time
-    (must stay under the interval — reference flusher.go:26-122's
-    one-interval deadline) and the sustained ingest rate. Reader threads
-    default to 2x the host's cores (capped at 4): oversubscribing a
-    small host starves the flush thread of GIL time and measures convoy
-    behaviour, not pipeline capacity."""
-    if threads is None:
-        threads = min(4, max(2, 2 * (os.cpu_count() or 1)))
-    server = _mk_server(num_keys, interval=interval_s,
-                        synchronize_with_interval=False)
+                           intervals: int = 3, rig: UdpRig = None,
+                           offered: float = None, ladder_s: float = 6.0):
+    """The north-star gate at the reference's production shape: a live
+    server with a real flush ticker (interval_s, >= `intervals` flushes)
+    under sustained UDP load; reports per-interval flush wall time (must
+    stay under the interval — reference flusher.go:26-122's one-interval
+    deadline, config.go:109's 10s default) and the sustained processed
+    rate. Load is offered at ~85% of the measured knee so the number
+    reflects steady aggregation, not drop handling."""
+    from veneur_tpu import native
+
+    if not native.available():
+        raise RuntimeError(
+            f"sustained gate needs the native rig: "
+            f"{native.unavailable_reason()}")
+    own_rig = rig is None
+    if own_rig:
+        packets, samples = make_packets(num_keys)
+        datagrams = make_datagrams(packets)
+        rig = UdpRig(num_keys, datagrams, samples / len(datagrams),
+                     interval=interval_s, synchronize_with_interval=False)
+        log(f"sustained: warmup ({num_keys} keys)")
+        rig.warmup()
+        log("sustained: warmup done")
+    server = rig.server
     flush_times = []
     orig_flush_locked = server._flush_locked
 
@@ -308,75 +415,49 @@ def run_scenario_sustained(num_keys: int = 100_000, interval_s: float = 10.0,
         flush_times.append(time.perf_counter() - t0)
 
     server._flush_locked = timed_flush
-
-    packets, samples_per_round = make_packets(num_keys)
-    datagrams = make_datagrams(packets)
-    log(f"sustained: warmup ({num_keys} keys)")
-    server.start()
-    server.handle_packet_batch(datagrams)
-    server.store.apply_all_pending()
-    server.flush()
-    # the server's own kernel-warmup thread flushes a scratch store at
-    # full capacity; let it finish before measuring so its device allocs
-    # and GIL time don't land on the first measured ticker flush
-    if server._warmup_thread is not None:
-        server._warmup_thread.join(timeout=120)
-    with server._flush_lock:  # let an in-flight ticker flush drain
-        pass
-    flush_times.clear()
-    log("sustained: warmup done; ticker live")
-
-    stop = threading.Event()
-    counts = [0] * threads
-
-    def worker(slot):
-        my = datagrams[slot::threads]
-        while not stop.is_set():
-            server.handle_packet_batch(my)
-            counts[slot] += 1
-
-    ts = [threading.Thread(target=worker, args=(i,), daemon=True)
-          for i in range(threads)]
-    t0 = time.perf_counter()
-    for t in ts:
-        t.start()
-    deadline = t0 + intervals * interval_s + 0.5
-    while time.perf_counter() < deadline:
-        time.sleep(0.1)
-    stop.set()
-    elapsed = time.perf_counter() - t0
-    for t in ts:
-        t.join(timeout=60)
-    # let an in-flight ticker flush finish so its wall time is recorded
-    wait_deadline = time.perf_counter() + interval_s * 2
-    while (len(flush_times) < intervals
-           and time.perf_counter() < wait_deadline):
-        time.sleep(0.1)
-    # device-queue drain: how long until everything enqueued lands
-    drain_t0 = time.perf_counter()
-    server.store.apply_all_pending()
-    import jax
-    jax.block_until_ready(server.store.counters.state)
-    drain_s = time.perf_counter() - drain_t0
-    ticker_flushes = len(flush_times)
-    # a final timed flush guarantees at least one real measurement of a
-    # full-table flush under post-load state
-    server.flush()
-    server.shutdown()
-    total = sum(c * samples_per_round // threads for c in counts)
-    rate = total / elapsed
-    times = sorted(flush_times)
+    try:
+        if offered is None:
+            # short knee probe to pick the sustained offered rate
+            best, _ = run_pipeline_mt(ladder_s, num_keys, rig=rig,
+                                      ladder=(4e6, 12e6, 0))
+            offered = max(best * 0.85, 2e5)
+        log(f"sustained: offering {offered:,.0f} samples/s for "
+            f"{intervals}x{interval_s:g}s")
+        flush_times.clear()
+        off_rate, rate, elapsed = rig.blast(
+            intervals * interval_s + 0.5, offered)
+        # let an in-flight ticker flush finish so its wall time lands
+        wait_deadline = time.perf_counter() + interval_s * 2
+        while (len(flush_times) < intervals
+               and time.perf_counter() < wait_deadline
+               and time_left() > 10):
+            time.sleep(0.1)
+        drain_t0 = time.perf_counter()
+        server.store.apply_all_pending()
+        import jax
+        jax.block_until_ready(server.store.counters.state)
+        drain_s = time.perf_counter() - drain_t0
+        ticker_flushes = len(flush_times)
+        # a final timed flush guarantees at least one measurement of a
+        # full-table flush under post-load state
+        server.flush()
+    finally:
+        server._flush_locked = orig_flush_locked
+        if own_rig:
+            rig.close()
+    times = sorted(flush_times) or [0.0]
     p50 = times[len(times) // 2]
     p99 = times[min(len(times) - 1, int(len(times) * 0.99))]
-    log(f"sustained: {rate:,.0f} samples/s over {elapsed:.1f}s, "
-        f"{len(times)} flushes, p50={p50:.3f}s p99={p99:.3f}s "
-        f"drain={drain_s:.2f}s")
+    log(f"sustained: {rate:,.0f} samples/s over {elapsed:.1f}s "
+        f"(offered {off_rate:,.0f}), {len(times)} flushes, "
+        f"p50={p50:.3f}s p99={p99:.3f}s drain={drain_s:.2f}s")
     return rate, {
         "flush_p50_s": round(p50, 4),
         "flush_p99_s": round(p99, 4),
         "flush_count": ticker_flushes,
         "queue_drain_s": round(drain_s, 3),
         "interval_s": interval_s,
+        "offered_samples_per_sec": round(off_rate, 1),
         "sustained_keys": num_keys,
     }
 
@@ -421,45 +502,65 @@ def _mk_server(num_keys: int, **cfg_overrides):
     return Server(cfg, extra_metric_sinks=[BlackholeMetricSink()])
 
 
+def _run_udp_scenario(duration_s: float, packets, samples: int,
+                      num_keys: int, offered: float = 0.0):
+    """Shared driver for the UDP config scenarios: warmup, then offer
+    load (unpaced knee by default, or an exact paced rate) and report the
+    processed rate."""
+    from veneur_tpu import native
+
+    datagrams = make_datagrams(packets)
+    if not native.available():
+        server = _mk_server(num_keys)
+        server.handle_packet_batch(datagrams)
+        server.store.apply_all_pending()
+        server.flush()
+        t0 = time.perf_counter()
+        rounds = 0
+        while time.perf_counter() - t0 < duration_s:
+            server.handle_packet_batch(datagrams)
+            rounds += 1
+        server.store.apply_all_pending()
+        elapsed = time.perf_counter() - t0
+        server.flush()
+        return rounds * samples / elapsed
+    rig = UdpRig(num_keys, datagrams, samples / len(datagrams),
+                 interval=3600.0)
+    try:
+        rig.warmup(join_warmup_thread=False)
+        if offered:
+            _off, rate, _el = rig.blast(duration_s, offered)
+        else:
+            # two-rung mini-ladder: paced near capacity beats unpaced on
+            # small hosts where the sender competes for the core
+            per = max(1.0, duration_s / 2)
+            _off, r1, _ = rig.blast(per, 0.0)
+            _off, r2, _ = rig.blast(per, max(r1 * 2.0, 1e6))
+            rate = max(r1, r2)
+    finally:
+        rig.close()
+    return rate
+
+
 def run_scenario_counter(duration_s: float):
-    """BASELINE config 1: one counter key, blackhole sink."""
-    server = _mk_server(16)
-    dgram = b"\n".join(b"bench.one:1|c" for _ in range(40))
-    server.handle_packet_batch([dgram])
-    server.store.apply_all_pending()
-    server.flush()
-    t0 = time.perf_counter()
-    total = 0
-    while time.perf_counter() - t0 < duration_s:
-        for _ in range(50):
-            server.handle_packet_batch([dgram])
-        total += 50 * 40
-    server.store.apply_all_pending()
-    server.flush()
-    return total / (time.perf_counter() - t0)
+    """BASELINE config 1: one counter key at 10k packets/s (the
+    veneur-emit shape) into a blackhole sink; single-metric datagrams."""
+    packets = [b"bench.one:1|c"] * 512
+    return _run_udp_scenario(duration_s, packets, len(packets), 16,
+                             offered=10_000.0)
 
 
 def run_scenario_timers(duration_s: float, num_keys: int = 1000):
-    """BASELINE config 2: t-digest stress, multi-value timer packets."""
+    """BASELINE config 2: t-digest stress, multi-value timer packets
+    replayed over UDP."""
     import numpy as np
     rng = np.random.default_rng(1)
     packets = []
     for i in range(num_keys):
         vals = b":".join(b"%.2f" % v for v in rng.normal(100, 15, 8))
         packets.append(b"bench.timer.%d:%s|ms" % (i, vals))
-    datagrams = make_datagrams(packets)
-    server = _mk_server(num_keys * 2)
-    server.handle_packet_batch(datagrams)
-    server.store.apply_all_pending()
-    server.flush()
-    t0 = time.perf_counter()
-    total = 0
-    while time.perf_counter() - t0 < duration_s:
-        server.handle_packet_batch(datagrams)
-        total += num_keys * 8
-    server.store.apply_all_pending()
-    server.flush()
-    return total / (time.perf_counter() - t0)
+    return _run_udp_scenario(duration_s, packets, num_keys * 8,
+                             num_keys * 2)
 
 
 def run_scenario_forward(duration_s: float, num_keys: int = 50_000):
@@ -666,19 +767,8 @@ def run_scenario_hll(duration_s: float, num_keys: int = 10_000,
             packets.append(
                 b"bench.hll.%d:user%d|s|#card:%d,env:bench"
                 % (i, rng.integers(0, 100_000), t))
-    datagrams = make_datagrams(packets)
-    server = _mk_server(num_keys * 2)
-    server.handle_packet_batch(datagrams)
-    server.store.apply_all_pending()
-    server.flush()
-    t0 = time.perf_counter()
-    total = 0
-    while time.perf_counter() - t0 < duration_s:
-        server.handle_packet_batch(datagrams)
-        total += len(packets)
-    server.store.apply_all_pending()
-    server.flush()
-    return total / (time.perf_counter() - t0)
+    return _run_udp_scenario(duration_s, packets, len(packets),
+                             num_keys * 2)
 
 
 SCENARIOS = ["default", "mixed", "single", "counter", "timers", "hll",
@@ -722,6 +812,99 @@ def run_one(scenario: str, duration: float, keys: int, on_tpu: bool = True):
     return metric, rate, extra
 
 
+def run_default(args, on_tpu: bool) -> None:
+    """The driver's default artifact: one rig runs the mixed offered-load
+    ladder and the sustained flush-latency gate at the production shape
+    (100k keys / 10s interval on TPU — BASELINE.md's north star; budget-
+    adaptive on the CPU fallback), then the device-kernel stage and a
+    short run of each of the five BASELINE configs."""
+    from veneur_tpu import native
+
+    if on_tpu:
+        keys, interval_s, intervals = 100_000, 10.0, 3
+    elif time_left() > 130:
+        keys, interval_s, intervals = 50_000, 5.0, 2
+    else:  # late start (probe retries ate the budget): keep stages landing
+        keys, interval_s, intervals = 10_000, 2.0, 2
+
+    log(f"stage 1/3: pipeline rig ({keys} keys, {interval_s:g}s interval)")
+    rig = None
+    try:
+        if native.available():
+            packets, samples = make_packets(keys)
+            datagrams = make_datagrams(packets)
+            rig = UdpRig(keys, datagrams, samples / len(datagrams),
+                         interval=interval_s,
+                         synchronize_with_interval=False)
+            log(f"pipeline: warmup (intern {keys} keys + compile)")
+            rig.warmup()
+            log("pipeline: warmup done; ticker live")
+        rate, sweep = run_pipeline_mt(args.duration, keys, rig=rig)
+        RESULT.update(metric=METRIC_NAMES["mixed"], value=round(rate, 1),
+                      unit="samples/s", offered_sweep=sweep,
+                      pipeline_keys=keys)
+        if time_left() < intervals * interval_s + 25:
+            log(f"sustained skipped: {time_left():.0f}s of budget left")
+            RESULT["sustained_skipped"] = True
+        else:
+            try:
+                srate, sextra = run_scenario_sustained(
+                    keys, interval_s=interval_s, intervals=intervals,
+                    rig=rig, offered=max(rate * 0.85, 2e5))
+                RESULT["sustained_samples_per_sec"] = round(srate, 1)
+                RESULT.update(sextra)
+            except Exception as e:
+                traceback.print_exc()
+                RESULT["sustained_error"] = f"{type(e).__name__}: {e}"
+    finally:
+        if rig is not None:
+            rig.close()
+
+    log("stage 2/3: device-only kernel throughput")
+    if time_left() < 25:
+        log(f"device stage skipped: {time_left():.0f}s of budget left")
+        RESULT["device_skipped"] = True
+    else:
+        try:
+            _m, drate, dextra = run_one(
+                "device", 3.0 if on_tpu else 2.0, args.keys, on_tpu)
+            RESULT["device_samples_per_sec"] = round(drate, 1)
+            RESULT["device_flush_latency_s"] = dextra.get("flush_latency_s")
+        except Exception as e:
+            traceback.print_exc()
+            RESULT["device_error"] = f"{type(e).__name__}: {e}"
+
+    # the five BASELINE configs, cheapest first so a tight budget still
+    # lands most of the table (BASELINE.json `configs`)
+    log("stage 3/3: BASELINE config suite")
+    configs = {}
+    RESULT["configs"] = configs
+    config_runs = [
+        ("counter", lambda d: run_scenario_counter(d), 20),
+        ("timers", lambda d: run_scenario_timers(d, 1000), 20),
+        ("hll", lambda d: run_scenario_hll(d, 10_000), 25),
+        ("ssf", lambda d: run_scenario_ssf(d, 10_000), 30),
+        ("forward", lambda d: run_scenario_forward(
+            d, 50_000 if on_tpu else 10_000), 35),
+    ]
+    for name, fn, reserve in config_runs:
+        if time_left() < reserve:
+            configs[name] = {"skipped": True}
+            log(f"config {name} skipped: {time_left():.0f}s left")
+            continue
+        dur = min(4.0, max(2.0, (time_left() - reserve + 15) / 6))
+        try:
+            t0 = time.perf_counter()
+            r = fn(dur)
+            configs[name] = {
+                "samples_per_sec": round(r, 1),
+                "wall_s": round(time.perf_counter() - t0, 1)}
+            log(f"config {name}: {r:,.0f} samples/s")
+        except Exception as e:
+            traceback.print_exc()
+            configs[name] = {"error": f"{type(e).__name__}: {e}"}
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--duration", type=float, default=8.0)
@@ -754,42 +937,7 @@ def main():
 
     try:
         if args.scenario == "default":
-            log("stage 1/3: mixed multi-threaded host pipeline")
-            rate, scaling = run_pipeline_mt(args.duration, args.keys)
-            RESULT.update(metric=METRIC_NAMES["mixed"],
-                          value=round(rate, 1), unit="samples/s",
-                          threads=scaling)
-            log("stage 2/3: sustained live-ticker gate")
-            if time_left() < 45:
-                log(f"stage 2 skipped: {time_left():.0f}s of budget left")
-                RESULT["sustained_skipped"] = True
-            else:
-                try:
-                    # the gate regime stays pinned (100k TPU / 10k CPU):
-                    # sustained_samples_per_sec is only comparable across
-                    # rounds at a fixed shape
-                    srate, sextra = run_scenario_sustained(
-                        100_000 if on_tpu else 10_000,
-                        interval_s=10.0 if on_tpu else 2.0)
-                    RESULT["sustained_samples_per_sec"] = round(srate, 1)
-                    RESULT.update(sextra)
-                except Exception as e:
-                    traceback.print_exc()
-                    RESULT["sustained_error"] = f"{type(e).__name__}: {e}"
-            log("stage 3/3: device-only kernel throughput")
-            if time_left() < 25:
-                log(f"stage 3 skipped: {time_left():.0f}s of budget left")
-                RESULT["device_skipped"] = True
-            else:
-                try:
-                    _m, drate, dextra = run_one(
-                        "device", 3.0 if on_tpu else 2.0, args.keys, on_tpu)
-                    RESULT["device_samples_per_sec"] = round(drate, 1)
-                    RESULT["device_flush_latency_s"] = dextra.get(
-                        "flush_latency_s")
-                except Exception as e:
-                    traceback.print_exc()
-                    RESULT["device_error"] = f"{type(e).__name__}: {e}"
+            run_default(args, on_tpu)
         else:
             metric, rate, extra = run_one(
                 args.scenario, args.duration, args.keys, on_tpu)
