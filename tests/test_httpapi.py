@@ -294,9 +294,10 @@ class TestHeapPprof:
 
         from veneur_tpu.core import profiling
 
-        # first call arms tracemalloc; allocate between calls so the
-        # second snapshot has content attributable to this file
-        profiling.heap_pprof()
+        # keep_tracing (the enable_profiling mode) leaves tracemalloc
+        # armed; allocate between calls so the second snapshot has
+        # content attributable to this file
+        profiling.heap_pprof(keep_tracing=True)
         keepalive = [bytearray(4096) for _ in range(200)]
         body = profiling.heap_pprof()
         assert keepalive  # hold the allocations through the snapshot
@@ -309,6 +310,17 @@ class TestHeapPprof:
         assert samples
         # this test file shows up as an allocation site
         assert any("test_httpapi" in s for s in strings)
+
+    def test_heap_profile_is_request_scoped_by_default(self):
+        import tracemalloc
+
+        from veneur_tpu.core import profiling
+
+        assert not tracemalloc.is_tracing()
+        profiling.heap_pprof()
+        # a single unauthenticated GET must not durably arm 25-frame
+        # tracing (it costs real steady-state CPU on the ingest path)
+        assert not tracemalloc.is_tracing()
 
     def test_http_route_serves_heap(self):
         import gzip

@@ -98,13 +98,20 @@ class _Handler(BaseHTTPRequestHandler):
             from veneur_tpu.core import profiling
             seconds = _query_float(self.path, "seconds", 5.0,
                                    max_value=120.0)
-            body = profiling.pprof_for(seconds)
+            try:
+                body = profiling.pprof_for(seconds)
+            except RuntimeError as e:
+                # one capture at a time (Go pprof parity)
+                self._send(503, str(e).encode())
+                return
             self._send(200, body, "application/octet-stream")
         elif path == "/debug/pprof/heap":
-            # pprof heap profile backed by tracemalloc; the first request
-            # arms tracing, later requests see allocations since
+            # pprof heap profile backed by tracemalloc: request-scoped by
+            # default; enable_profiling keeps tracing armed so later
+            # requests see allocations since
             from veneur_tpu.core import profiling
-            self._send(200, profiling.heap_pprof(),
+            keep = bool(getattr(api.config, "enable_profiling", False))
+            self._send(200, profiling.heap_pprof(keep_tracing=keep),
                        "application/octet-stream")
         elif path == "/debug/pprof/goroutine":
             # thread stacks in pprof form (Go names this route goroutine;

@@ -305,20 +305,39 @@ def threads_pprof() -> bytes:
 
 
 _heap_traced_since = [0.0]
+_heap_lock = threading.Lock()
 
 
-def heap_pprof(limit: int = 10_000) -> bytes:
+def heap_pprof(limit: int = 10_000, keep_tracing: bool = False) -> bytes:
     """Heap profile at /debug/pprof/heap: a tracemalloc snapshot encoded
-    as pprof with objects/count + space/bytes sample types. tracemalloc
-    starts on first call (CPython can't reconstruct allocations made
-    before tracing began, so the first request arms the profiler and
-    later requests see everything allocated since)."""
+    as pprof with objects/count + space/bytes sample types. CPython can't
+    reconstruct allocations made before tracing began, so a request with
+    tracing off arms it for the duration of the request only — 25-frame
+    tracemalloc costs real steady-state CPU on the ingest hot path, and a
+    single unauthenticated GET must not durably slow the server (the Go
+    reference's heap profile is near-free). keep_tracing=True (the
+    enable_profiling config) leaves it armed so later requests see
+    everything allocated since."""
     import tracemalloc
 
-    if not tracemalloc.is_tracing():
-        tracemalloc.start(25)
-        _heap_traced_since[0] = time.time()
-    snap = tracemalloc.take_snapshot()
+    # serialized: without the lock, one request's request-scoped stop()
+    # could land between another's is_tracing() check and take_snapshot()
+    with _heap_lock:
+        armed_here = False
+        if not tracemalloc.is_tracing():
+            tracemalloc.start(25)
+            armed_here = True
+            _heap_traced_since[0] = time.time()
+            # give the arena a moment to accumulate request-scoped
+            # truth: with tracing armed only for this request, an
+            # instant snapshot would be near-empty
+            time.sleep(0.5)
+        try:
+            snap = tracemalloc.take_snapshot()
+        finally:
+            if armed_here and not keep_tracing:
+                tracemalloc.stop()
+                _heap_traced_since[0] = 0.0
     stats = sorted(snap.statistics("traceback"),
                    key=lambda s: s.size, reverse=True)[:limit]
     stacks = {}
@@ -337,14 +356,27 @@ def heap_pprof(limit: int = 10_000) -> bytes:
                         _heap_traced_since[0] or time.time())
 
 
+_cpu_profile_lock = threading.Lock()
+
+
 def pprof_for(seconds: float, hz: float = 100.0) -> bytes:
     """One-shot pprof-format CPU profile (the /debug/pprof/profile
-    contract: block for `seconds`, then return the gzipped proto)."""
-    sampler = StackSampler(hz=hz, collect_stacks=True)
-    sampler.start()
-    time.sleep(max(0.01, seconds))
-    sampler.stop()
-    return sampler_to_pprof(sampler)
+    contract: block for `seconds`, then return the gzipped proto).
+
+    One capture at a time, matching Go pprof: concurrent requests would
+    each spawn a 100 Hz sys._current_frames() sampler and compound
+    whole-process GIL overhead. Raises RuntimeError when busy (the HTTP
+    layer maps it to a 503)."""
+    if not _cpu_profile_lock.acquire(blocking=False):
+        raise RuntimeError("a CPU profile capture is already in progress")
+    try:
+        sampler = StackSampler(hz=hz, collect_stacks=True)
+        sampler.start()
+        time.sleep(max(0.01, seconds))
+        sampler.stop()
+        return sampler_to_pprof(sampler)
+    finally:
+        _cpu_profile_lock.release()
 
 
 def capture_device_trace(seconds: float) -> bytes:

@@ -216,10 +216,15 @@ class CortexMetricSink(MetricSink):
                 continue
             series.append(self._series(m))
         if self.convert_counters_to_monotonic:
-            now = int(_time.time())
+            # stamp the re-emitted monotonic series with the flush's own
+            # metric timestamp so they align with the gauges in the same
+            # remote-write batch; wall clock only when the flush carried
+            # no timestamped metrics at all
+            stamp = max((m.timestamp for m in metrics), default=0) \
+                or int(_time.time())
             for (mname, tags, mhost), total in self._monotonic.items():
                 series.append(self._series(InterMetric(
-                    name=mname, timestamp=now, value=total,
+                    name=mname, timestamp=stamp, value=total,
                     tags=list(tags), type=MetricType.COUNTER,
                     hostname=mhost)))
         if not series:

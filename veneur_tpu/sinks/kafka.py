@@ -119,26 +119,18 @@ class KafkaPythonProducer(Producer):
         self._cfg = config or ProducerConfig()
         self._p = KafkaProducer(bootstrap_servers=brokers.split(","),
                                 **self._cfg.kafka_python_kwargs())
-        self._since_flush = 0
 
     def send(self, topic: str, key: bytes, value: bytes) -> None:
+        # sarama's Flush.Messages (buffer_messages) is an async batching
+        # trigger, not a blocking flush — kafka-python's own batch_size/
+        # linger_ms batching already plays that role, and even a
+        # 100ms-bounded flush() here would insert caller-thread stalls
+        # into the span/metric flush path whenever the broker is slow.
+        # Delivery is guaranteed by the interval flush() below.
         self._p.send(topic, key=key or None, value=value)
-        # kafka-python has no message-count flush trigger; approximate
-        # sarama's (async) Flush.Messages with a short bounded flush so
-        # a slow broker can't stall the ingest path for the full
-        # delivery timeout
-        if self._cfg.buffer_messages:
-            self._since_flush += 1
-            if self._since_flush >= self._cfg.buffer_messages:
-                try:
-                    self._p.flush(timeout=0.1)
-                except Exception:
-                    pass  # still queued; the interval flush delivers it
-                self._since_flush = 0
 
     def flush(self) -> None:
         self._p.flush(timeout=10)
-        self._since_flush = 0
 
     def close(self) -> None:
         self._p.close()

@@ -70,6 +70,11 @@ class SplunkSpanSink(SpanSink):
         self._events: List[dict] = []
         self._lock = threading.Lock()
         self.dropped = 0
+        # submissions that completed after their own flush's accounting
+        # deadline: credited into the NEXT flush's sent/drop totals so
+        # late deliveries are not over-reported as drops
+        self._late_sent = 0
+        self._late_failed = 0
         self._work_q: queue.Queue = queue.Queue()
         if self.submission_workers > 1:
             for i in range(self.submission_workers):
@@ -117,13 +122,21 @@ class SplunkSpanSink(SpanSink):
             dropped = 0
             if reportable and self.dropped:
                 dropped, self.dropped = self.dropped, 0
+        # late completions from a prior flush's in-flight batches are
+        # drained on every flush — including empty ones, else a quiet
+        # tail would leave them unreported forever
+        with self._lock:
+            late_sent, self._late_sent = self._late_sent, 0
+            late_failed, self._late_failed = self._late_failed, 0
         if not events:
-            self.emit_flush_self_metrics(0, flush_start, dropped)
+            self.emit_flush_self_metrics(
+                late_sent, flush_start, dropped + late_failed)
             return
         per = self.batch_size or len(events)
         batches = [events[i:i + per] for i in range(0, len(events), per)]
         sent = [0]
         failed = [0]
+        accounted = [False]  # set once this flush's totals are emitted
         sent_lock = threading.Lock()
 
         def submit(batch: List[dict]) -> None:
@@ -135,11 +148,19 @@ class SplunkSpanSink(SpanSink):
                     headers={"Authorization": f"Splunk {self.token}"},
                     timeout=self.timeout)
                 with sent_lock:
-                    sent[0] += len(batch)
+                    if accounted[0]:
+                        with self._lock:
+                            self._late_sent += len(batch)
+                    else:
+                        sent[0] += len(batch)
             except Exception as e:
                 logger.error("splunk HEC POST failed: %s", e)
                 with sent_lock:
-                    failed[0] += len(batch)
+                    if accounted[0]:
+                        with self._lock:
+                            self._late_failed += len(batch)
+                    else:
+                        failed[0] += len(batch)
 
         if self.submission_workers > 1 and len(batches) > 1:
             done = threading.Event()
@@ -168,12 +189,15 @@ class SplunkSpanSink(SpanSink):
         else:
             for batch in batches:
                 submit(batch)
-        # failed batches' events are gone, and batches unaccounted at
-        # the deadline are conservatively counted as drops
+        # failed batches' events are gone and count as drops; batches
+        # still in flight at the deadline are NOT drops — their submits
+        # credit _late_sent/_late_failed and land in a later flush's
+        # totals (the workers may well deliver them after this point)
         with sent_lock:
-            unaccounted = len(events) - sent[0] - failed[0]
+            accounted[0] = True
             self.emit_flush_self_metrics(
-                sent[0], flush_start, dropped + failed[0] + unaccounted)
+                sent[0] + late_sent, flush_start,
+                dropped + failed[0] + late_failed)
 
 
 @register_span_sink("splunk")
