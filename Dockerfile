@@ -1,0 +1,26 @@
+# veneur-tpu container image (reference Dockerfile parity): the server
+# plus all four console scripts. g++ stays in the image because the
+# native ingest hot path (veneur_tpu/native/dogstatsd.cc) compiles on
+# first use and falls back to pure Python without it.
+FROM python:3.12-slim
+
+RUN apt-get update \
+    && apt-get install -y --no-install-recommends g++ \
+    && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /opt/veneur-tpu
+COPY pyproject.toml README.md ./
+COPY veneur_tpu ./veneur_tpu
+RUN pip install --no-cache-dir .[sinks]
+
+# pre-compile the native parser so first packet doesn't pay the build
+RUN python -c "from veneur_tpu import native; assert native.available(), \
+    native.unavailable_reason()"
+
+COPY examples ./examples
+
+# DogStatsD UDP, HTTP API, SSF UDP (match examples/example.yaml)
+EXPOSE 8126/udp 8127/tcp 8128/udp
+
+ENTRYPOINT ["veneur-tpu"]
+CMD ["-f", "examples/example.yaml"]

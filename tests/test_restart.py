@@ -1,0 +1,111 @@
+"""Graceful restart: SIGUSR2 spawns a replacement that overlap-binds via
+SO_REUSEPORT; the old process drains and exits only after the
+replacement answers /healthcheck/ready (reference einhorn handoff,
+server.go:1404, README.md:170-178)."""
+
+from __future__ import annotations
+
+import os
+import re
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def ready_pid(port: int):
+    """Returns the answering pid, or None when not ready."""
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthcheck/ready",
+                timeout=2) as r:
+            if r.status == 200:
+                return int(r.headers.get("X-Veneur-Pid", "0"))
+    except Exception:
+        return None
+    return None
+
+
+@pytest.mark.skipif(not hasattr(socket, "SO_REUSEPORT"),
+                    reason="needs SO_REUSEPORT")
+def test_sigusr2_hands_off_without_dropping_the_listener(tmp_path):
+    udp_port, http_port = free_port(), free_port()
+    cfg = tmp_path / "veneur.yaml"
+    cfg.write_text(
+        "statsd_listen_addresses:\n"
+        f"  - udp://127.0.0.1:{udp_port}\n"
+        f"http_address: \"127.0.0.1:{http_port}\"\n"
+        "interval: 1.0\n"
+        "flush_on_shutdown: true\n"
+        "stats_address: \"\"\n"
+        "metric_sinks:\n"
+        "  - kind: blackhole\n"
+        "    name: blackhole\n")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["XLA_FLAGS"] = re.sub(
+        r"--xla_force_host_platform_device_count=\d+", "",
+        env.get("XLA_FLAGS", "")).strip()
+    if not env["XLA_FLAGS"]:
+        del env["XLA_FLAGS"]
+    old = subprocess.Popen(
+        [sys.executable, "-m", "veneur_tpu.cmd.veneur", "-f", str(cfg)],
+        cwd=REPO, env=env,
+        stdout=subprocess.DEVNULL, stderr=subprocess.PIPE, text=True)
+    new_pid = None
+    try:
+        deadline = time.time() + 90
+        while time.time() < deadline and ready_pid(http_port) != old.pid:
+            assert old.poll() is None, old.stderr.read()[-3000:]
+            time.sleep(0.5)
+        assert ready_pid(http_port) == old.pid, "server never became ready"
+
+        old.send_signal(signal.SIGUSR2)
+        # the replacement must answer ready from a different pid
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            pid = ready_pid(http_port)
+            if pid and pid != old.pid:
+                new_pid = pid
+                break
+            time.sleep(0.5)
+        assert new_pid, "replacement never became ready"
+        # old process drains and exits on its own
+        assert old.wait(timeout=60) == 0
+        # the port is still served throughout — no listening gap
+        deadline = time.time() + 10
+        pid = None
+        while time.time() < deadline:
+            pid = ready_pid(http_port)
+            if pid:
+                break
+            time.sleep(0.2)
+        assert pid == new_pid
+        # and the UDP listener answers to the new process too: send a
+        # packet, then confirm the replacement is still healthy
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.sendto(b"restart.probe:1|c", ("127.0.0.1", udp_port))
+        assert ready_pid(http_port) == new_pid
+    finally:
+        for pid in {new_pid, old.pid if old.poll() is None else None}:
+            if pid:
+                try:
+                    os.kill(pid, signal.SIGTERM)
+                except OSError:
+                    pass
+        try:
+            old.wait(timeout=10)
+        except Exception:
+            old.kill()

@@ -11,6 +11,8 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os
+import socket
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional
@@ -49,6 +51,10 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(status)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
+        # which process answered: under a SO_REUSEPORT graceful restart
+        # two instances share the port, and the old one's readiness poll
+        # must not accept its own listener's answer
+        self.send_header("X-Veneur-Pid", str(os.getpid()))
         self.end_headers()
         self.wfile.write(body)
 
@@ -212,7 +218,22 @@ class HTTPApi:
         self.require_flush_for_ready = require_flush_for_ready
         host, _, port = address.rpartition(":")
         handler = type("BoundHandler", (_Handler,), {"server_ref": self})
-        self._httpd = ThreadingHTTPServer((host or "127.0.0.1", int(port)),
+
+        class _ReusableHTTPServer(ThreadingHTTPServer):
+            # graceful restart: the replacement process binds the same
+            # fixed port while this one still serves. Set the socket
+            # option by hand — socketserver's allow_reuse_port attribute
+            # only exists on Python 3.11+, and this package supports 3.10
+            def server_bind(self):
+                if hasattr(socket, "SO_REUSEPORT"):
+                    try:
+                        self.socket.setsockopt(
+                            socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+                    except OSError:
+                        pass
+                super().server_bind()
+
+        self._httpd = _ReusableHTTPServer((host or "127.0.0.1", int(port)),
                                           handler)
         self._thread: Optional[threading.Thread] = None
 

@@ -1,0 +1,122 @@
+"""Zero-gap graceful restart: the einhorn SIGUSR2 handoff rebuilt
+without a socket master (reference server.go:1404, README.md:170-178).
+
+The reference hands live fds to a replacement through einhorn. Here the
+same zero-downtime property comes from SO_REUSEPORT: every UDP/TCP
+listener (and the HTTP API) binds with it, so on SIGUSR2 this process
+spawns a replacement from its own argv, the replacement binds the same
+addresses while the old one still serves, and once the replacement
+reports ready (/healthcheck/ready) the old process shuts down — the
+listeners close, the native ingest pump drains, and (with
+flush_on_shutdown) the partial interval flushes. At no point is there no
+listener on the port.
+
+Caveats, by design: UNIX-path listeners rebind with a brief gap
+(filesystem binds are exclusive); the handoff interval's counters are
+split across two flushes (they sum correctly downstream — same property
+as the reference's handoff).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+logger = logging.getLogger("veneur_tpu.restart")
+
+READY_TIMEOUT_S = 60.0
+NO_HTTP_GRACE_S = 3.0
+
+
+_in_progress = threading.Lock()
+
+
+def install(server, argv=None) -> None:
+    """Handle SIGUSR2 with a spawn-replacement-then-drain handoff.
+    Must be called from the main thread (signal module contract)."""
+
+    def handler(signum, frame):
+        if not _in_progress.acquire(blocking=False):
+            logger.warning("SIGUSR2 ignored: a handoff is in progress")
+            return
+
+        def run():
+            try:
+                _restart(server, argv)
+            finally:
+                _in_progress.release()
+
+        t = threading.Thread(target=run, name="graceful-restart",
+                             daemon=True)
+        t.start()
+
+    signal.signal(signal.SIGUSR2, handler)
+
+
+def respawn_argv(argv=None):
+    argv = list(sys.argv if argv is None else argv)
+    if argv and os.access(argv[0], os.X_OK) and not argv[0].endswith(".py"):
+        return argv  # console-script shim: exec it directly
+    # `python -m veneur_tpu.cmd.veneur`: argv[0] is the module FILE, and
+    # re-running it as a script would lose the package on sys.path —
+    # respawn through -m with the original module name instead
+    import __main__
+    spec = getattr(__main__, "__spec__", None)
+    if spec is not None and spec.name:
+        return [sys.executable, "-m", spec.name] + argv[1:]
+    return [sys.executable] + argv
+
+
+def _restart(server, argv) -> None:
+    cmd = respawn_argv(argv)
+    logger.info("SIGUSR2: spawning replacement process: %s", cmd)
+    try:
+        child = subprocess.Popen(cmd)
+    except Exception:
+        logger.exception("replacement spawn failed; keeping this process")
+        return
+    if not _wait_ready(server, child):
+        if child.poll() is None:
+            logger.error("replacement not ready after %.0fs; keeping "
+                         "this process (replacement left running)",
+                         READY_TIMEOUT_S)
+        else:
+            logger.error("replacement exited rc=%s before becoming "
+                         "ready; keeping this process", child.returncode)
+        return
+    logger.info("replacement ready (pid %d); draining and exiting",
+                child.pid)
+    server.shutdown()
+
+
+def _wait_ready(server, child, timeout: float = READY_TIMEOUT_S) -> bool:
+    addr = server.config.http_address
+    if not addr:
+        # no readiness endpoint: a short grace period, then hand off if
+        # the replacement is still alive
+        time.sleep(NO_HTTP_GRACE_S)
+        return child.poll() is None
+    host, _, port = addr.rpartition(":")
+    url = f"http://{host or '127.0.0.1'}:{port}/healthcheck/ready"
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if child.poll() is not None:
+            return False
+        try:
+            with urllib.request.urlopen(url, timeout=2) as resp:
+                # the kernel load-balances REUSEPORT connections, so
+                # this poll can reach our OWN listener: only a ready
+                # answer from another pid counts
+                pid = resp.headers.get("X-Veneur-Pid", "")
+                if resp.status == 200 and pid not in ("", str(os.getpid())):
+                    return True
+        except Exception:
+            pass
+        time.sleep(0.5)
+    return False
