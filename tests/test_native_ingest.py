@@ -280,6 +280,116 @@ class TestPump:
             server.shutdown()
 
 
+class TestSsfNative:
+    """The native SSF decode path (C++ span decode + metric extraction)
+    must be observably identical to the per-packet Python path."""
+
+    def _spans(self):
+        from veneur_tpu import ssf
+        packets = []
+        for i in range(40):
+            span = ssf.SSFSpan(
+                id=i + 1, trace_id=(i % 7) + 1, name=f"op{i % 5}",
+                service="parity-svc", start_timestamp=100 + i,
+                end_timestamp=200 + i, indicator=(i % 3 == 0))
+            span.metrics.append(ssf.count(
+                f"ssfp.c{i % 4}", 2, {"env": "test", "shard": str(i % 2)}))
+            span.metrics.append(ssf.gauge(f"ssfp.g{i % 4}", i * 1.5))
+            t = ssf.timing(f"ssfp.t{i % 4}", 0.001 * i, 1e-3)
+            t.sample_rate = 0.5
+            span.metrics.append(t)
+            span.metrics.append(ssf.set_sample(
+                "ssfp.users", f"user{i}", {"veneurglobalonly": "true"}))
+            if i % 10 == 0:
+                span.metrics.append(ssf.status(
+                    "ssfp.check", ssf.WARNING, "degraded"))
+            if i % 11 == 0:
+                span.metrics.append(ssf.set_sample("ssfp.non", "café"))
+            packets.append(span.SerializeToString())
+        packets.append(b"\x07garbage\xff\xff")  # undecodable
+        return packets
+
+    def _run(self, packets, disable_native: bool, repeats: int = 2):
+        import time
+        server, ch = make_server(disable_native)
+        # uniqueness must be deterministic across paths for the oracle
+        server.metric_extraction._uniqueness_rate = 1.0
+        server.start()  # the Python path extracts in the span workers
+        try:
+            for _ in range(repeats):
+                if disable_native or server._ingester is None:
+                    for p in packets:
+                        server.handle_ssf_packet(p)
+                else:
+                    server.handle_ssf_batch(packets)
+            deadline = time.time() + 10
+            while not server.span_chan.empty() and time.time() < deadline:
+                time.sleep(0.02)
+            time.sleep(0.2)  # let the last worker iteration finish
+            rows = flush_rows(server, ch)
+            return rows, dict(server.stats), server
+        finally:
+            server.shutdown()
+
+    def test_ssf_batch_parity_with_python_path(self):
+        packets = self._spans()
+        nat_rows, nat_stats, nat_srv = self._run(packets, False)
+        py_rows, py_stats, _ = self._run(packets, True)
+        assert nat_rows == py_rows
+        assert nat_stats == py_stats
+
+    def test_second_pass_runs_native(self):
+        packets = self._spans()
+        server, ch = make_server(False)
+        server.metric_extraction._uniqueness_rate = 0.0
+        server.handle_ssf_batch(packets)  # interns via slow path
+        before = server._ingester.interned_keys
+        assert before > 0
+        # packet 1 has no STATUS / non-ASCII samples (those defer by
+        # design forever); all its samples must now extract natively
+        res = server._ingester._parser().parse_ssf(
+            packets[1], [0], [len(packets[1])], uniq_rate=0.0)
+        assert not res.deferred
+        assert res.samples > 0
+
+    def test_indicator_timers_via_batch(self):
+        from veneur_tpu import ssf
+        cfg = Config()
+        cfg.interval = 10.0
+        cfg.indicator_span_timer_name = "sli.timer"
+        cfg.objective_span_timer_name = "slo.timer"
+        cfg.apply_defaults()
+        results = []
+        for use_batch in (True, False):
+            ch = ChannelMetricSink()
+            server = Server(cfg, extra_metric_sinks=[ch])
+            server.metric_extraction._uniqueness_rate = 0.0
+            span = ssf.SSFSpan(
+                id=5, trace_id=5, name="ind-op", service="svc",
+                start_timestamp=10**9, end_timestamp=2 * 10**9,
+                indicator=True)
+            packet = span.SerializeToString()
+            server.start()
+            try:
+                if use_batch and server._ingester is not None:
+                    server.handle_ssf_batch([packet])
+                else:
+                    server.handle_ssf_packet(packet)
+                import time
+                deadline = time.time() + 10
+                while (not server.span_chan.empty()
+                       and time.time() < deadline):
+                    time.sleep(0.02)
+                time.sleep(0.2)
+                results.append(flush_rows(server, ch))
+            finally:
+                server.shutdown()
+        assert results[0] == results[1]
+        names = {r[0] for r in results[0]}
+        assert any(n.startswith("sli.timer") for n in names)
+        assert any(n.startswith("slo.timer") for n in names)
+
+
 class TestGarbageFuzz:
     def test_byte_soup_never_crashes_and_parsers_agree(self):
         """Random byte soup (printable garbage, truncated metrics,

@@ -188,6 +188,39 @@ class TestUDPIngest:
         finally:
             server.shutdown()
 
+    def _unix_roundtrip(self, path: str):
+        cfg = generate_config(
+            statsd_listen_addresses=[f"unixgram://{path}"])
+        server, observer = setup_server(cfg)
+        server.start()
+        try:
+            bind = server.local_addr("unixgram")
+            with socket.socket(socket.AF_UNIX, socket.SOCK_DGRAM) as s:
+                s.sendto(b"unix.test:5|c", bind)
+            deadline = time.time() + 5
+            seen = {}
+            while time.time() < deadline and "unix.test" not in seen:
+                try:
+                    for metric in observer.wait_flush(timeout=1.0):
+                        seen[metric.name] = metric
+                except Exception:
+                    pass
+            assert seen["unix.test"].value == 5.0
+        finally:
+            server.shutdown()
+
+    def test_unixgram_end_to_end(self, tmp_path):
+        self._unix_roundtrip(str(tmp_path / "statsd.sock"))
+
+    @pytest.mark.skipif(not hasattr(socket, "AF_UNIX")
+                        or not __import__("sys").platform.startswith("linux"),
+                        reason="abstract sockets are Linux-only")
+    def test_abstract_unixgram_end_to_end(self):
+        # @name is a Linux abstract socket: no filesystem entry
+        # (reference protocol/addr.go handles the @ convention)
+        import os
+        self._unix_roundtrip(f"@veneur-tpu-test-{os.getpid()}")
+
     def test_tcp_end_to_end(self):
         cfg = generate_config(
             statsd_listen_addresses=["tcp://127.0.0.1:0"])

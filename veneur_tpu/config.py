@@ -91,6 +91,15 @@ class TpuConfig:
     # force the pure-Python per-packet parser (the C++ batch parser is
     # used whenever it compiles; this is the escape hatch)
     disable_native_parser: bool = False
+    # idle-row reclamation: a key idle for this many flushes is evicted
+    # (dict entry + native intern mapping removed, row id recycled one
+    # flush later), bounding host memory under key churn the way the
+    # reference's per-interval map swap does (worker.go:470-489).
+    # 0 disables eviction.
+    idle_key_intervals: int = 5
+    # hard per-family cardinality cap: new keys beyond it are dropped
+    # (and counted) until eviction frees rows. 0 = unlimited.
+    max_rows_per_family: int = 2_000_000
 
 
 @dataclass

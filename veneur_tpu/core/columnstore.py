@@ -87,14 +87,31 @@ class _BaseTable:
     setting it later lets a snapshot emit a touched-but-valueless row.
     """
 
-    def __init__(self, capacity: int = 1024, batch_cap: int = 8192):
+    def __init__(self, capacity: int = 1024, batch_cap: int = 8192,
+                 max_rows: int = 0):
         self.capacity = capacity
         self.batch_cap = batch_cap
+        self.max_rows = max_rows  # hard cardinality cap (0 = unlimited)
         self.rows: Dict[int, int] = {}  # digest64 -> row
         self.meta: List[RowMeta] = []
         self.touched = np.zeros(capacity, bool)
         self.lock = threading.Lock()
         self.apply_lock = threading.Lock()
+        # idle-row reclamation state (the TPU build's answer to the
+        # reference's per-interval map swap, worker.go:470-489: row
+        # IDENTITY persists here for fast-path reuse, so under key churn
+        # it must be reclaimed or host memory grows without bound).
+        # Rows are tombstoned (dict entry + native intern mapping
+        # removed) once idle for N flushes, then recycled one further
+        # flush later so in-flight native chunks can no longer reference
+        # them.
+        self._generation = 0
+        self._last_touched = np.zeros(capacity, np.int64)
+        self._tombstone_gen = np.full(capacity, -1, np.int64)
+        self._has_meta = np.zeros(capacity, bool)
+        self._dict_key_of: List[int] = []  # row -> rows-dict key
+        self._free_rows: List[int] = []
+        self.keys_dropped = 0
         self._init_arrays()
 
     # subclasses define _init_arrays / _grow_arrays / _apply_cols / reset
@@ -142,20 +159,96 @@ class _BaseTable:
         dict_key = (metric.digest64 << 2) | int(metric.scope)
         row = self.rows.get(dict_key)
         if row is None:
-            row = len(self.meta)
-            if row >= self.capacity:
-                self._grow()
-            self.rows[dict_key] = row
-            self.meta.append(RowMeta(
+            meta = RowMeta(
                 name=metric.key.name, tags=list(metric.tags),
                 joined_tags=metric.key.joined_tags, digest32=metric.digest,
-                scope=metric.scope, wire_type=metric.key.type))
+                scope=metric.scope, wire_type=metric.key.type)
+            if self._free_rows:
+                row = self._free_rows.pop()
+                self.meta[row] = meta
+                self._dict_key_of[row] = dict_key
+                self._last_touched[row] = self._generation
+                self._has_meta[row] = True
+            elif self.max_rows and len(self.rows) >= self.max_rows:
+                # hard cardinality cap: protects host memory during a
+                # within-interval key flood; the sample is dropped and
+                # counted (keys_dropped self-metric)
+                self.keys_dropped += 1
+                return -1
+            else:
+                row = len(self.meta)
+                if row >= self.capacity:
+                    self._grow()
+                self.meta.append(meta)
+                self._dict_key_of.append(dict_key)
+                self._has_meta[row] = True
+                # stamp creation as activity: without this a row interned
+                # (but not yet touched) late in life would read as idle
+                # since generation 0 and tombstone on its first flush
+                self._last_touched[row] = self._generation
+            self.rows[dict_key] = row
         return row
+
+    def _note_generation_locked(self) -> None:
+        """Advance the flush generation and stamp rows touched this
+        interval (caller holds ``lock``, before clearing ``touched``)."""
+        self._generation += 1
+        self._last_touched[self.touched] = self._generation
+
+    def reclaim_idle(self, idle_intervals: int):
+        """Two-phase idle-row reclamation, run after each flush.
+
+        Phase 1 (tombstone): rows idle for >= idle_intervals flushes
+        lose their rows-dict entry now; the caller must also erase their
+        native intern mappings (the returned rows) so no NEW native
+        samples can reference them.
+
+        Phase 2 (recycle): rows tombstoned at least one flush ago and
+        untouched since go to the free list. A tombstoned row that was
+        touched in the gap (an in-flight chunk straggler, emitted
+        normally) has its tombstone re-stamped and waits another flush.
+
+        Returns the list of rows tombstoned in this call."""
+        if idle_intervals <= 0:
+            return []
+        with self.lock:
+            gen = self._generation
+            n = len(self.meta)
+            if n == 0:
+                return []
+            last = self._last_touched[:n]
+            tomb = self._tombstone_gen[:n]
+            # phase 2
+            rearm = (tomb >= 0) & (last > tomb)
+            if rearm.any():
+                tomb[rearm] = gen
+            recycle = (tomb >= 0) & (gen > tomb) & (last <= tomb)
+            for row in np.nonzero(recycle)[0]:
+                row = int(row)
+                tomb[row] = -1
+                self.meta[row] = None
+                self._has_meta[row] = False
+                self._free_rows.append(row)
+            # phase 1
+            cand = ((tomb < 0) & (gen - last >= idle_intervals)
+                    & self._has_meta[:n])
+            evicted = [int(r) for r in np.nonzero(cand)[0]]
+            for row in evicted:
+                self.rows.pop(self._dict_key_of[row], None)
+                tomb[row] = gen
+            return evicted
 
     def _grow(self):
         new_cap = self.capacity * 2
+        pad = new_cap - self.capacity
         self.touched = np.concatenate(
-            [self.touched, np.zeros(new_cap - self.capacity, bool)])
+            [self.touched, np.zeros(pad, bool)])
+        self._last_touched = np.concatenate(
+            [self._last_touched, np.zeros(pad, np.int64)])
+        self._tombstone_gen = np.concatenate(
+            [self._tombstone_gen, np.full(pad, -1, np.int64)])
+        self._has_meta = np.concatenate(
+            [self._has_meta, np.zeros(pad, bool)])
         # _grow_arrays re-lays-out the device state, so it needs the state
         # lock; caller already holds the buffer lock (correct lock order)
         with self.apply_lock:
@@ -218,6 +311,8 @@ class CounterTable(_BaseTable):
     def add(self, metric: UDPMetric):
         with self.lock:
             row = self.row_for(metric)
+            if row < 0:
+                return
             self.touched[row] = True
             n = self._n
             self._prow[n] = row
@@ -249,20 +344,25 @@ class CounterTable(_BaseTable):
         int64 sums that f32 would quantize."""
         with self.lock:
             rows = []
-            for stub in stubs:
+            vals = []
+            for stub, value in zip(stubs, values):
                 row = self.row_for(stub)
+                if row < 0:  # cardinality cap
+                    continue
                 self.touched[row] = True
                 rows.append(row)
+                vals.append(value)
             if self._import_acc.shape[0] < self.capacity:
                 grown = np.zeros(self.capacity, np.float64)
                 grown[: self._import_acc.shape[0]] = self._import_acc
                 self._import_acc = grown
-            np.add.at(self._import_acc, rows, np.asarray(values, np.float64))
+            np.add.at(self._import_acc, rows, np.asarray(vals, np.float64))
 
     def snapshot_and_reset(self) -> Tuple[np.ndarray, np.ndarray, List[RowMeta]]:
         with self.lock:
             cols = self._swap_locked()
             self.apply_lock.acquire()
+            self._note_generation_locked()
             touched = self.touched.copy()
             meta = list(self.meta)
             import_acc = self._import_acc
@@ -297,6 +397,8 @@ class GaugeTable(_BaseTable):
     def add(self, metric: UDPMetric):
         with self.lock:
             row = self.row_for(metric)
+            if row < 0:
+                return
             self.touched[row] = True
             n = self._n
             self._prow[n] = row
@@ -325,11 +427,13 @@ class GaugeTable(_BaseTable):
         with self.lock:
             rows = np.fromiter(
                 (self.row_for(s) for s in stubs), np.int32, len(stubs))
+            ok = rows >= 0  # cardinality-capped stubs drop out
+            rows = rows[ok]
             self.touched[rows] = True
             self.apply_lock.acquire()
         try:
             self.state = scalars.merge_gauges(
-                self.state, rows, np.asarray(values, np.float32))
+                self.state, rows, np.asarray(values, np.float32)[ok])
         finally:
             self.apply_lock.release()
 
@@ -337,6 +441,7 @@ class GaugeTable(_BaseTable):
         with self.lock:
             cols = self._swap_locked()
             self.apply_lock.acquire()
+            self._note_generation_locked()
             touched = self.touched.copy()
             meta = list(self.meta)
             self.touched[:] = False
@@ -392,6 +497,8 @@ class HistoTable(_BaseTable):
     def add(self, metric: UDPMetric):
         with self.lock:
             row = self.row_for(metric)
+            if row < 0:
+                return
             self.touched[row] = True
             n = self._n
             self._prow[n] = row
@@ -430,16 +537,18 @@ class HistoTable(_BaseTable):
         with self.lock:
             rows = np.fromiter(
                 (self.row_for(s) for s in stubs), np.int32, len(stubs))
+            ok = rows >= 0  # cardinality-capped stubs drop out
+            rows = rows[ok]
             self.touched[rows] = True
             self.apply_lock.acquire()
         try:
             self.state = batch_tdigest.merge_centroid_rows(
                 self.state, rows,
-                np.asarray(in_means, np.float32),
-                np.asarray(in_weights, np.float32),
-                np.asarray(in_min, np.float32),
-                np.asarray(in_max, np.float32),
-                np.asarray(in_recip, np.float32))
+                np.asarray(in_means, np.float32)[ok],
+                np.asarray(in_weights, np.float32)[ok],
+                np.asarray(in_min, np.float32)[ok],
+                np.asarray(in_max, np.float32)[ok],
+                np.asarray(in_recip, np.float32)[ok])
             # the merge folds staging for every row with staged weight
             # (merge_centroid_rows touches staged rows too), so the whole
             # occupancy map resets
@@ -461,6 +570,7 @@ class HistoTable(_BaseTable):
         with self.lock:
             cols = self._swap_locked()
             self.apply_lock.acquire()
+            self._note_generation_locked()
             touched = self.touched.copy()
             meta = list(self.meta)
             self.touched[:] = False
@@ -535,9 +645,9 @@ class SetTable(_BaseTable):
     PROMOTE_SAMPLES = 2048
 
     def __init__(self, capacity: int = 256, batch_cap: int = 8192,
-                 sparse: bool = True):
+                 sparse: bool = True, max_rows: int = 0):
         self._sparse = sparse
-        super().__init__(capacity, batch_cap)
+        super().__init__(capacity, batch_cap, max_rows=max_rows)
 
     def _init_pending(self):
         self._prow = np.full(self.batch_cap, PAD_ROW, np.int32)
@@ -589,6 +699,8 @@ class SetTable(_BaseTable):
         idx, rho = hll_ref.pos_val(h)
         with self.lock:
             row = self.row_for(metric)
+            if row < 0:
+                return
             self.touched[row] = True
             if self._sparse:
                 self._counts[row] += 1
@@ -673,6 +785,8 @@ class SetTable(_BaseTable):
         with self.lock:
             rows = np.fromiter(
                 (self.row_for(s) for s in stubs), np.int32, len(stubs))
+            ok = rows >= 0  # cardinality-capped stubs drop out
+            rows = rows[ok]
             self.touched[rows] = True
             if self._sparse:
                 for r in rows:
@@ -684,7 +798,7 @@ class SetTable(_BaseTable):
             self.apply_lock.acquire()
         try:
             self.state = batch_hll.merge_rows(
-                self.state, target, np.asarray(in_regs, np.int8))
+                self.state, target, np.asarray(in_regs, np.int8)[ok])
         finally:
             self.apply_lock.release()
 
@@ -717,6 +831,7 @@ class SetTable(_BaseTable):
         with self.lock:
             cols = self._swap_locked()
             self.apply_lock.acquire()
+            self._note_generation_locked()
             touched = self.touched.copy()
             meta = list(self.meta)
             self.touched[:] = False
@@ -809,6 +924,8 @@ class StatusTable(_BaseTable):
     def add(self, metric: UDPMetric):
         with self.lock:
             row = self.row_for(metric)
+            if row < 0:
+                return
             while len(self.values) <= row:
                 self.values.append(StatusEntry())
             self.touched[row] = True
@@ -822,6 +939,7 @@ class StatusTable(_BaseTable):
     def snapshot_and_reset(self):
         with self.lock:
             vals = list(self.values)
+            self._note_generation_locked()
             touched = self.touched.copy()
             meta = list(self.meta)
             self.values = [StatusEntry() for _ in vals]
@@ -838,9 +956,11 @@ class ColumnStore:
 
     def __init__(self, counter_capacity=1024, gauge_capacity=1024,
                  histo_capacity=1024, set_capacity=256, batch_cap=8192,
-                 shard_devices=0):
-        self.counters = CounterTable(counter_capacity, batch_cap)
-        self.gauges = GaugeTable(gauge_capacity, batch_cap)
+                 shard_devices=0, max_rows=0):
+        self.counters = CounterTable(counter_capacity, batch_cap,
+                                     max_rows=max_rows)
+        self.gauges = GaugeTable(gauge_capacity, batch_cap,
+                                 max_rows=max_rows)
         devices = None
         if shard_devices and shard_devices > 1:
             from veneur_tpu.core import sharded_tables
@@ -851,12 +971,15 @@ class ColumnStore:
             from veneur_tpu.core.sharded_tables import (
                 ShardedHistoTable, ShardedSetTable)
             self.histos = ShardedHistoTable(
-                histo_capacity, batch_cap, devices)
-            self.sets = ShardedSetTable(set_capacity, batch_cap, devices)
+                histo_capacity, batch_cap, devices, max_rows=max_rows)
+            self.sets = ShardedSetTable(set_capacity, batch_cap, devices,
+                                        max_rows=max_rows)
         else:
-            self.histos = HistoTable(histo_capacity, batch_cap)
-            self.sets = SetTable(set_capacity, batch_cap)
-        self.statuses = StatusTable()
+            self.histos = HistoTable(histo_capacity, batch_cap,
+                                     max_rows=max_rows)
+            self.sets = SetTable(set_capacity, batch_cap,
+                                 max_rows=max_rows)
+        self.statuses = StatusTable(max_rows=max_rows)
         self.processed = 0
         self._processed_lock = threading.Lock()
 

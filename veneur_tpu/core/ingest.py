@@ -18,6 +18,7 @@ native table, so each unique timeseries pays the Python path exactly once.
 from __future__ import annotations
 
 import logging
+import random
 import threading
 from typing import Optional
 
@@ -35,6 +36,30 @@ _FAMILY_BY_TYPE = {
     m.TIMER: native.FAM_HISTO,
     m.SET: native.FAM_SET,
 }
+
+# SSF metric enum -> DogStatsD family char (dogstatsd.cc kFamilyChar)
+_SSF_TC = {0: b"c", 1: b"g", 2: b"h", 3: b"s"}
+
+
+def ssf_meta_key(sample) -> Optional[bytes]:
+    """Canonical intern key for an SSF sample, byte-identical to
+    dogstatsd.cc ssf_key: DogStatsD line-key form with sorted tag keys,
+    a "|@rate" chunk when the rate is not 1, and a "|$N" suffix for an
+    enum-forced scope. Identical identities unify with rows interned by
+    the DogStatsD plane."""
+    tc = _SSF_TC.get(sample.metric)
+    if tc is None:
+        return None
+    parts = [sample.name.encode(), b"|", tc]
+    rate = sample.sample_rate or 1.0
+    if rate != 1.0:
+        parts.append(b"|@%g" % rate)
+    if sample.tags:
+        kv = ",".join(f"{k}:{sample.tags[k]}" for k in sorted(sample.tags))
+        parts.append(b"|#" + kv.encode())
+    if sample.scope in (1, 2):
+        parts.append(b"|$%d" % sample.scope)
+    return b"".join(parts)
 
 
 class BatchIngester:
@@ -103,6 +128,8 @@ class BatchIngester:
             def capture(metric):
                 if metric.key.type == m.GAUGE:
                     row = store.gauges.intern(metric)
+                    if row < 0:  # cardinality cap: drop, already counted
+                        return
                     gauge_rows.append(row)
                     gauge_vals.append(metric.value)
                     gauge_lines.append(line_no)
@@ -182,6 +209,166 @@ class BatchIngester:
     @property
     def interned_keys(self) -> int:
         return self._engine.size()
+
+    # ---- SSF fast path ----------------------------------------------------
+
+    def ingest_ssf_batch(self, packets) -> np.ndarray:
+        """List-of-packets convenience wrapper over
+        ingest_ssf_buffer."""
+        n = len(packets)
+        buf = b"".join(packets)
+        lens = np.fromiter((len(p) for p in packets), np.int64, n)
+        offs = np.zeros(n, np.int64)
+        if n > 1:
+            np.cumsum(lens[:-1], out=offs[1:])
+        return self.ingest_ssf_buffer(buf, offs, lens)
+
+    def ingest_ssf_buffer(self, buf, offs, lens) -> np.ndarray:
+        """Native SSF span decode + metric extraction (reference
+        protocol/wire.go:108-186 + sinks/ssfmetrics/metrics.go:89-146
+        semantics): spans decode and their samples extract in C++ through
+        the shared intern table; samples the native path defers (unknown
+        keys, STATUS, non-ASCII members, malformed) replay through the
+        Python SSF converter, which also registers their canonical keys.
+        Returns the per-packet decoded mask (True = span parsed OK, for
+        the span-sink handoff)."""
+        from veneur_tpu import protocol, ssf
+        from veneur_tpu.samplers.parser import ParseError
+
+        server = self.server
+        store = self.store
+        cfg = server.config
+        ext = server.metric_extraction
+        parser_nat = self._parser()
+        n = len(offs)
+        indicator_enabled = bool(cfg.indicator_span_timer_name
+                                 or cfg.objective_span_timer_name)
+        uniq_rate = getattr(ext, "_uniqueness_rate", 0.01)
+        res = parser_nat.parse_ssf(
+            buf, offs, lens, indicator_enabled, uniq_rate,
+            rng_seed=random.getrandbits(63) | 1)
+        server.stats.inc("packets_received", n)
+        flags = res.flags
+        bad = int(((flags & native.SSF_BAD) != 0).sum())
+        if bad:
+            server.stats.inc("parse_errors", bad)
+        store.count_processed(res.samples)
+
+        spans_cache: dict = {}
+
+        def get_span(idx: int):
+            span = spans_cache.get(idx)
+            if span is None:
+                start = int(offs[idx])
+                span = protocol.parse_ssf(buf[start:start + int(lens[idx])])
+                spans_cache[idx] = span
+            return span
+
+        replayed = 0
+        gauge_rows: list = []
+        gauge_vals: list = []
+        gauge_lines: list = []
+        for pkt_idx, raw, line_no in res.deferred:
+            sample = ssf.SSFSample()
+            try:
+                sample.ParseFromString(raw)
+            except Exception:
+                logger.debug("undecodable SSF sample (%d bytes)", len(raw))
+                continue
+            try:
+                metric = server.parser.parse_metric_ssf(sample)
+            except ParseError:
+                continue  # invalid sample (reference parser.go:154-171)
+            if not metric.name or metric.value is None:
+                continue
+            if metric.key.type == m.GAUGE:
+                # captured, not applied: merged with the native gauge
+                # columns by line index so last-write-wins holds
+                row = store.gauges.intern(metric)
+                if row >= 0:
+                    gauge_rows.append(row)
+                    gauge_vals.append(metric.value)
+                    gauge_lines.append(line_no)
+                    store.count_processed(1)
+            else:
+                server.ingest_metric(metric)  # process() counts it
+            replayed += 1
+            self._register_ssf_sample(sample, metric)
+
+        if len(res.c_rows):
+            store.counters.add_batch(res.c_rows, res.c_vals, res.c_rates)
+        if gauge_rows:
+            all_rows = np.concatenate(
+                [res.g_rows, np.asarray(gauge_rows, np.int32)])
+            all_vals = np.concatenate(
+                [res.g_vals, np.asarray(gauge_vals, np.float32)])
+            all_lines = np.concatenate(
+                [res.g_lines, np.asarray(gauge_lines, np.int32)])
+            order = np.argsort(all_lines, kind="stable")
+            store.gauges.add_batch(all_rows[order], all_vals[order])
+        elif len(res.g_rows):
+            store.gauges.add_batch(res.g_rows, res.g_vals)
+        if len(res.h_rows):
+            store.histos.add_batch(res.h_rows, res.h_vals, res.h_wts)
+        if len(res.s_rows):
+            store.sets.add_batch(res.s_rows, res.s_idx, res.s_rho)
+
+        # derived-metric replays the native path owed us
+        for idx in np.nonzero((flags & native.SSF_NEEDS_UNIQ) != 0)[0]:
+            span = get_span(int(idx))
+            sample = ssf.set_sample("ssf.names_unique", span.name, {
+                "indicator": "true" if span.indicator else "false",
+                "service": span.service,
+                "root_span": ("true" if span.id == span.trace_id
+                              else "false")})
+            # the keep/drop roll already happened in C++; only the
+            # rate-scaling half of ssf.randomly_sample applies here
+            if 0 < uniq_rate <= 1:
+                sample.sample_rate = uniq_rate
+            try:
+                metric = server.parser.parse_metric_ssf(sample)
+            except ParseError:
+                continue
+            server.ingest_metric(metric)  # process() counts it
+            replayed += 1
+            self._register_ssf_sample(sample, metric)
+        if indicator_enabled:
+            for idx in np.nonzero(
+                    (flags & native.SSF_NEEDS_INDICATOR) != 0)[0]:
+                span = get_span(int(idx))
+                for metric in server.parser.convert_indicator_metrics(
+                        span, cfg.indicator_span_timer_name,
+                        cfg.objective_span_timer_name):
+                    server.ingest_metric(metric)  # process() counts it
+                    replayed += 1
+
+        decoded_mask = (flags & native.SSF_DECODED) != 0
+        with ext._lock:
+            ext.spans_processed += int(decoded_mask.sum())
+            ext.metrics_generated += res.samples + replayed
+        return decoded_mask
+
+    def _register_ssf_sample(self, sample, metric) -> None:
+        """Bind an SSF sample's canonical key to the row the Python path
+        just interned, so its next occurrence never leaves C++."""
+        key = ssf_meta_key(sample)
+        if key is None:
+            return
+        family = _FAMILY_BY_TYPE.get(metric.key.type)
+        if family is None:
+            return
+        table = {
+            native.FAM_COUNTER: self.store.counters,
+            native.FAM_GAUGE: self.store.gauges,
+            native.FAM_HISTO: self.store.histos,
+            native.FAM_SET: self.store.sets,
+        }[family]
+        dict_key = (metric.digest64 << 2) | int(metric.scope)
+        row = table.rows.get(dict_key)
+        if row is None:
+            return
+        self._engine.register(key, family, row,
+                              metric.sample_rate or 1.0)
 
     # ---- C++-resident pump ------------------------------------------------
 

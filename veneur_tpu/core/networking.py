@@ -135,7 +135,9 @@ def _start_statsd_udp(u, server, num_readers: int, rcvbuf: int) -> Listener:
     host = u.hostname or "127.0.0.1"
     port = u.port or 0
     threads = []
-    first = _new_udp_socket(host, port, rcvbuf, reuseport=num_readers > 1)
+    # reuseport unconditionally: beyond multi-reader fanout it lets a
+    # graceful-restart replacement bind while this process still serves
+    first = _new_udp_socket(host, port, rcvbuf, reuseport=True)
     bound_port = first.getsockname()[1]
     listener = Listener("udp", first.getsockname(), first, threads)
     socks = [first]
@@ -217,6 +219,9 @@ def _start_statsd_tcp(u, server) -> Listener:
     port = u.port or 0
     sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    if hasattr(socket, "SO_REUSEPORT"):
+        # graceful restart: replacement binds while we still accept
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
     sock.bind((host, port))
     sock.listen(128)
     threads: List[threading.Thread] = []
@@ -287,10 +292,15 @@ def _read_tcp_lines(conn, server, listener: Listener) -> None:
 
 def _start_statsd_unix(u, server) -> Listener:
     path = u.path or u.netloc
-    try:
-        os.unlink(path)
-    except OSError:
-        pass
+    if path.startswith("@"):
+        # Linux abstract socket (reference protocol/addr.go handles @
+        # names): no filesystem entry, address starts with a NUL byte
+        path = "\0" + path[1:]
+    else:
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
     sock = socket.socket(socket.AF_UNIX, socket.SOCK_DGRAM)
     sock.bind(path)
     threads: List[threading.Thread] = []
@@ -331,6 +341,30 @@ def _start_ssf_udp(u, server, rcvbuf: int) -> Listener:
                    _MAX_DGRAM)
 
     def read_loop():
+        # native batched drain: recvmmsg with per-datagram boundaries
+        # feeding the C++ SSF decode path
+        reader = None
+        if (getattr(server, "_ingester", None) is not None
+                and not os.environ.get("VENEUR_TPU_DISABLE_PUMP")):
+            try:
+                from veneur_tpu import native
+                reader = native.NativeReader(
+                    max_msgs=256, max_dgram=max_read + 1)
+            except Exception:
+                reader = None
+        if reader is not None:
+            import ctypes
+            fd = sock.fileno()
+            while not listener.closed:
+                length, offs, lens, dropped = reader.read2(fd, max_read)
+                if length < 0:
+                    return
+                if dropped:
+                    server.stats.inc("parse_errors", dropped)
+                if length > 0:
+                    raw = ctypes.string_at(reader.buf_ptr, length)
+                    server.handle_ssf_buffer(raw, offs, lens)
+            return
         while not listener.closed:
             try:
                 buf = sock.recv(max_read)
@@ -349,10 +383,14 @@ def _start_ssf_udp(u, server, rcvbuf: int) -> Listener:
 def _start_ssf_stream(u, server) -> Listener:
     if u.scheme == "unix":
         path = u.path or u.netloc
-        try:
-            os.unlink(path)
-        except OSError:
-            pass
+        if path.startswith("@"):
+            # Linux abstract socket (reference protocol/addr.go)
+            path = "\0" + path[1:]
+        else:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
         sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         sock.bind(path)
         address = path

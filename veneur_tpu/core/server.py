@@ -10,6 +10,7 @@ device flush kernels and fans InterMetrics out to sinks in parallel.
 from __future__ import annotations
 
 import logging
+import os
 import queue
 import socket
 import threading
@@ -29,6 +30,17 @@ from veneur_tpu.samplers.parser import ParseError, Parser
 from veneur_tpu.util.matcher import SinkRoutingMatcher
 
 logger = logging.getLogger("veneur_tpu.server")
+
+
+class RawSpan:
+    """A span still in wire form: the native SSF path already extracted
+    its metrics, so decoding (for external span sinks) happens lazily in
+    the span worker instead of on the ingest path."""
+
+    __slots__ = ("data",)
+
+    def __init__(self, data: bytes):
+        self.data = data
 
 
 class _SpanSinkWorker:
@@ -106,7 +118,9 @@ class Server:
             histo_capacity=config.tpu.histo_capacity,
             set_capacity=config.tpu.set_capacity,
             batch_cap=config.tpu.batch_cap,
-            shard_devices=config.tpu.shards)
+            shard_devices=config.tpu.shards,
+            max_rows=config.tpu.max_rows_per_family)
+        self._keys_dropped_reported = 0
         self.aggregates = HistogramAggregates.from_names(config.aggregates)
         self.percentiles = tuple(config.percentiles)
 
@@ -296,6 +310,52 @@ class Server:
             return
         self.ingest_span(span)
 
+    def handle_ssf_batch(self, packets) -> None:
+        """A batch of unframed SSF datagrams; delegates to
+        handle_ssf_buffer over their concatenation."""
+        import numpy as np
+        n = len(packets)
+        if not n:
+            return
+        lens = np.fromiter((len(p) for p in packets), np.int64, n)
+        offs = np.zeros(n, np.int64)
+        if n > 1:
+            np.cumsum(lens[:-1], out=offs[1:])
+        self.handle_ssf_buffer(b"".join(packets), offs, lens)
+
+    def handle_ssf_buffer(self, buf, offs, lens) -> None:
+        """A batch of unframed SSF datagrams as a contiguous buffer with
+        per-packet (offset, length) — the shape the native UDP reader
+        produces. With the native library the spans decode and their
+        metrics extract in C++ (SURVEY §2 native-components item 6); the
+        span objects external sinks need are decoded lazily at worker
+        pace (RawSpan), so sink-side decode cost rides the existing
+        bounded-queue drop semantics instead of the ingest path."""
+        ing = getattr(self, "_ingester", None)
+        if ing is not None and not os.environ.get(
+                "VENEUR_TPU_DISABLE_PUMP"):
+            try:
+                decoded = ing.ingest_ssf_buffer(buf, offs, lens)
+            except Exception:
+                # the native path may already have applied part of the
+                # batch; replaying it through the Python path would
+                # double-count, so the remainder is dropped (UDP
+                # semantics) and the failure is loud
+                logger.exception(
+                    "native SSF buffer failed; dropping the batch "
+                    "remainder to avoid double-counting")
+                self.stats.inc("parse_errors", len(offs))
+                return
+            if self._span_sink_workers:
+                import numpy as np
+                for i in np.nonzero(decoded)[0]:
+                    start = int(offs[i])
+                    self.ingest_span(
+                        RawSpan(buf[start:start + int(lens[i])]))
+            return
+        for off, ln in zip(offs, lens):
+            self.handle_ssf_packet(buf[int(off):int(off) + int(ln)])
+
     def ingest_span(self, span) -> None:
         """Enqueue a span for the worker pool; drops (and counts) when the
         channel is saturated rather than blocking ingest."""
@@ -320,10 +380,19 @@ class Server:
                 continue
             if span is None:
                 return
-            try:
-                self.metric_extraction.ingest(span)
-            except Exception:
-                logger.exception("span metric extraction failed")
+            if isinstance(span, RawSpan):
+                # metrics were already extracted natively; only external
+                # sinks need the decoded object
+                from veneur_tpu import protocol
+                try:
+                    span = protocol.parse_ssf(span.data)
+                except Exception:
+                    continue  # native decode succeeded; should not happen
+            else:
+                try:
+                    self.metric_extraction.ingest(span)
+                except Exception:
+                    logger.exception("span metric extraction failed")
             for worker in self._span_sink_workers:
                 worker.submit(span)
 
@@ -645,6 +714,47 @@ class Server:
         if self.spans_dropped or span_sink_drops:
             self.statsd.gauge("worker.ssf.spans_dropped_total",
                               self.spans_dropped + span_sink_drops)
+        self._reclaim_idle_rows()
+
+    def _reclaim_idle_rows(self) -> None:
+        """Idle-key reclamation + intern-table self-metrics, once per
+        flush: tombstoned rows lose their native intern mappings
+        immediately; their ids are recycled by the tables one flush later
+        (columnstore._BaseTable.reclaim_idle). Bounds host memory under
+        key churn (the reference instead resets ALL sampler state every
+        interval, worker.go:470-489)."""
+        from veneur_tpu import native
+
+        idle = self.config.tpu.idle_key_intervals
+        store = self.store
+        tables = (
+            (store.counters, native.FAM_COUNTER),
+            (store.gauges, native.FAM_GAUGE),
+            (store.histos, native.FAM_HISTO),
+            (store.sets, native.FAM_SET),
+            (store.statuses, None),  # never registered natively
+        )
+        engine = (self._ingester._engine
+                  if getattr(self, "_ingester", None) is not None else None)
+        if idle > 0:
+            for table, family in tables:
+                try:
+                    evicted = table.reclaim_idle(idle)
+                except Exception:
+                    logger.exception("idle-row reclamation failed")
+                    continue
+                if evicted and family is not None and engine is not None:
+                    engine.unregister_rows(family, evicted)
+        self.statsd.gauge(
+            "intern.rows_total",
+            sum(len(t.rows) for t, _f in tables))
+        if engine is not None:
+            self.statsd.gauge("intern.native_table_size", engine.size())
+        dropped = sum(t.keys_dropped for t, _f in tables)
+        if dropped > self._keys_dropped_reported:
+            self.statsd.count("intern.keys_dropped_total",
+                              dropped - self._keys_dropped_reported)
+            self._keys_dropped_reported = dropped
 
     def _forward_safe(self, fwd: ForwardableState) -> None:
         try:

@@ -119,11 +119,11 @@ class ShardedHistoTable(HistoTable):
     devices; flush merges across the device axis with collectives."""
 
     def __init__(self, capacity: int = 1024, batch_cap: int = 8192,
-                 devices: List = None):
+                 devices: List = None, max_rows: int = 0):
         self._devices = devices or local_shard_devices(2)
         self._mesh = Mesh(np.asarray(self._devices), (SHARD_AXIS,))
         self._next = 0
-        super().__init__(capacity, batch_cap)
+        super().__init__(capacity, batch_cap, max_rows=max_rows)
 
     def _init_arrays(self):
         self._init_pending()
@@ -200,6 +200,7 @@ class ShardedHistoTable(HistoTable):
         with self.lock:
             cols = self._swap_locked()
             self.apply_lock.acquire()
+            self._note_generation_locked()
             touched = self.touched.copy()
             meta = list(self.meta)
             self.touched[:] = False
@@ -229,13 +230,14 @@ class ShardedSetTable(SetTable):
     devices; flush merges registers with an all-reduce max."""
 
     def __init__(self, capacity: int = 256, batch_cap: int = 8192,
-                 devices: List = None):
+                 devices: List = None, max_rows: int = 0):
         self._devices = devices or local_shard_devices(2)
         self._mesh = Mesh(np.asarray(self._devices), (SHARD_AXIS,))
         self._next = 0
         # dense path: sharding already spreads register memory across
         # devices, and the collective merge needs uniform dense rows
-        super().__init__(capacity, batch_cap, sparse=False)
+        super().__init__(capacity, batch_cap, sparse=False,
+                         max_rows=max_rows)
 
     def _init_arrays(self):
         self._init_pending()
@@ -282,6 +284,7 @@ class ShardedSetTable(SetTable):
         with self.lock:
             cols = self._swap_locked()
             self.apply_lock.acquire()
+            self._note_generation_locked()
             touched = self.touched.copy()
             meta = list(self.meta)
             self.touched[:] = False

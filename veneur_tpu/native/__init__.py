@@ -33,6 +33,12 @@ FAM_GAUGE = 1
 FAM_HISTO = 2
 FAM_SET = 3
 
+# per-packet flags from vnt_ssf_parse, mirroring dogstatsd.cc
+SSF_DECODED = 1
+SSF_BAD = 2
+SSF_NEEDS_UNIQ = 4
+SSF_NEEDS_INDICATOR = 8
+
 
 class ChunkDesc(ctypes.Structure):
     """Mirror of dogstatsd.cc ChunkDesc: one sealed pump chunk's array
@@ -85,6 +91,9 @@ def _declare(lib) -> None:
     lib.vnt_register.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, i64, ctypes.c_int32,
         ctypes.c_int32, ctypes.c_double]
+    lib.vnt_unregister_rows.restype = None
+    lib.vnt_unregister_rows.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, i32p, i64]
     lib.vnt_reader_new.restype = ctypes.c_void_p
     lib.vnt_reader_new.argtypes = [ctypes.c_int32, i64]
     lib.vnt_reader_free.restype = None
@@ -126,6 +135,22 @@ def _declare(lib) -> None:
     lib.vnt_pump_stop.argtypes = [ctypes.c_void_p]
     lib.vnt_pump_free.restype = None
     lib.vnt_pump_free.argtypes = [ctypes.c_void_p]
+    lib.vnt_reader_read2.restype = i64
+    lib.vnt_reader_read2.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, i64, ctypes.c_int32, i64p, i64p,
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32)]
+    lib.vnt_ssf_parse.restype = i64
+    lib.vnt_ssf_parse.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, i64p, i64p, i64,
+        i32p, f32p, f32p, i64, i64p,          # counters
+        i32p, f32p, i32p, i64p,               # gauges (+line index)
+        i32p, f32p, f32p, i64p,               # histos
+        i32p, i32p, i32p, i64p,               # sets
+        i32p, i64p, i64p, i32p, i64, i64p,    # deferred samples
+        i32p,                                 # per-packet flags
+        ctypes.c_int32, ctypes.c_double, ctypes.c_uint64,
+        i64p,                                 # samples extracted
+    ]
     lib.vnt_blast_new.restype = ctypes.c_void_p
     lib.vnt_blast_new.argtypes = [ctypes.c_void_p, i64, i64p, i64p, i64]
     lib.vnt_blast_free.restype = None
@@ -202,6 +227,8 @@ class NativeReader:
         self.buf_ptr = self._lib.vnt_reader_buf(self._r)
         self._n1 = ctypes.c_int32()
         self._n2 = ctypes.c_int32()
+        self._off = np.empty(max_msgs, np.int64)
+        self._len = np.empty(max_msgs, np.int64)
 
     def __del__(self):
         try:
@@ -218,6 +245,17 @@ class NativeReader:
             self._r, fd, max_len, timeout_ms,
             ctypes.byref(self._n1), ctypes.byref(self._n2))
         return length, self._n1.value, self._n2.value
+
+    def read2(self, fd: int, max_len: int, timeout_ms: int = 500):
+        """Boundary-preserving drain for binary protocols (SSF): returns
+        (joined_length, offsets_view, lengths_view, n_dropped). The
+        offset/length views are valid until the next read."""
+        length = self._lib.vnt_reader_read2(
+            self._r, fd, max_len, timeout_ms,
+            _ptr(self._off, ctypes.c_int64), _ptr(self._len, ctypes.c_int64),
+            ctypes.byref(self._n1), ctypes.byref(self._n2))
+        n = self._n1.value
+        return length, self._off[:n], self._len[:n], self._n2.value
 
 
 class Engine:
@@ -245,6 +283,14 @@ class Engine:
                  rate: float) -> None:
         self._lib.vnt_register(
             self.ptr, meta_key, len(meta_key), family, row, rate)
+
+    def unregister_rows(self, family: int, rows) -> None:
+        """Erase every mapping pointing at `rows` in `family` (idle-row
+        reclamation; must happen before the row ids are recycled)."""
+        arr = np.asarray(rows, np.int32)
+        if arr.size:
+            self._lib.vnt_unregister_rows(
+                self.ptr, family, _ptr(arr, ctypes.c_int32), arr.size)
 
 
 class NativeParser:
@@ -284,6 +330,7 @@ class NativeParser:
         self._unk_off = np.empty(cap, np.int64)
         self._unk_len = np.empty(cap, np.int64)
         self._unk_lines = np.empty(cap, np.int32)
+        self._def_pkt = np.empty(cap, np.int32)
         self._cap = cap
 
     def size(self) -> int:
@@ -346,6 +393,74 @@ class NativeParser:
         res.unknown_lines = self._unk_lines[:un]
         del keepalive
         return res
+
+    def parse_ssf(self, buf: bytes, offs, lens,
+                  indicator_enabled: bool = False,
+                  uniq_rate: float = 0.01,
+                  rng_seed: int = 0x9E3779B97F4A7C15) -> SsfResult:
+        """Decode SSFSpan packets at (offs, lens) within buf and extract
+        their samples through the shared intern table; see
+        dogstatsd.cc vnt_ssf_parse for the deferral contract."""
+        n_pkts = len(offs)
+        total = int(np.sum(lens)) if n_pkts else 0
+        self._ensure_capacity(total // 2 + 2)
+        offs = np.ascontiguousarray(offs, np.int64)
+        lens = np.ascontiguousarray(lens, np.int64)
+        flags = np.zeros(n_pkts, np.int32)
+        i32, f32, i64 = ctypes.c_int32, ctypes.c_float, ctypes.c_int64
+        ns = self._outs
+        cap = i64(self._cap)
+        ptr = ctypes.cast(ctypes.c_char_p(buf), ctypes.c_void_p)
+        decoded = self._lib.vnt_ssf_parse(
+            self._eng, ptr, _ptr(offs, i64), _ptr(lens, i64), n_pkts,
+            _ptr(self._c_rows, i32), _ptr(self._c_vals, f32),
+            _ptr(self._c_rates, f32), cap, ctypes.byref(ns[0]),
+            _ptr(self._g_rows, i32), _ptr(self._g_vals, f32),
+            _ptr(self._g_lines, i32), ctypes.byref(ns[1]),
+            _ptr(self._h_rows, i32), _ptr(self._h_vals, f32),
+            _ptr(self._h_wts, f32), ctypes.byref(ns[2]),
+            _ptr(self._s_rows, i32), _ptr(self._s_idx, i32),
+            _ptr(self._s_rho, i32), ctypes.byref(ns[3]),
+            _ptr(self._def_pkt, i32), _ptr(self._unk_off, i64),
+            _ptr(self._unk_len, i64), _ptr(self._unk_lines, i32),
+            cap, ctypes.byref(ns[4]),
+            _ptr(flags, i32),
+            1 if indicator_enabled else 0, float(uniq_rate),
+            rng_seed & 0xFFFFFFFFFFFFFFFF, ctypes.byref(ns[5]))
+        res = SsfResult()
+        res.decoded = decoded
+        res.flags = flags
+        cn, gn, hn, sn, dn = (ns[i].value for i in range(5))
+        res.samples = ns[5].value
+        res.c_rows = self._c_rows[:cn]
+        res.c_vals = self._c_vals[:cn]
+        res.c_rates = self._c_rates[:cn]
+        res.g_rows = self._g_rows[:gn]
+        res.g_vals = self._g_vals[:gn]
+        res.g_lines = self._g_lines[:gn]
+        res.h_rows = self._h_rows[:hn]
+        res.h_vals = self._h_vals[:hn]
+        res.h_wts = self._h_wts[:hn]
+        res.s_rows = self._s_rows[:sn]
+        res.s_idx = self._s_idx[:sn]
+        res.s_rho = self._s_rho[:sn]
+        res.deferred = [
+            (int(self._def_pkt[i]),
+             buf[int(self._unk_off[i]):
+                 int(self._unk_off[i]) + int(self._unk_len[i])],
+             int(self._unk_lines[i]))
+            for i in range(dn)]
+        return res
+
+
+class SsfResult:
+    """Output of one NativeParser.parse_ssf call: trimmed COO views plus
+    deferred (pkt_idx, sample_bytes, line) tuples and per-packet flags."""
+
+    __slots__ = ("decoded", "samples", "flags",
+                 "c_rows", "c_vals", "c_rates",
+                 "g_rows", "g_vals", "g_lines", "h_rows", "h_vals", "h_wts",
+                 "s_rows", "s_idx", "s_rho", "deferred")
 
 
 def _view(addr: int, n: int, dtype):

@@ -20,9 +20,11 @@
 // the Python column store when it first sees a key via the slow path and
 // registered here; after that the line never touches Python again.
 
+#include <algorithm>
 #include <atomic>
 #include <chrono>
 #include <condition_variable>
+#include <cstdio>
 #include <cstdint>
 #include <cstring>
 #include <deque>
@@ -32,6 +34,7 @@
 #include <string_view>
 #include <thread>
 #include <unordered_map>
+#include <unordered_set>
 
 #include <locale.h>
 #include <math.h>
@@ -342,6 +345,25 @@ void vnt_register(void* ep, const uint8_t* key, int64_t keylen,
       std::string(reinterpret_cast<const char*>(key), keylen), ent);
 }
 
+// Erases every intern mapping pointing at one of `rows` in `family` —
+// the native half of idle-row reclamation (the Python column store
+// tombstones the rows; this guarantees no NEW native samples can
+// reference them before the row ids are recycled an interval later).
+// One O(table) sweep amortizes over the whole evicted batch.
+void vnt_unregister_rows(void* ep, int32_t family, const int32_t* rows,
+                         int64_t n) {
+  Engine* e = static_cast<Engine*>(ep);
+  std::unordered_set<int32_t> dead(rows, rows + n);
+  std::unique_lock lock(e->mu);
+  for (auto it = e->table.begin(); it != e->table.end();) {
+    if (it->second.family == family && dead.count(it->second.row)) {
+      it = e->table.erase(it);
+    } else {
+      ++it;
+    }
+  }
+}
+
 // Parses a newline-joined buffer of packets. Returns the number of
 // non-empty lines seen (the packets_received delta). Per-family sample
 // arrays are filled up to their capacities; lines the native path cannot
@@ -480,6 +502,49 @@ int64_t vnt_reader_read(void* rp, int32_t fd, int64_t max_len,
     (*n_dgrams)++;
   }
   if (pos > 0) pos--;  // trailing separator
+  return pos;
+}
+
+// Boundary-preserving variant for binary protocols (SSF): same drain as
+// vnt_reader_read, but also reports each datagram's (offset, length)
+// within the joined buffer — binary frames may contain '\n', so the
+// separator convention of the DogStatsD path cannot delimit them.
+int64_t vnt_reader_read2(void* rp, int32_t fd, int64_t max_len,
+                         int32_t timeout_ms, int64_t* msg_off,
+                         int64_t* msg_len, int32_t* n_dgrams,
+                         int32_t* n_dropped) {
+  Reader* r = static_cast<Reader*>(rp);
+  *n_dgrams = 0;
+  *n_dropped = 0;
+
+  struct pollfd pfd = {fd, POLLIN, 0};
+  int pr = poll(&pfd, 1, timeout_ms);
+  if (pr < 0) return (errno == EINTR) ? 0 : -1;
+  if (pr == 0) return 0;
+  if (pfd.revents & (POLLERR | POLLNVAL)) return -1;
+
+  int got = recvmmsg(fd, r->hdrs.data(), r->max_msgs, MSG_DONTWAIT, nullptr);
+  if (got < 0) {
+    if (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR) return 0;
+    return -1;
+  }
+
+  uint8_t* out = r->joined.data();
+  int64_t pos = 0;
+  for (int i = 0; i < got; i++) {
+    int64_t len = r->hdrs[i].msg_len;
+    if (len <= 0) continue;
+    if (len > max_len) {
+      (*n_dropped)++;
+      continue;
+    }
+    memcpy(out + pos, r->scratch.data() + static_cast<size_t>(i) * r->max_dgram,
+           len);
+    msg_off[*n_dgrams] = pos;
+    msg_len[*n_dgrams] = len;
+    pos += len;
+    (*n_dgrams)++;
+  }
   return pos;
 }
 
@@ -837,6 +902,436 @@ void vnt_pump_free(void* pp) {
   vnt_pump_stop(p);
   delete p;
 }
+
+// ---- native SSF span decode + metric extraction ---------------------------
+//
+// The span-pipeline hot path (SURVEY §2 native-components item 6;
+// reference protocol/wire.go:108-186 + sinks/ssfmetrics/metrics.go:89-146):
+// SSFSpan packets are decoded with a hand-rolled protobuf-wire reader and
+// their embedded SSFSamples extracted straight into COO columns via the
+// SAME intern table the DogStatsD path uses — the canonical meta-key for
+// an SSF sample is rendered in DogStatsD line-key form
+// ("name|c|@rate|#k:v,..." with tag keys sorted, plus a "|$N" suffix for
+// an enum-forced scope), so a key's row identity is shared across both
+// ingest planes. Anything the native path cannot take bit-exactly
+// (uninterned keys, STATUS samples, non-ASCII set members, indicator
+// spans when SLI timers are configured, malformed packets) defers to the
+// Python slow path at per-sample granularity.
+
+namespace {
+
+struct PB {
+  const uint8_t* p;
+  const uint8_t* end;
+  bool ok = true;
+
+  uint64_t varint() {
+    uint64_t v = 0;
+    int shift = 0;
+    while (p < end && shift < 64) {
+      uint8_t b = *p++;
+      v |= static_cast<uint64_t>(b & 0x7f) << shift;
+      if (!(b & 0x80)) return v;
+      shift += 7;
+    }
+    ok = false;
+    return 0;
+  }
+
+  float fixed32f() {
+    if (end - p < 4) {
+      ok = false;
+      return 0.0f;
+    }
+    float f;
+    memcpy(&f, p, 4);
+    p += 4;
+    return f;
+  }
+
+  std::string_view bytes() {
+    uint64_t n = varint();
+    if (!ok || n > static_cast<uint64_t>(end - p)) {
+      ok = false;
+      return {};
+    }
+    std::string_view sv(reinterpret_cast<const char*>(p),
+                        static_cast<size_t>(n));
+    p += n;
+    return sv;
+  }
+
+  void skip(uint32_t wire) {
+    switch (wire) {
+      case 0: varint(); break;
+      case 1: p = (end - p >= 8) ? p + 8 : (ok = false, end); break;
+      case 2: bytes(); break;
+      case 5: p = (end - p >= 4) ? p + 4 : (ok = false, end); break;
+      default: ok = false; break;
+    }
+  }
+};
+
+struct TagKV {
+  std::string_view k, v;
+  bool operator<(const TagKV& o) const { return k < o.k; }
+};
+
+// map<string,string> entry: {1: key, 2: value}
+inline bool parse_map_entry(std::string_view entry, TagKV* out) {
+  PB b{reinterpret_cast<const uint8_t*>(entry.data()),
+       reinterpret_cast<const uint8_t*>(entry.data()) + entry.size()};
+  while (b.ok && b.p < b.end) {
+    uint64_t tag = b.varint();
+    if (!b.ok) break;
+    uint32_t field = static_cast<uint32_t>(tag >> 3);
+    uint32_t wire = static_cast<uint32_t>(tag & 7);
+    if (field == 1 && wire == 2) {
+      out->k = b.bytes();
+    } else if (field == 2 && wire == 2) {
+      out->v = b.bytes();
+    } else {
+      b.skip(wire);
+    }
+  }
+  return b.ok;
+}
+
+struct SsfSampleView {
+  int64_t metric = 0;       // enum: 0 c, 1 g, 2 h, 3 s, 4 status
+  std::string_view name;
+  float value = 0.0f;
+  std::string_view message;  // SET member
+  float sample_rate = 0.0f;
+  int64_t scope = 0;         // 0 default, 1 local, 2 global
+  std::vector<TagKV> tags;
+  bool ok = true;
+};
+
+inline bool parse_ssf_sample(std::string_view raw, SsfSampleView* s) {
+  PB b{reinterpret_cast<const uint8_t*>(raw.data()),
+       reinterpret_cast<const uint8_t*>(raw.data()) + raw.size()};
+  while (b.ok && b.p < b.end) {
+    uint64_t tag = b.varint();
+    if (!b.ok) break;
+    uint32_t field = static_cast<uint32_t>(tag >> 3);
+    uint32_t wire = static_cast<uint32_t>(tag & 7);
+    switch (field) {
+      case 1: if (wire == 0) s->metric = static_cast<int64_t>(b.varint());
+              else b.skip(wire); break;
+      case 2: if (wire == 2) s->name = b.bytes(); else b.skip(wire); break;
+      case 3: if (wire == 5) s->value = b.fixed32f();
+              else b.skip(wire); break;
+      case 5: if (wire == 2) s->message = b.bytes();
+              else b.skip(wire); break;
+      case 7: if (wire == 5) s->sample_rate = b.fixed32f();
+              else b.skip(wire); break;
+      case 8: if (wire == 2) {
+                TagKV kv;
+                if (!parse_map_entry(b.bytes(), &kv)) return false;
+                s->tags.push_back(kv);
+              } else b.skip(wire);
+              break;
+      case 10: if (wire == 0) s->scope = static_cast<int64_t>(b.varint());
+               else b.skip(wire); break;
+      default: b.skip(wire); break;
+    }
+  }
+  return b.ok;
+}
+
+struct SsfSpanView {
+  int64_t trace_id = 0, id = 0, start = 0, end_ts = 0;
+  bool error = false, indicator = false;
+  std::string_view service, name;
+  std::vector<std::string_view> samples;  // raw SSFSample submessages
+  bool ok = true;
+};
+
+inline bool parse_ssf_span(const uint8_t* data, int64_t len,
+                           SsfSpanView* sp) {
+  PB b{data, data + len};
+  while (b.ok && b.p < b.end) {
+    uint64_t tag = b.varint();
+    if (!b.ok) break;
+    uint32_t field = static_cast<uint32_t>(tag >> 3);
+    uint32_t wire = static_cast<uint32_t>(tag & 7);
+    switch (field) {
+      case 2: if (wire == 0) sp->trace_id = static_cast<int64_t>(b.varint());
+              else b.skip(wire); break;
+      case 3: if (wire == 0) sp->id = static_cast<int64_t>(b.varint());
+              else b.skip(wire); break;
+      case 5: if (wire == 0) sp->start = static_cast<int64_t>(b.varint());
+              else b.skip(wire); break;
+      case 6: if (wire == 0) sp->end_ts = static_cast<int64_t>(b.varint());
+              else b.skip(wire); break;
+      case 7: if (wire == 0) sp->error = b.varint() != 0;
+              else b.skip(wire); break;
+      case 8: if (wire == 2) sp->service = b.bytes();
+              else b.skip(wire); break;
+      case 10: if (wire == 2) sp->samples.push_back(b.bytes());
+               else b.skip(wire); break;
+      case 12: if (wire == 0) sp->indicator = b.varint() != 0;
+               else b.skip(wire); break;
+      case 13: if (wire == 2) sp->name = b.bytes(); else b.skip(wire); break;
+      default: b.skip(wire); break;
+    }
+  }
+  return b.ok;
+}
+
+const char kFamilyChar[4] = {'c', 'g', 'h', 's'};
+
+// Canonical meta-key for an SSF sample, byte-identical to the Python
+// helper (veneur_tpu/core/ingest.py ssf_meta_key): DogStatsD line-key
+// form with sorted tag keys, so identical identities unify with
+// DogStatsD-interned rows.
+inline void ssf_key(std::string& out, std::string_view name, char tc,
+                    float rate, std::vector<TagKV>& tags, int64_t scope) {
+  out.clear();
+  out.append(name.data(), name.size());
+  out.push_back('|');
+  out.push_back(tc);
+  float r = (rate == 0.0f) ? 1.0f : rate;
+  if (r != 1.0f) {
+    char buf[40];
+    snprintf(buf, sizeof(buf), "|@%g", static_cast<double>(r));
+    out.append(buf);
+  }
+  if (!tags.empty()) {
+    std::sort(tags.begin(), tags.end());
+    out.append("|#");
+    for (size_t i = 0; i < tags.size(); i++) {
+      if (i) out.push_back(',');
+      out.append(tags[i].k.data(), tags[i].k.size());
+      out.push_back(':');
+      out.append(tags[i].v.data(), tags[i].v.size());
+    }
+  }
+  if (scope == 1 || scope == 2) {
+    out.push_back('|');
+    out.push_back('$');
+    out.push_back(scope == 1 ? '1' : '2');
+  }
+}
+
+inline bool all_ascii(std::string_view sv) {
+  for (char c : sv) {
+    if (static_cast<uint8_t>(c) >= 0x80) return false;
+  }
+  return true;
+}
+
+inline uint64_t xorshift64(uint64_t* s) {
+  uint64_t x = *s;
+  x ^= x << 13;
+  x ^= x >> 7;
+  x ^= x << 17;
+  *s = x;
+  return x;
+}
+
+// pkt_flags bits
+constexpr int32_t SSF_DECODED = 1;
+constexpr int32_t SSF_BAD = 2;
+constexpr int32_t SSF_NEEDS_UNIQ = 4;
+constexpr int32_t SSF_NEEDS_INDICATOR = 8;
+
+}  // namespace
+
+extern "C" {
+
+// Decodes n_pkts SSFSpan packets (buf + offs/lens) and extracts their
+// samples into COO columns through the shared intern table. Samples the
+// native path cannot take are returned as (pkt, off, len, line) tuples
+// relative to buf; per-packet flags report decode status and which
+// derived-metric replays Python owes. Returns the number of packets
+// decoded successfully.
+int64_t vnt_ssf_parse(void* ep, const uint8_t* buf, const int64_t* offs,
+                      const int64_t* lens, int64_t n_pkts,
+                      int32_t* c_rows, float* c_vals, float* c_rates,
+                      int64_t cap, int64_t* c_n,
+                      int32_t* g_rows, float* g_vals, int32_t* g_lines,
+                      int64_t* g_n,
+                      int32_t* h_rows, float* h_vals, float* h_wts,
+                      int64_t* h_n,
+                      int32_t* s_rows, int32_t* s_idx, int32_t* s_rho,
+                      int64_t* s_n,
+                      int32_t* def_pkt, int64_t* def_off, int64_t* def_len,
+                      int32_t* def_line, int64_t def_cap, int64_t* def_n,
+                      int32_t* pkt_flags,
+                      int32_t indicator_enabled, double uniq_rate,
+                      uint64_t rng_seed, int64_t* samples_out) {
+  Engine* e = static_cast<Engine*>(ep);
+  Out o;
+  o.c_rows = c_rows; o.c_vals = c_vals; o.c_rates = c_rates; o.c_cap = cap;
+  o.g_rows = g_rows; o.g_vals = g_vals; o.g_lines = g_lines; o.g_cap = cap;
+  o.h_rows = h_rows; o.h_vals = h_vals; o.h_wts = h_wts; o.h_cap = cap;
+  o.s_rows = s_rows; o.s_idx = s_idx; o.s_rho = s_rho; o.s_cap = cap;
+  int64_t dn = 0;
+  int64_t decoded = 0;
+  int32_t line = 0;  // global sample index: keeps gauge LWW replayable
+  uint64_t rng = rng_seed | 1;
+  thread_local std::string keybuf;
+  thread_local SsfSpanView sp;
+  thread_local SsfSampleView sv;
+
+  auto defer = [&](int32_t pkt, const uint8_t* p, int64_t len,
+                   int32_t ln) {
+    if (dn < def_cap) {
+      def_pkt[dn] = pkt;
+      def_off[dn] = p - buf;
+      def_len[dn] = len;
+      def_line[dn] = ln;
+      dn++;
+    }
+  };
+
+  std::shared_lock lock(e->mu);
+  for (int64_t i = 0; i < n_pkts; i++) {
+    sp.trace_id = sp.id = sp.start = sp.end_ts = 0;
+    sp.error = sp.indicator = false;
+    sp.service = {};
+    sp.name = {};
+    sp.samples.clear();  // reset by hand to reuse the vector's capacity
+    if (!parse_ssf_span(buf + offs[i], lens[i], &sp)) {
+      pkt_flags[i] = SSF_BAD;
+      continue;
+    }
+    int32_t flags = SSF_DECODED;
+    for (std::string_view raw : sp.samples) {
+      int32_t my_line = line++;
+      sv.metric = 0;
+      sv.name = {};
+      sv.value = 0.0f;
+      sv.message = {};
+      sv.sample_rate = 0.0f;
+      sv.scope = 0;
+      sv.tags.clear();
+      bool sample_ok = parse_ssf_sample(raw, &sv);
+      if (!sample_ok || sv.metric < 0 || sv.metric > 3 ||
+          sv.name.empty()) {
+        // STATUS, unknown enums, empty names and malformed samples all
+        // take the Python path, which reproduces the reference's
+        // invalid-sample accounting
+        defer(static_cast<int32_t>(i),
+              reinterpret_cast<const uint8_t*>(raw.data()),
+              static_cast<int64_t>(raw.size()), my_line);
+        continue;
+      }
+      ssf_key(keybuf, sv.name, kFamilyChar[sv.metric], sv.sample_rate,
+              sv.tags, sv.scope);
+      auto it = e->table.find(std::string_view(keybuf));
+      if (it == e->table.end()) {
+        defer(static_cast<int32_t>(i),
+              reinterpret_cast<const uint8_t*>(raw.data()),
+              static_cast<int64_t>(raw.size()), my_line);
+        continue;
+      }
+      const Entry& ent = it->second;
+      bool emitted = false;
+      switch (ent.family) {
+        case FAM_COUNTER:
+          if (o.c_n < o.c_cap) {
+            o.c_rows[o.c_n] = ent.row;
+            o.c_vals[o.c_n] = sv.value;
+            o.c_rates[o.c_n] = ent.rate;
+            o.c_n++;
+            emitted = true;
+          }
+          break;
+        case FAM_GAUGE:
+          if (o.g_n < o.g_cap) {
+            o.g_rows[o.g_n] = ent.row;
+            o.g_vals[o.g_n] = sv.value;
+            o.g_lines[o.g_n] = my_line;
+            o.g_n++;
+            emitted = true;
+          }
+          break;
+        case FAM_HISTO:
+          if (o.h_n < o.h_cap) {
+            o.h_rows[o.h_n] = ent.row;
+            o.h_vals[o.h_n] = sv.value;
+            o.h_wts[o.h_n] = 1.0f / ent.rate;
+            o.h_n++;
+            emitted = true;
+          }
+          break;
+        case FAM_SET:
+          if (o.s_n < o.s_cap && all_ascii(sv.message)) {
+            int32_t idx, rho;
+            pos_val(hash_member(
+                reinterpret_cast<const uint8_t*>(sv.message.data()),
+                sv.message.size()), &idx, &rho);
+            o.s_rows[o.s_n] = ent.row;
+            o.s_idx[o.s_n] = idx;
+            o.s_rho[o.s_n] = rho;
+            o.s_n++;
+            emitted = true;
+          }
+          break;
+        default:
+          break;
+      }
+      if (emitted) {
+        o.samples++;
+      } else {
+        defer(static_cast<int32_t>(i),
+              reinterpret_cast<const uint8_t*>(raw.data()),
+              static_cast<int64_t>(raw.size()), my_line);
+      }
+    }
+
+    bool valid_trace = sp.id != 0 && sp.trace_id != 0 && sp.start != 0 &&
+                       sp.end_ts != 0 && !sp.name.empty();
+    if (indicator_enabled && sp.indicator && valid_trace) {
+      flags |= SSF_NEEDS_INDICATOR;
+    }
+    if (uniq_rate > 0 && !sp.service.empty()) {
+      // parity with ssf.randomly_sample: keep with probability rate,
+      // survivor's sample_rate becomes 1.0 * rate
+      double roll = static_cast<double>(xorshift64(&rng) >> 11) /
+                    static_cast<double>(1ULL << 53);
+      if (roll <= uniq_rate) {
+        thread_local std::vector<TagKV> utags;
+        utags.clear();
+        utags.push_back({"indicator", sp.indicator ? "true" : "false"});
+        utags.push_back(
+            {"root_span", sp.id == sp.trace_id ? "true" : "false"});
+        utags.push_back({"service", sp.service});
+        ssf_key(keybuf, "ssf.names_unique", 's',
+                static_cast<float>(uniq_rate), utags, 0);
+        auto uit = e->table.find(std::string_view(keybuf));
+        if (uit != e->table.end() && all_ascii(sp.name) &&
+            o.s_n < o.s_cap) {
+          int32_t idx, rho;
+          pos_val(hash_member(
+              reinterpret_cast<const uint8_t*>(sp.name.data()),
+              sp.name.size()), &idx, &rho);
+          o.s_rows[o.s_n] = uit->second.row;
+          o.s_idx[o.s_n] = idx;
+          o.s_rho[o.s_n] = rho;
+          o.s_n++;
+          o.samples++;
+        } else {
+          flags |= SSF_NEEDS_UNIQ;
+        }
+      }
+    }
+    pkt_flags[i] = flags;
+    decoded++;
+  }
+  *c_n = o.c_n;
+  *g_n = o.g_n;
+  *h_n = o.h_n;
+  *s_n = o.s_n;
+  *def_n = dn;
+  *samples_out = o.samples;
+  return decoded;
+}
+
+}  // extern "C"
 
 // ---- native load blaster (sendmmsg) ---------------------------------------
 //
