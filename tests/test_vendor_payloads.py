@@ -1,0 +1,154 @@
+"""Vendor wire-shape pins: the long-tail sinks' request bodies are
+validated against schemas transcribed from public vendor API docs
+(tests/testdata/vendor_schemas.json) — not against fakes shaped by the
+same author as the sink. Byte-fixture analog of the metricpb/SSF/HLL
+pins for the JSON vendors."""
+
+from __future__ import annotations
+
+import json
+import numbers
+import os
+
+import pytest
+
+from tests.test_sinks import CapturingHTTPServer, im, make_span
+from veneur_tpu.samplers.metrics import MetricType
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+SCHEMAS = json.load(open(os.path.join(HERE, "testdata",
+                                      "vendor_schemas.json")))
+
+
+def check(value, schema, path="$"):
+    """Minimal structural validator for the fixture format."""
+    if isinstance(schema, str):
+        kind = schema
+        if kind == "int":
+            assert isinstance(value, int) and not isinstance(value, bool), \
+                f"{path}: want int, got {value!r}"
+        elif kind == "num":
+            assert isinstance(value, numbers.Number) \
+                and not isinstance(value, bool), \
+                f"{path}: want number, got {value!r}"
+        elif kind == "str":
+            assert isinstance(value, str), f"{path}: want str, got {value!r}"
+        elif kind == "object":
+            assert isinstance(value, dict), f"{path}: want object"
+        elif kind == "map_str_str":
+            assert isinstance(value, dict), f"{path}: want object"
+            for k, v in value.items():
+                assert isinstance(k, str) and isinstance(v, str), \
+                    f"{path}.{k}: want str->str, got {v!r}"
+        elif kind == "map_str_num":
+            assert isinstance(value, dict), f"{path}: want object"
+            for k, v in value.items():
+                assert isinstance(k, str) and isinstance(v, numbers.Number), \
+                    f"{path}.{k}: want str->num, got {v!r}"
+        else:
+            raise AssertionError(f"unknown schema kind {kind}")
+        return
+    if "enum" in schema:
+        assert value in schema["enum"], \
+            f"{path}: {value!r} not in {schema['enum']}"
+        return
+    stype = schema["type"]
+    if stype == "array":
+        assert isinstance(value, list), f"{path}: want array"
+        assert len(value) >= schema.get("min_items", 0), f"{path}: empty"
+        for i, item in enumerate(value):
+            check(item, schema["items"], f"{path}[{i}]")
+    elif stype == "object":
+        assert isinstance(value, dict), f"{path}: want object, got {value!r}"
+        for key, sub in schema.get("required", {}).items():
+            assert key in value, f"{path}: missing required key {key!r}"
+            check(value[key], sub, f"{path}.{key}")
+        for key, sub in schema.get("optional", {}).items():
+            if key in value:
+                check(value[key], sub, f"{path}.{key}")
+    else:
+        raise AssertionError(f"unknown schema type {stype}")
+
+
+@pytest.fixture
+def fake():
+    server = CapturingHTTPServer()
+    yield server
+    server.close()
+
+
+class FakeStatsd:
+    def count(self, *a, **k):
+        pass
+
+    def gauge(self, *a, **k):
+        pass
+
+
+class FakeServer:
+    statsd = FakeStatsd()
+
+
+def test_datadog_apm_traces_shape(fake):
+    from veneur_tpu.sinks.datadog import DatadogSpanSink
+
+    sink = DatadogSpanSink("datadog", trace_api_url=fake.url,
+                           hostname="dh")
+    sink.start(FakeServer())
+    root = make_span(trace_id=9, span_id=9, name="root", service="api",
+                     tags={"resource": "GET /x"})
+    child = make_span(trace_id=9, span_id=10, parent_id=9, name="child",
+                      service="api", error=True)
+    sink.ingest(root)
+    sink.ingest(child)
+    sink.flush()
+    assert fake.event.wait(5)
+    _path, _headers, body = fake.requests[0]
+    payload = json.loads(body)
+    check(payload, SCHEMAS["datadog_apm"])
+    spans = [s for trace in payload for s in trace]
+    by_id = {s["span_id"]: s for s in spans}
+    # vendor semantics spot checks: ns timestamps, error code, resource
+    assert by_id[9]["parent_id"] == 0
+    assert by_id[9]["resource"] == "GET /x"
+    assert by_id[10]["error"] != 0
+    assert by_id[9]["start"] > 10 ** 17  # nanoseconds, not seconds
+    assert by_id[9]["duration"] > 0
+
+
+def test_newrelic_metrics_shape(fake):
+    from veneur_tpu.sinks.newrelic import NewRelicMetricSink
+
+    sink = NewRelicMetricSink(
+        "newrelic", insert_key="k", hostname="h1", interval=10.0,
+        metric_url=fake.url + "/metric/v1", tags=["env:test"])
+    sink.flush([
+        im("nr.count", 5, MetricType.COUNTER, tags=("a:b",)),
+        im("nr.gauge", 2.5, MetricType.GAUGE),
+    ])
+    assert fake.event.wait(5)
+    body = json.loads(fake.requests[0][2])
+    check(body, SCHEMAS["newrelic_metrics"])
+    metrics = body[0]["metrics"]
+    by_name = {mm["name"]: mm for mm in metrics}
+    # counters must be type=count with an interval.ms window
+    assert by_name["nr.count"]["type"] == "count"
+    assert by_name["nr.count"].get("interval.ms", 0) > 0
+    assert by_name["nr.gauge"]["type"] == "gauge"
+
+
+def test_newrelic_trace_shape(fake):
+    from veneur_tpu.sinks.newrelic import NewRelicSpanSink
+
+    sink = NewRelicSpanSink(
+        "newrelic", insert_key="k", trace_url=fake.url + "/trace/v1",
+        common_tags={"env": "test"})
+    sink.ingest(make_span(trace_id=7, span_id=8, name="op",
+                          service="svc"))
+    sink.flush()
+    assert fake.event.wait(5)
+    body = json.loads(fake.requests[0][2])
+    check(body, SCHEMAS["newrelic_trace"])
+    span = body[0]["spans"][0]
+    assert span["attributes"]["service.name"] == "svc"
+    assert span["attributes"]["duration.ms"] == pytest.approx(1000.0)

@@ -243,6 +243,9 @@ class DatadogSpanSink(SpanSink):
             "type": _DD_SPAN_TYPE,
             "error": 2 if s.error else 0,
             "meta": meta,
+            # numeric span tags; always present in the DD wire shape
+            # (reference DatadogTraceSpan.Metrics, datadog.go:434)
+            "metrics": {},
         }
 
     def flush(self) -> None:

@@ -1,11 +1,19 @@
 """LightStep sink: spans to a LightStep collector.
 
-Behavioral parity with reference sinks/lightstep/lightstep.go (264 LoC),
-which wraps the LightStep tracer. LightStep collectors accept the
-OpenTelemetry/LightStep JSON report shape over HTTPS; spans are reported
-with the access token, with load-balancing across `num_clients`
-round-robin (the reference stripes spans across multiple tracer clients
-keyed by trace id)."""
+Behavioral parity with reference sinks/lightstep/lightstep.go (264 LoC)
+for buffering, striping, and accounting: the reference wraps the
+official LightStep tracer library, which speaks the LightStep collector
+protocol (protobuf collector.proto over HTTPS/gRPC).
+
+COLLECTOR-SHAPE-UNVERIFIED: this rebuild posts a homegrown JSON report
+(span fields + access token) rather than the tracer library's wire
+protocol, and no fixture captured from a real LightStep collector
+validates it. Use it as a structural stand-in — buffering/striping/drop
+semantics match the reference — but verify the report shape against a
+live collector (or swap in an OTLP exporter, which current
+LightStep/ServiceNow collectors accept) before production use. The
+vendor-schema pins in tests/test_vendor_payloads.py deliberately do NOT
+cover this sink for that reason."""
 
 from __future__ import annotations
 
