@@ -353,6 +353,12 @@ def run_pipeline_mt(duration_s: float, num_keys: int, rig: UdpRig = None,
             sweep[label] = round(rate, 1)
             log(f"mixed: offered {off_rate:,.0f}/s -> processed "
                 f"{rate:,.0f} samples/s")
+            best_so_far = max(sweep.values())
+            if best_so_far and rate < 0.5 * best_so_far:
+                # past the knee: on a small host higher offered load only
+                # starves the pipeline; further rungs waste budget
+                log("mixed: past the knee; stopping ladder")
+                break
     finally:
         if own_rig:
             rig.close()

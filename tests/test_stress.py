@@ -314,3 +314,86 @@ class TestSetPromotionRaces:
                 emitted.add(metric.name)
         missing = sent_keys - emitted
         assert not missing, f"{len(missing)} set keys never emitted"
+
+
+class TestPumpConservation:
+    def test_udp_pump_conserves_received_samples_under_flush(self):
+        """The C++ pump path: native blaster -> kernel loopback -> pump
+        readers -> chunk dispatch, with a concurrent flush hammer.
+        Kernel-buffer UDP loss is legal; losing a sample AFTER it was
+        counted into store.processed is not — every counted counter
+        increment must appear in exactly one flush."""
+        import socket
+
+        from veneur_tpu import native
+
+        if not native.available():
+            pytest.skip(f"native unavailable: {native.unavailable_reason()}")
+        server, observer = make_server(
+            statsd_listen_addresses=["udp://127.0.0.1:0"])
+        # the server's own flush self-trace spans pass through metric
+        # extraction: the 1% span-uniqueness sampling would add
+        # ssf.names_unique samples to store.processed that this test's
+        # pump.stress.* filter can't see
+        server.metric_extraction._uniqueness_rate = 0.0
+        server.start()
+        flushed_total = [0.0]
+
+        def count_flushes():
+            for mm in observer.drain():
+                if mm.name.startswith("pump.stress."):
+                    flushed_total[0] += mm.value
+
+        try:
+            assert server._listeners[0].pump is not None
+            # intern the keys so the measured window is all-native
+            server.handle_packet_batch(
+                [b"\n".join(b"pump.stress.%d:1|c" % i
+                            for i in range(64))])
+            server.flush()
+            observer.drain()
+            base = server.store.processed
+
+            datagrams = [
+                b"\n".join(b"pump.stress.%d:1|c" % ((j + k) % 64)
+                           for k in range(20))
+                for j in range(64)]
+            blaster = native.Blaster(datagrams)
+            sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            sock.connect(server.local_addr("udp"))
+            sent = [0]
+
+            def send():
+                # paced: the point is racing flushes, not overload
+                sent[0] = blaster.run(sock.fileno(), burst=16,
+                                      pace_pps=3000)
+
+            sender = threading.Thread(target=send, daemon=True)
+            sender.start()
+            t0 = time.time()
+            while time.time() - t0 < DURATION_S:
+                server.flush()
+                count_flushes()
+                time.sleep(0.05)
+            blaster.stop()
+            sender.join(timeout=10)
+            # deterministic drain: close the listener (joins the pump
+            # readers) and join the dispatcher thread, so no chunk can
+            # land between the final flush and the processed read
+            listener = server._listeners[0]
+            listener.close()
+            for t in listener._threads:
+                t.join(timeout=30)
+                assert not t.is_alive(), "pump drain stuck"
+            server.flush()
+            count_flushes()
+            processed = server.store.processed - base
+            assert flushed_total[0] == processed, (
+                f"flushed {flushed_total[0]} != processed {processed} "
+                f"(sent {sent[0] * 20})")
+        finally:
+            try:
+                sock.close()
+            except Exception:
+                pass
+            server.shutdown()

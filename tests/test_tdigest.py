@@ -229,3 +229,57 @@ class TestBatchedDigest:
             assert float(out["quantiles"][0, j]) == pytest.approx(
                 want, abs=0.05), q
         assert float(out["count"][0]) == pytest.approx(len(data), rel=1e-3)
+
+
+class TestFusedExportFlush:
+    def test_fused_matches_legacy_compact_flush_export(self):
+        """flush_export_packed must produce the exact export grid the
+        compact->export path produces (same sort, same segment reduce)
+        and quantiles within the digest's own tolerance of the legacy
+        two-pass flush."""
+        import numpy as np
+
+        from veneur_tpu.ops import batch_tdigest as bt
+
+        rng = np.random.default_rng(5)
+        K, B = 257, 4096
+        ps = (0.25, 0.5, 0.9, 0.99)
+        state = bt.init_state(K)
+        for _ in range(3):
+            rows = rng.integers(0, K, B).astype(np.int32)
+            vals = rng.normal(50, 20, B).astype(np.float32)
+            wts = rng.choice([1.0, 2.0], B).astype(np.float32)
+            state = bt.apply_batch(state, rows, vals, wts)
+            state = bt.compact(state)
+        rows = rng.integers(0, K, B).astype(np.int32)
+        vals = rng.lognormal(1, 1, B).astype(np.float32)
+        wts = np.ones(B, np.float32)
+        state = bt.apply_batch(state, rows, vals, wts)  # staged, uncompacted
+
+        packed, export_packed = bt.flush_export_packed(state, ps)
+        fused_out = bt.unpack_flush(np.asarray(packed), len(ps))
+        f_means, f_w, f_min, f_max, f_recip = bt.unpack_export(
+            export_packed)
+
+        legacy = bt.compact(dict(state))
+        legacy_packed = bt.flush_quantiles_packed(
+            legacy, ps, fold_staging=False)
+        legacy_out = bt.unpack_flush(np.asarray(legacy_packed), len(ps))
+        l_means, l_w, l_min, l_max, l_recip = bt.export_centroids(legacy)
+
+        np.testing.assert_allclose(f_w, l_w, rtol=1e-6, atol=1e-4)
+        np.testing.assert_allclose(f_means, l_means, rtol=1e-5, atol=1e-3)
+        np.testing.assert_array_equal(f_min, l_min)
+        np.testing.assert_array_equal(f_max, l_max)
+        np.testing.assert_allclose(f_recip, l_recip, rtol=1e-6)
+        np.testing.assert_allclose(fused_out["count"], legacy_out["count"],
+                                   rtol=1e-5)
+        np.testing.assert_allclose(fused_out["sum"], legacy_out["sum"],
+                                   rtol=1e-4)
+        # quantiles: fused interpolates over the finer pre-merge grid;
+        # both must agree within the digest's own approximation band
+        q_f = fused_out["quantiles"]
+        q_l = legacy_out["quantiles"]
+        spread = np.maximum(legacy_out["max"] - legacy_out["min"], 1e-6)
+        rel = np.abs(q_f - q_l) / spread[:, None]
+        assert np.nanmax(rel) < 0.05, np.nanmax(rel)

@@ -579,11 +579,12 @@ class HistoTable(_BaseTable):
                 self._apply_cols(cols)
             ps = tuple(percentiles)
             if need_export:
-                # fold any staged batches so export sees the tight main grid
-                self.state = batch_tdigest.compact(self.state)
-                packed = batch_tdigest.flush_quantiles_packed(
-                    self.state, ps, fold_staging=False)
-                export = batch_tdigest.export_centroids(self.state)
+                # fused forwarding flush: one dispatch, one sort, and
+                # two device->host transfers (the packed flush and the
+                # packed export) instead of compact+flush+export
+                packed, export_packed = batch_tdigest.flush_export_packed(
+                    self.state, ps)
+                export = batch_tdigest.unpack_export(export_packed)
             else:
                 packed = batch_tdigest.flush_quantiles_packed(
                     self.state, ps, fold_staging=True)

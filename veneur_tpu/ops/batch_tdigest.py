@@ -251,13 +251,12 @@ def _segment_reduce_matmul(bucket, sw, swv):
     return new_w, new_wv
 
 
-def _recompress(cat_means, cat_weights, num_keys):
-    """Sort a (K, J) centroid set per row by mean and recompress to C
-    k-buckets with the contiguous-segment prefix reduce."""
-    sort_key = jnp.where(cat_weights > 0, cat_means, _INF)
-    _, sw, sm = jax.lax.sort(
-        (sort_key, cat_weights, cat_means), num_keys=1, dimension=-1)
-    cum = jnp.cumsum(sw, axis=-1)
+def _recompress_sorted(sm, sw, cum):
+    """Recompress per-row mean-SORTED centroids into C k-buckets with the
+    contiguous-segment prefix reduce. The single source of truth for the
+    recompress math: compact() (via _recompress) and the fused
+    forwarding flush both go through here, so their grids cannot
+    diverge."""
     tot = cum[:, -1:]
     q_mid = (cum - sw * 0.5) / jnp.maximum(tot, 1e-30)
     bucket = jnp.clip(
@@ -266,6 +265,16 @@ def _recompress(cat_means, cat_weights, num_keys):
     new_w = jnp.maximum(new_w, 0.0)  # guard cumsum-difference round-off
     new_m = jnp.where(new_w > 0, new_wv / jnp.maximum(new_w, 1e-30), 0.0)
     return new_m, new_w
+
+
+def _recompress(cat_means, cat_weights, num_keys):
+    """Sort a (K, J) centroid set per row by mean and recompress to C
+    k-buckets."""
+    sort_key = jnp.where(cat_weights > 0, cat_means, _INF)
+    _, sw, sm = jax.lax.sort(
+        (sort_key, cat_weights, cat_means), num_keys=1, dimension=-1)
+    cum = jnp.cumsum(sw, axis=-1)
+    return _recompress_sorted(sm, sw, cum)
 
 
 def apply_batch(state, rows, values, weights, slots=None):
@@ -411,20 +420,11 @@ def merge_centroid_rows(state, rows, in_means, in_weights, in_min, in_max,
     return state
 
 
-def _flush_quantiles_impl(state, percentiles: Sequence[float],
-                          fold_staging: bool):
-    if fold_staging:
-        means, weights = _fold_grids(state)
-    else:
-        weights = state["weights"]
-        means = jnp.where(
-            weights > 0, state["wv"] / jnp.maximum(weights, 1e-30), 0.0)
-    num_keys = means.shape[0]
-
-    sort_key = jnp.where(weights > 0, means, _INF)
-    _, sw, sm = jax.lax.sort(
-        (sort_key, weights, means), num_keys=1, dimension=-1)
-    cum = jnp.cumsum(sw, axis=-1)
+def _quantiles_from_sorted(sm, sw, cum, state, percentiles):
+    """Quantile interpolation over per-row mean-sorted centroids
+    (parity with merging_digest.go:302-332: uniform within centroid,
+    bounds at neighbor midpoints, min/max at the ends)."""
+    num_keys = sm.shape[0]
     tot = cum[:, -1]
     n = jnp.sum(sw > 0, axis=-1)
 
@@ -446,11 +446,14 @@ def _flush_quantiles_impl(state, percentiles: Sequence[float],
     lb_i, ub_i = g(lb), g(ub)
     proportion = (q_t - (cum_i - w_i)) / jnp.maximum(w_i, 1e-30)
     quant = lb_i + proportion * (ub_i - lb_i)
-    quant = jnp.where((n > 0)[:, None], quant, jnp.nan)
+    return jnp.where((n > 0)[:, None], quant, jnp.nan)
 
+
+def _flush_outputs(quant, sm, sw, cum, state):
+    dcount = cum[:, -1]
     dsum = jnp.sum(sm * sw, axis=-1)
-    dcount = tot
-    hmean = jnp.where(state["drecip"] != 0, dcount / state["drecip"], jnp.nan)
+    hmean = jnp.where(state["drecip"] != 0, dcount / state["drecip"],
+                      jnp.nan)
     return {
         "quantiles": quant,
         "count": dcount,
@@ -464,6 +467,23 @@ def _flush_quantiles_impl(state, percentiles: Sequence[float],
         "lweight": state["lweight"],
         "lrecip": state["lrecip"],
     }
+
+
+def _flush_quantiles_impl(state, percentiles: Sequence[float],
+                          fold_staging: bool):
+    if fold_staging:
+        means, weights = _fold_grids(state)
+    else:
+        weights = state["weights"]
+        means = jnp.where(
+            weights > 0, state["wv"] / jnp.maximum(weights, 1e-30), 0.0)
+
+    sort_key = jnp.where(weights > 0, means, _INF)
+    _, sw, sm = jax.lax.sort(
+        (sort_key, weights, means), num_keys=1, dimension=-1)
+    cum = jnp.cumsum(sw, axis=-1)
+    quant = _quantiles_from_sorted(sm, sw, cum, state, percentiles)
+    return _flush_outputs(quant, sm, sw, cum, state)
 
 
 @partial(jax.jit, static_argnums=(1, 2))
@@ -484,6 +504,11 @@ FLUSH_SCALARS = ("count", "sum", "min", "max", "hmean",
                  "lmin", "lmax", "lsum", "lweight", "lrecip")
 
 
+def _pack_flush(out):
+    cols = [out["quantiles"]] + [out[k][:, None] for k in FLUSH_SCALARS]
+    return jnp.concatenate(cols, axis=-1)
+
+
 @partial(jax.jit, static_argnums=(1, 2))
 def flush_quantiles_packed(state, percentiles: Sequence[float],
                            fold_staging: bool = True):
@@ -493,9 +518,8 @@ def flush_quantiles_packed(state, percentiles: Sequence[float],
     round-trip per array it pulls to host; packing the 11 outputs into a
     single device array makes the whole digest flush one transfer.
     Unpack host-side with unpack_flush."""
-    out = _flush_quantiles_impl(state, percentiles, fold_staging)
-    cols = [out["quantiles"]] + [out[k][:, None] for k in FLUSH_SCALARS]
-    return jnp.concatenate(cols, axis=-1)
+    return _pack_flush(_flush_quantiles_impl(state, percentiles,
+                                             fold_staging))
 
 
 def unpack_flush(packed, num_percentiles: int):
@@ -506,6 +530,44 @@ def unpack_flush(packed, num_percentiles: int):
     for i, k in enumerate(FLUSH_SCALARS):
         out[k] = packed[:, num_percentiles + i]
     return out
+
+
+@partial(jax.jit, static_argnums=(1,))
+def flush_export_packed(state, percentiles: Sequence[float]):
+    """The forwarding flush, fused: fold staging, sort ONCE, interpolate
+    quantiles from the sorted pre-merge centroids, and recompress the
+    same sorted arrays into the <= C export grid — replacing the
+    compact -> flush_quantiles_packed -> export_centroids sequence
+    (three dispatches, two sorts, six device->host transfers) with one
+    dispatch, one sort, and two transfers. Quantiles computed from the
+    pre-merge centroids are at least as tight an approximation as the
+    post-merge ones (finer grid, same invariant,
+    merging_digest.go:140-224).
+
+    Returns (flush_packed (K, P+10), export_packed (K, 2C+3):
+    [means | weights | dmin dmax drecip]); unpack with unpack_flush /
+    unpack_export."""
+    cat_m, cat_w = _fold_grids(state)  # (K, 2C)
+    sort_key = jnp.where(cat_w > 0, cat_m, _INF)
+    _, sw, sm = jax.lax.sort(
+        (sort_key, cat_w, cat_m), num_keys=1, dimension=-1)
+    cum = jnp.cumsum(sw, axis=-1)
+    quant = _quantiles_from_sorted(sm, sw, cum, state, percentiles)
+    flush_packed = _pack_flush(_flush_outputs(quant, sm, sw, cum, state))
+    new_m, new_w = _recompress_sorted(sm, sw, cum)
+    export_packed = jnp.concatenate(
+        [new_m, new_w, state["dmin"][:, None], state["dmax"][:, None],
+         state["drecip"][:, None]], axis=-1)
+    return flush_packed, export_packed
+
+
+def unpack_export(export_packed):
+    """Host-side inverse of flush_export_packed's export half: one
+    np.asarray transfer, then views shaped like export_centroids'
+    (means, weights, dmin, dmax, drecip)."""
+    packed = np.asarray(export_packed)
+    return (packed[:, :C], packed[:, C:2 * C], packed[:, 2 * C],
+            packed[:, 2 * C + 1], packed[:, 2 * C + 2])
 
 
 def pack_centroids(means, weights, cap: int = C):
