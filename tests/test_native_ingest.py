@@ -352,6 +352,24 @@ class TestSsfNative:
         assert not res.deferred
         assert res.samples > 0
 
+    def test_name_tag_normalization_parity(self):
+        """ParseSSF fills an empty span name from tags["name"]
+        (wire.go ParseSSF); the native decoder must agree, since the
+        span name feeds valid_trace and the uniqueness set member."""
+        from veneur_tpu import ssf
+        packets = []
+        for i in range(20):
+            span = ssf.SSFSpan(
+                id=i + 1, trace_id=i + 1, service="tagged-svc",
+                start_timestamp=10, end_timestamp=20)
+            span.tags["name"] = f"tag-op{i % 3}"  # no span.name set
+            span.metrics.append(ssf.count(f"nt.c{i % 3}", 1))
+            packets.append(span.SerializeToString())
+        nat_rows, nat_stats, _ = self._run(packets, False)
+        py_rows, py_stats, _ = self._run(packets, True)
+        assert nat_rows == py_rows
+        assert nat_stats == py_stats
+
     def test_indicator_timers_via_batch(self):
         from veneur_tpu import ssf
         cfg = Config()

@@ -1051,6 +1051,9 @@ struct SsfSpanView {
 inline bool parse_ssf_span(const uint8_t* data, int64_t len,
                            SsfSpanView* sp) {
   PB b{data, data + len};
+  // tags["name"] fills an empty span name (parse_ssf normalization,
+  // wire.go ParseSSF); local so no cross-packet reset is needed
+  std::string_view name_tag;
   while (b.ok && b.p < b.end) {
     uint64_t tag = b.varint();
     if (!b.ok) break;
@@ -1071,11 +1074,20 @@ inline bool parse_ssf_span(const uint8_t* data, int64_t len,
               else b.skip(wire); break;
       case 10: if (wire == 2) sp->samples.push_back(b.bytes());
                else b.skip(wire); break;
+      case 11: if (wire == 2) {
+                 TagKV kv;
+                 if (!parse_map_entry(b.bytes(), &kv)) return false;
+                 if (kv.k == "name") name_tag = kv.v;
+               } else b.skip(wire);
+               break;
       case 12: if (wire == 0) sp->indicator = b.varint() != 0;
                else b.skip(wire); break;
       case 13: if (wire == 2) sp->name = b.bytes(); else b.skip(wire); break;
       default: b.skip(wire); break;
     }
+  }
+  if (b.ok && sp->name.empty() && !name_tag.empty()) {
+    sp->name = name_tag;  // ParseSSF normalization parity
   }
   return b.ok;
 }
