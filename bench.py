@@ -658,6 +658,8 @@ def run_scenario_ssf(duration_s: float, num_keys: int = 10_000):
     while time.perf_counter() - t0 < duration_s:
         server.handle_ssf_buffer(joined, offs, lens)
         sent += len(spans)
+    elapsed = time.perf_counter() - t0  # before the settle wait: idle
+    # tail time would deflate the rate
     server.store.apply_all_pending()
     # native extraction counts processed synchronously in this thread;
     # the non-native fallback extracts in span workers, so wait for the
@@ -670,13 +672,14 @@ def run_scenario_ssf(duration_s: float, num_keys: int = 10_000):
             break
         last = cur
         time.sleep(0.15)
-    elapsed = time.perf_counter() - t0
     # extraction throughput is what aggregates; span-SINK delivery is
     # best-effort by design (bounded isolation queues, drops counted)
     extracted = server.store.processed - p0
+    sink_drops = (server.spans_dropped - d0
+                  + sum(w.dropped for w in server._span_sink_workers))
     log(f"ssf: {sent / elapsed:,.0f} spans/s ingested, "
         f"{extracted / elapsed:,.0f} samples/s extracted, "
-        f"{server.spans_dropped - d0} sink-plane drops")
+        f"{sink_drops} sink-plane drops")
     server.flush()
     server.shutdown()
     return extracted / elapsed

@@ -132,6 +132,64 @@ class TestServerChurnBounded:
         # the full history DID pass through (waves x per_wave keys)
         assert t._generation >= waves
 
+    def test_evicted_key_returns_through_the_pump(self):
+        """The full lifecycle over real UDP: a key interned via the pump
+        slow path is evicted (native mapping erased, row recycled), then
+        returns — it must re-intern cleanly and aggregate correctly,
+        and the engine must shrink at eviction."""
+        import socket
+        import time
+
+        cfg = Config()
+        cfg.interval = 10.0
+        cfg.tpu.idle_key_intervals = 1
+        cfg.statsd_listen_addresses = ["udp://127.0.0.1:0"]
+        cfg.apply_defaults()
+        ch = ChannelMetricSink()
+        server = Server(cfg, extra_metric_sinks=[ch])
+        if server._ingester is None:
+            pytest.skip("native unavailable")
+        server.start()
+        try:
+            addr = server.local_addr("udp")
+            sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+
+            def send_and_wait(count, want_processed):
+                for _ in range(count):
+                    sock.sendto(b"cycle.key:2|c", addr)
+                deadline = time.time() + 10
+                while (server.store.processed < want_processed
+                       and time.time() < deadline):
+                    time.sleep(0.05)
+
+            send_and_wait(10, 10)
+            server.flush()
+            got = {m.name: m.value for m in ch.wait_flush(timeout=5)}
+            assert got["cycle.key"] == 20.0
+            engine_size = server._ingester.interned_keys
+            assert engine_size >= 1
+            # idle flushes: tombstone (engine erase) then recycle
+            server.flush()
+            server.flush()
+            assert server._ingester.interned_keys < engine_size
+            assert server.store.counters._free_rows  # recycled, not just
+            # tombstoned (dict entries empty either way)
+            # the key returns: slow path re-interns and re-registers
+            send_and_wait(5, 15)
+            server.flush()
+            got = {}
+            for m in ch.wait_flush(timeout=5):
+                got[m.name] = m.value
+            assert got["cycle.key"] == 10.0
+            # and it is native again (registered in the engine)
+            assert server._ingester.interned_keys >= 1
+        finally:
+            try:
+                sock.close()
+            except Exception:
+                pass
+            server.shutdown()
+
     def test_recycled_rows_emit_correct_values(self):
         """Row recycling must never cross-credit: a new key taking a
         recycled row id emits under its own name with its own value."""
