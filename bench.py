@@ -653,6 +653,7 @@ def run_scenario_ssf(duration_s: float, num_keys: int = 10_000):
     server.flush()
     p0 = server.store.processed
     d0 = server.spans_dropped
+    w0 = sum(w.dropped for w in server._span_sink_workers)
     t0 = time.perf_counter()
     sent = 0
     while time.perf_counter() - t0 < duration_s:
@@ -676,7 +677,7 @@ def run_scenario_ssf(duration_s: float, num_keys: int = 10_000):
     # best-effort by design (bounded isolation queues, drops counted)
     extracted = server.store.processed - p0
     sink_drops = (server.spans_dropped - d0
-                  + sum(w.dropped for w in server._span_sink_workers))
+                  + sum(w.dropped for w in server._span_sink_workers) - w0)
     log(f"ssf: {sent / elapsed:,.0f} spans/s ingested, "
         f"{extracted / elapsed:,.0f} samples/s extracted, "
         f"{sink_drops} sink-plane drops")

@@ -132,3 +132,40 @@ class TestShardedServerEquivalence:
         assert int(stouched.sum()) == 40
         np.testing.assert_allclose(est[stouched[: est.shape[0]]], 1.0,
                                    rtol=1e-2)
+
+
+class TestShardedExport:
+    def test_sharded_export_matches_single_device(self):
+        """The forwarding export (fused flush) across shards must carry
+        the same digest mass as single-device: identical per-row weight
+        totals, weighted means, and min/max."""
+        store1 = ColumnStore(histo_capacity=128, batch_cap=64)
+        store8 = ColumnStore(histo_capacity=128, batch_cap=64,
+                             shard_devices=8)
+        from veneur_tpu.samplers.parser import Parser
+        parser = Parser()
+        rng = np.random.default_rng(17)
+        for i in range(600):
+            pkt = b"sh.exp.t%d:%.3f|ms" % (i % 29, rng.normal(100, 15))
+            parser.parse_metric_fast(pkt, store1.process)
+            parser.parse_metric_fast(pkt, store8.process)
+        store1.apply_all_pending()
+        store8.apply_all_pending()
+        out1, exp1, touched1, _ = store1.histos.snapshot_and_reset(
+            (0.5,), need_export=True)
+        out8, exp8, touched8, _ = store8.histos.snapshot_and_reset(
+            (0.5,), need_export=True)
+        np.testing.assert_array_equal(touched1, touched8)
+        m1, w1, min1, max1, r1 = exp1
+        m8, w8, min8, max8, r8 = exp8
+        rows = np.flatnonzero(touched1)
+        # digest mass and moments are conserved exactly; centroid
+        # placement may differ (shards reorder batch boundaries)
+        np.testing.assert_allclose(w8[rows].sum(axis=-1),
+                                   w1[rows].sum(axis=-1), rtol=1e-5)
+        np.testing.assert_allclose(
+            (m8[rows] * w8[rows]).sum(axis=-1),
+            (m1[rows] * w1[rows]).sum(axis=-1), rtol=1e-4)
+        np.testing.assert_allclose(min8[rows], min1[rows], rtol=1e-6)
+        np.testing.assert_allclose(max8[rows], max1[rows], rtol=1e-6)
+        np.testing.assert_allclose(r8[rows], r1[rows], rtol=1e-5)

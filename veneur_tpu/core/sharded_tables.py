@@ -209,12 +209,18 @@ class ShardedHistoTable(HistoTable):
                 self._apply_cols(cols)
             merged = self._merged_state()
             ps = tuple(percentiles)
-            # the stacked merge already folded every shard's staging
-            packed = batch_tdigest.flush_quantiles_packed(
-                merged, ps, fold_staging=False)
+            if need_export:
+                # fused flush+export: one dispatch, two transfers (the
+                # merged state's staging is already folded, so the fold
+                # inside the fused op is a no-op concat of zeros)
+                packed, export_packed = batch_tdigest.flush_export_packed(
+                    merged, ps)
+                export = batch_tdigest.unpack_export(export_packed)
+            else:
+                packed = batch_tdigest.flush_quantiles_packed(
+                    merged, ps, fold_staging=False)
+                export = None
             out = batch_tdigest.unpack_flush(packed, len(ps))
-            export = (batch_tdigest.export_centroids(merged)
-                      if need_export else None)
             self.states = [
                 jax.device_put(batch_tdigest.init_state(self.capacity), d)
                 for d in self._devices]
