@@ -356,9 +356,12 @@ def run_pipeline_mt(duration_s: float, num_keys: int, rig: UdpRig = None,
             log(f"mixed: offered {off_rate:,.0f}/s -> processed "
                 f"{rate:,.0f} samples/s")
             best_so_far = max(sweep.values())
-            if best_so_far and rate < 0.5 * best_so_far:
+            if best_so_far and 0 < rate < 0.5 * best_so_far:
                 # past the knee: on a small host higher offered load only
-                # starves the pipeline; further rungs waste budget
+                # starves the pipeline; further rungs waste budget. A
+                # ZERO rung is a measurement artifact (one long
+                # synchronous apply swallowed the window), not a knee —
+                # keep climbing in that case.
                 log("mixed: past the knee; stopping ladder")
                 break
         # the headline/knee comes from the single-sender ladder only:
@@ -606,6 +609,9 @@ def run_scenario_forward(duration_s: float, num_keys: int = 50_000):
     datagrams = make_datagrams(packets)
     local.handle_packet_batch(datagrams)
     local.store.apply_all_pending()
+    # warmup flush: compiles the fused flush+export kernel outside the
+    # timed window (a cold TPU compile would eat the whole budget)
+    local.flush()
     t0 = time.perf_counter()
     rounds = 0
     while time.perf_counter() - t0 < duration_s:

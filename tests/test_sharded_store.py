@@ -142,6 +142,9 @@ class TestShardedExport:
         store1 = ColumnStore(histo_capacity=128, batch_cap=64)
         store8 = ColumnStore(histo_capacity=128, batch_cap=64,
                              shard_devices=8)
+        from veneur_tpu.core.sharded_tables import ShardedHistoTable
+        assert isinstance(store8.histos, ShardedHistoTable), \
+            "sharded path not taken (virtual mesh unavailable?)"
         from veneur_tpu.samplers.parser import Parser
         parser = Parser()
         rng = np.random.default_rng(17)
