@@ -38,6 +38,73 @@ def ready_pid(port: int):
     return None
 
 
+class TestInstallContract:
+    """restart.install's explicit (shutdown, http_address) contract —
+    the seam both CLIs (server and proxy) depend on."""
+
+    def test_ready_handoff_calls_shutdown(self, monkeypatch):
+        from veneur_tpu.core import restart
+
+        calls = []
+
+        class FakeChild:
+            pid = 4242
+
+            def poll(self):
+                return None
+
+        monkeypatch.setattr(restart.subprocess, "Popen",
+                            lambda cmd: FakeChild())
+        monkeypatch.setattr(restart, "_wait_ready",
+                            lambda addr, child, timeout=0: (
+                                calls.append(("ready", addr)) or True))
+        restart._restart(lambda: calls.append(("shutdown",)),
+                         "127.0.0.1:9999", ["prog"])
+        assert ("ready", "127.0.0.1:9999") in calls
+        assert ("shutdown",) in calls
+
+    def test_unready_replacement_keeps_the_old_process(self, monkeypatch):
+        from veneur_tpu.core import restart
+
+        calls = []
+
+        class FakeChild:
+            pid = 4242
+            returncode = 1
+
+            def poll(self):
+                return 1  # replacement died
+
+        monkeypatch.setattr(restart.subprocess, "Popen",
+                            lambda cmd: FakeChild())
+        restart._restart(lambda: calls.append("shutdown"),
+                        "127.0.0.1:9999", ["prog"])
+        assert calls == []  # old process keeps serving
+
+    def test_no_http_degrades_to_grace_with_warning(self, monkeypatch,
+                                                    caplog):
+        import logging
+
+        from veneur_tpu.core import restart
+
+        monkeypatch.setattr(restart, "NO_HTTP_GRACE_S", 0.01)
+        with caplog.at_level(logging.WARNING, "veneur_tpu.restart"):
+            restart.install(lambda: None, "")
+        assert any("WITHOUT a readiness endpoint" in r.message
+                   for r in caplog.records)
+
+        class DeadChild:
+            def poll(self):
+                return 1
+
+        class LiveChild:
+            def poll(self):
+                return None
+
+        assert restart._wait_ready("", DeadChild()) is False
+        assert restart._wait_ready("", LiveChild()) is True
+
+
 @pytest.mark.skipif(not hasattr(socket, "SO_REUSEPORT"),
                     reason="needs SO_REUSEPORT")
 def test_sigusr2_hands_off_without_dropping_the_listener(tmp_path):

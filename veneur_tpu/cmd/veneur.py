@@ -88,7 +88,7 @@ def main(argv=None) -> int:
     # SIGUSR2: zero-gap graceful restart via SO_REUSEPORT handoff (the
     # einhorn equivalent, reference server.go:1404, README.md:170-178)
     from veneur_tpu.core import restart
-    restart.install(server)
+    restart.install(server.shutdown, cfg.http_address)
     # exit on signal OR on internally-triggered shutdown (/quitquitquit)
     while not stop.is_set() and not server.shutdown_complete.is_set():
         stop.wait(0.2)

@@ -126,6 +126,17 @@ def main(argv=None) -> int:
 
     signal.signal(signal.SIGINT, handle_signal)
     signal.signal(signal.SIGTERM, handle_signal)
+
+    # SIGUSR2 graceful restart (the reference ran the proxy under
+    # einhorn too): gRPC servers bind with SO_REUSEPORT by default and
+    # the HTTP API sets it explicitly, so the replacement overlap-binds;
+    # shutdown here just unblocks the main loop, which stops the proxy
+    # after the replacement is ready. Zero-gap needs http_address (the
+    # readiness endpoint); without it restart.py warns and uses a
+    # blind grace.
+    from veneur_tpu.core import restart
+    restart.install(stop.set, http_addr or "")
+
     stop.wait()
     proxy.stop()
     if http_api is not None:

@@ -37,9 +37,22 @@ NO_HTTP_GRACE_S = 3.0
 _in_progress = threading.Lock()
 
 
-def install(server, argv=None) -> None:
+def install(shutdown, http_address: str = "", argv=None) -> None:
     """Handle SIGUSR2 with a spawn-replacement-then-drain handoff.
-    Must be called from the main thread (signal module contract)."""
+
+    Explicit contract (no server duck-typing): `shutdown` is called once
+    the replacement is ready; `http_address` is the readiness endpoint
+    the replacement will serve. Without an http_address the handoff
+    degrades to a blind grace period — the replacement is only checked
+    for being alive, so the zero-gap guarantee does NOT hold; a warning
+    says so at install time. Must be called from the main thread
+    (signal module contract)."""
+    if not http_address:
+        logger.warning(
+            "graceful restart installed WITHOUT a readiness endpoint: "
+            "SIGUSR2 will use a blind %.0fs grace instead of waiting "
+            "for /healthcheck/ready — configure http_address for a "
+            "zero-gap handoff", NO_HTTP_GRACE_S)
 
     def handler(signum, frame):
         if not _in_progress.acquire(blocking=False):
@@ -48,7 +61,7 @@ def install(server, argv=None) -> None:
 
         def run():
             try:
-                _restart(server, argv)
+                _restart(shutdown, http_address, argv)
             finally:
                 _in_progress.release()
 
@@ -73,7 +86,7 @@ def respawn_argv(argv=None):
     return [sys.executable] + argv
 
 
-def _restart(server, argv) -> None:
+def _restart(shutdown, http_address: str, argv) -> None:
     cmd = respawn_argv(argv)
     logger.info("SIGUSR2: spawning replacement process: %s", cmd)
     try:
@@ -81,7 +94,7 @@ def _restart(server, argv) -> None:
     except Exception:
         logger.exception("replacement spawn failed; keeping this process")
         return
-    if not _wait_ready(server, child):
+    if not _wait_ready(http_address, child):
         if child.poll() is None:
             logger.error("replacement not ready after %.0fs; keeping "
                          "this process (replacement left running)",
@@ -92,11 +105,10 @@ def _restart(server, argv) -> None:
         return
     logger.info("replacement ready (pid %d); draining and exiting",
                 child.pid)
-    server.shutdown()
+    shutdown()
 
 
-def _wait_ready(server, child, timeout: float = READY_TIMEOUT_S) -> bool:
-    addr = server.config.http_address
+def _wait_ready(addr: str, child, timeout: float = READY_TIMEOUT_S) -> bool:
     if not addr:
         # no readiness endpoint: a short grace period, then hand off if
         # the replacement is still alive
