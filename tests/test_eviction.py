@@ -83,6 +83,28 @@ class TestTableReclaim:
         cycle(t, idle=1)
         assert t.meta[0] is None      # quiet interval -> recycled
 
+    def test_straggler_between_snapshot_and_reclaim(self):
+        # The narrower window: the straggler chunk lands AFTER the
+        # flush's snapshot_and_reset but BEFORE reclaim_idle. touched is
+        # set but _last_touched won't be stamped until the NEXT
+        # snapshot, so recycle must key off the live touched flag too —
+        # otherwise the row is freed while its value sits in the new
+        # pending buffer (lost metric, or mis-credit after re-intern).
+        t = CounterTable(64)
+        t.add(mk_metric("s"))
+        cycle(t, idle=1)
+        assert cycle(t, idle=1) == [0]        # tombstoned
+        t.snapshot_and_reset()                # quiet interval's snapshot
+        t.add_batch(*_coo([0], [5.0]))        # straggler in the gap
+        assert t.reclaim_idle(1) == []
+        assert t.meta[0] is not None          # NOT recycled
+        assert not t._free_rows
+        vals, touched, meta = t.snapshot_and_reset()
+        assert touched[0] and vals[0] == 5.0  # emitted next flush
+        cycle(t, idle=1)                      # re-armed: waits one more
+        cycle(t, idle=1)
+        assert t.meta[0] is None              # quiet -> recycled
+
     def test_cardinality_cap_drops_and_counts(self):
         t = CounterTable(64, max_rows=4)
         for i in range(10):

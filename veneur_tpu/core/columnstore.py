@@ -59,6 +59,10 @@ class RowMeta:
     # flusher's hot loop renders each name once per key lifetime instead
     # of once per flush
     flush_names: dict = None
+    # per-row cache of the metricpb wire prefix/suffix (serialized
+    # fields 1-3 and field 9) used by the native forward encoder —
+    # identity-only, so it too lives for the row's lifetime
+    pb_frame: tuple = None
 
 
 class _BaseTable:
@@ -218,8 +222,13 @@ class _BaseTable:
                 return []
             last = self._last_touched[:n]
             tomb = self._tombstone_gen[:n]
-            # phase 2
-            rearm = (tomb >= 0) & (last > tomb)
+            # phase 2. A currently-set touched flag counts as activity
+            # even though _last_touched is only stamped at snapshot time:
+            # a straggler chunk landing between snapshot_and_reset and
+            # this call has touched[row]=True and its value in the NEW
+            # pending buffer — recycling now would orphan that value (or
+            # credit it to whatever key re-interns the row).
+            rearm = (tomb >= 0) & ((last > tomb) | self.touched[:n])
             if rearm.any():
                 tomb[rearm] = gen
             recycle = (tomb >= 0) & (gen > tomb) & (last <= tomb)

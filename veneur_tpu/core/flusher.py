@@ -78,6 +78,8 @@ def flush_columnstore(
         vlist = np.asarray(vals, np.float64)[rows].tolist()
         for i, row in enumerate(rows.tolist()):
             meta = meta_list[row]
+            if meta is None:  # recycled mid-interval (reclaim straggler)
+                continue
             if meta.scope == MetricScope.GLOBAL_ONLY and is_local:
                 if collect_forward:
                     fwd_list.append((meta, vlist[i]))
@@ -120,6 +122,8 @@ def flush_columnstore(
 
     for i, row in enumerate(h_rows.tolist()):
         meta = h_meta[row]
+        if meta is None:  # recycled mid-interval (reclaim straggler)
+            continue
         scope = meta.scope
         if scope == MetricScope.MIXED:
             ps, agg_bits, use_global = server_ps, server_agg_bits, False
@@ -145,6 +149,8 @@ def flush_columnstore(
     e_list = np.asarray(estimates, np.float64)[s_rows].tolist()
     for i, row in enumerate(s_rows.tolist()):
         meta = s_meta[row]
+        if meta is None:  # recycled mid-interval (reclaim straggler)
+            continue
         if meta.scope == MetricScope.LOCAL_ONLY:
             final.append(InterMetric(
                 name=meta.name, timestamp=now, value=e_list[i],
@@ -162,6 +168,8 @@ def flush_columnstore(
     st_vals, st_touched, st_meta = store.statuses.snapshot_and_reset()
     for row in np.flatnonzero(st_touched).tolist():
         meta = st_meta[row]
+        if meta is None:  # recycled mid-interval (reclaim straggler)
+            continue
         entry = st_vals[row]
         final.append(InterMetric(
             name=meta.name, timestamp=now, value=entry.value,

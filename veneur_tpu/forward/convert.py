@@ -75,6 +75,136 @@ def forwardable_to_protos(fwd: ForwardableState) -> List[metric_pb2.Metric]:
     return out
 
 
+def _pb_frame(meta) -> Tuple[bytes, bytes]:
+    """Per-row metricpb wire frame: (serialized fields 1-3, serialized
+    field 9). Cached on the meta — row identity never changes, so the
+    name/tags/type/scope bytes are paid once per key lifetime, not once
+    per flush."""
+    frame = meta.pb_frame
+    if frame is None:
+        mtype = (metric_pb2.Timer if meta.wire_type == m.TIMER
+                 else metric_pb2.Histogram)
+        head = metric_pb2.Metric(
+            name=meta.name, tags=list(meta.tags),
+            type=mtype).SerializeToString()
+        tail = metric_pb2.Metric(
+            scope=_SCOPE_TO_PB[meta.scope]).SerializeToString()
+        frame = meta.pb_frame = (head, tail)
+    return frame
+
+
+def _histograms_to_wire(histograms) -> List[bytes]:
+    """Native bulk serialization of the digest rows: the per-centroid
+    Python proto loop was the forward plane's wall (883 keys/s and blown
+    flush intervals at 10k keys, BENCH_r04). Emits bytes identical to
+    forwardable_to_protos + SerializeToString (pinned by
+    tests/test_forward_wire.py); returns None if the native encoder
+    can't take this batch (caller falls back to protos)."""
+    from veneur_tpu import native
+    lib = native.load()
+    if lib is None:
+        return None
+    # the byte-identity contract is calibrated against upb's BITWISE
+    # implicit-presence rule (-0.0 is emitted); the pure-Python backend
+    # compares by value and would omit it, so fall back there
+    from google.protobuf.internal import api_implementation
+    if api_implementation.Type() != "upb":
+        return None
+    K = len(histograms)
+    means0 = histograms[0][1]
+    C = means0.shape[0]
+    import ctypes
+    f32 = np.dtype(np.float32)
+    means = np.empty((K, C), np.float32)
+    weights = np.empty((K, C), np.float32)
+    mins = np.empty(K, np.float64)
+    maxs = np.empty(K, np.float64)
+    recips = np.empty(K, np.float64)
+    heads: List[bytes] = []
+    tails: List[bytes] = []
+    for k, (meta, mrow, wrow, dmin, dmax, drecip) in enumerate(histograms):
+        # byte-identity contract: refuse (-> proto fallback) anything the
+        # silent f32 cast below could round, instead of emitting bytes
+        # that diverge from forwardable_to_protos
+        if (mrow.dtype != f32 or wrow.dtype != f32
+                or mrow.shape != (C,) or wrow.shape != (C,)):
+            return None
+        means[k] = mrow
+        weights[k] = wrow
+        mins[k] = dmin
+        maxs[k] = dmax
+        recips[k] = drecip
+        head, tail = _pb_frame(meta)
+        heads.append(head)
+        tails.append(tail)
+    f32p = ctypes.POINTER(ctypes.c_float)
+    f64p = ctypes.POINTER(ctypes.c_double)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+
+    def _p(arr, ct):
+        return arr.ctypes.data_as(ct)
+
+    nnz = int(np.count_nonzero(weights > 0))
+    dig_cap = nnz * 20 + K * 36 + 64
+    dig_buf = np.empty(dig_cap, np.uint8)
+    dig_offs = np.empty(K + 1, np.int64)
+    dig_total = lib.vnt_digest_encode(
+        _p(means, f32p), _p(weights, f32p), K, C, _p(mins, f64p),
+        _p(maxs, f64p), _p(recips, f64p), float(COMPRESSION),
+        _p(dig_buf, u8p), dig_cap, _p(dig_offs, i64p))
+    if dig_total < 0:
+        return None
+
+    head_buf = b"".join(heads)
+    tail_buf = b"".join(tails)
+    head_offs = np.zeros(K + 1, np.int64)
+    np.cumsum([len(h) for h in heads], out=head_offs[1:])
+    tail_offs = np.zeros(K + 1, np.int64)
+    np.cumsum([len(t) for t in tails], out=tail_offs[1:])
+    out_cap = dig_total + len(head_buf) + len(tail_buf) + K * 16
+    out_buf = np.empty(out_cap, np.uint8)
+    out_offs = np.empty(K + 1, np.int64)
+    head_arr = np.frombuffer(head_buf, np.uint8)
+    tail_arr = np.frombuffer(tail_buf, np.uint8)
+    total = lib.vnt_metric_wrap(
+        _p(dig_buf, u8p), _p(dig_offs, i64p),
+        _p(head_arr, u8p) if head_buf else _p(dig_buf, u8p),
+        _p(head_offs, i64p),
+        _p(tail_arr, u8p) if tail_buf else _p(dig_buf, u8p),
+        _p(tail_offs, i64p), K, _p(out_buf, u8p), out_cap,
+        _p(out_offs, i64p))
+    if total < 0:
+        return None
+    mv = memoryview(out_buf)
+    offs = out_offs.tolist()
+    return [bytes(mv[offs[k]:offs[k + 1]]) for k in range(K)]
+
+
+def forwardable_to_wire(fwd: ForwardableState) -> List[bytes]:
+    """Serialize a flush's forwardable snapshot straight to metricpb wire
+    bytes (one entry per Metric) — what the reference gets for free from
+    compiled Go (flusher.go:578-591). Byte-identical to
+    forwardable_to_protos + SerializeToString."""
+    out: List[bytes] = []
+    if fwd.counters or fwd.gauges:
+        slim = ForwardableState(counters=fwd.counters, gauges=fwd.gauges)
+        out.extend(p.SerializeToString()
+                   for p in forwardable_to_protos(slim))
+    if fwd.histograms:
+        wired = _histograms_to_wire(fwd.histograms)
+        if wired is None:  # no native lib / odd dtype: proto fallback
+            slim = ForwardableState(histograms=fwd.histograms)
+            wired = [p.SerializeToString()
+                     for p in forwardable_to_protos(slim)]
+        out.extend(wired)
+    if fwd.sets:
+        slim = ForwardableState(sets=fwd.sets)
+        out.extend(p.SerializeToString()
+                   for p in forwardable_to_protos(slim))
+    return out
+
+
 def metric_key_of_proto(pbm: metric_pb2.Metric,
                         ignored_tags: Iterable = ()) -> Tuple[MetricKey, int, int, list]:
     """Build the (key, digest32, digest64, tags) identity for an imported

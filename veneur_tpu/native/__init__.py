@@ -151,6 +151,15 @@ def _declare(lib) -> None:
         ctypes.c_int32, ctypes.c_double, ctypes.c_uint64,
         i64p,                                 # samples extracted
     ]
+    lib.vnt_digest_encode.restype = i64
+    lib.vnt_digest_encode.argtypes = [
+        f32p, f32p, i64, i64,
+        ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double),
+        ctypes.POINTER(ctypes.c_double), ctypes.c_double,
+        u8p, i64, i64p]
+    lib.vnt_metric_wrap.restype = i64
+    lib.vnt_metric_wrap.argtypes = [
+        u8p, i64p, u8p, i64p, u8p, i64p, i64, u8p, i64, i64p]
     lib.vnt_blast_new.restype = ctypes.c_void_p
     lib.vnt_blast_new.argtypes = [ctypes.c_void_p, i64, i64p, i64p, i64]
     lib.vnt_blast_free.restype = None
