@@ -31,6 +31,13 @@ def make_config(**overrides) -> Config:
     return cfg.apply_defaults()
 
 
+def _mk_meta(name):
+    from veneur_tpu.core.columnstore import RowMeta
+    from veneur_tpu.samplers.metrics import MetricScope
+    return RowMeta(name=name, tags=[], joined_tags="", digest32=1,
+                   scope=MetricScope.GLOBAL_ONLY, wire_type="counter")
+
+
 def wait_until(fn, timeout=10.0, step=0.05):
     deadline = time.time() + timeout
     while time.time() < deadline:
@@ -78,6 +85,58 @@ class TestForwardClient:
             server.shutdown()
         finally:
             ft.stop()
+
+    def test_v1_fallback_to_v2_stream(self):
+        """A V2-only importer (the reference contract,
+        sources/proxy/server.go:138-142) answers the bulk V1 call with
+        UNIMPLEMENTED; the client must pin to V2 and deliver the SAME
+        flush, not drop it."""
+        from veneur_tpu.core.flusher import ForwardableState
+        from veneur_tpu.forward.client import ForwardClient
+
+        received = []
+        ft = ForwardTestServer(received.extend)  # implements only V2
+        ft.start()
+        try:
+            client = ForwardClient(ft.address, deadline=10.0)
+            assert client._v1_ok is True
+            fwd = ForwardableState()
+            meta = _mk_meta("fb.count")
+            fwd.counters.append((meta, 4.0))
+            assert client.forward(fwd) == 1
+            assert client._v1_ok is False      # pinned after refusal
+            assert client.forward(fwd) == 1    # subsequent direct V2
+            assert wait_until(lambda: len(received) == 2)
+            assert received[0].counter.value == 4
+            assert not any(v for k, v in client.stats.items()
+                           if k.startswith("errors"))
+            client.close()
+        finally:
+            ft.stop()
+
+    def test_v1_bulk_path_against_import_server(self):
+        """Against this framework's importer the first V1 call sticks
+        (one unary MetricList instead of 50k stream messages)."""
+        from veneur_tpu.core.flusher import ForwardableState
+        from veneur_tpu.forward.client import ForwardClient
+        from veneur_tpu.forward.server import ImportServer
+
+        gcfg = make_config()
+        gserver = Server(gcfg, extra_metric_sinks=[ChannelMetricSink()])
+        imp = ImportServer(gserver, "127.0.0.1:0")
+        imp.start()
+        try:
+            client = ForwardClient(imp.address, deadline=10.0)
+            fwd = ForwardableState()
+            fwd.counters.append((_mk_meta("v1.count"), 11.0))
+            assert client.forward(fwd) == 1
+            assert client._v1_ok is True
+            assert wait_until(lambda: imp.imported_total == 1)
+            assert imp.rpc_stats.snapshot()["SendMetrics"]["count"] >= 1
+            client.close()
+        finally:
+            imp.stop()
+            gserver.shutdown()
 
     def test_forward_bad_address_does_not_crash(self):
         cfg = make_config(forward_address="127.0.0.1:1")  # nothing listens

@@ -144,4 +144,6 @@ class TestThroughput:
         wired = convert.forwardable_to_wire(fwd)
         dt = time.perf_counter() - t0
         assert len(wired) == 50_000
-        assert dt < 1.0, f"warm 50k-key serialization took {dt:.2f}s"
+        # generous bound for loaded CI machines: the Python proto path
+        # this replaced took ~57 s, warm native runs in ~0.15 s
+        assert dt < 3.0, f"warm 50k-key serialization took {dt:.2f}s"

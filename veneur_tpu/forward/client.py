@@ -28,6 +28,21 @@ def _serialize_metric(m) -> bytes:
     return m if type(m) is bytes else m.SerializeToString()
 
 
+def _frame_v1(m) -> bytes:
+    """Wraps one serialized Metric as a MetricList `metrics` entry
+    (field 1, length-delimited); concatenating the frames IS the
+    MetricList wire body."""
+    b = _serialize_metric(m)
+    n = len(b)
+    out = [b"\x0a"]
+    while n >= 0x80:
+        out.append(bytes((n & 0x7F | 0x80,)))
+        n >>= 7
+    out.append(bytes((n,)))
+    out.append(b)
+    return b"".join(out)
+
+
 class ForwardClient:
     """gRPC client for /forwardrpc.Forward, built on the generic channel
     API (no generated stubs needed)."""
@@ -37,26 +52,60 @@ class ForwardClient:
                  tls: Optional[GrpcTLS] = None):
         self.address = address
         self.deadline = deadline
-        self._channel = channel or secure_or_insecure_channel(address, tls)
+        self._channel = channel or secure_or_insecure_channel(
+            address, tls,
+            # the V1 bulk body scales with key count (~36 MB at 50k keys)
+            options=[("grpc.max_send_message_length", 256 << 20)])
         self._send_v2 = self._channel.stream_unary(
             "/forwardrpc.Forward/SendMetricsV2",
             request_serializer=_serialize_metric,
             response_deserializer=_EMPTY_DESERIALIZER)
+        # V1 body is assembled by hand from the already-serialized
+        # metrics (MetricList = repeated field-1 Metric), so the
+        # serializer is identity
+        self._send_v1 = self._channel.unary_unary(
+            "/forwardrpc.Forward/SendMetrics",
+            request_serializer=lambda b: b,
+            response_deserializer=_EMPTY_DESERIALIZER)
+        # a reference-style importer rejects V1 (UNIMPLEMENTED,
+        # sources/proxy/server.go:138-142) and an un-upgraded receiver
+        # may bounce the body (RESOURCE_EXHAUSTED); either pins the
+        # client to V2 streams
+        self._v1_ok = True
         self.stats: Dict[str, int] = {
             "forwarded_total": 0, "errors_deadline": 0,
             "errors_unavailable": 0, "errors_send": 0,
         }
 
     def forward(self, fwd: ForwardableState) -> int:
-        """Serialize and stream one flush's state; returns count sent.
+        """Serialize and send one flush's state; returns count sent.
         Serialization goes through the native digest encoder
         (convert.forwardable_to_wire) — the per-centroid Python proto
-        loop capped the plane at 883 keys/s (BENCH_r04)."""
+        loop capped the plane at 883 keys/s (BENCH_r04). Transport
+        prefers one unary SendMetrics (MetricList) — per-message stream
+        overhead at 50k keys costs seconds — falling back to the V2
+        stream for importers that reject V1."""
         protos = forwardable_to_wire(fwd)
         if not protos:
             return 0
         try:
-            self._send_v2(iter(protos), timeout=self.deadline)
+            if self._v1_ok:
+                try:
+                    body = b"".join(_frame_v1(m) for m in protos)
+                    self._send_v1(body, timeout=self.deadline)
+                except grpc.RpcError as e:
+                    code = e.code() if hasattr(e, "code") else None
+                    if code in (grpc.StatusCode.UNIMPLEMENTED,
+                                grpc.StatusCode.RESOURCE_EXHAUSTED):
+                        # V1 is structurally refused (even after an
+                        # earlier success — e.g. failover to an older
+                        # importer): pin to V2 and retry THIS flush
+                        self._v1_ok = False
+                        self._send_v2(iter(protos), timeout=self.deadline)
+                    else:
+                        raise
+            else:
+                self._send_v2(iter(protos), timeout=self.deadline)
         except grpc.RpcError as e:
             code = e.code() if hasattr(e, "code") else None
             if code == grpc.StatusCode.DEADLINE_EXCEEDED:

@@ -29,9 +29,6 @@ from veneur_tpu.util.matcher import TagMatcher
 
 logger = logging.getLogger("veneur_tpu.forward.server")
 
-_CHUNK = 512
-
-
 class ImportServer:
     def __init__(self, server, address: str = "127.0.0.1:0",
                  ignored_tags: Optional[List[TagMatcher]] = None,
@@ -40,8 +37,11 @@ class ImportServer:
         self._server = server
         self._ignored = list(ignored_tags or [])
         self.rpc_stats = RpcStats()
+        # a V1 MetricList at 50k digest keys is ~36 MB; the 4 MB gRPC
+        # default would reject the bulk path outright
         self._grpc = grpc.server(
-            futures.ThreadPoolExecutor(max_workers=max_workers))
+            futures.ThreadPoolExecutor(max_workers=max_workers),
+            options=[("grpc.max_receive_message_length", 256 << 20)])
         handler = grpc.method_handlers_generic_handler("forwardrpc.Forward", {
             "SendMetricsV2": grpc.stream_unary_rpc_method_handler(
                 self.rpc_stats.timed("SendMetricsV2", self._send_metrics_v2),
@@ -76,99 +76,140 @@ class ImportServer:
     # -- handlers --------------------------------------------------------
 
     def _send_metrics_v1(self, req, ctx):
-        # unary batch endpoint is retired in the reference importer
-        # (sources/proxy/server.go:138-142); keep the same contract
-        ctx.abort(grpc.StatusCode.UNIMPLEMENTED,
-                  "SendMetrics is not implemented; use SendMetricsV2")
+        """Unary MetricList import — the bulk fast path. The reference
+        importer retires this endpoint (sources/proxy/server.go:138-142)
+        but its proxy still accepts it (proxy/handlers/handlers.go:41-60,
+        "grpc-single"); this framework accepts it on the importer too
+        because one unary message parsed by upb in C is dramatically
+        cheaper than 50k individually-framed stream messages — the native
+        forward client sends V1 first and falls back to V2 streams."""
+        buf = _MergeBuffer(self)
+        for pbm in req.metrics:
+            buf.add(pbm)
+        buf.flush_all()
+        self.imported_total += len(req.metrics)
+        return b""
 
     def _send_metrics_v2(self, request_iterator, ctx):
-        buf: List[metric_pb2.Metric] = []
+        buf = _MergeBuffer(self)
         count = 0
         for pbm in request_iterator:
-            buf.append(pbm)
+            buf.add(pbm)
             count += 1
-            if len(buf) >= _CHUNK:
-                self._merge_chunk(buf)
-                buf = []
-        if buf:
-            self._merge_chunk(buf)
+        buf.flush_all()
         self.imported_total += count
         return b""
 
-    # -- merge -----------------------------------------------------------
 
-    def _merge_chunk(self, chunk: List[metric_pb2.Metric]) -> None:
-        """Group a chunk per family, then intern+merge each family in one
-        atomic table call (so a concurrent flush never observes touched
-        rows whose state hasn't merged yet)."""
-        store = self._server.store
-        c_stubs, c_vals = [], []
-        g_stubs, g_vals = [], []
-        h_stubs, h_means, h_weights, h_min, h_max, h_recip = [], [], [], [], [], []
-        s_stubs, s_regs = [], []
+class _MergeBuffer:
+    """Per-family accumulation for one import request: intern+merge
+    happens in as few atomic table calls as possible. The digest merge
+    kernel's cost scales with TABLE capacity, not batch size, so merging
+    per small chunk (the old _CHUNK=512) paid ~100 full-table passes for
+    a 50k-key stream; buffering the whole request costs ~1 KB/metric and
+    merges once. Caps bound transient memory against unbounded streams:
+    a buffered histogram costs ~2.5 KB (two float64 centroid arrays plus
+    the stub), so 16384 ≈ 40 MB; a set costs 16 KB of registers, so
+    4096 ≈ 64 MB; scalars are ~100 B stubs."""
 
-        for pbm in chunk:
-            which = pbm.WhichOneof("value")
-            if which is None:
-                logger.warning("can't import a metric with no value: %s",
-                               pbm.name)
-                continue
-            scope = import_scope(pbm)
-            if scope == MetricScope.LOCAL_ONLY:
-                logger.warning("gRPC import does not accept local metrics")
-                continue
-            try:
-                key, h32, h64, tags = metric_key_of_proto(pbm, self._ignored)
-            except KeyError:
-                # open proto3 enums: a newer peer may send unknown types;
-                # skip the metric, keep the stream (worker.go ImportMetric
-                # logs-and-continues likewise)
-                logger.warning("unknown metric type %s for %r; skipped",
-                               pbm.type, pbm.name)
-                continue
-            stub = UDPMetric(key=key, digest=h32, digest64=h64,
-                             tags=list(tags), scope=scope)
-            if which == "counter":
-                c_stubs.append(stub)
-                c_vals.append(float(pbm.counter.value))
-            elif which == "gauge":
-                g_stubs.append(stub)
-                g_vals.append(pbm.gauge.value)
-            elif which == "histogram":
-                d = pbm.histogram.t_digest
-                if not d.main_centroids:
-                    # an empty digest carries no samples; merging it would
-                    # still clobber the row's min/max with default zeros
-                    continue
-                means = np.fromiter(
-                    (c.mean for c in d.main_centroids), np.float64,
-                    len(d.main_centroids))
-                weights = np.fromiter(
-                    (c.weight for c in d.main_centroids), np.float64,
-                    len(d.main_centroids))
-                pm, pw = batch_tdigest.pack_centroids(means, weights)
-                h_stubs.append(stub)
-                h_means.append(pm)
-                h_weights.append(pw)
-                h_min.append(d.min)
-                h_max.append(d.max)
-                h_recip.append(d.reciprocalSum)
-            elif which == "set":
-                regs = _decode_hll(pbm.set.hyper_log_log)
-                if regs is not None:
-                    s_stubs.append(stub)
-                    s_regs.append(regs)
+    HISTO_CAP = 16384
+    SCALAR_CAP = 65536
+    SET_CAP = 4096
 
-        if c_stubs:
-            store.counters.merge_batch(c_stubs, c_vals)
-        if g_stubs:
-            store.gauges.merge_batch(g_stubs, g_vals)
-        if h_stubs:
-            store.histos.merge_batch(
-                h_stubs, np.stack(h_means), np.stack(h_weights),
-                h_min, h_max, h_recip)
-        if s_stubs:
-            store.sets.merge_batch(s_stubs, np.stack(s_regs))
+    def __init__(self, srv: "ImportServer"):
+        self._srv = srv
+        self._store = srv._server.store
+        self.c_stubs, self.c_vals = [], []
+        self.g_stubs, self.g_vals = [], []
+        self.h_stubs, self.h_means, self.h_weights = [], [], []
+        self.h_min, self.h_max, self.h_recip = [], [], []
+        self.s_stubs, self.s_regs = [], []
+
+    def add(self, pbm: metric_pb2.Metric) -> None:
+        which = pbm.WhichOneof("value")
+        if which is None:
+            logger.warning("can't import a metric with no value: %s",
+                           pbm.name)
+            return
+        scope = import_scope(pbm)
+        if scope == MetricScope.LOCAL_ONLY:
+            logger.warning("gRPC import does not accept local metrics")
+            return
+        try:
+            key, h32, h64, tags = metric_key_of_proto(pbm, self._srv._ignored)
+        except KeyError:
+            # open proto3 enums: a newer peer may send unknown types;
+            # skip the metric, keep the stream (worker.go ImportMetric
+            # logs-and-continues likewise)
+            logger.warning("unknown metric type %s for %r; skipped",
+                           pbm.type, pbm.name)
+            return
+        stub = UDPMetric(key=key, digest=h32, digest64=h64,
+                         tags=list(tags), scope=scope)
+        if which == "counter":
+            self.c_stubs.append(stub)
+            self.c_vals.append(float(pbm.counter.value))
+            if len(self.c_stubs) >= self.SCALAR_CAP:
+                self._flush_counters()
+        elif which == "gauge":
+            self.g_stubs.append(stub)
+            self.g_vals.append(pbm.gauge.value)
+            if len(self.g_stubs) >= self.SCALAR_CAP:
+                self._flush_gauges()
+        elif which == "histogram":
+            d = pbm.histogram.t_digest
+            if not d.main_centroids:
+                # an empty digest carries no samples; merging it would
+                # still clobber the row's min/max with default zeros
+                return
+            n = len(d.main_centroids)
+            self.h_stubs.append(stub)
+            self.h_means.append(np.fromiter(
+                (c.mean for c in d.main_centroids), np.float64, n))
+            self.h_weights.append(np.fromiter(
+                (c.weight for c in d.main_centroids), np.float64, n))
+            self.h_min.append(d.min)
+            self.h_max.append(d.max)
+            self.h_recip.append(d.reciprocalSum)
+            if len(self.h_stubs) >= self.HISTO_CAP:
+                self._flush_histos()
+        elif which == "set":
+            regs = _decode_hll(pbm.set.hyper_log_log)
+            if regs is not None:
+                self.s_stubs.append(stub)
+                self.s_regs.append(regs)
+                if len(self.s_stubs) >= self.SET_CAP:
+                    self._flush_sets()
+
+    def _flush_counters(self):
+        self._store.counters.merge_batch(self.c_stubs, self.c_vals)
+        self.c_stubs, self.c_vals = [], []
+
+    def _flush_gauges(self):
+        self._store.gauges.merge_batch(self.g_stubs, self.g_vals)
+        self.g_stubs, self.g_vals = [], []
+
+    def _flush_histos(self):
+        pm, pw = batch_tdigest.pack_centroids_many(
+            self.h_means, self.h_weights)
+        self._store.histos.merge_batch(
+            self.h_stubs, pm, pw, self.h_min, self.h_max, self.h_recip)
+        self.h_stubs, self.h_means, self.h_weights = [], [], []
+        self.h_min, self.h_max, self.h_recip = [], [], []
+
+    def _flush_sets(self):
+        self._store.sets.merge_batch(self.s_stubs, np.stack(self.s_regs))
+        self.s_stubs, self.s_regs = [], []
+
+    def flush_all(self):
+        if self.c_stubs:
+            self._flush_counters()
+        if self.g_stubs:
+            self._flush_gauges()
+        if self.h_stubs:
+            self._flush_histos()
+        if self.s_stubs:
+            self._flush_sets()
 
 
 def _decode_hll(data: bytes) -> Optional[np.ndarray]:

@@ -58,8 +58,12 @@ class ProxyServer:
         self._shutdown = threading.Event()
         self._discovery_thread: Optional[threading.Thread] = None
 
+        # the forward client's V1 bulk body scales with key count
+        # (~36 MB at 50k digest keys); the 4 MB gRPC default would
+        # bounce it at exactly the scale the bulk path exists for
         self._grpc = grpc.server(
-            futures.ThreadPoolExecutor(max_workers=max_workers))
+            futures.ThreadPoolExecutor(max_workers=max_workers),
+            options=[("grpc.max_receive_message_length", 256 << 20)])
         handler = grpc.method_handlers_generic_handler("forwardrpc.Forward", {
             "SendMetricsV2": grpc.stream_unary_rpc_method_handler(
                 self.rpc_stats.timed("SendMetricsV2", self._send_metrics_v2),
