@@ -202,6 +202,38 @@ class TestSpanPipeline:
         finally:
             server.shutdown()
 
+    def test_sink_worker_chunk_semantics(self):
+        """_SpanSinkWorker accounting: span-counted capacity, whole-chunk
+        drops, batch delivery through ingest_many, drain-on-stop."""
+        from veneur_tpu.core.server import _SpanSinkWorker
+
+        got = []
+
+        class BatchSink:
+            def name(self):
+                return "batch"
+
+            def ingest(self, span):
+                raise AssertionError("batch path should be used")
+
+            def ingest_many(self, spans):
+                got.extend(spans)
+
+        w = _SpanSinkWorker(BatchSink(), capacity=100)
+        w.submit_many(list(range(60)))
+        w.submit_many(list(range(50)))   # 60+50 > 100: dropped whole
+        assert w.dropped == 50
+        w.submit_many(list(range(40)))   # fits exactly
+        w.start()
+        deadline = time.time() + 2
+        while len(got) < 100 and time.time() < deadline:
+            time.sleep(0.01)
+        assert len(got) == 100 and w.ingested == 100
+        # spans submitted before stop() are drained, not abandoned
+        w.submit_many([1, 2, 3])
+        w.stop()
+        assert len(got) == 103
+
     def test_ssf_udp_ingest(self):
         cfg = generate_config()
         cfg.ssf_listen_addresses = ["udp://127.0.0.1:0"]

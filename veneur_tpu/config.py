@@ -137,6 +137,11 @@ class Config:
     sentry_dsn: StringSecret = field(default_factory=StringSecret)
     sources: List[SourceConfig] = field(default_factory=list)
     span_channel_capacity: int = 100
+    # per-sink isolation buffer, counted in spans; 0 = auto-size to
+    # max(4096, 8x span_channel_capacity). Unlike span_channel_capacity
+    # (reference-pinned default) this one must absorb offered-rate x
+    # sink-latency bursts, so it defaults much larger.
+    span_sink_queue_capacity: int = 0
     span_sinks: List[SinkConfig] = field(default_factory=list)
     ssf_listen_addresses: List[str] = field(default_factory=list)
     stats_address: str = ""
@@ -174,6 +179,9 @@ class Config:
             self.read_buffer_size_bytes = 2 * 1024 * 1024
         if self.span_channel_capacity <= 0:
             self.span_channel_capacity = 100
+        if self.span_sink_queue_capacity <= 0:
+            self.span_sink_queue_capacity = max(
+                4096, 8 * self.span_channel_capacity)
         if self.trace_max_length_bytes <= 0:
             self.trace_max_length_bytes = 16 * 1024 * 1024
         return self

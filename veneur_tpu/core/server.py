@@ -45,17 +45,31 @@ class RawSpan:
 
 class _SpanSinkWorker:
     """Per-sink span ingest isolation: each external span sink gets a
-    bounded queue and one dedicated thread, so a slow or hung sink drops
+    bounded buffer and one dedicated thread, so a slow or hung sink drops
     its own spans instead of stalling the shared span workers — the
     TPU-build equivalent of the reference's 9 s per-sink ingest timeout
     (reference worker.go:588-656). Internal sinks (metric extraction) are
-    called inline by the span workers and bypass this."""
+    called inline by the span workers and bypass this.
+
+    Spans move in CHUNKS: the span workers submit whole decoded batches
+    and this thread swaps the pending list out in one lock window, so
+    per-span cost on the shared path is one list-append — at bench rate
+    (>100k spans/s) per-span Queue put/get was itself the bottleneck and
+    shed half the stream (BENCH_r04: 137,896 drops in 5.7 s). Capacity
+    counts SPANS, not chunks, and a chunk that would overflow is dropped
+    whole (accounted per-sink)."""
 
     def __init__(self, sink, capacity: int):
         self.sink = sink
-        self.queue: "queue.Queue" = queue.Queue(maxsize=max(16, capacity))
+        # duck-typed sinks (tests, plugins) may predate the batch API
+        self._ingest_many = getattr(sink, "ingest_many", None)
+        self.capacity = max(16, capacity)
+        self._pending: list = []  # list of chunks (lists of spans)
+        self._pending_spans = 0
+        self._lock = threading.Lock()
+        self._ready = threading.Condition(self._lock)
         self.dropped = 0
-        self._dropped_lock = threading.Lock()
+        self.ingested = 0
         self._stop = threading.Event()
         self.thread: Optional[threading.Thread] = None
 
@@ -67,34 +81,57 @@ class _SpanSinkWorker:
         self.thread.start()
 
     def submit(self, span) -> None:
-        try:
-            self.queue.put_nowait(span)
-        except queue.Full:
-            with self._dropped_lock:
-                self.dropped += 1
+        self.submit_many((span,))
+
+    def submit_many(self, spans) -> None:
+        n = len(spans)
+        if n == 0:
+            return
+        with self._lock:
+            # overflow drops whole chunks, but an empty buffer always
+            # accepts one — otherwise a configured capacity below the
+            # worker batch size (256) would starve the sink forever
+            if self._pending and self._pending_spans + n > self.capacity:
+                self.dropped += n
+                return
+            self._pending.append(spans)
+            self._pending_spans += n
+            self._ready.notify()
 
     def _loop(self) -> None:
         while True:
-            try:
-                span = self.queue.get(timeout=0.5)
-            except queue.Empty:
-                if self._stop.is_set():
-                    return
-                continue
-            if span is None:
-                return
-            try:
-                self.sink.ingest(span)
-            except Exception:
-                logger.exception(
-                    "span sink %s ingest failed", self.sink.name())
+            with self._lock:
+                while not self._pending:
+                    if self._stop.is_set():
+                        return
+                    self._ready.wait(timeout=0.5)
+                chunks, self._pending = self._pending, []
+                self._pending_spans = 0
+            for chunk in chunks:
+                try:
+                    if self._ingest_many is not None:
+                        # batch-aware sinks and the base-class default
+                        # (which isolates per-span failures itself)
+                        self._ingest_many(chunk)
+                    else:
+                        for span in chunk:  # duck-typed legacy sinks
+                            try:
+                                self.sink.ingest(span)
+                            except Exception:
+                                logger.exception(
+                                    "span sink %s ingest failed",
+                                    self.sink.name())
+                    self.ingested += len(chunk)
+                except Exception:
+                    logger.exception(
+                        "span sink %s ingest failed", self.sink.name())
 
     def stop(self, timeout: float = 2.0) -> None:
+        """Signal, then join: the loop drains whatever was already
+        submitted before it sees the stop flag on its next empty wait."""
         self._stop.set()
-        try:
-            self.queue.put_nowait(None)
-        except queue.Full:
-            pass
+        with self._lock:
+            self._ready.notify()
         if self.thread is not None:
             self.thread.join(timeout)
 
@@ -365,36 +402,55 @@ class Server:
             self.spans_dropped += 1
 
     def _span_worker_loop(self) -> None:
-        """Fan each span out to every span sink (worker.go:587-662):
-        metric extraction runs inline (internal, cannot hang); external
-        sinks receive the span through their isolation queues so one hung
-        sink can't stall the pipeline. On shutdown, drains queued spans
-        (which sit ahead of the None sentinels) before exiting; the timed
-        get covers the case where a full channel swallowed the sentinels."""
+        """Fan spans out to every span sink (worker.go:587-662): metric
+        extraction runs inline (internal, cannot hang); external sinks
+        receive spans through their isolation buffers so one hung sink
+        can't stall the pipeline. Spans are drained and fanned out in
+        batches — one submit_many per sink per batch instead of per-span
+        queue traffic. On shutdown, drains queued spans (which sit ahead
+        of the None sentinels) before exiting; the timed get covers the
+        case where a full channel swallowed the sentinels."""
+        from veneur_tpu import protocol
         while True:
             try:
-                span = self.span_chan.get(timeout=0.5)
+                first = self.span_chan.get(timeout=0.5)
             except queue.Empty:
                 if self._shutdown.is_set():
                     return
                 continue
-            if span is None:
+            if first is None:
                 return
-            if isinstance(span, RawSpan):
-                # metrics were already extracted natively; only external
-                # sinks need the decoded object
-                from veneur_tpu import protocol
-                try:
-                    span = protocol.parse_ssf(span.data)
-                except Exception:
-                    continue  # native decode succeeded; should not happen
-            else:
-                try:
-                    self.metric_extraction.ingest(span)
-                except Exception:
-                    logger.exception("span metric extraction failed")
-            for worker in self._span_sink_workers:
-                worker.submit(span)
+            batch = [first]
+            done = False
+            try:
+                while len(batch) < 256:
+                    nxt = self.span_chan.get_nowait()
+                    if nxt is None:  # consume at most ONE sentinel so
+                        done = True  # sibling workers still get theirs
+                        break
+                    batch.append(nxt)
+            except queue.Empty:
+                pass
+            out = []
+            for span in batch:
+                if isinstance(span, RawSpan):
+                    # metrics were already extracted natively; only
+                    # external sinks need the decoded object
+                    try:
+                        out.append(protocol.parse_ssf(span.data))
+                    except Exception:
+                        pass  # native decode succeeded; should not happen
+                else:
+                    try:
+                        self.metric_extraction.ingest(span)
+                    except Exception:
+                        logger.exception("span metric extraction failed")
+                    out.append(span)
+            if out:
+                for worker in self._span_sink_workers:
+                    worker.submit_many(out)
+            if done:
+                return
 
     # -- lifecycle -------------------------------------------------------
 
@@ -406,7 +462,7 @@ class Server:
             if sink is self.metric_extraction:
                 continue
             worker = _SpanSinkWorker(
-                sink, self.config.span_channel_capacity)
+                sink, self.config.span_sink_queue_capacity)
             worker.start()
             self._span_sink_workers.append(worker)
         for i in range(max(1, self.config.num_span_workers)):
@@ -710,7 +766,18 @@ class Server:
         # cumulative process counters emit as gauges (they never reset)
         self.statsd.gauge("worker.metrics_processed_total",
                           int(self.stats["packets_received"]))
-        span_sink_drops = sum(w.dropped for w in self._span_sink_workers)
+        span_sink_drops = 0
+        for w in self._span_sink_workers:
+            span_sink_drops += w.dropped
+            if w.dropped or w.ingested:
+                # per-sink shed visibility: drop RATE is the signal that
+                # a sink's buffer is undersized for the offered load
+                self.statsd.gauge("worker.ssf.sink.dropped_total",
+                                  w.dropped,
+                                  tags=[f"sink:{w.sink.name()}"])
+                self.statsd.gauge("worker.ssf.sink.ingested_total",
+                                  w.ingested,
+                                  tags=[f"sink:{w.sink.name()}"])
         if self.spans_dropped or span_sink_drops:
             self.statsd.gauge("worker.ssf.spans_dropped_total",
                               self.spans_dropped + span_sink_drops)

@@ -10,9 +10,12 @@ server.go:62-91, populated in cmd/veneur/main.go:98-170).
 from __future__ import annotations
 
 import abc
+import logging
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from veneur_tpu.samplers.metrics import InterMetric
+
+_logger = logging.getLogger("veneur_tpu.sinks")
 
 # sink "kinds" report what they drop: a metric sink is expected to handle
 # every InterMetric it receives
@@ -69,6 +72,20 @@ class SpanSink(abc.ABC):
 
     @abc.abstractmethod
     def ingest(self, span) -> None: ...
+
+    def ingest_many(self, spans) -> None:
+        """Batch ingest: the span sink workers hand over whole decoded
+        chunks, so a sink that can take spans wholesale (buffer appends,
+        no-ops) overrides this and pays one Python call per chunk rather
+        than per span. The default delegates per-span and isolates
+        failures, so one poison span costs exactly one span (the
+        pre-batching contract)."""
+        for span in spans:
+            try:
+                self.ingest(span)
+            except Exception:
+                _logger.exception("span sink %s ingest failed",
+                                  self.name())
 
     def flush(self) -> None:  # noqa: B027
         pass

@@ -30,6 +30,9 @@ class BlackholeSpanSink(SpanSink):
     def ingest(self, span) -> None:
         pass
 
+    def ingest_many(self, spans) -> None:
+        pass
+
 
 @register_metric_sink("blackhole")
 def _metric_factory(sink_config, server_config):

@@ -225,6 +225,16 @@ class DatadogSpanSink(SpanSink):
                 self.overwritten_total += 1
             self.buffer.append(span)
 
+    def ingest_many(self, spans) -> None:
+        good = [s for s in spans if s.trace_id]
+        if not good:
+            return
+        with self._lock:
+            room = self.buffer.maxlen - len(self.buffer)
+            if len(good) > room:
+                self.overwritten_total += len(good) - room
+            self.buffer.extend(good)
+
     def _to_dd_span(self, s) -> dict:
         meta = dict(s.tags)
         resource = meta.pop(_DD_RESOURCE_KEY, "") or "unknown"
