@@ -100,6 +100,84 @@ class TestSparse:
         np.testing.assert_array_equal(regs, want)
 
 
+class TestSparseMarshal:
+    def test_round_trip_exact(self):
+        """marshal_sparse -> unmarshal reproduces the registers exactly,
+        including rho values in both key formats (<=pp-p packs the rank
+        in the remainder bits; larger rho uses the explicit form)."""
+        regs = np.zeros(hll_ref.M, np.uint8)
+        rng = np.random.default_rng(7)
+        idxs = rng.choice(hll_ref.M, 300, replace=False)
+        regs[idxs[:150]] = rng.integers(1, 12, 150)    # LSB=0 form
+        regs[idxs[150:]] = rng.integers(12, 51, 150)   # LSB=1 form
+        blob = hllwire.marshal_sparse(regs)
+        got, p = hllwire.unmarshal(blob)
+        assert p == 14
+        np.testing.assert_array_equal(got, regs)
+
+    def test_matches_go_member_hash_path(self):
+        """Registers built from real member hashes (the Go insert path,
+        encode_hash) survive the sparse round trip bit-for-bit."""
+        rng = np.random.default_rng(17)
+        regs = np.zeros(hll_ref.M, np.uint8)
+        for _ in range(400):
+            x = int(rng.integers(0, 2**63)) << 1 | int(rng.integers(0, 2))
+            idx, rho = hll_ref.pos_val(x)
+            regs[idx] = max(regs[idx], rho)
+        got, _ = hllwire.unmarshal(hllwire.marshal_sparse(regs))
+        np.testing.assert_array_equal(got, regs)
+
+    def test_small_set_is_small(self):
+        """VERDICT bar: a 10-member set serializes in <100 bytes vs the
+        ~8 KB dense form."""
+        regs = np.zeros(hll_ref.M, np.uint8)
+        rng = np.random.default_rng(3)
+        for _ in range(10):
+            x = int(rng.integers(0, 2**63))
+            idx, rho = hll_ref.pos_val(x)
+            regs[idx] = max(regs[idx], rho)
+        blob = hllwire.marshal(regs)
+        assert blob[3] == 1  # sparse form chosen
+        assert len(blob) < 100, len(blob)
+        got, _ = hllwire.unmarshal(blob)
+        np.testing.assert_array_equal(got, regs)
+
+    def test_crossover_prefers_smaller(self):
+        rng = np.random.default_rng(5)
+        for nnz in (0, 1, 500, 1600, 1700, 8000, hll_ref.M):
+            regs = np.zeros(hll_ref.M, np.uint8)
+            if nnz:
+                idxs = rng.choice(hll_ref.M, nnz, replace=False)
+                regs[idxs] = rng.integers(1, 30, nnz)
+            blob = hllwire.marshal(regs)
+            alt = (hllwire.marshal_dense(regs) if blob[3] == 1
+                   else hllwire.marshal_sparse(regs))
+            assert len(blob) <= len(alt), (nnz, len(blob), len(alt))
+            got, _ = hllwire.unmarshal(blob)
+            # dense clamps to the 4-bit tailcut range; sparse is exact
+            if blob[3] == 1:
+                np.testing.assert_array_equal(got, regs)
+
+    def test_oversized_rho_falls_back_to_dense(self):
+        """A rho beyond pp-p+63 (possible after merging a based dense
+        import) would overflow the sparse 6-bit rank field; marshal must
+        route such registers through the dense/base encoding instead of
+        emitting corrupt keys."""
+        regs = np.zeros(hll_ref.M, np.int16)
+        regs[:] = 70                 # base floor so dense b > 0
+        regs[5] = 80                 # > 11 + 63
+        blob = hllwire.marshal(regs.astype(np.uint8))
+        assert blob[3] == 0          # dense chosen
+        got, _ = hllwire.unmarshal(blob)
+        assert int(got[5]) > int(got[6])  # ordering survives the base
+
+    def test_empty_set_round_trips(self):
+        regs = np.zeros(hll_ref.M, np.uint8)
+        blob = hllwire.marshal(regs)
+        got, _ = hllwire.unmarshal(blob)
+        assert got.sum() == 0
+
+
 class TestForwardPlane:
     def test_import_server_accepts_axiomhq_payload(self):
         from veneur_tpu.forward.server import _decode_hll

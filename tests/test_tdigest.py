@@ -231,6 +231,49 @@ class TestBatchedDigest:
         assert float(out["count"][0]) == pytest.approx(len(data), rel=1e-3)
 
 
+class TestPackCentroidsMany:
+    def test_parity_with_per_key_pack(self):
+        """The segmented packer must conserve each digest's mass and
+        weighted mean exactly, and may only differ from pack_centroids
+        by weight shifting to an ADJACENT k-scale slot (floor(k) flips
+        at a bucket boundary from cumsum rounding)."""
+        rng = np.random.default_rng(11)
+        ms, ws = [], []
+        for i in range(800):
+            n = int(rng.integers(0, 160))
+            m = rng.standard_normal(n) * 100
+            w = rng.random(n) * 5
+            if n and rng.random() < 0.1:
+                w[:] = 0.0                       # weightless digest
+            if n and rng.random() < 0.2:
+                w[rng.random(n) < 0.4] = 0.0     # holes
+            ms.append(m)
+            ws.append(w)
+        ms.append(np.array([]))                  # empty digest
+        ws.append(np.array([]))
+        OM, OW = btd.pack_centroids_many(ms, ws)
+        exact = 0
+        for i in range(len(ms)):
+            em, ew = btd.pack_centroids(ms[i], ws[i])
+            if (np.allclose(OW[i], ew, atol=1e-6)
+                    and np.allclose(OM[i] * OW[i], em * ew, atol=1e-4)):
+                exact += 1
+                continue
+            wmax = ws[i].max() if len(ws[i]) else 0.0
+            # an adjacent-slot shift changes exactly one prefix sum by
+            # the shifted weight (<= the digest's largest weight)
+            np.testing.assert_allclose(
+                np.cumsum(OW[i]), np.cumsum(ew), atol=wmax * 1.01 + 1e-9)
+            assert abs(OW[i].sum() - ew.sum()) < 1e-9
+            assert abs((OM[i] * OW[i]).sum() - (em * ew).sum()) < 1e-4
+        # drift must stay rare, not the norm
+        assert exact >= len(ms) * 0.97, exact
+
+    def test_empty_batch(self):
+        OM, OW = btd.pack_centroids_many([], [])
+        assert OM.shape == (0, btd.C) and OW.shape == (0, btd.C)
+
+
 class TestFusedExportFlush:
     def test_fused_matches_legacy_compact_flush_export(self):
         """flush_export_packed must produce the exact export grid the

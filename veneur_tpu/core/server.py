@@ -60,9 +60,14 @@ class _SpanSinkWorker:
     whole (accounted per-sink)."""
 
     def __init__(self, sink, capacity: int):
+        from veneur_tpu.sinks import SpanSink
         self.sink = sink
-        # duck-typed sinks (tests, plugins) may predate the batch API
-        self._ingest_many = getattr(sink, "ingest_many", None)
+        # duck-typed sinks (tests, plugins) may predate the batch API;
+        # bind the base default for them (per-span isolate-and-log) so
+        # the loop has exactly one delivery path
+        self._ingest_many = getattr(
+            sink, "ingest_many",
+            lambda chunk: SpanSink.ingest_many(sink, chunk))
         self.capacity = max(16, capacity)
         self._pending: list = []  # list of chunks (lists of spans)
         self._pending_spans = 0
@@ -109,18 +114,7 @@ class _SpanSinkWorker:
                 self._pending_spans = 0
             for chunk in chunks:
                 try:
-                    if self._ingest_many is not None:
-                        # batch-aware sinks and the base-class default
-                        # (which isolates per-span failures itself)
-                        self._ingest_many(chunk)
-                    else:
-                        for span in chunk:  # duck-typed legacy sinks
-                            try:
-                                self.sink.ingest(span)
-                            except Exception:
-                                logger.exception(
-                                    "span sink %s ingest failed",
-                                    self.sink.name())
+                    self._ingest_many(chunk)
                     self.ingested += len(chunk)
                 except Exception:
                     logger.exception(

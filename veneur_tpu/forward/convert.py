@@ -64,13 +64,14 @@ def forwardable_to_protos(fwd: ForwardableState) -> List[metric_pb2.Metric]:
             histogram=metric_pb2.HistogramValue(t_digest=digest)))
     for meta, registers in fwd.sets:
         # axiomhq binary form: a Go global veneur can UnmarshalBinary and
-        # merge this directly (reference samplers.go:279-311)
+        # merge this directly (reference samplers.go:279-311); low-
+        # cardinality sets go out in the ~100x smaller sparse encoding
         from veneur_tpu.forward import hllwire
         out.append(metric_pb2.Metric(
             name=meta.name, tags=list(meta.tags), type=metric_pb2.Set,
             scope=_SCOPE_TO_PB[meta.scope],
             set=metric_pb2.SetValue(
-                hyper_log_log=hllwire.marshal_dense(
+                hyper_log_log=hllwire.marshal(
                     np.asarray(registers, np.uint8)))))
     return out
 

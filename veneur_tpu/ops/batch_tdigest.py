@@ -600,8 +600,15 @@ def pack_centroids_many(means_list, weights_list, cap: int = C):
     """Segmented pack_centroids over a whole import chunk: one lexsort +
     one scatter-add for every digest in the batch, replacing the per-key
     argsort/cumsum/add.at stack (which at 50k imported digests was ~3 s
-    of host time per flush). Returns (K, cap) float32 means/weights;
-    exact same bucketing as pack_centroids (pinned by tests)."""
+    of host time per flush). Returns (K, cap) float32 means/weights.
+
+    Bucketing is statistically identical to pack_centroids but not
+    bit-identical: the within-segment cumsum (global cumsum minus an
+    exclusive-prefix base) can round differently, flipping floor(k) at
+    a bucket boundary for ~1% of digests — mass moves one adjacent
+    k-scale slot, which the digest grid re-buckets on merge anyway.
+    tests/test_tdigest.py pins total weight / weighted mean exactly and
+    bounds the drift to adjacent slots."""
     K = len(means_list)
     out_m = np.zeros((K, cap), np.float32)
     out_w = np.zeros((K, cap), np.float32)
