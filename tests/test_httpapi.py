@@ -282,6 +282,11 @@ class TestPprofEndpoint:
 
 
 class TestHeapPprof:
+    def setup_method(self):
+        # each test exercises arming fresh: clear the re-arm throttle
+        from veneur_tpu.core import profiling
+        profiling._heap_last_armed[0] = 0.0
+
     def teardown_method(self):
         # heap_pprof arms tracemalloc; leaving it on would slow every
         # later test in this process
@@ -321,6 +326,22 @@ class TestHeapPprof:
         # a single unauthenticated GET must not durably arm 25-frame
         # tracing (it costs real steady-state CPU on the ingest path)
         assert not tracemalloc.is_tracing()
+
+    def test_request_scoped_arming_is_rate_limited(self):
+        import pytest as _pytest
+
+        from veneur_tpu.core import profiling
+
+        profiling.heap_pprof()
+        # hammering the unauthenticated endpoint must not keep tracing
+        # effectively always-on: a second request-scoped arming inside
+        # the window is refused (HTTP layer maps it to 429)...
+        with _pytest.raises(profiling.HeapProfileThrottled):
+            profiling.heap_pprof()
+        # ...but the enable_profiling mode (keep_tracing) is exempt
+        profiling.heap_pprof(keep_tracing=True)
+        # and with tracing already armed there is no re-arm to throttle
+        profiling.heap_pprof()
 
     def test_http_route_serves_heap(self):
         import gzip

@@ -54,9 +54,9 @@ class TestInstallContract:
                 return None
 
         monkeypatch.setattr(restart.subprocess, "Popen",
-                            lambda cmd: FakeChild())
+                            lambda cmd, env=None: FakeChild())
         monkeypatch.setattr(restart, "_wait_ready",
-                            lambda addr, child, timeout=0: (
+                            lambda addr, child, timeout=0, ready_file="": (
                                 calls.append(("ready", addr)) or True))
         restart._restart(lambda: calls.append(("shutdown",)),
                          "127.0.0.1:9999", ["prog"])
@@ -76,33 +76,65 @@ class TestInstallContract:
                 return 1  # replacement died
 
         monkeypatch.setattr(restart.subprocess, "Popen",
-                            lambda cmd: FakeChild())
+                            lambda cmd, env=None: FakeChild())
         restart._restart(lambda: calls.append("shutdown"),
                         "127.0.0.1:9999", ["prog"])
         assert calls == []  # old process keeps serving
 
-    def test_no_http_degrades_to_grace_with_warning(self, monkeypatch,
-                                                    caplog):
-        import logging
-
+    def test_no_http_uses_ready_file_handshake(self, tmp_path):
+        """Without a readiness endpoint the handoff waits for the
+        replacement to write its pid once its listeners are bound — a
+        merely-alive child (wedged in startup) must NOT win, and a dead
+        child loses immediately."""
         from veneur_tpu.core import restart
 
-        monkeypatch.setattr(restart, "NO_HTTP_GRACE_S", 0.01)
-        with caplog.at_level(logging.WARNING, "veneur_tpu.restart"):
-            restart.install(lambda: None, "")
-        assert any("WITHOUT a readiness endpoint" in r.message
-                   for r in caplog.records)
-
         class DeadChild:
+            pid = 1111
+
             def poll(self):
                 return 1
 
         class LiveChild:
+            pid = 2222
+
             def poll(self):
                 return None
 
-        assert restart._wait_ready("", DeadChild()) is False
-        assert restart._wait_ready("", LiveChild()) is True
+        rf = str(tmp_path / "ready")
+        assert restart._wait_ready("", DeadChild(), timeout=0.3,
+                                   ready_file=rf) is False
+        # alive but never binds: refused
+        assert restart._wait_ready("", LiveChild(), timeout=0.5,
+                                   ready_file=rf) is False
+        # bound (pid written): handoff proceeds
+        with open(rf, "w") as f:
+            f.write("2222")
+        assert restart._wait_ready("", LiveChild(), timeout=2.0,
+                                   ready_file=rf) is True
+        # a stale file from some OTHER pid does not count
+        with open(rf, "w") as f:
+            f.write("9999")
+        assert restart._wait_ready("", LiveChild(), timeout=0.5,
+                                   ready_file=rf) is False
+
+    def test_server_start_writes_ready_file(self, tmp_path, monkeypatch):
+        from veneur_tpu.config import Config
+        from veneur_tpu.core.server import Server
+        from veneur_tpu.sinks.channel import ChannelMetricSink
+
+        rf = str(tmp_path / "ready")
+        monkeypatch.setenv("VENEUR_TPU_READY_FILE", rf)
+        cfg = Config()
+        cfg.interval = 3600
+        cfg.statsd_listen_addresses = ["udp://127.0.0.1:0"]
+        cfg.apply_defaults()
+        server = Server(cfg, extra_metric_sinks=[ChannelMetricSink()])
+        server.start()
+        try:
+            with open(rf) as f:
+                assert f.read().strip() == str(os.getpid())
+        finally:
+            server.shutdown()
 
 
 @pytest.mark.skipif(not hasattr(socket, "SO_REUSEPORT"),

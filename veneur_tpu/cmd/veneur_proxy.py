@@ -118,6 +118,10 @@ def main(argv=None) -> int:
         http_api = HTTPApi(raw, server=None, address=http_addr)
         http_api.start()
 
+    # every listener is bound: report readiness to a parent mid-handoff
+    from veneur_tpu.core import restart as _restart_mod
+    _restart_mod.mark_ready()
+
     stop = threading.Event()
 
     def handle_signal(signum, frame):
@@ -131,9 +135,9 @@ def main(argv=None) -> int:
     # einhorn too): gRPC servers bind with SO_REUSEPORT by default and
     # the HTTP API sets it explicitly, so the replacement overlap-binds;
     # shutdown here just unblocks the main loop, which stops the proxy
-    # after the replacement is ready. Zero-gap needs http_address (the
-    # readiness endpoint); without it restart.py warns and uses a
-    # blind grace.
+    # after the replacement is ready. With http_address the parent polls
+    # /healthcheck/ready; without it the handoff uses the ready-file
+    # handshake (mark_ready above, written once the proxy was bound).
     from veneur_tpu.core import restart
     restart.install(stop.set, http_addr or "")
 

@@ -117,8 +117,14 @@ class _Handler(BaseHTTPRequestHandler):
             # requests see allocations since
             from veneur_tpu.core import profiling
             keep = bool(getattr(api.config, "enable_profiling", False))
-            self._send(200, profiling.heap_pprof(keep_tracing=keep),
-                       "application/octet-stream")
+            try:
+                body = profiling.heap_pprof(keep_tracing=keep)
+            except profiling.HeapProfileThrottled as e:
+                # request-scoped armings are rate-limited so hammering
+                # the endpoint can't keep tracemalloc always-on
+                self._send(429, str(e).encode())
+                return
+            self._send(200, body, "application/octet-stream")
         elif path == "/debug/pprof/goroutine":
             # thread stacks in pprof form (Go names this route goroutine;
             # tooling hardcodes the path)

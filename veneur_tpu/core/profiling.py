@@ -305,7 +305,19 @@ def threads_pprof() -> bytes:
 
 
 _heap_traced_since = [0.0]
+_heap_last_armed = [0.0]
 _heap_lock = threading.Lock()
+# minimum spacing between request-scoped tracemalloc armings: hammering
+# the unauthenticated endpoint must not keep 25-frame tracing (the
+# steady-state ingest overhead the request-scoped design removes)
+# effectively always-on, nor serialize HTTP threads behind back-to-back
+# half-second holds
+HEAP_ARM_MIN_INTERVAL_S = 10.0
+
+
+class HeapProfileThrottled(RuntimeError):
+    """Raised when a request-scoped arming is asked for too soon after
+    the previous one (HTTP layer maps it to 429)."""
 
 
 def heap_pprof(limit: int = 10_000, keep_tracing: bool = False) -> bytes:
@@ -325,9 +337,16 @@ def heap_pprof(limit: int = 10_000, keep_tracing: bool = False) -> bytes:
     with _heap_lock:
         armed_here = False
         if not tracemalloc.is_tracing():
+            now = time.time()
+            if not keep_tracing and \
+                    now - _heap_last_armed[0] < HEAP_ARM_MIN_INTERVAL_S:
+                raise HeapProfileThrottled(
+                    f"heap profile re-armed too soon; retry in "
+                    f"{HEAP_ARM_MIN_INTERVAL_S - (now - _heap_last_armed[0]):.0f}s")
             tracemalloc.start(25)
             armed_here = True
-            _heap_traced_since[0] = time.time()
+            _heap_last_armed[0] = now
+            _heap_traced_since[0] = now
             # give the arena a moment to accumulate request-scoped
             # truth: with tracing armed only for this request, an
             # instant snapshot would be near-empty

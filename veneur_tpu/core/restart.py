@@ -31,7 +31,12 @@ import urllib.request
 logger = logging.getLogger("veneur_tpu.restart")
 
 READY_TIMEOUT_S = 60.0
-NO_HTTP_GRACE_S = 3.0
+# env var through which the replacement reports "listeners bound": the
+# child writes its pid to this path at the end of Server.start(). Used
+# when no HTTP readiness endpoint is configured — a merely-alive child
+# wedged in startup must NOT win the handoff (draining for it leaves
+# the port unserved, worse than refusing the restart).
+READY_FILE_ENV = "VENEUR_TPU_READY_FILE"
 
 
 _in_progress = threading.Lock()
@@ -43,16 +48,16 @@ def install(shutdown, http_address: str = "", argv=None) -> None:
     Explicit contract (no server duck-typing): `shutdown` is called once
     the replacement is ready; `http_address` is the readiness endpoint
     the replacement will serve. Without an http_address the handoff
-    degrades to a blind grace period — the replacement is only checked
-    for being alive, so the zero-gap guarantee does NOT hold; a warning
-    says so at install time. Must be called from the main thread
+    falls back to a ready-file handshake (the replacement writes its pid
+    once its listeners are bound, Server.start()); a replacement that
+    never reports bound — even one still alive — loses the handoff and
+    the old process keeps serving. Must be called from the main thread
     (signal module contract)."""
     if not http_address:
-        logger.warning(
-            "graceful restart installed WITHOUT a readiness endpoint: "
-            "SIGUSR2 will use a blind %.0fs grace instead of waiting "
-            "for /healthcheck/ready — configure http_address for a "
-            "zero-gap handoff", NO_HTTP_GRACE_S)
+        logger.info(
+            "graceful restart installed without a readiness endpoint: "
+            "SIGUSR2 handoffs will use the ready-file handshake "
+            "(replacement reports once its listeners are bound)")
 
     def handler(signum, frame):
         if not _in_progress.acquire(blocking=False):
@@ -72,6 +77,21 @@ def install(shutdown, http_address: str = "", argv=None) -> None:
     signal.signal(signal.SIGUSR2, handler)
 
 
+def mark_ready() -> None:
+    """Report "listeners bound" to a parent mid-SIGUSR2 handoff: write
+    our pid to the ready file it named in the environment. Called by
+    Server.start() and the proxy CLI once every listener is up; a no-op
+    outside a handoff."""
+    ready_file = os.environ.get(READY_FILE_ENV)
+    if not ready_file:
+        return
+    try:
+        with open(ready_file, "w") as f:
+            f.write(str(os.getpid()))
+    except OSError:
+        logger.exception("could not write restart ready-file")
+
+
 def respawn_argv(argv=None):
     argv = list(sys.argv if argv is None else argv)
     if argv and os.access(argv[0], os.X_OK) and not argv[0].endswith(".py"):
@@ -89,12 +109,29 @@ def respawn_argv(argv=None):
 def _restart(shutdown, http_address: str, argv) -> None:
     cmd = respawn_argv(argv)
     logger.info("SIGUSR2: spawning replacement process: %s", cmd)
+    ready_file = ""
+    env = None
+    if not http_address:
+        import tempfile
+        # the mkstemp-owned (0600) file stays in place — unlinking and
+        # letting the child re-create the path would hand a
+        # world-writable-dir TOCTOU to anyone watching TMPDIR. The file
+        # stays empty until the replacement truncate-writes its pid.
+        fd, ready_file = tempfile.mkstemp(prefix="veneur-ready-")
+        os.close(fd)
+        env = dict(os.environ, **{READY_FILE_ENV: ready_file})
     try:
-        child = subprocess.Popen(cmd)
+        child = subprocess.Popen(cmd, env=env)
     except Exception:
         logger.exception("replacement spawn failed; keeping this process")
         return
-    if not _wait_ready(http_address, child):
+    ok = _wait_ready(http_address, child, ready_file=ready_file)
+    if ready_file:
+        try:
+            os.unlink(ready_file)
+        except OSError:
+            pass
+    if not ok:
         if child.poll() is None:
             logger.error("replacement not ready after %.0fs; keeping "
                          "this process (replacement left running)",
@@ -108,12 +145,24 @@ def _restart(shutdown, http_address: str, argv) -> None:
     shutdown()
 
 
-def _wait_ready(addr: str, child, timeout: float = READY_TIMEOUT_S) -> bool:
+def _wait_ready(addr: str, child, timeout: float = READY_TIMEOUT_S,
+                ready_file: str = "") -> bool:
     if not addr:
-        # no readiness endpoint: a short grace period, then hand off if
-        # the replacement is still alive
-        time.sleep(NO_HTTP_GRACE_S)
-        return child.poll() is None
+        # no readiness endpoint: wait for the ready-file handshake — the
+        # replacement writes its pid once Server.start() has bound the
+        # listeners. Alive-but-wedged is NOT ready.
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if child.poll() is not None:
+                return False
+            try:
+                with open(ready_file) as f:
+                    if f.read().strip() == str(child.pid):
+                        return True
+            except OSError:
+                pass
+            time.sleep(0.25)
+        return False
     host, _, port = addr.rpartition(":")
     url = f"http://{host or '127.0.0.1'}:{port}/healthcheck/ready"
     deadline = time.time() + timeout

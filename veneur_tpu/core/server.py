@@ -540,6 +540,11 @@ class Server:
             self._watchdog_thread = threading.Thread(
                 target=self._flush_watchdog, name="flush-watchdog", daemon=True)
             self._watchdog_thread.start()
+        # graceful-restart handshake: a parent mid-SIGUSR2 waits for the
+        # ready file before it drains — written only now, with every
+        # listener bound, so a wedged startup never wins a handoff
+        from veneur_tpu.core import restart
+        restart.mark_ready()
 
     def local_addr(self, scheme: str = "udp"):
         for listener in self._listeners:
@@ -798,14 +803,19 @@ class Server:
         engine = (self._ingester._engine
                   if getattr(self, "_ingester", None) is not None else None)
         if idle > 0:
+            pairs = []
             for table, family in tables:
                 try:
                     evicted = table.reclaim_idle(idle)
                 except Exception:
                     logger.exception("idle-row reclamation failed")
                     continue
-                if evicted and family is not None and engine is not None:
-                    engine.unregister_rows(family, evicted)
+                if evicted and family is not None:
+                    pairs.extend((family, row) for row in evicted)
+            if pairs and engine is not None:
+                # one combined intern-table sweep per flush: the pump
+                # readers block on the shared lock once, not per family
+                engine.unregister_rows_multi(pairs)
         self.statsd.gauge(
             "intern.rows_total",
             sum(len(t.rows) for t, _f in tables))

@@ -91,9 +91,8 @@ def _declare(lib) -> None:
     lib.vnt_register.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, i64, ctypes.c_int32,
         ctypes.c_int32, ctypes.c_double]
-    lib.vnt_unregister_rows.restype = None
-    lib.vnt_unregister_rows.argtypes = [
-        ctypes.c_void_p, ctypes.c_int32, i32p, i64]
+    lib.vnt_unregister_rows2.restype = None
+    lib.vnt_unregister_rows2.argtypes = [ctypes.c_void_p, i32p, i32p, i64]
     lib.vnt_reader_new.restype = ctypes.c_void_p
     lib.vnt_reader_new.argtypes = [ctypes.c_int32, i64]
     lib.vnt_reader_free.restype = None
@@ -293,13 +292,16 @@ class Engine:
         self._lib.vnt_register(
             self.ptr, meta_key, len(meta_key), family, row, rate)
 
-    def unregister_rows(self, family: int, rows) -> None:
-        """Erase every mapping pointing at `rows` in `family` (idle-row
-        reclamation; must happen before the row ids are recycled)."""
-        arr = np.asarray(rows, np.int32)
-        if arr.size:
-            self._lib.vnt_unregister_rows(
-                self.ptr, family, _ptr(arr, ctypes.c_int32), arr.size)
+    def unregister_rows_multi(self, pairs) -> None:
+        """Erase (family, row) mappings across ALL families in a single
+        table sweep — the per-flush form, so pump readers block on the
+        intern lock once per flush instead of once per family."""
+        fams = np.asarray([f for f, _r in pairs], np.int32)
+        rows = np.asarray([r for _f, r in pairs], np.int32)
+        if fams.size:
+            self._lib.vnt_unregister_rows2(
+                self.ptr, _ptr(fams, ctypes.c_int32),
+                _ptr(rows, ctypes.c_int32), fams.size)
 
 
 class NativeParser:

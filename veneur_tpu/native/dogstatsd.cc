@@ -398,13 +398,25 @@ void vnt_register(void* ep, const uint8_t* key, int64_t keylen,
 // tombstones the rows; this guarantees no NEW native samples can
 // reference them before the row ids are recycled an interval later).
 // One O(table) sweep amortizes over the whole evicted batch.
-void vnt_unregister_rows(void* ep, int32_t family, const int32_t* rows,
-                         int64_t n) {
+// Erases every (family, row) mapping named in the parallel arrays in
+// ONE O(table) sweep under the unique lock. The server collects every
+// family's evicted rows per flush and pays the reader-blocking lock
+// once (a per-family sweep would block the pump readers up to four
+// times per flush).
+void vnt_unregister_rows2(void* ep, const int32_t* families,
+                          const int32_t* rows, int64_t n) {
   Engine* e = static_cast<Engine*>(ep);
-  std::unordered_set<int32_t> dead(rows, rows + n);
+  std::unordered_set<int64_t> dead;
+  dead.reserve(static_cast<size_t>(n));
+  for (int64_t i = 0; i < n; i++) {
+    dead.insert((static_cast<int64_t>(families[i]) << 32) |
+                static_cast<uint32_t>(rows[i]));
+  }
   std::unique_lock lock(e->mu);
   for (auto it = e->table.begin(); it != e->table.end();) {
-    if (it->second.family == family && dead.count(it->second.row)) {
+    int64_t key = (static_cast<int64_t>(it->second.family) << 32) |
+                  static_cast<uint32_t>(it->second.row);
+    if (dead.count(key)) {
       it = e->table.erase(it);
     } else {
       ++it;
