@@ -497,11 +497,14 @@ class TestFalconerLightstepNewrelic:
         sink.ingest(make_span(trace_id=1))
         sink.ingest(make_span(trace_id=2))
         sink.flush()
-        assert len(fake.requests) == 2
-        _, _, body = fake.requests[0]
+        assert len(fake.requests) == 2  # one OTLP request per stripe
+        path, headers, body = fake.requests[0]
+        assert path.endswith("/v1/traces")
+        lower = {k.lower(): v for k, v in headers.items()}
+        assert lower["lightstep-access-token"] == "at"
         payload = json.loads(body)
-        assert payload["auth"]["access_token"] == "at"
-        assert len(payload["span_records"]) == 1
+        spans = payload["resourceSpans"][0]["scopeSpans"][0]["spans"]
+        assert len(spans) == 1
 
     def test_newrelic_metrics(self, fake):
         from veneur_tpu.sinks.newrelic import NewRelicMetricSink
@@ -938,7 +941,8 @@ class TestLightstepMaxSpans:
         assert sink.dropped_total == 7
         sink.flush()
         payload = json.loads(fake.requests[0][2])
-        assert len(payload["span_records"]) == 3
+        spans = payload["resourceSpans"][0]["scopeSpans"][0]["spans"]
+        assert len(spans) == 3
 
 
 class TestNewRelicEvents:

@@ -137,6 +137,43 @@ def test_newrelic_metrics_shape(fake):
     assert by_name["nr.gauge"]["type"] == "gauge"
 
 
+def test_lightstep_otlp_shape(fake):
+    """The lightstep sink speaks OTLP/HTTP JSON (the OpenTelemetry
+    ExportTraceServiceRequest shape, which current LightStep/ServiceNow
+    collectors accept at /v1/traces) — schema transcribed from the
+    public OTLP JSON encoding spec."""
+    from veneur_tpu.sinks.lightstep import LightStepSpanSink
+
+    sink = LightStepSpanSink("ls", access_token="tok",
+                             collector_url=fake.url)
+    sink.ingest(make_span(trace_id=11, span_id=12, name="root",
+                          service="svc"))
+    sink.ingest(make_span(trace_id=11, span_id=13, parent_id=12,
+                          name="child", service="svc", error=True))
+    sink.flush()
+    assert fake.event.wait(5)
+    path, headers, body = fake.requests[0]
+    assert path.endswith("/v1/traces")
+    lower = {k.lower(): v for k, v in headers.items()}
+    assert lower["lightstep-access-token"] == "tok"
+    payload = json.loads(body)
+    check(payload, SCHEMAS["otlp_traces"])
+    spans = payload["resourceSpans"][0]["scopeSpans"][0]["spans"]
+    by_id = {s["spanId"]: s for s in spans}
+    # OTLP semantics spot checks: fixed-width hex ids, ns-string
+    # timestamps, parent link, error status
+    root = by_id[format(12, "016x")]
+    child = by_id[format(13, "016x")]
+    assert len(root["traceId"]) == 32 and root["traceId"].endswith("b")
+    assert "parentSpanId" not in root
+    assert child["parentSpanId"] == format(12, "016x")
+    assert child["status"]["code"] == 2
+    assert int(root["startTimeUnixNano"]) > 10 ** 17  # ns, not s
+    svc_attr = payload["resourceSpans"][0]["resource"]["attributes"][0]
+    assert svc_attr == {"key": "service.name",
+                        "value": {"stringValue": "svc"}}
+
+
 def test_newrelic_trace_shape(fake):
     from veneur_tpu.sinks.newrelic import NewRelicSpanSink
 

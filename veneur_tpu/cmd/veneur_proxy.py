@@ -119,8 +119,8 @@ def main(argv=None) -> int:
         http_api.start()
 
     # every listener is bound: report readiness to a parent mid-handoff
-    from veneur_tpu.core import restart as _restart_mod
-    _restart_mod.mark_ready()
+    from veneur_tpu.core import restart
+    restart.mark_ready()
 
     stop = threading.Event()
 
@@ -138,7 +138,6 @@ def main(argv=None) -> int:
     # after the replacement is ready. With http_address the parent polls
     # /healthcheck/ready; without it the handoff uses the ready-file
     # handshake (mark_ready above, written once the proxy was bound).
-    from veneur_tpu.core import restart
     restart.install(stop.set, http_addr or "")
 
     stop.wait()

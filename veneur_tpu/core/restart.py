@@ -82,9 +82,13 @@ def mark_ready() -> None:
     our pid to the ready file it named in the environment. Called by
     Server.start() and the proxy CLI once every listener is up; a no-op
     outside a handoff."""
-    ready_file = os.environ.get(READY_FILE_ENV)
+    ready_file = os.environ.pop(READY_FILE_ENV, "")
     if not ready_file:
         return
+    # popped above: the handshake is single-use — inheriting the env var
+    # would make descendants re-create the (by then unlinked) /tmp path
+    # with open('w') later, the symlink-following TOCTOU the mkstemp in
+    # _restart exists to avoid
     try:
         with open(ready_file, "w") as f:
             f.write(str(os.getpid()))
@@ -124,6 +128,11 @@ def _restart(shutdown, http_address: str, argv) -> None:
         child = subprocess.Popen(cmd, env=env)
     except Exception:
         logger.exception("replacement spawn failed; keeping this process")
+        if ready_file:
+            try:
+                os.unlink(ready_file)
+            except OSError:
+                pass
         return
     ok = _wait_ready(http_address, child, ready_file=ready_file)
     if ready_file:

@@ -1,19 +1,17 @@
-"""LightStep sink: spans to a LightStep collector.
+"""LightStep sink: spans to a LightStep / ServiceNow Cloud Observability
+collector over OTLP/HTTP JSON.
 
 Behavioral parity with reference sinks/lightstep/lightstep.go (264 LoC)
 for buffering, striping, and accounting: the reference wraps the
-official LightStep tracer library, which speaks the LightStep collector
-protocol (protobuf collector.proto over HTTPS/gRPC).
-
-COLLECTOR-SHAPE-UNVERIFIED: this rebuild posts a homegrown JSON report
-(span fields + access token) rather than the tracer library's wire
-protocol, and no fixture captured from a real LightStep collector
-validates it. Use it as a structural stand-in — buffering/striping/drop
-semantics match the reference — but verify the report shape against a
-live collector (or swap in an OTLP exporter, which current
-LightStep/ServiceNow collectors accept) before production use. The
-vendor-schema pins in tests/test_vendor_payloads.py deliberately do NOT
-cover this sink for that reason."""
+official LightStep tracer library (one buffer per tracer client,
+`lightstep_num_clients` stripes keyed by trace id, MaxBufferedSpans
+overflow drops, flush-time delivery). The tracer's proprietary
+collector protocol was retired by the vendor in favor of OTLP, which
+current LightStep/ServiceNow collectors ingest natively at /v1/traces
+(access token in the `lightstep-access-token` header) — so this rebuild
+speaks OTLP/HTTP JSON, the OpenTelemetry ExportTraceServiceRequest
+shape. The payload schema is pinned in tests/test_vendor_payloads.py.
+"""
 
 from __future__ import annotations
 
@@ -27,6 +25,39 @@ from veneur_tpu.util import http as vhttp
 logger = logging.getLogger("veneur_tpu.sinks.lightstep")
 
 
+def _hex_id(value: int, width: int) -> str:
+    """OTLP JSON carries trace/span ids as fixed-width lowercase hex
+    (16 bytes / 8 bytes); SSF ids are 64-bit, so trace ids zero-extend
+    into the high 8 bytes."""
+    return format(value & ((1 << 64) - 1), f"0{width}x")
+
+
+def span_to_otlp(span) -> dict:
+    """One SSF span -> one OTLP JSON Span object (trace.v1.Span)."""
+    attributes = [
+        {"key": k, "value": {"stringValue": str(v)}}
+        for k, v in dict(span.tags).items()
+    ]
+    out = {
+        "traceId": _hex_id(span.trace_id, 32),
+        "spanId": _hex_id(span.id, 16),
+        "name": span.name or "unknown",
+        # SPAN_KIND_INTERNAL: SSF spans carry no client/server direction
+        "kind": 1,
+        "startTimeUnixNano": str(span.start_timestamp),
+        "endTimeUnixNano": str(span.end_timestamp),
+        "attributes": attributes,
+    }
+    if span.parent_id:
+        out["parentSpanId"] = _hex_id(span.parent_id, 16)
+    if span.error:
+        out["status"] = {"code": 2}  # STATUS_CODE_ERROR
+    if span.indicator:
+        attributes.append(
+            {"key": "indicator", "value": {"boolValue": True}})
+    return out
+
+
 class LightStepSpanSink(SpanSink):
     def __init__(self, name: str, access_token: str, collector_url: str,
                  num_clients: int = 1, timeout: float = 10.0,
@@ -36,7 +67,7 @@ class LightStepSpanSink(SpanSink):
         # one buffer per "client" stripe, keyed by trace id, mirroring the
         # reference's multiple tracer clients (lightstep.go)
         self.num_clients = max(1, num_clients)
-        self.collector_url = collector_url
+        self.collector_url = collector_url.rstrip("/")
         self.timeout = timeout
         self._buffers: List[List[dict]] = [[] for _ in range(self.num_clients)]
         self._lock = threading.Lock()
@@ -53,28 +84,36 @@ class LightStepSpanSink(SpanSink):
         return "lightstep"
 
     def ingest(self, span) -> None:
-        report = {
-            "span_guid": format(span.id & ((1 << 64) - 1), "x"),
-            "trace_guid": format(span.trace_id & ((1 << 64) - 1), "x"),
-            "span_name": span.name,
-            "oldest_micros": span.start_timestamp // 1000,
-            "youngest_micros": span.end_timestamp // 1000,
-            "attributes": [{"Key": k, "Value": v}
-                           for k, v in dict(span.tags).items()]
-            + [{"Key": "service", "Value": span.service},
-               {"Key": "error", "Value": str(bool(span.error)).lower()}],
-        }
-        if span.parent_id:
-            report["attributes"].append(
-                {"Key": "parent_span_guid",
-                 "Value": format(span.parent_id & ((1 << 64) - 1), "x")})
+        otlp = span_to_otlp(span)
+        otlp["_service"] = span.service or "unknown"  # grouped at flush
         with self._lock:
             buf = self._buffers[span.trace_id % self.num_clients]
             if self.maximum_spans and len(buf) >= self.maximum_spans:
                 self.dropped_total += 1
                 return
-            buf.append(report)
+            buf.append(otlp)
             self.spans_handled += 1
+
+    def _report_of(self, spans: List[dict]) -> dict:
+        """Buffered spans -> one ExportTraceServiceRequest: spans group
+        into a resourceSpans entry per service.name (OTLP's resource is
+        the emitting entity; SSF carries it per span)."""
+        by_service: dict = {}
+        for s in spans:
+            by_service.setdefault(s.pop("_service"), []).append(s)
+        return {"resourceSpans": [
+            {
+                "resource": {"attributes": [
+                    {"key": "service.name",
+                     "value": {"stringValue": service}},
+                ]},
+                "scopeSpans": [{
+                    "scope": {"name": "veneur-tpu"},
+                    "spans": group,
+                }],
+            }
+            for service, group in sorted(by_service.items())
+        ]}
 
     def flush(self) -> None:
         import time as _time
@@ -88,12 +127,13 @@ class LightStepSpanSink(SpanSink):
         for spans in buffers:
             if not spans or not self.collector_url:
                 continue
-            payload = {"auth": {"access_token": self.access_token},
-                       "span_records": spans}
+            payload = self._report_of(spans)
             try:
-                vhttp.post_json(f"{self.collector_url}/api/v0/reports",
+                vhttp.post_json(f"{self.collector_url}/v1/traces",
                                 payload, compress="gzip",
-                                timeout=self.timeout)
+                                timeout=self.timeout,
+                                headers={"lightstep-access-token":
+                                         self.access_token})
                 sent += len(spans)
             except Exception as e:
                 logger.error("lightstep report failed: %s", e)
