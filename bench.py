@@ -121,6 +121,26 @@ def enable_compile_cache() -> None:
         log(f"compile cache unavailable: {e}")
 
 
+def _relay_listening(host: str = "127.0.0.1",
+                     ports=range(8080, 8121)) -> bool:
+    """Instant health check for the axon TPU tunnel: its relay is a
+    local TCP forwarder, so a dead relay means nothing listens on any
+    pool port and a jax probe can only time out. 50 ms connect scans
+    beat two 45-75 s subprocess probes when the answer is already no."""
+    import socket as _socket
+    for port in ports:
+        s = _socket.socket()
+        s.settimeout(0.05)
+        try:
+            s.connect((host, port))
+            return True
+        except OSError:
+            continue
+        finally:
+            s.close()
+    return False
+
+
 def initialize_backend(probe_timeouts=None) -> str:
     """Bring up the JAX backend before constructing any pipeline object so
     a backend failure is visible up front (round-1 failure modes: axon TPU
@@ -145,7 +165,18 @@ def initialize_backend(probe_timeouts=None) -> str:
     # is a fresh subprocess, i.e. a full backend re-init from scratch —
     # staged backoff with growing timeouts rides out a transient tunnel
     # wedge without eating the whole wall-clock budget.
-    if not env_platform.startswith("cpu"):
+    relay_ok = None
+    if env_platform.split(",")[0] == "axon":
+        relay_ok = _relay_listening()
+        RESULT["tunnel"] = {"relay_listening": relay_ok}
+    if relay_ok is False:
+        # the axon relay is a local TCP forwarder; when its process is
+        # gone nothing listens on the pool ports and every probe is a
+        # guaranteed timeout — skip them and keep the budget for the
+        # CPU stages (tunnel provenance lands in the artifact)
+        fallback_reason = "relay not listening (instant pre-check)"
+        log("axon relay ports closed; skipping subprocess probes")
+    elif not env_platform.startswith("cpu"):
         for attempt, probe_timeout in enumerate(probe_timeouts, 1):
             if time_left() < probe_timeout + 45:
                 fallback_reason = fallback_reason or "probe budget exhausted"
@@ -898,9 +929,13 @@ def run_default(args, on_tpu: bool) -> None:
     from veneur_tpu import native
 
     if on_tpu:
-        keys, interval_s, intervals = 100_000, 10.0, 3
+        # >= 5 flushes when the budget allows: p50/p99 quoted off 2-3
+        # samples is not a latency claim (VERDICT r04); the time_left
+        # guard below still protects the device/config stages
+        keys, interval_s = 100_000, 10.0
+        intervals = 5 if time_left() > 150 else 3
     elif time_left() > 130:
-        keys, interval_s, intervals = 50_000, 5.0, 2
+        keys, interval_s, intervals = 50_000, 5.0, 3
     else:  # late start (probe retries ate the budget): keep stages landing
         keys, interval_s, intervals = 10_000, 2.0, 2
 

@@ -67,7 +67,8 @@ class LightStepSpanSink(SpanSink):
         # one buffer per "client" stripe, keyed by trace id, mirroring the
         # reference's multiple tracer clients (lightstep.go)
         self.num_clients = max(1, num_clients)
-        self.collector_url = collector_url.rstrip("/")
+        # explicit YAML null reaches here as None; flush() skips falsy
+        self.collector_url = (collector_url or "").rstrip("/")
         self.timeout = timeout
         self._buffers: List[List[dict]] = [[] for _ in range(self.num_clients)]
         self._lock = threading.Lock()
