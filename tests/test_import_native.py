@@ -1,0 +1,261 @@
+"""Native MetricList import decoder (vnt_import_parse): must merge the
+same state as the upb object path for every family, survive foreign
+wire shapes (unknown fields, oversized digests, the retired `samples`
+centroid field), and fall back cleanly on garbage."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from veneur_tpu import native
+from veneur_tpu.config import Config
+from veneur_tpu.core.server import Server
+from veneur_tpu.forward.client import _frame_v1
+from veneur_tpu.forward.protos import forward_pb2, metric_pb2, tdigest_pb2
+from veneur_tpu.forward.server import ImportServer, _MergeBuffer
+from veneur_tpu.ops import batch_tdigest
+from veneur_tpu.sinks.channel import ChannelMetricSink
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native library unavailable")
+
+
+def mk_server():
+    cfg = Config()
+    cfg.interval = 3600
+    cfg.hostname = "imp"
+    cfg.statsd_listen_addresses = []
+    cfg.tpu.histo_capacity = 1024
+    cfg.apply_defaults()
+    obs = ChannelMetricSink()
+    return Server(cfg, extra_metric_sinks=[obs]), obs
+
+
+def digest_metric(name, means, weights, dmin=0.0, dmax=0.0, drecip=0.0,
+                  tags=(), mtype=metric_pb2.Timer,
+                  scope=metric_pb2.Mixed):
+    d = tdigest_pb2.MergingDigestData(
+        compression=batch_tdigest.COMPRESSION, min=dmin, max=dmax,
+        reciprocalSum=drecip)
+    for mean, w in zip(means, weights):
+        d.main_centroids.add(mean=mean, weight=w)
+    return metric_pb2.Metric(
+        name=name, tags=list(tags), type=mtype, scope=scope,
+        histogram=metric_pb2.HistogramValue(t_digest=d))
+
+
+def body_of(metrics):
+    return b"".join(_frame_v1(m.SerializeToString()) for m in metrics)
+
+
+def flush_names_values(server, obs):
+    server.flush()
+    try:
+        return {m.name: m.value for m in obs.wait_flush(timeout=2)}
+    except Exception:  # a flush that emitted nothing
+        return {}
+
+
+class TestParityWithUpbPath:
+    def test_all_families_merge_identically(self):
+        rng = np.random.default_rng(5)
+        metrics = []
+        for i in range(40):
+            metrics.append(metric_pb2.Metric(
+                name=f"c{i}", tags=[f"t:{i % 4}"], type=metric_pb2.Counter,
+                scope=metric_pb2.Global,
+                counter=metric_pb2.CounterValue(value=i * 3)))
+            metrics.append(metric_pb2.Metric(
+                name=f"g{i}", type=metric_pb2.Gauge, scope=metric_pb2.Global,
+                gauge=metric_pb2.GaugeValue(value=i * 0.5)))
+            vals = rng.normal(50, 10, 30)
+            metrics.append(digest_metric(
+                f"h{i}", vals, rng.random(30) + 0.1,
+                dmin=float(vals.min()), dmax=float(vals.max()),
+                tags=(f"k:{i}",)))
+        body = body_of(metrics)
+
+        srv_a, obs_a = mk_server()
+        imp_a = ImportServer(srv_a, "127.0.0.1:0")
+        assert imp_a._merge_native(body) == len(metrics)
+
+        srv_b, obs_b = mk_server()
+        imp_b = ImportServer(srv_b, "127.0.0.1:0")
+        req = forward_pb2.MetricList.FromString(body)
+        buf = _MergeBuffer(imp_b)
+        for pbm in req.metrics:
+            buf.add(pbm)
+        buf.flush_all()
+
+        got_a = flush_names_values(srv_a, obs_a)
+        got_b = flush_names_values(srv_b, obs_b)
+        assert set(got_a) == set(got_b)
+        for name in got_b:
+            assert got_a[name] == pytest.approx(got_b[name], rel=1e-4,
+                                                abs=1e-4), name
+        srv_a.shutdown()
+        srv_b.shutdown()
+
+    def test_sets_merge_identically(self):
+        from veneur_tpu.forward import hllwire
+        from veneur_tpu.ops import hll_ref
+
+        rng = np.random.default_rng(9)
+        regs = np.zeros(hll_ref.M, np.uint8)
+        for _ in range(500):
+            x = int(rng.integers(0, 2**63))
+            idx, rho = hll_ref.pos_val(x)
+            regs[idx] = max(regs[idx], rho)
+        pbm = metric_pb2.Metric(
+            name="s1", type=metric_pb2.Set, scope=metric_pb2.Global,
+            set=metric_pb2.SetValue(hyper_log_log=hllwire.marshal(regs)))
+        body = body_of([pbm])
+        srv, obs = mk_server()
+        imp = ImportServer(srv, "127.0.0.1:0")
+        assert imp._merge_native(body) == 1
+        got = flush_names_values(srv, obs)
+        assert got["s1"] == pytest.approx(500, rel=0.05)
+        srv.shutdown()
+
+
+class TestForeignShapes:
+    def test_oversized_digest_rebuckets(self):
+        # a foreign peer may send up to ~158 centroids; they must fold
+        # onto the C-slot grid, preserving total weight
+        rng = np.random.default_rng(3)
+        n = batch_tdigest.C + 30
+        vals = np.sort(rng.normal(100, 20, n))
+        weights = rng.random(n) + 0.5
+        # Global scope: mixed digests at the global tier deliberately
+        # emit only percentiles (the local tier owns min/max/count)
+        body = body_of([digest_metric("big", vals, weights,
+                                      dmin=float(vals.min()),
+                                      dmax=float(vals.max()),
+                                      scope=metric_pb2.Global)])
+        srv, obs = mk_server()
+        imp = ImportServer(srv, "127.0.0.1:0")
+        assert imp._merge_native(body) == 1
+        got = flush_names_values(srv, obs)
+        assert got["big.count"] == pytest.approx(weights.sum(), rel=1e-3)
+        assert got["big.min"] == pytest.approx(vals.min(), rel=1e-4)
+        srv.shutdown()
+
+    def test_unknown_fields_and_samples_skipped(self):
+        pbm = digest_metric("x", [1.0, 2.0], [1.0, 1.0], dmin=1, dmax=2,
+                            scope=metric_pb2.Global)
+        raw = bytearray(pbm.SerializeToString())
+        # append an unknown field 15 (varint) at the Metric level
+        raw += bytes([15 << 3 | 0, 42])
+        body = _frame_v1(bytes(raw))
+        srv, obs = mk_server()
+        imp = ImportServer(srv, "127.0.0.1:0")
+        assert imp._merge_native(body) == 1
+        got = flush_names_values(srv, obs)
+        assert got["x.count"] == pytest.approx(2.0)
+        srv.shutdown()
+
+    def test_unknown_type_enum_skipped(self):
+        pbm = metric_pb2.Metric(
+            name="odd", type=metric_pb2.Counter, scope=metric_pb2.Global,
+            counter=metric_pb2.CounterValue(value=1))
+        raw = bytearray(pbm.SerializeToString())
+        # rewrite field 3 (type) to an unknown enum value 9
+        body = body_of([pbm])
+        srv, obs = mk_server()
+        imp = ImportServer(srv, "127.0.0.1:0")
+        # hand-build: type=9 (open proto3 enum from a newer peer)
+        alt = metric_pb2.Metric.FromString(pbm.SerializeToString())
+        alt.type = 9
+        body2 = body_of([alt])
+        assert imp._merge_native(body2) == 1  # consumed but not merged
+        got = flush_names_values(srv, obs)
+        assert "odd" not in got
+        srv.shutdown()
+
+    def test_empty_digest_skipped(self):
+        body = body_of([digest_metric("empty", [], [])])
+        srv, obs = mk_server()
+        imp = ImportServer(srv, "127.0.0.1:0")
+        assert imp._merge_native(body) == 1
+        got = flush_names_values(srv, obs)
+        assert not any(k.startswith("empty") for k in got)
+        srv.shutdown()
+
+    def test_garbage_falls_back_to_none(self):
+        srv, _obs = mk_server()
+        imp = ImportServer(srv, "127.0.0.1:0")
+        assert imp._merge_native(b"\xff\xff\xff\x07garbage") is None
+        srv.shutdown()
+
+    def test_truncated_nested_value_rejected(self):
+        """A corrupt CounterValue (truncated varint) must reject the
+        whole request — never merge a fabricated zero. The upb fallback
+        then raises DecodeError to the sender, matching its contract."""
+        srv, _obs = mk_server()
+        imp = ImportServer(srv, "127.0.0.1:0")
+        # Metric{name="x", counter=CounterValue<truncated varint>}
+        bad = _frame_v1(b"\x0a\x01x\x2a\x02\x08\xff\x48\x02")
+        assert imp._merge_native(bad) is None
+        assert len(srv.store.counters.rows) == 0
+        srv.shutdown()
+
+    def test_zero_field_number_rejected(self):
+        """A 0x00 byte mid-stream is invalid wire data (field number 0),
+        not a clean end: metrics after it must not be silently dropped
+        behind an OK ack."""
+        good = metric_pb2.Metric(
+            name="ok", type=metric_pb2.Counter, scope=metric_pb2.Global,
+            counter=metric_pb2.CounterValue(value=1))
+        body = body_of([good]) + b"\x00\x00\x00"
+        srv, _obs = mk_server()
+        imp = ImportServer(srv, "127.0.0.1:0")
+        assert imp._merge_native(body) is None
+        srv.shutdown()
+
+    def test_wide_open_enum_not_aliased(self):
+        """Open proto3 enums can exceed one byte; type=256 must not
+        alias onto Counter through the key's uint8 truncation."""
+        pbm = metric_pb2.Metric(
+            name="wide", scope=metric_pb2.Global,
+            counter=metric_pb2.CounterValue(value=5))
+        pbm.type = 256
+        body = body_of([pbm])
+        srv, obs = mk_server()
+        imp = ImportServer(srv, "127.0.0.1:0")
+        assert imp._merge_native(body) == 1  # consumed, not merged
+        assert len(srv.store.counters.rows) == 0
+        srv.shutdown()
+
+
+class TestStubCache:
+    def test_cache_hit_skips_rebuild(self):
+        body = body_of([metric_pb2.Metric(
+            name="cc", tags=["a:1"], type=metric_pb2.Counter,
+            scope=metric_pb2.Global,
+            counter=metric_pb2.CounterValue(value=2))])
+        srv, obs = mk_server()
+        imp = ImportServer(srv, "127.0.0.1:0")
+        imp._merge_native(body)
+        assert len(imp._stub_cache) == 1
+        stub = next(iter(imp._stub_cache.values()))
+        imp._merge_native(body)
+        assert next(iter(imp._stub_cache.values())) is stub  # reused
+        got = flush_names_values(srv, obs)
+        assert got["cc"] == 4.0  # both merges landed
+        srv.shutdown()
+
+    def test_ignored_tags_filtered_once(self):
+        from veneur_tpu.util.matcher import TagMatcher
+        body = body_of([metric_pb2.Metric(
+            name="ct", tags=["drop:me", "keep:yes"],
+            type=metric_pb2.Counter, scope=metric_pb2.Global,
+            counter=metric_pb2.CounterValue(value=1))])
+        srv, obs = mk_server()
+        imp = ImportServer(srv, "127.0.0.1:0",
+                           ignored_tags=[TagMatcher(kind="prefix",
+                                                    value="drop")])
+        imp._merge_native(body)
+        stub = next(iter(imp._stub_cache.values()))
+        assert stub.tags == ["keep:yes"]
+        srv.shutdown()

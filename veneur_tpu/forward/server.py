@@ -49,7 +49,9 @@ class ImportServer:
                 response_serializer=lambda _: b""),
             "SendMetrics": grpc.unary_unary_rpc_method_handler(
                 self.rpc_stats.timed("SendMetrics", self._send_metrics_v1),
-                request_deserializer=forward_pb2.MetricList.FromString,
+                # raw bytes: the native MetricList decoder wants the wire
+                # body; the upb fallback parses it itself
+                request_deserializer=lambda b: b,
                 response_serializer=lambda _: b""),
         })
         self._grpc.add_generic_rpc_handlers((handler,))
@@ -61,6 +63,10 @@ class ImportServer:
         if self.port == 0:
             raise RuntimeError(f"could not bind import server to {address}")
         self.imported_total = 0
+        # identity-key -> UDPMetric stub: forward streams repeat the
+        # same keys every interval, so the native import path pays
+        # update_tags/fnv once per key lifetime instead of per flush
+        self._stub_cache: dict = {}
 
     @property
     def address(self) -> str:
@@ -75,20 +81,126 @@ class ImportServer:
 
     # -- handlers --------------------------------------------------------
 
-    def _send_metrics_v1(self, req, ctx):
+    def _send_metrics_v1(self, body, ctx):
         """Unary MetricList import — the bulk fast path. The reference
         importer retires this endpoint (sources/proxy/server.go:138-142)
         but its proxy still accepts it (proxy/handlers/handlers.go:41-60,
         "grpc-single"); this framework accepts it on the importer too
         because one unary message parsed by upb in C is dramatically
         cheaper than 50k individually-framed stream messages — the native
-        forward client sends V1 first and falls back to V2 streams."""
-        buf = _MergeBuffer(self)
-        for pbm in req.metrics:
-            buf.add(pbm)
-        buf.flush_all()
-        self.imported_total += len(req.metrics)
+        forward client sends V1 first and falls back to V2 streams.
+
+        The body decodes through the native MetricList parser
+        (vnt_import_parse: identity keys + pre-bucketed centroid grids
+        in one C pass) with a cached-stub intern layer; an unavailable
+        native library or unparseable body falls back to upb objects."""
+        count = self._merge_native(body)
+        if count is None:
+            req = forward_pb2.MetricList.FromString(body)
+            buf = _MergeBuffer(self)
+            for pbm in req.metrics:
+                buf.add(pbm)
+            buf.flush_all()
+            count = len(req.metrics)
+        self.imported_total += count
         return b""
+
+    # -- native bulk merge ----------------------------------------------
+
+    STUB_CACHE_MAX = 1_000_000
+
+    def _merge_native(self, body) -> Optional[int]:
+        from veneur_tpu import native
+
+        batch = native.parse_metric_list(
+            body, batch_tdigest.C, batch_tdigest.COMPRESSION)
+        if batch is None:
+            return None
+        store = self._server.store
+        if batch.c_keys:
+            stubs, ok = self._stubs_for(batch.c_keys)
+            if stubs:
+                store.counters.merge_batch(stubs, batch.c_vals[ok])
+        if batch.g_keys:
+            stubs, ok = self._stubs_for(batch.g_keys)
+            if stubs:
+                store.gauges.merge_batch(stubs, batch.g_vals[ok])
+        if batch.h_keys:
+            stubs, ok = self._stubs_for(batch.h_keys)
+            if stubs:
+                store.histos.merge_batch(
+                    stubs, batch.h_means[ok], batch.h_weights[ok],
+                    batch.h_min[ok], batch.h_max[ok], batch.h_recip[ok])
+        if batch.s_keys:
+            stubs, ok = self._stubs_for(batch.s_keys)
+            if stubs:
+                regs, keep = [], []
+                for i, payload in enumerate(
+                        [p for p, use in zip(batch.s_payloads, ok) if use]):
+                    r = _decode_hll(payload)
+                    if r is not None:
+                        regs.append(r)
+                        keep.append(stubs[i])
+                if regs:
+                    store.sets.merge_batch(keep, np.stack(regs))
+        return batch.consumed
+
+    def _stubs_for(self, keys):
+        """Identity keys -> UDPMetric stubs through the intern cache.
+        Forward streams repeat the same keys every interval, so the
+        steady state is one dict hit per key; misses run the same
+        update_tags/scope-coercion path as the upb importer. Returns
+        (stubs, keep-mask) — keys that don't map (unknown type enum,
+        local scope) drop out of the mask."""
+        cache = self._stub_cache
+        stubs = []
+        ok = np.ones(len(keys), bool)
+        for i, key in enumerate(keys):
+            stub = cache.get(key)
+            if stub is None:
+                stub = self._build_stub(key)
+                if stub is False:
+                    ok[i] = False
+                    continue
+                if len(cache) >= self.STUB_CACHE_MAX:
+                    # crude wholesale bound: the cache refills from the
+                    # live key set within one interval
+                    logger.warning("import stub cache cleared at %d "
+                                   "entries", len(cache))
+                    cache.clear()
+                cache[key] = stub
+            stubs.append(stub)
+        return stubs, ok
+
+    def _build_stub(self, key: bytes):
+        from veneur_tpu import native
+        from veneur_tpu.forward.convert import (_TYPE_PB_TO_NAME,
+                                                _SCOPE_FROM_PB)
+        from veneur_tpu.samplers.metrics import update_tags
+
+        try:
+            mtype, scope_pb, name, tags = native.decode_import_key(key)
+        except (IndexError, ValueError):
+            return False
+        type_name = _TYPE_PB_TO_NAME.get(mtype)
+        if type_name is None:
+            logger.warning("unknown metric type %s for %r; skipped",
+                           mtype, name)
+            return False
+        if mtype in (metric_pb2.Counter, metric_pb2.Gauge):
+            scope = MetricScope.GLOBAL_ONLY  # import coercion
+        else:
+            scope = _SCOPE_FROM_PB.get(scope_pb, MetricScope.MIXED)
+        if scope == MetricScope.LOCAL_ONLY:
+            logger.warning("gRPC import does not accept local metrics")
+            return False
+        tags = [t for t in tags
+                if not any(im.match(t) for im in self._ignored)]
+        final, joined, h32, h64 = update_tags(name, type_name, tags, None)
+        from veneur_tpu.samplers.metrics import MetricKey
+        return UDPMetric(key=MetricKey(name, type_name, joined),
+                         digest=h32, digest64=h64, tags=list(final),
+                         scope=scope)
 
     def _send_metrics_v2(self, request_iterator, ctx):
         buf = _MergeBuffer(self)

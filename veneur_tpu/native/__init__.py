@@ -150,6 +150,18 @@ def _declare(lib) -> None:
         ctypes.c_int32, ctypes.c_double, ctypes.c_uint64,
         i64p,                                 # samples extracted
     ]
+    f64p = ctypes.POINTER(ctypes.c_double)
+    lib.vnt_import_count.restype = i64
+    lib.vnt_import_count.argtypes = [ctypes.c_void_p, i64]
+    lib.vnt_import_parse.restype = i64
+    lib.vnt_import_parse.argtypes = [
+        ctypes.c_void_p, i64, i64, ctypes.c_double,
+        u8p, i64,
+        i64p, i64p, f64p, i64, i64p,            # counters
+        i64p, i64p, f64p, i64, i64p,            # gauges
+        i64p, i64p, f32p, f32p, f64p, f64p, f64p, i64, i64p,  # histos
+        i64p, i64p, i64p, i64p, i64, i64p,      # sets
+    ]
     lib.vnt_digest_encode.restype = i64
     lib.vnt_digest_encode.argtypes = [
         f32p, f32p, i64, i64,
@@ -302,6 +314,120 @@ class Engine:
             self._lib.vnt_unregister_rows2(
                 self.ptr, _ptr(fams, ctypes.c_int32),
                 _ptr(rows, ctypes.c_int32), fams.size)
+
+
+class ImportBatch:
+    """Output of parse_metric_list: per-family batches decoded straight
+    from a MetricList wire body. Keys are the self-delimiting identity
+    byte strings the import server caches stubs under."""
+
+    __slots__ = ("consumed", "c_keys", "c_vals", "g_keys", "g_vals",
+                 "h_keys", "h_means", "h_weights", "h_min", "h_max",
+                 "h_recip", "s_keys", "s_payloads")
+
+
+def parse_metric_list(body: bytes, grid_slots: int, compression: float):
+    """Decode a forwardrpc.MetricList request natively. Returns an
+    ImportBatch, or None when the native library is unavailable or the
+    buffer doesn't parse (caller falls back to the upb path)."""
+    lib = load()
+    if lib is None or not body:
+        return None
+    n = lib.vnt_import_count(body, len(body))
+    if n < 0:
+        return None
+    cap = max(1, int(n))
+    key_cap = len(body) + 16 * cap + 64
+    key_buf = np.empty(key_cap, np.uint8)
+    koff = [np.empty(cap, np.int64) for _ in range(4)]
+    klen = [np.empty(cap, np.int64) for _ in range(4)]
+    c_vals = np.empty(cap, np.float64)
+    g_vals = np.empty(cap, np.float64)
+    h_means = np.empty((cap, grid_slots), np.float32)
+    h_weights = np.empty((cap, grid_slots), np.float32)
+    h_min = np.empty(cap, np.float64)
+    h_max = np.empty(cap, np.float64)
+    h_recip = np.empty(cap, np.float64)
+    s_payoff = np.empty(cap, np.int64)
+    s_paylen = np.empty(cap, np.int64)
+    ns = [ctypes.c_int64() for _ in range(4)]
+    rc = lib.vnt_import_parse(
+        body, len(body), grid_slots, float(compression),
+        _ptr(key_buf, ctypes.c_uint8), key_cap,
+        _ptr(koff[0], ctypes.c_int64), _ptr(klen[0], ctypes.c_int64),
+        _ptr(c_vals, ctypes.c_double), cap, ctypes.byref(ns[0]),
+        _ptr(koff[1], ctypes.c_int64), _ptr(klen[1], ctypes.c_int64),
+        _ptr(g_vals, ctypes.c_double), cap, ctypes.byref(ns[1]),
+        _ptr(koff[2], ctypes.c_int64), _ptr(klen[2], ctypes.c_int64),
+        _ptr(h_means, ctypes.c_float), _ptr(h_weights, ctypes.c_float),
+        _ptr(h_min, ctypes.c_double), _ptr(h_max, ctypes.c_double),
+        _ptr(h_recip, ctypes.c_double), cap, ctypes.byref(ns[2]),
+        _ptr(koff[3], ctypes.c_int64), _ptr(klen[3], ctypes.c_int64),
+        _ptr(s_payoff, ctypes.c_int64), _ptr(s_paylen, ctypes.c_int64),
+        cap, ctypes.byref(ns[3]))
+    if rc < 0:
+        return None
+    # keys were written sequentially: copy only the used prefix, not the
+    # body-sized capacity
+    used = 0
+    for i in range(4):
+        if ns[i].value:
+            used = max(used, int(koff[i][ns[i].value - 1]
+                                 + klen[i][ns[i].value - 1]))
+    kb = key_buf[:used].tobytes()
+
+    def keys_of(i):
+        offs = koff[i][:ns[i].value].tolist()
+        lens = klen[i][:ns[i].value].tolist()
+        return [kb[o:o + ln] for o, ln in zip(offs, lens)]
+
+    out = ImportBatch()
+    out.consumed = int(rc)
+    out.c_keys = keys_of(0)
+    out.c_vals = c_vals[:ns[0].value]
+    out.g_keys = keys_of(1)
+    out.g_vals = g_vals[:ns[1].value]
+    nh = ns[2].value
+    out.h_keys = keys_of(2)
+    out.h_means = h_means[:nh]
+    out.h_weights = h_weights[:nh]
+    out.h_min = h_min[:nh]
+    out.h_max = h_max[:nh]
+    out.h_recip = h_recip[:nh]
+    out.s_keys = keys_of(3)
+    out.s_payloads = [body[o:o + ln] for o, ln in zip(
+        s_payoff[:ns[3].value].tolist(), s_paylen[:ns[3].value].tolist())]
+    return out
+
+
+def decode_import_key(key: bytes):
+    """Inverse of the C encoder's identity-key layout:
+    [type][scope][varint nlen][name][varint tcount]{[varint tlen][tag]}*
+    Returns (type_enum, scope_enum, name, [tags])."""
+    mtype, scope = key[0], key[1]
+    pos = 2
+
+    def varint(p):
+        v = 0
+        shift = 0
+        while True:
+            b = key[p]
+            p += 1
+            v |= (b & 0x7F) << shift
+            if not (b & 0x80):
+                return v, p
+            shift += 7
+
+    nlen, pos = varint(pos)
+    name = key[pos:pos + nlen].decode("utf-8", "replace")
+    pos += nlen
+    tcount, pos = varint(pos)
+    tags = []
+    for _ in range(tcount):
+        tlen, pos = varint(pos)
+        tags.append(key[pos:pos + tlen].decode("utf-8", "replace"))
+        pos += tlen
+    return mtype, scope, name, tags
 
 
 class NativeParser:

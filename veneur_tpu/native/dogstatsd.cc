@@ -1531,6 +1531,381 @@ int64_t vnt_metric_wrap(const uint8_t* digests, const int64_t* doffs,
 
 }  // extern "C"
 
+// ---- forward-plane import decoder -----------------------------------------
+//
+// Parses a whole forwardrpc.MetricList request straight from the wire
+// into per-family column batches: identity keys (opaque bytes the
+// Python side caches stubs under), scalar values, and histogram
+// centroid grids ALREADY re-bucketed onto the k-scale import grid.
+// Replaces the per-metric upb object walk + per-centroid Python
+// generator + numpy re-bucketing (~1.7 s for a 50k-key flush on one
+// core; sources/proxy/server.go gets this for free in compiled Go).
+
+namespace {
+
+struct WireReader {
+  const uint8_t* p;
+  const uint8_t* end;
+  bool ok = true;
+
+  uint64_t varint() {
+    uint64_t v = 0;
+    int shift = 0;
+    while (p < end && shift < 64) {
+      uint8_t b = *p++;
+      v |= static_cast<uint64_t>(b & 0x7F) << shift;
+      if (!(b & 0x80)) return v;
+      shift += 7;
+    }
+    ok = false;
+    return 0;
+  }
+
+  // returns field number, sets wire type; 0 on end/error (field number
+  // 0 is invalid wire data, so it poisons ok rather than reading as a
+  // clean end-of-message)
+  uint32_t tag(uint32_t* wt) {
+    if (p >= end) return 0;
+    uint64_t t = varint();
+    if (!ok) return 0;
+    *wt = static_cast<uint32_t>(t & 7);
+    uint32_t f = static_cast<uint32_t>(t >> 3);
+    if (f == 0) ok = false;
+    return f;
+  }
+
+  std::string_view bytes() {
+    uint64_t n = varint();
+    if (!ok || static_cast<uint64_t>(end - p) < n) {
+      ok = false;
+      return {};
+    }
+    std::string_view out(reinterpret_cast<const char*>(p),
+                         static_cast<size_t>(n));
+    p += n;
+    return out;
+  }
+
+  double f64() {
+    if (end - p < 8) {
+      ok = false;
+      return 0;
+    }
+    double v;
+    memcpy(&v, p, 8);
+    p += 8;
+    return v;
+  }
+
+  void skip(uint32_t wt) {
+    switch (wt) {
+      case 0: varint(); break;
+      case 1: if (end - p >= 8) p += 8; else ok = false; break;
+      case 2: bytes(); break;
+      case 5: if (end - p >= 4) p += 4; else ok = false; break;
+      default: ok = false;
+    }
+  }
+};
+
+inline void put_key_varint(std::vector<uint8_t>& out, uint64_t v) {
+  while (v >= 0x80) {
+    out.push_back(static_cast<uint8_t>(v) | 0x80);
+    v >>= 7;
+  }
+  out.push_back(static_cast<uint8_t>(v));
+}
+
+struct Centroid2 {
+  double mean, weight;
+};
+
+}  // namespace
+
+extern "C" {
+
+// Counts top-level `metrics` entries so the caller can size the output
+// arrays exactly. Returns -1 on a malformed buffer.
+int64_t vnt_import_count(const uint8_t* buf, int64_t len) {
+  WireReader r{buf, buf + len};
+  int64_t n = 0;
+  uint32_t wt;
+  while (uint32_t f = r.tag(&wt)) {
+    if (f == 1 && wt == 2) {
+      r.bytes();
+      n++;
+    } else {
+      r.skip(wt);
+    }
+    if (!r.ok) return -1;
+  }
+  return r.ok ? n : -1;
+}
+
+// Decodes a MetricList into per-family batches.
+//
+// Identity keys are self-delimiting byte strings
+//   [type][scope][varint nlen][name][varint tcount]{[varint tlen][tag]}*
+// written into key_buf; each family's rows reference (off, len) pairs.
+// Histogram centroids are re-bucketed onto the C-slot k-scale grid with
+// the same arcsine rule as ops/batch_tdigest.pack_centroids (weights
+// <= 0 dropped, weightless/empty digests skipped entirely — merging
+// one would clobber the row's min/max with zeros). Set payloads are
+// returned as (off, len) into the INPUT buffer. Returns the number of
+// metrics consumed, or -1 on malformed input / -2 when an output
+// capacity was exhausted (caps come from vnt_import_count, so -2 only
+// means key_cap was undersized).
+int64_t vnt_import_parse(
+    const uint8_t* buf, int64_t len, int64_t C, double compression,
+    uint8_t* key_buf, int64_t key_cap,
+    int64_t* c_keyoff, int64_t* c_keylen, double* c_vals, int64_t c_cap,
+    int64_t* c_n,
+    int64_t* g_keyoff, int64_t* g_keylen, double* g_vals, int64_t g_cap,
+    int64_t* g_n,
+    int64_t* h_keyoff, int64_t* h_keylen, float* h_means, float* h_weights,
+    double* h_min, double* h_max, double* h_recip, int64_t h_cap,
+    int64_t* h_n,
+    int64_t* s_keyoff, int64_t* s_keylen, int64_t* s_payoff,
+    int64_t* s_paylen, int64_t s_cap, int64_t* s_n) {
+  WireReader top{buf, buf + len};
+  int64_t key_used = 0;
+  *c_n = *g_n = *h_n = *s_n = 0;
+  int64_t consumed = 0;
+  std::vector<uint8_t> key;
+  std::vector<std::string_view> tags;
+  std::vector<Centroid2> cents;
+  uint32_t wt;
+  while (uint32_t f = top.tag(&wt)) {
+    if (!(f == 1 && wt == 2)) {
+      top.skip(wt);
+      if (!top.ok) return -1;
+      continue;
+    }
+    std::string_view mbytes = top.bytes();
+    if (!top.ok) return -1;
+    WireReader m{reinterpret_cast<const uint8_t*>(mbytes.data()),
+                 reinterpret_cast<const uint8_t*>(mbytes.data()) +
+                     mbytes.size()};
+    std::string_view name;
+    tags.clear();
+    int64_t type = 0, scope = 0;
+    int which = 0;  // 5=counter 6=gauge 7=histogram 8=set
+    double cval = 0, gval = 0;
+    double dmin = 0, dmax = 0, drecip = 0;
+    std::string_view set_payload;
+    cents.clear();
+    uint32_t mwt;
+    while (uint32_t mf = m.tag(&mwt)) {
+      switch (mf) {
+        case 1: name = m.bytes(); break;
+        case 2: tags.push_back(m.bytes()); break;
+        case 3: type = static_cast<int64_t>(m.varint()); break;
+        case 9: scope = static_cast<int64_t>(m.varint()); break;
+        case 5: {  // CounterValue{int64 value=1}
+          std::string_view v = m.bytes();
+          WireReader cv{reinterpret_cast<const uint8_t*>(v.data()),
+                        reinterpret_cast<const uint8_t*>(v.data()) +
+                            v.size()};
+          uint32_t cwt;
+          while (uint32_t cf = cv.tag(&cwt)) {
+            if (cf == 1 && cwt == 0) {
+              cval = static_cast<double>(
+                  static_cast<int64_t>(cv.varint()));
+            } else {
+              cv.skip(cwt);
+            }
+          }
+          if (!cv.ok) return -1;
+          which = 5;
+          break;
+        }
+        case 6: {  // GaugeValue{double value=1}
+          std::string_view v = m.bytes();
+          WireReader gv{reinterpret_cast<const uint8_t*>(v.data()),
+                        reinterpret_cast<const uint8_t*>(v.data()) +
+                            v.size()};
+          uint32_t gwt;
+          while (uint32_t gf = gv.tag(&gwt)) {
+            if (gf == 1 && gwt == 1) {
+              gval = gv.f64();
+            } else {
+              gv.skip(gwt);
+            }
+          }
+          if (!gv.ok) return -1;
+          which = 6;
+          break;
+        }
+        case 7: {  // HistogramValue{ MergingDigestData t_digest=1 }
+          std::string_view hv = m.bytes();
+          WireReader h{reinterpret_cast<const uint8_t*>(hv.data()),
+                       reinterpret_cast<const uint8_t*>(hv.data()) +
+                           hv.size()};
+          uint32_t hwt;
+          while (uint32_t hf = h.tag(&hwt)) {
+            if (hf == 1 && hwt == 2) {
+              std::string_view dv = h.bytes();
+              WireReader d{reinterpret_cast<const uint8_t*>(dv.data()),
+                           reinterpret_cast<const uint8_t*>(dv.data()) +
+                               dv.size()};
+              uint32_t dwt;
+              while (uint32_t df = d.tag(&dwt)) {
+                switch (df) {
+                  case 1: {  // Centroid
+                    std::string_view cb = d.bytes();
+                    WireReader c{
+                        reinterpret_cast<const uint8_t*>(cb.data()),
+                        reinterpret_cast<const uint8_t*>(cb.data()) +
+                            cb.size()};
+                    double mean = 0, weight = 0;
+                    uint32_t ct;
+                    while (uint32_t cf2 = c.tag(&ct)) {
+                      if (cf2 == 1 && ct == 1) mean = c.f64();
+                      else if (cf2 == 2 && ct == 1) weight = c.f64();
+                      else c.skip(ct);  // samples etc.
+                    }
+                    if (!c.ok) return -1;
+                    if (weight > 0) cents.push_back({mean, weight});
+                    break;
+                  }
+                  case 3: if (dwt == 1) dmin = d.f64(); else d.skip(dwt);
+                    break;
+                  case 4: if (dwt == 1) dmax = d.f64(); else d.skip(dwt);
+                    break;
+                  case 5: if (dwt == 1) drecip = d.f64();
+                    else d.skip(dwt);
+                    break;
+                  default: d.skip(dwt);
+                }
+              }
+              if (!d.ok) return -1;
+            } else {
+              h.skip(hwt);
+            }
+          }
+          if (!h.ok) return -1;
+          which = 7;
+          break;
+        }
+        case 8: {  // SetValue{bytes hyper_log_log=1}
+          std::string_view v = m.bytes();
+          WireReader sv{reinterpret_cast<const uint8_t*>(v.data()),
+                        reinterpret_cast<const uint8_t*>(v.data()) +
+                            v.size()};
+          uint32_t swt;
+          while (uint32_t sf = sv.tag(&swt)) {
+            if (sf == 1 && swt == 2) {
+              set_payload = sv.bytes();
+            } else {
+              sv.skip(swt);
+            }
+          }
+          if (!sv.ok) return -1;
+          which = 8;
+          break;
+        }
+        default:
+          m.skip(mwt);
+      }
+      if (!m.ok) return -1;
+    }
+    if (!m.ok) return -1;
+    consumed++;
+    if (which == 0) continue;            // no value: skipped (logged by
+                                         // the Python fallback path)
+    if (type > 255 || scope > 255) continue;  // open enum beyond the
+                                              // key's byte fields: skip
+                                              // (upb path skips too)
+    if (which == 7 && cents.empty()) continue;  // empty digest
+    // identity key
+    key.clear();
+    key.push_back(static_cast<uint8_t>(type));
+    key.push_back(static_cast<uint8_t>(scope));
+    put_key_varint(key, name.size());
+    key.insert(key.end(), name.begin(), name.end());
+    put_key_varint(key, tags.size());
+    for (const auto& t : tags) {
+      put_key_varint(key, t.size());
+      key.insert(key.end(), t.begin(), t.end());
+    }
+    if (key_used + static_cast<int64_t>(key.size()) > key_cap) return -2;
+    memcpy(key_buf + key_used, key.data(), key.size());
+    int64_t koff = key_used;
+    int64_t klen = static_cast<int64_t>(key.size());
+    key_used += klen;
+
+    if (which == 5) {
+      if (*c_n >= c_cap) return -2;
+      c_keyoff[*c_n] = koff;
+      c_keylen[*c_n] = klen;
+      c_vals[*c_n] = cval;
+      (*c_n)++;
+    } else if (which == 6) {
+      if (*g_n >= g_cap) return -2;
+      g_keyoff[*g_n] = koff;
+      g_keylen[*g_n] = klen;
+      g_vals[*g_n] = gval;
+      (*g_n)++;
+    } else if (which == 7) {
+      if (*h_n >= h_cap) return -2;
+      // re-bucket onto the k-scale grid: pack_centroids' arcsine rule
+      std::stable_sort(cents.begin(), cents.end(),
+                       [](const Centroid2& a, const Centroid2& b) {
+                         return a.mean < b.mean;
+                       });
+      double tot = 0;
+      for (const auto& c : cents) tot += c.weight;
+      float* om = h_means + (*h_n) * C;
+      float* ow = h_weights + (*h_n) * C;
+      memset(om, 0, sizeof(float) * C);
+      memset(ow, 0, sizeof(float) * C);
+      if (tot > 0) {
+        std::vector<double> acc_w(C, 0.0), acc_wv(C, 0.0);
+        double cw = 0;
+        for (const auto& c : cents) {
+          cw += c.weight;
+          double q_mid = (cw - c.weight * 0.5) / tot;
+          double x = 2 * q_mid - 1;
+          if (x < -1) x = -1;
+          if (x > 1) x = 1;
+          double k = compression * (asin(x) / M_PI + 0.5);
+          int64_t b = static_cast<int64_t>(floor(k));
+          if (b < 0) b = 0;
+          if (b >= C) b = C - 1;
+          acc_w[b] += c.weight;
+          acc_wv[b] += c.weight * c.mean;
+        }
+        for (int64_t b = 0; b < C; b++) {
+          if (acc_w[b] > 0) {
+            ow[b] = static_cast<float>(acc_w[b]);
+            om[b] = static_cast<float>(acc_wv[b] / acc_w[b]);
+          }
+        }
+      }
+      h_keyoff[*h_n] = koff;
+      h_keylen[*h_n] = klen;
+      h_min[*h_n] = dmin;
+      h_max[*h_n] = dmax;
+      h_recip[*h_n] = drecip;
+      (*h_n)++;
+    } else if (which == 8) {
+      if (*s_n >= s_cap) return -2;
+      s_keyoff[*s_n] = koff;
+      s_keylen[*s_n] = klen;
+      // a SetValue with no payload field decodes as empty bytes (the
+      // Python HLL decoder then drops it with a log line)
+      s_payoff[*s_n] = set_payload.data() == nullptr
+          ? 0
+          : reinterpret_cast<const uint8_t*>(set_payload.data()) - buf;
+      s_paylen[*s_n] = static_cast<int64_t>(set_payload.size());
+      (*s_n)++;
+    }
+  }
+  return top.ok ? consumed : -1;
+}
+
+}  // extern "C"
+
 // ---- native load blaster (sendmmsg) ---------------------------------------
 //
 // The benchmark-driver half of the story (the veneur-emit equivalent,
