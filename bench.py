@@ -549,6 +549,12 @@ def _mk_server(num_keys: int, extra_span_sinks=None, **cfg_overrides):
 
     cfg = Config()
     cfg.interval = 10.0
+    # TPU A/B hook for the fused flush kernel (config env overlay does
+    # not run here; bench builds its Config directly). Bool parsing
+    # matches config._env_value so "=0"/"=false" really is the off arm.
+    if os.environ.get("VENEUR_TPU_PALLAS_TDIGEST_FLUSH", "").lower() in (
+            "1", "true", "yes", "on"):
+        cfg.tpu.pallas_tdigest_flush = True
     cfg.tpu.counter_capacity = max(4096, num_keys)
     cfg.tpu.gauge_capacity = max(4096, num_keys)
     cfg.tpu.histo_capacity = max(4096, num_keys)

@@ -206,8 +206,11 @@ class TestServerConfigTLS:
                        and time.time() < deadline):
                     time.sleep(0.05)
                 assert glob.import_server.imported_total == 1
+                # the V1 bulk body crossed the mTLS channel (the
+                # client's preferred path; V2 streams are the fallback)
                 snap = glob.import_server.rpc_stats.snapshot()
-                assert snap["SendMetricsV2"]["count"] == 1
+                assert snap["SendMetrics"]["count"] == 1
+                assert snap["SendMetrics"]["errors"] == 0
             finally:
                 local.shutdown()
         finally:

@@ -228,6 +228,35 @@ class TestForeignShapes:
         srv.shutdown()
 
 
+class TestShardedStore:
+    def test_native_import_into_sharded_tables(self):
+        """tpu.shards routes the histo/set families through
+        Sharded*Table; the native import's merge_batch calls must land
+        there identically."""
+        rng = np.random.default_rng(21)
+        cfg = Config()
+        cfg.interval = 3600
+        cfg.hostname = "imp"
+        cfg.statsd_listen_addresses = []
+        cfg.tpu.histo_capacity = 512
+        cfg.tpu.shards = 2
+        cfg.apply_defaults()
+        obs = ChannelMetricSink()
+        srv = Server(cfg, extra_metric_sinks=[obs])
+        imp = ImportServer(srv, "127.0.0.1:0")
+        vals = rng.normal(10, 2, 40)
+        body = body_of([
+            digest_metric(f"sh{i}", vals, np.ones(40),
+                          dmin=float(vals.min()), dmax=float(vals.max()),
+                          scope=metric_pb2.Global)
+            for i in range(32)])
+        assert imp._merge_native(body) == 32
+        got = flush_names_values(srv, obs)
+        assert got["sh7.count"] == pytest.approx(40.0)
+        assert got["sh7.min"] == pytest.approx(vals.min(), rel=1e-4)
+        srv.shutdown()
+
+
 class TestStubCache:
     def test_cache_hit_skips_rebuild(self):
         body = body_of([metric_pb2.Metric(

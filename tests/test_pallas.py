@@ -54,3 +54,132 @@ class TestPallasHLLEstimate:
         want = np.asarray(batch_hll._estimate_jnp(regs))
         got = np.asarray(batch_hll.estimate(regs))
         np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+class TestPallasTdigestFlush:
+    """The fused flush interpolation must match the jnp path bit-for-
+    tolerance across the full output layout (quantiles + FLUSH_SCALARS),
+    including empty rows, single-centroid rows, and the min/max bounds
+    rules (merging_digest.go:302-332)."""
+
+    def _state_with_data(self, num_keys, seed=0):
+        import jax.numpy as jnp
+
+        from veneur_tpu.ops import batch_tdigest as btd
+        rng = np.random.default_rng(seed)
+        state = btd.init_state(num_keys)
+        rows, vals, wts = [], [], []
+        for row in range(num_keys - 2):  # leave two rows empty
+            n = int(rng.integers(1, 200))
+            rows.extend([row] * n)
+            vals.extend(rng.normal(rng.uniform(-50, 50),
+                                   rng.uniform(0.1, 20), n).tolist())
+            wts.extend((rng.random(n) * 3 + 0.1).tolist())
+        rows = np.asarray(rows, np.int32)
+        order = np.argsort(rows, kind="stable")
+        rows, vals, wts = (rows[order], np.asarray(vals, np.float32)[order],
+                           np.asarray(wts, np.float32)[order])
+        state = btd.apply_batch(state, rows, vals, wts)
+        return state
+
+    def test_packed_flush_matches_jnp(self):
+        from veneur_tpu.ops import batch_tdigest as btd
+        from veneur_tpu.ops import pallas_tdigest as ptd
+
+        num_keys = ptd.BK
+        state = self._state_with_data(num_keys, seed=3)
+        ps = (0.5, 0.75, 0.99)
+        want = np.asarray(btd.flush_quantiles_packed(state, ps,
+                                                     fold_staging=True))
+        got = np.asarray(btd.flush_quantiles_packed_pallas(
+            state, ps, True, True))
+        assert got.shape == want.shape
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-4,
+                                   equal_nan=True)
+
+    def test_export_variant_matches_jnp(self):
+        from veneur_tpu.ops import batch_tdigest as btd
+        from veneur_tpu.ops import pallas_tdigest as ptd
+
+        num_keys = ptd.BK
+        state = self._state_with_data(num_keys, seed=11)
+        ps = (0.5, 0.99)
+        want_f, want_e = btd.flush_export_packed(state, ps)
+        got_f, got_e = btd.flush_export_packed_pallas(state, ps, True)
+        np.testing.assert_allclose(np.asarray(got_f), np.asarray(want_f),
+                                   rtol=2e-5, atol=1e-4, equal_nan=True)
+        # export half is shared XLA code: identical
+        np.testing.assert_allclose(np.asarray(got_e), np.asarray(want_e),
+                                   rtol=1e-6, equal_nan=True)
+
+    def test_multi_tile(self):
+        from veneur_tpu.ops import batch_tdigest as btd
+        from veneur_tpu.ops import pallas_tdigest as ptd
+
+        num_keys = ptd.BK * 2
+        state = self._state_with_data(num_keys, seed=5)
+        ps = (0.9,)
+        want = np.asarray(btd.flush_quantiles_packed(state, ps,
+                                                     fold_staging=True))
+        got = np.asarray(btd.flush_quantiles_packed_pallas(
+            state, ps, True, True))
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-4,
+                                   equal_nan=True)
+
+    def test_histo_table_platform_gate(self):
+        """Off-TPU the flag routes straight to the jnp path (no kernel
+        attempt, no exception noise) and the flush is correct."""
+        from veneur_tpu.core.columnstore import HistoTable
+        from veneur_tpu.samplers.parser import Parser
+
+        t = HistoTable(256)
+        t.pallas_flush = True
+        parser = Parser()
+        for pkt in (b"pf.lat:1|ms", b"pf.lat:2|ms", b"pf.lat:3|ms"):
+            out = []
+            parser.parse_metric_fast(pkt, out.append)
+            t.add(out[0])
+        res, export, touched, meta = t.snapshot_and_reset((0.5,))
+        row = next(iter(t.rows.values()))
+        assert touched[row]
+        assert res["count"][row] == 3.0
+        assert res["max"][row] == 3.0
+
+    def test_kernel_failure_latches_jnp_fallback(self, monkeypatch):
+        """A failing kernel must latch pallas off for the process and
+        still deliver the flush through the jnp path — the contract
+        config.py's pallas_tdigest_flush documents."""
+        from veneur_tpu.core.columnstore import HistoTable
+        from veneur_tpu.ops import batch_tdigest as btd
+        from veneur_tpu.ops import pallas_tdigest as ptd
+        from veneur_tpu.samplers.parser import Parser
+
+        t = HistoTable(256)
+        t.pallas_flush = True
+        monkeypatch.setattr(t, "_use_pallas",
+                            lambda: not ptd._State.failed)
+        calls = []
+
+        def boom(*a, **k):
+            calls.append(1)
+            raise RuntimeError("mosaic says no")
+
+        monkeypatch.setattr(btd, "flush_quantiles_packed_pallas", boom)
+        monkeypatch.setattr(btd, "flush_export_packed_pallas", boom)
+        monkeypatch.setattr(ptd._State, "failed", False)
+        parser = Parser()
+        out = []
+        parser.parse_metric_fast(b"lf.lat:7|ms", out.append)
+        t.add(out[0])
+        res, _, touched, _ = t.snapshot_and_reset((0.5,))
+        row = next(iter(t.rows.values()))
+        assert res["count"][row] == 1.0          # jnp fallback delivered
+        assert calls == [1]
+        assert ptd._State.failed is True         # latched
+        # second flush: latch short-circuits, kernel never retried
+        out2 = []
+        parser.parse_metric_fast(b"lf.lat:9|ms", out2.append)
+        t.add(out2[0])
+        res2, _, _, _ = t.snapshot_and_reset((0.5,))
+        assert res2["count"][row] == 1.0
+        assert calls == [1]

@@ -100,6 +100,13 @@ class TpuConfig:
     # hard per-family cardinality cap: new keys beyond it are dropped
     # (and counted) until eviction frees rows. 0 = unlimited.
     max_rows_per_family: int = 2_000_000
+    # run the t-digest flush's post-sort interpolation through the
+    # fused Pallas kernel (ops/pallas_tdigest). OFF by default until
+    # real-TPU validation lands; any kernel failure falls back to the
+    # jnp path permanently for the process. Requires histo_capacity to
+    # be a multiple of 128 (the kernel's row tile) — otherwise flushes
+    # stay on the jnp path (warned at startup).
+    pallas_tdigest_flush: bool = False
 
 
 @dataclass

@@ -27,6 +27,7 @@ batch) shape.
 
 from __future__ import annotations
 
+import logging
 import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
@@ -38,6 +39,8 @@ import numpy as np
 from veneur_tpu.ops import batch_hll, batch_tdigest, hll_ref, scalars
 from veneur_tpu.samplers import metrics as m
 from veneur_tpu.samplers.metrics import MetricScope, UDPMetric
+
+logger = logging.getLogger("veneur_tpu.core.columnstore")
 
 # pending-buffer padding marker: any out-of-range row is dropped by the
 # scatter kernels (mode="drop"), independent of table capacity
@@ -487,9 +490,55 @@ class HistoTable(_BaseTable):
         self._staged_counts = np.zeros(self.capacity, np.int32)
 
 
+    # when True (tpu.pallas_tdigest_flush) the flush's post-sort
+    # interpolation runs through the fused Pallas kernel; any failure
+    # latches the jnp path for the process (pallas_hll's safety model)
+    pallas_flush = False
+
     def _init_arrays(self):
         self._init_pending()
         self.state = batch_tdigest.init_state(self.capacity)
+
+    def _use_pallas(self) -> bool:
+        if not self.pallas_flush:
+            return False
+        from veneur_tpu.ops import pallas_tdigest
+        # off-TPU only interpret mode exists (parity tests); production
+        # flushes take the jnp path there
+        platform = jax.devices()[0].platform
+        return (platform in ("tpu", "axon")
+                and pallas_tdigest.available(self.capacity))
+
+    def _flush_packed(self, ps, state=None, fold_staging=True):
+        st = self.state if state is None else state
+        if self._use_pallas():
+            try:
+                # realize inside the try: a device-side kernel fault
+                # surfaces at blocking, and it must latch the fallback
+                # rather than crash every subsequent flush
+                return jax.block_until_ready(
+                    batch_tdigest.flush_quantiles_packed_pallas(
+                        st, ps, fold_staging))
+            except Exception:
+                self._latch_pallas_off()
+        return batch_tdigest.flush_quantiles_packed(
+            st, ps, fold_staging=fold_staging)
+
+    def _flush_export(self, ps, state=None):
+        st = self.state if state is None else state
+        if self._use_pallas():
+            try:
+                return jax.block_until_ready(
+                    batch_tdigest.flush_export_packed_pallas(st, ps))
+            except Exception:
+                self._latch_pallas_off()
+        return batch_tdigest.flush_export_packed(st, ps)
+
+    def _latch_pallas_off(self):
+        from veneur_tpu.ops import pallas_tdigest
+        pallas_tdigest._State.failed = True
+        logger.exception(
+            "pallas t-digest flush failed; jnp path latched")
 
     def _grow_arrays(self, new_cap):
         old = self.state
@@ -591,12 +640,10 @@ class HistoTable(_BaseTable):
                 # fused forwarding flush: one dispatch, one sort, and
                 # two device->host transfers (the packed flush and the
                 # packed export) instead of compact+flush+export
-                packed, export_packed = batch_tdigest.flush_export_packed(
-                    self.state, ps)
+                packed, export_packed = self._flush_export(ps)
                 export = batch_tdigest.unpack_export(export_packed)
             else:
-                packed = batch_tdigest.flush_quantiles_packed(
-                    self.state, ps, fold_staging=True)
+                packed = self._flush_packed(ps)
                 export = None
             self._applies = 0
             self._staged_counts[:] = 0
@@ -966,7 +1013,7 @@ class ColumnStore:
 
     def __init__(self, counter_capacity=1024, gauge_capacity=1024,
                  histo_capacity=1024, set_capacity=256, batch_cap=8192,
-                 shard_devices=0, max_rows=0):
+                 shard_devices=0, max_rows=0, pallas_flush=False):
         self.counters = CounterTable(counter_capacity, batch_cap,
                                      max_rows=max_rows)
         self.gauges = GaugeTable(gauge_capacity, batch_cap,
@@ -989,6 +1036,15 @@ class ColumnStore:
                                      max_rows=max_rows)
             self.sets = SetTable(set_capacity, batch_cap,
                                  max_rows=max_rows)
+        self.histos.pallas_flush = bool(pallas_flush)
+        if pallas_flush and histo_capacity % 128:
+            # pallas_tdigest.BK tiling: a non-multiple capacity silently
+            # takes the jnp path, which would make a kernel A/B
+            # measure nothing
+            logger.warning(
+                "tpu.pallas_tdigest_flush requested but histo_capacity "
+                "%d is not a multiple of 128; flushes use the jnp path",
+                histo_capacity)
         self.statuses = StatusTable(max_rows=max_rows)
         self.processed = 0
         self._processed_lock = threading.Lock()

@@ -150,7 +150,8 @@ class Server:
             set_capacity=config.tpu.set_capacity,
             batch_cap=config.tpu.batch_cap,
             shard_devices=config.tpu.shards,
-            max_rows=config.tpu.max_rows_per_family)
+            max_rows=config.tpu.max_rows_per_family,
+            pallas_flush=config.tpu.pallas_tdigest_flush)
         self._keys_dropped_reported = 0
         self.aggregates = HistogramAggregates.from_names(config.aggregates)
         self.percentiles = tuple(config.percentiles)
@@ -647,7 +648,8 @@ class Server:
                 histo_capacity=cfg.tpu.histo_capacity,
                 set_capacity=cfg.tpu.set_capacity,
                 batch_cap=cfg.tpu.batch_cap,
-                shard_devices=cfg.tpu.shards)
+                shard_devices=cfg.tpu.shards,
+                pallas_flush=cfg.tpu.pallas_tdigest_flush)
             # collect_forward must match the live flush's value: need_export
             # selects between two distinct JIT specializations (fold_staging
             # is a static arg), and warming the wrong one would leave the

@@ -212,13 +212,14 @@ class ShardedHistoTable(HistoTable):
             if need_export:
                 # fused flush+export: one dispatch, two transfers (the
                 # merged state's staging is already folded, so the fold
-                # inside the fused op is a no-op concat of zeros)
-                packed, export_packed = batch_tdigest.flush_export_packed(
-                    merged, ps)
+                # inside the fused op is a no-op concat of zeros).
+                # Routed through the pallas-aware wrappers so
+                # tpu.pallas_tdigest_flush applies to sharded stores too.
+                packed, export_packed = self._flush_export(ps, merged)
                 export = batch_tdigest.unpack_export(export_packed)
             else:
-                packed = batch_tdigest.flush_quantiles_packed(
-                    merged, ps, fold_staging=False)
+                packed = self._flush_packed(ps, merged,
+                                            fold_staging=False)
                 export = None
             out = batch_tdigest.unpack_flush(packed, len(ps))
             self.states = [

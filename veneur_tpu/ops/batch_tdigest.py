@@ -469,18 +469,33 @@ def _flush_outputs(quant, sm, sw, cum, state):
     }
 
 
-def _flush_quantiles_impl(state, percentiles: Sequence[float],
-                          fold_staging: bool):
+def _sorted_centroids(state, fold_staging: bool):
+    """The shared flush preamble: (optionally) fold staging, then the
+    per-row mean sort with weightless slots keyed to +inf. Every flush
+    variant (jnp and pallas) MUST go through this so the sort recipe
+    cannot diverge between paths."""
     if fold_staging:
         means, weights = _fold_grids(state)
     else:
         weights = state["weights"]
         means = jnp.where(
             weights > 0, state["wv"] / jnp.maximum(weights, 1e-30), 0.0)
-
     sort_key = jnp.where(weights > 0, means, _INF)
     _, sw, sm = jax.lax.sort(
         (sort_key, weights, means), num_keys=1, dimension=-1)
+    return sm, sw
+
+
+def _pack_export(new_m, new_w, state):
+    """The export layout: [means | weights | dmin dmax drecip]."""
+    return jnp.concatenate(
+        [new_m, new_w, state["dmin"][:, None], state["dmax"][:, None],
+         state["drecip"][:, None]], axis=-1)
+
+
+def _flush_quantiles_impl(state, percentiles: Sequence[float],
+                          fold_staging: bool):
+    sm, sw = _sorted_centroids(state, fold_staging)
     cum = jnp.cumsum(sw, axis=-1)
     quant = _quantiles_from_sorted(sm, sw, cum, state, percentiles)
     return _flush_outputs(quant, sm, sw, cum, state)
@@ -547,18 +562,43 @@ def flush_export_packed(state, percentiles: Sequence[float]):
     Returns (flush_packed (K, P+10), export_packed (K, 2C+3):
     [means | weights | dmin dmax drecip]); unpack with unpack_flush /
     unpack_export."""
-    cat_m, cat_w = _fold_grids(state)  # (K, 2C)
-    sort_key = jnp.where(cat_w > 0, cat_m, _INF)
-    _, sw, sm = jax.lax.sort(
-        (sort_key, cat_w, cat_m), num_keys=1, dimension=-1)
+    sm, sw = _sorted_centroids(state, fold_staging=True)  # (K, 2C)
     cum = jnp.cumsum(sw, axis=-1)
     quant = _quantiles_from_sorted(sm, sw, cum, state, percentiles)
     flush_packed = _pack_flush(_flush_outputs(quant, sm, sw, cum, state))
     new_m, new_w = _recompress_sorted(sm, sw, cum)
-    export_packed = jnp.concatenate(
-        [new_m, new_w, state["dmin"][:, None], state["dmax"][:, None],
-         state["drecip"][:, None]], axis=-1)
-    return flush_packed, export_packed
+    return flush_packed, _pack_export(new_m, new_w, state)
+
+
+@partial(jax.jit, static_argnums=(1, 2, 3))
+def flush_quantiles_packed_pallas(state, percentiles: Sequence[float],
+                                  fold_staging: bool = True,
+                                  interpret: bool = False):
+    """flush_quantiles_packed with the post-sort interpolation in the
+    fused Pallas kernel (ops/pallas_tdigest) — the XLA sort feeds one
+    single-pass VMEM-tiled kernel instead of the (K, P, C) comparison
+    cube + gathers. Raises on kernel failure; the column store latches
+    the jnp fallback."""
+    from veneur_tpu.ops import pallas_tdigest
+
+    sm, sw = _sorted_centroids(state, fold_staging)
+    return pallas_tdigest.flush_packed_post_sort(
+        sm, sw, state, percentiles, interpret)
+
+
+@partial(jax.jit, static_argnums=(1, 2))
+def flush_export_packed_pallas(state, percentiles: Sequence[float],
+                               interpret: bool = False):
+    """flush_export_packed with the quantile phase in the fused Pallas
+    kernel; the shared sort and the export recompress stay in XLA."""
+    from veneur_tpu.ops import pallas_tdigest
+
+    sm, sw = _sorted_centroids(state, fold_staging=True)
+    flush_packed = pallas_tdigest.flush_packed_post_sort(
+        sm, sw, state, percentiles, interpret)
+    cum = jnp.cumsum(sw, axis=-1)
+    new_m, new_w = _recompress_sorted(sm, sw, cum)
+    return flush_packed, _pack_export(new_m, new_w, state)
 
 
 def unpack_export(export_packed):

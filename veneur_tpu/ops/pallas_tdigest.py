@@ -1,0 +1,140 @@
+"""Pallas TPU kernel: fused t-digest flush interpolation.
+
+After the per-row mean sort, the jnp flush path
+(batch_tdigest._quantiles_from_sorted) materializes a (K, P, C)
+comparison cube to find each percentile's centroid, then gathers four
+arrays through take_along_axis — several full passes over the (K, C)
+grid in HBM. This kernel runs the whole post-sort phase in one pass
+per VMEM tile: running cumsum, percentile search as a compare-count,
+one-hot selection instead of gathers, and the packed (K, P+10) flush
+layout written directly (quantiles + FLUSH_SCALARS), so the flush's
+device work after the sort is a single bandwidth-bound sweep.
+
+The sort itself stays in XLA (jax.lax.sort is already tuned); parity
+with the jnp interpolation — including the merging_digest.go:302-332
+bounds rules — is pinned by tests/test_pallas.py in interpret mode.
+
+Safety mirrors pallas_hll: compiled lazily, any failure latches a
+permanent fallback to the jnp path; the config gate
+(tpu.pallas_tdigest_flush) defaults OFF until the kernel has real-TPU
+validation.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+
+import jax
+import jax.numpy as jnp
+
+logger = logging.getLogger("veneur_tpu.ops.pallas_tdigest")
+
+BK = 128  # rows per grid step; (BK, 2C) f32 blocks stay well under VMEM
+
+# scalar column order in the (K, 8) input block
+_SCALARS_IN = ("dmin", "dmax", "drecip", "lmin", "lmax", "lsum",
+               "lweight", "lrecip")
+
+
+def _flush_block(sm, sw, scal, percentiles):
+    """Per-tile math: mean-sorted centroids (BK, W) + scalars (BK, 8)
+    -> packed flush rows (BK, P+10). Mirrors _quantiles_from_sorted +
+    _flush_outputs exactly, minus the (K, P, C) intermediate."""
+    rows = sm.shape[0]
+    cum = jnp.cumsum(sw, axis=-1)
+    tot = cum[:, -1]
+    n = jnp.sum((sw > 0).astype(jnp.int32), axis=-1)
+    idx = jax.lax.broadcasted_iota(jnp.int32, sm.shape, 1)
+    next_m = jnp.concatenate(
+        [sm[:, 1:], jnp.zeros((rows, 1), sm.dtype)], axis=-1)
+    dmin, dmax, drecip = scal[:, 0], scal[:, 1], scal[:, 2]
+    ub = jnp.where(idx == (n - 1)[:, None], dmax[:, None],
+                   (next_m + sm) * 0.5)
+    lb = jnp.concatenate([dmin[:, None], ub[:, :-1]], axis=-1)
+    quants = []
+    for p in percentiles:  # static unroll: P one-hot selects, no gathers
+        q_t = p * tot
+        i_star = jnp.sum((cum < q_t[:, None]).astype(jnp.int32), axis=-1)
+        i_star = jnp.clip(i_star, 0, jnp.maximum(n - 1, 0))
+        onehot = idx == i_star[:, None]
+
+        def pick(a, onehot=onehot):
+            return jnp.sum(jnp.where(onehot, a, 0.0), axis=-1)
+
+        w_i = pick(sw)
+        cum_i = pick(cum)
+        lb_i = pick(lb)
+        ub_i = pick(ub)
+        proportion = (q_t - (cum_i - w_i)) / jnp.maximum(w_i, 1e-30)
+        q = lb_i + proportion * (ub_i - lb_i)
+        quants.append(jnp.where(n > 0, q, jnp.nan))
+    dcount = tot
+    dsum = jnp.sum(sm * sw, axis=-1)
+    hmean = jnp.where(drecip != 0, dcount / drecip, jnp.nan)
+    cols = quants + [dcount, dsum, dmin, dmax, hmean,
+                     scal[:, 3], scal[:, 4], scal[:, 5], scal[:, 6],
+                     scal[:, 7]]
+    return jnp.stack(cols, axis=-1)
+
+
+@functools.partial(jax.jit, static_argnums=(3, 4))
+def _flush_pallas(sm, sw, scal, percentiles, interpret: bool):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    num_keys, width = sm.shape
+    out_cols = len(percentiles) + 10
+    n_tiles = num_keys // BK  # exact: flush_packed_post_sort guards % BK
+
+    def kernel(sm_ref, sw_ref, scal_ref, out_ref):
+        out_ref[:] = _flush_block(sm_ref[:], sw_ref[:], scal_ref[:],
+                                  percentiles)
+
+    return pl.pallas_call(
+        kernel,
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((BK, width), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((BK, width), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((BK, len(_SCALARS_IN)), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((BK, out_cols), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((num_keys, out_cols), jnp.float32),
+        interpret=interpret,
+    )(sm, sw, scal)
+
+
+class _State:
+    failed = False
+
+
+def available(num_keys: int) -> bool:
+    return (not _State.failed) and num_keys % BK == 0
+
+
+def scalars_of(state) -> jnp.ndarray:
+    """Stack the per-key scalar columns into the kernel's (K, 8) input."""
+    return jnp.stack([state[k] for k in _SCALARS_IN], axis=-1)
+
+
+def flush_packed_post_sort(sm, sw, state, percentiles,
+                           interpret: bool = False):
+    """Packed flush rows from mean-sorted centroids via the fused
+    kernel. Raises on kernel failure — callers (columnstore) latch the
+    fallback; interpret=True is for the CPU parity tests."""
+    if sm.shape[0] % BK:
+        # caller-shape error, not a kernel fault: raise without
+        # latching so correctly-sized tables keep their kernel
+        raise ValueError(
+            f"num_keys {sm.shape[0]} not a multiple of {BK}")
+    try:
+        return _flush_pallas(sm, sw, scalars_of(state), tuple(percentiles),
+                             interpret)
+    except Exception:
+        _State.failed = True
+        raise
