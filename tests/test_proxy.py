@@ -176,6 +176,14 @@ class TestProxyRouting:
             local_server.flush()
             assert wait_until(
                 lambda: global_server.import_server.imported_total >= 1)
+            # the proxy's destination sender took the V1 bulk path to
+            # this framework's importer (V2 streams are the fallback for
+            # reference-style receivers). The stats recorder runs after
+            # the handler returns (imported_total increments inside it),
+            # so poll; read before flush() drains the stats.
+            assert wait_until(lambda: global_server.import_server
+                              .rpc_stats.snapshot()
+                              .get("SendMetrics", {}).get("count", 0) >= 1)
             global_server.flush()
             got = {m.name: m for m in global_obs.wait_flush(timeout=10)}
             assert "proxy.lat.50percentile" in got
@@ -185,6 +193,35 @@ class TestProxyRouting:
             local_server.shutdown()
             proxy.stop()
             global_server.shutdown()
+
+    def test_destination_pins_to_v2_on_refusal(self):
+        """A V2-only receiver (the reference importer contract) answers
+        the first V1 batch with UNIMPLEMENTED; the destination must
+        deliver the SAME batch via the stream and stay on V2."""
+        from veneur_tpu.forward.protos import metric_pb2
+        from veneur_tpu.proxy.destinations import Destination
+        from veneur_tpu.testing.forwardtest import ForwardTestServer
+
+        got = []
+        ft = ForwardTestServer(got.extend)  # V2 only
+        ft.start()
+        try:
+            dest = Destination(ft.address, on_close=lambda d: None,
+                               flush_interval=0.1)
+            for i in range(3):
+                dest.send(metric_pb2.Metric(
+                    name=f"d{i}", type=metric_pb2.Counter,
+                    counter=metric_pb2.CounterValue(value=i)))
+            assert wait_until(lambda: len(got) == 3)
+            assert dest._v1_ok is False
+            assert dest.dropped_total == 0
+            dest.send(metric_pb2.Metric(
+                name="later", type=metric_pb2.Counter,
+                counter=metric_pb2.CounterValue(value=9)))
+            assert wait_until(lambda: len(got) == 4)
+            dest.close()
+        finally:
+            ft.stop()
 
 
 class TestDiscovery:

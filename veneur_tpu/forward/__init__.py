@@ -6,10 +6,28 @@ samplers/metricpb/metric.proto): local servers stream mergeable state
 /forwardrpc.Forward/SendMetricsV2; the global side merges into its device
 column store with batched kernels (counter add, gauge overwrite, HLL
 register max, digest recompress).
+
+The package __init__ is lazy (PEP 562): convert/client/server pull jax
+at import, and jax-free consumers — the proxy tier imports only
+forward.protos and forward.wire — must not pay TPU-stack startup (or a
+wedged-tunnel hang) just for touching a subpackage.
 """
 
-from veneur_tpu.forward.convert import (  # noqa: F401
-    forwardable_to_protos, metric_key_of_proto,
-)
-from veneur_tpu.forward.client import ForwardClient  # noqa: F401
-from veneur_tpu.forward.server import ImportServer  # noqa: F401
+_EXPORTS = {
+    "forwardable_to_protos": "veneur_tpu.forward.convert",
+    "metric_key_of_proto": "veneur_tpu.forward.convert",
+    "ForwardClient": "veneur_tpu.forward.client",
+    "ImportServer": "veneur_tpu.forward.server",
+}
+
+__all__ = list(_EXPORTS)
+
+
+def __getattr__(name):
+    target = _EXPORTS.get(name)
+    if target is None:
+        raise AttributeError(f"module 'veneur_tpu.forward' has no "
+                             f"attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(target), name)

@@ -15,32 +15,13 @@ import grpc
 
 from veneur_tpu.core.flusher import ForwardableState
 from veneur_tpu.forward.convert import forwardable_to_wire
+from veneur_tpu.forward.wire import (_frame_v1, _serialize_metric,
+                                     send_batch)
 from veneur_tpu.util.grpctls import GrpcTLS, secure_or_insecure_channel
 
 logger = logging.getLogger("veneur_tpu.forward.client")
 
 _EMPTY_DESERIALIZER = lambda b: b  # google.protobuf.Empty carries nothing
-
-
-def _serialize_metric(m) -> bytes:
-    """Stream entries are either pre-serialized wire bytes (the native
-    digest encoder's output) or metricpb.Metric objects."""
-    return m if type(m) is bytes else m.SerializeToString()
-
-
-def _frame_v1(m) -> bytes:
-    """Wraps one serialized Metric as a MetricList `metrics` entry
-    (field 1, length-delimited); concatenating the frames IS the
-    MetricList wire body."""
-    b = _serialize_metric(m)
-    n = len(b)
-    out = [b"\x0a"]
-    while n >= 0x80:
-        out.append(bytes((n & 0x7F | 0x80,)))
-        n >>= 7
-    out.append(bytes((n,)))
-    out.append(b)
-    return b"".join(out)
 
 
 class ForwardClient:
@@ -89,23 +70,14 @@ class ForwardClient:
         if not protos:
             return 0
         try:
-            if self._v1_ok:
-                try:
-                    body = b"".join(_frame_v1(m) for m in protos)
-                    self._send_v1(body, timeout=self.deadline)
-                except grpc.RpcError as e:
-                    code = e.code() if hasattr(e, "code") else None
-                    if code in (grpc.StatusCode.UNIMPLEMENTED,
-                                grpc.StatusCode.RESOURCE_EXHAUSTED):
-                        # V1 is structurally refused (even after an
-                        # earlier success — e.g. failover to an older
-                        # importer): pin to V2 and retry THIS flush
-                        self._v1_ok = False
-                        self._send_v2(iter(protos), timeout=self.deadline)
-                    else:
-                        raise
-            else:
-                self._send_v2(iter(protos), timeout=self.deadline)
+            # a single flush body scales with key count (~36 MB at 50k
+            # keys), so RESOURCE_EXHAUSTED here is structural, not
+            # transient — both codes pin the client to V2
+            self._v1_ok = send_batch(
+                self._send_v1, self._send_v2, protos, self.deadline,
+                self._v1_ok,
+                pin_codes=(grpc.StatusCode.UNIMPLEMENTED,
+                           grpc.StatusCode.RESOURCE_EXHAUSTED))
         except grpc.RpcError as e:
             code = e.code() if hasattr(e, "code") else None
             if code == grpc.StatusCode.DEADLINE_EXCEEDED:

@@ -1,0 +1,62 @@
+"""Light forward-plane wire helpers shared by the forward client and
+the proxy's destination senders.
+
+Deliberately free of jax imports: the proxy tier routes protobufs and
+never aggregates, so dragging the TPU stack into its import chain
+(forward.client -> convert -> ops.batch_tdigest -> jax) would add
+seconds of startup and a hard dependency the process doesn't use.
+"""
+
+from __future__ import annotations
+
+import grpc
+
+
+def _serialize_metric(m) -> bytes:
+    """Entries are either pre-serialized wire bytes (the native digest
+    encoder's output) or metricpb.Metric objects."""
+    return m if type(m) is bytes else m.SerializeToString()
+
+
+def _frame_v1(m) -> bytes:
+    """Wraps one serialized Metric as a MetricList `metrics` entry
+    (field 1, length-delimited); concatenating the frames IS the
+    MetricList wire body."""
+    b = _serialize_metric(m)
+    n = len(b)
+    out = [b"\x0a"]
+    while n >= 0x80:
+        out.append(bytes((n & 0x7F | 0x80,)))
+        n >>= 7
+    out.append(bytes((n,)))
+    out.append(b)
+    return b"".join(out)
+
+
+def send_batch(send_v1, send_v2, batch, timeout, v1_ok: bool,
+               pin_codes, retry_codes=()) -> bool:
+    """One batch over the V1 bulk body when the peer takes it, else the
+    V2 stream — the single transport policy both the forward client and
+    the proxy destinations use, so the fallback semantics cannot drift.
+
+    `pin_codes` are structural refusals (retry THIS batch via V2 and
+    return False so the caller stays on V2); `retry_codes` are
+    transient V1 failures (retry via V2 but keep preferring V1). Any
+    other error propagates for the caller's failure accounting.
+    Returns the updated v1-preference flag."""
+    if v1_ok:
+        try:
+            body = b"".join(_frame_v1(m) for m in batch)
+            send_v1(body, timeout=timeout)
+            return True
+        except grpc.RpcError as e:
+            code = e.code() if hasattr(e, "code") else None
+            if code in pin_codes:
+                send_v2(iter(batch), timeout=timeout)
+                return False
+            if code in retry_codes:
+                send_v2(iter(batch), timeout=timeout)
+                return True
+            raise
+    send_v2(iter(batch), timeout=timeout)
+    return v1_ok

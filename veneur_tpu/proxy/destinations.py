@@ -19,6 +19,7 @@ from typing import Callable, Dict, List, Optional
 import grpc
 
 from veneur_tpu.forward.protos import metric_pb2
+from veneur_tpu.forward.wire import send_batch
 from veneur_tpu.proxy.ring import ConsistentRing, EmptyRingError
 from veneur_tpu.util.grpctls import GrpcTLS, secure_or_insecure_channel
 
@@ -49,6 +50,14 @@ class Destination:
             "/forwardrpc.Forward/SendMetricsV2",
             request_serializer=metric_pb2.Metric.SerializeToString,
             response_deserializer=_EMPTY_DESERIALIZER)
+        # bulk path: one unary MetricList per batch instead of a
+        # per-metric stream; a reference-style receiver that refuses it
+        # pins this destination to V2 (same policy as ForwardClient)
+        self._send_v1 = self._channel.unary_unary(
+            "/forwardrpc.Forward/SendMetrics",
+            request_serializer=lambda b: b,
+            response_deserializer=_EMPTY_DESERIALIZER)
+        self._v1_ok = True
         self._thread = threading.Thread(
             target=self._run, name=f"proxy-dest-{address}", daemon=True)
         self._thread.start()
@@ -98,7 +107,15 @@ class Destination:
             if not batch:
                 continue
             try:
-                self._send_v2(iter(batch), timeout=10.0)
+                # proxy batches are <= self._batch small metrics, so
+                # RESOURCE_EXHAUSTED is far likelier transient receiver
+                # overload than an oversized body: retry via V2 but keep
+                # preferring V1; only UNIMPLEMENTED pins
+                self._v1_ok = send_batch(
+                    self._send_v1, self._send_v2, batch, 10.0,
+                    self._v1_ok,
+                    pin_codes=(grpc.StatusCode.UNIMPLEMENTED,),
+                    retry_codes=(grpc.StatusCode.RESOURCE_EXHAUSTED,))
                 self.sent_total += len(batch)
                 self._failures = 0
             except grpc.RpcError as e:
