@@ -224,6 +224,151 @@ class TestProxyRouting:
             ft.stop()
 
 
+class TestNativeRouting:
+    def _proxy_with_fakes(self, n_dest=3, **kwargs):
+        from veneur_tpu.testing.forwardtest import ForwardTestServer
+        received = [[] for _ in range(n_dest)]
+        servers = []
+        for i in range(n_dest):
+            ft = ForwardTestServer(received[i].extend)
+            ft.start()
+            servers.append(ft)
+        proxy = create_static_proxy([s.address for s in servers], **kwargs)
+        proxy.start()  # populates the destination pool via discovery
+        assert wait_until(lambda: len(proxy.destinations._pool) == n_dest)
+        return proxy, servers, received
+
+    def _body(self, metrics):
+        from veneur_tpu.forward.wire import _frame_v1
+        return b"".join(_frame_v1(m.SerializeToString()) for m in metrics)
+
+    def test_native_route_matches_upb_route(self):
+        """The native re-scatter must place every metric on the same
+        destination the upb handle_metric path would, and deliver
+        byte-identical protos."""
+        from veneur_tpu.forward.protos import metric_pb2
+
+        metrics = []
+        for i in range(200):
+            metrics.append(metric_pb2.Metric(
+                name=f"route.{i % 37}", tags=[f"t:{i % 7}", "env:x"],
+                type=(metric_pb2.Counter, metric_pb2.Gauge,
+                      metric_pb2.Timer)[i % 3],
+                scope=metric_pb2.Global,
+                counter=metric_pb2.CounterValue(value=i)))
+        body = self._body(metrics)
+
+        # ring placement depends on member addresses, so both paths must
+        # run through the SAME proxy (same ring) to compare
+        proxy, servers, received = self._proxy_with_fakes()
+        try:
+            want = len(metrics)
+
+            def wait_total(n):
+                deadline = time.time() + 10
+                while time.time() < deadline and sum(map(len,
+                                                         received)) < n:
+                    time.sleep(0.05)
+                assert sum(map(len, received)) == n
+
+            assert proxy._route_native(body) == want
+            wait_total(want)
+            native_placement = [
+                sorted(m.SerializeToString() for m in dest)
+                for dest in received]
+            for dest in received:
+                dest.clear()
+            for pbm in metrics:
+                proxy.handle_metric(pbm)
+            wait_total(want)
+            upb_placement = [
+                sorted(m.SerializeToString() for m in dest)
+                for dest in received]
+            assert sum(len(d) for d in native_placement) == want
+            assert any(native_placement), "vacuous: nothing delivered"
+            assert native_placement == upb_placement
+            assert len(proxy._route_cache) > 0
+        finally:
+            proxy.stop()
+            for s in servers:
+                s.stop()
+
+    def test_ignored_tags_affect_ring_key_once(self):
+        from veneur_tpu.forward.protos import metric_pb2
+        from veneur_tpu.util.matcher import TagMatcher
+
+        proxy, servers, received = self._proxy_with_fakes(
+            n_dest=1, ignore_tags=[TagMatcher(kind="prefix", value="drop")])
+        try:
+            m1 = metric_pb2.Metric(
+                name="ik", tags=["drop:a", "keep:1"],
+                type=metric_pb2.Counter,
+                counter=metric_pb2.CounterValue(value=1))
+            proxy._route_native(self._body([m1]))
+            (key, rk), = proxy._route_cache.items()
+            # ring key excludes the ignored tag, exactly like
+            # handle_metric's derivation
+            assert rk == "ikcounterkeep:1"
+        finally:
+            proxy.stop()
+            servers[0].stop()
+
+    def test_invalid_utf8_rejected_not_forwarded(self):
+        """A structurally-valid Metric with invalid UTF-8 in its name
+        must be rejected at the proxy (the upb contract) — never batched
+        with innocent metrics where it would poison a whole destination
+        send downstream."""
+        from veneur_tpu.forward.protos import metric_pb2
+        from veneur_tpu.forward.wire import _frame_v1
+
+        proxy, servers, received = self._proxy_with_fakes(n_dest=1)
+        try:
+            ok = metric_pb2.Metric(
+                name="clean", type=metric_pb2.Counter,
+                counter=metric_pb2.CounterValue(value=1))
+            # hand-build: field 1 (name) = b"\xff", field 5 counter
+            poison = b"\x0a\x01\xff\x2a\x02\x08\x01"
+            body = (_frame_v1(ok.SerializeToString())
+                    + _frame_v1(poison))
+            with pytest.raises(Exception):  # upb DecodeError surfaces
+                proxy._route_native(body)
+            deadline = time.time() + 5
+            while time.time() < deadline and not received[0]:
+                time.sleep(0.05)
+            # the clean metric was forwarded; the poison never was
+            assert [m.name for m in received[0]] == ["clean"]
+            assert proxy.stats["routed_total"] == 1
+        finally:
+            proxy.stop()
+            servers[0].stop()
+
+    def test_wide_enum_takes_upb_path(self):
+        from veneur_tpu.forward.protos import metric_pb2
+
+        proxy, servers, received = self._proxy_with_fakes(n_dest=1)
+        try:
+            pbm = metric_pb2.Metric(
+                name="wide", counter=metric_pb2.CounterValue(value=1))
+            pbm.type = 300  # beyond the identity key's byte field
+            ok = metric_pb2.Metric(
+                name="fine", type=metric_pb2.Counter,
+                counter=metric_pb2.CounterValue(value=2))
+            body = self._body([ok, pbm])
+            # Type.Name(300) raises in handle_metric — same contract as
+            # the stream path; the routable metric still goes through
+            try:
+                proxy._route_native(body)
+            except ValueError:
+                pass
+            deadline = time.time() + 5
+            while time.time() < deadline and not received[0]:
+                time.sleep(0.05)
+            assert [m.name for m in received[0]] == ["fine"]
+        finally:
+            proxy.stop()
+            servers[0].stop()
+
+
 class TestDiscovery:
     def test_http_json_discoverer(self):
         payload = ["10.0.0.1:8128",

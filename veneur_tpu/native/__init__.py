@@ -162,6 +162,10 @@ def _declare(lib) -> None:
         i64p, i64p, f32p, f32p, f64p, f64p, f64p, i64, i64p,  # histos
         i64p, i64p, i64p, i64p, i64, i64p,      # sets
     ]
+    lib.vnt_route_parse.restype = i64
+    lib.vnt_route_parse.argtypes = [
+        ctypes.c_void_p, i64, u8p, i64, i64p, i64p, i64p, i64p, i64,
+        i64p]
     lib.vnt_digest_encode.restype = i64
     lib.vnt_digest_encode.argtypes = [
         f32p, f32p, i64, i64,
@@ -367,19 +371,12 @@ def parse_metric_list(body: bytes, grid_slots: int, compression: float):
         cap, ctypes.byref(ns[3]))
     if rc < 0:
         return None
-    # keys were written sequentially: copy only the used prefix, not the
-    # body-sized capacity
-    used = 0
-    for i in range(4):
-        if ns[i].value:
-            used = max(used, int(koff[i][ns[i].value - 1]
-                                 + klen[i][ns[i].value - 1]))
-    kb = key_buf[:used].tobytes()
+    mv = memoryview(key_buf)  # slice per key: no full-buffer copy
 
     def keys_of(i):
         offs = koff[i][:ns[i].value].tolist()
         lens = klen[i][:ns[i].value].tolist()
-        return [kb[o:o + ln] for o, ln in zip(offs, lens)]
+        return [bytes(mv[o:o + ln]) for o, ln in zip(offs, lens)]
 
     out = ImportBatch()
     out.consumed = int(rc)
@@ -400,10 +397,49 @@ def parse_metric_list(body: bytes, grid_slots: int, compression: float):
     return out
 
 
+def route_parse(body: bytes):
+    """Proxy-side MetricList walk: returns (keys, raw_slices) where
+    keys[i] is the metric's identity-key bytes (b"" for metrics the
+    native path can't key — open enums past one byte) and raw_slices[i]
+    the metric's own serialized bytes. None -> upb fallback."""
+    lib = load()
+    if lib is None or not body:
+        return None
+    n = lib.vnt_import_count(body, len(body))
+    if n < 0:
+        return None
+    cap = max(1, int(n))
+    key_cap = len(body) + 16 * cap + 64
+    key_buf = np.empty(key_cap, np.uint8)
+    koff = np.empty(cap, np.int64)
+    klen = np.empty(cap, np.int64)
+    moff = np.empty(cap, np.int64)
+    mlen = np.empty(cap, np.int64)
+    n_out = ctypes.c_int64()
+    rc = lib.vnt_route_parse(
+        body, len(body), _ptr(key_buf, ctypes.c_uint8), key_cap,
+        _ptr(koff, ctypes.c_int64), _ptr(klen, ctypes.c_int64),
+        _ptr(moff, ctypes.c_int64), _ptr(mlen, ctypes.c_int64), cap,
+        ctypes.byref(n_out))
+    if rc < 0:
+        return None
+    count = n_out.value
+    mv = memoryview(key_buf)  # slice per key: no full-buffer copy
+    keys = [bytes(mv[o:o + ln]) for o, ln in zip(koff[:count].tolist(),
+                                                 klen[:count].tolist())]
+    raws = [body[o:o + ln] for o, ln in zip(moff[:count].tolist(),
+                                            mlen[:count].tolist())]
+    return keys, raws
+
+
 def decode_import_key(key: bytes):
     """Inverse of the C encoder's identity-key layout:
     [type][scope][varint nlen][name][varint tcount]{[varint tlen][tag]}*
-    Returns (type_enum, scope_enum, name, [tags])."""
+    Returns (type_enum, scope_enum, name, [tags]). Decoding is STRICT
+    utf-8 (raises UnicodeDecodeError/IndexError on bad input): the upb
+    path rejects invalid string fields at deserialization, and callers
+    rely on this raising to match — a lenient decode would let a
+    poisoned metric flow downstream with a mangled name."""
     mtype, scope = key[0], key[1]
     pos = 2
 
@@ -419,13 +455,13 @@ def decode_import_key(key: bytes):
             shift += 7
 
     nlen, pos = varint(pos)
-    name = key[pos:pos + nlen].decode("utf-8", "replace")
+    name = key[pos:pos + nlen].decode("utf-8")
     pos += nlen
     tcount, pos = varint(pos)
     tags = []
     for _ in range(tcount):
         tlen, pos = varint(pos)
-        tags.append(key[pos:pos + tlen].decode("utf-8", "replace"))
+        tags.append(key[pos:pos + tlen].decode("utf-8"))
         pos += tlen
     return mtype, scope, name, tags
 

@@ -1616,6 +1616,26 @@ inline void put_key_varint(std::vector<uint8_t>& out, uint64_t v) {
   out.push_back(static_cast<uint8_t>(v));
 }
 
+// THE identity-key layout — [type][scope][varint nlen][name]
+// [varint tcount]{[varint tlen][tag]}* — shared by the import decoder
+// and the proxy route parser so the stub cache, the route cache, and
+// decode_import_key can never drift. Caller guarantees type/scope fit
+// a byte.
+inline void emit_identity_key(std::vector<uint8_t>& key, int64_t type,
+                              int64_t scope, std::string_view name,
+                              const std::vector<std::string_view>& tags) {
+  key.clear();
+  key.push_back(static_cast<uint8_t>(type));
+  key.push_back(static_cast<uint8_t>(scope));
+  put_key_varint(key, name.size());
+  key.insert(key.end(), name.begin(), name.end());
+  put_key_varint(key, tags.size());
+  for (const auto& t : tags) {
+    put_key_varint(key, t.size());
+    key.insert(key.end(), t.begin(), t.end());
+  }
+}
+
 struct Centroid2 {
   double mean, weight;
 };
@@ -1817,17 +1837,7 @@ int64_t vnt_import_parse(
                                               // key's byte fields: skip
                                               // (upb path skips too)
     if (which == 7 && cents.empty()) continue;  // empty digest
-    // identity key
-    key.clear();
-    key.push_back(static_cast<uint8_t>(type));
-    key.push_back(static_cast<uint8_t>(scope));
-    put_key_varint(key, name.size());
-    key.insert(key.end(), name.begin(), name.end());
-    put_key_varint(key, tags.size());
-    for (const auto& t : tags) {
-      put_key_varint(key, t.size());
-      key.insert(key.end(), t.begin(), t.end());
-    }
+    emit_identity_key(key, type, scope, name, tags);
     if (key_used + static_cast<int64_t>(key.size()) > key_cap) return -2;
     memcpy(key_buf + key_used, key.data(), key.size());
     int64_t koff = key_used;
@@ -1902,6 +1912,76 @@ int64_t vnt_import_parse(
     }
   }
   return top.ok ? consumed : -1;
+}
+
+// Proxy-side routing parse: walks a MetricList body and emits, per
+// metric, the identity key (same layout as vnt_import_parse) plus the
+// (offset, length) of the metric's own serialized bytes inside `buf` —
+// the proxy hashes the key onto its ring and forwards the RAW bytes
+// untouched, so re-scattering a 50k-metric body never deserializes a
+// Metric in Python. No values are decoded. Returns the metric count,
+// -1 on malformed input, -2 on exhausted caps.
+int64_t vnt_route_parse(const uint8_t* buf, int64_t len,
+                        uint8_t* key_buf, int64_t key_cap,
+                        int64_t* koff, int64_t* klen,
+                        int64_t* moff, int64_t* mlen, int64_t cap,
+                        int64_t* n_out) {
+  WireReader top{buf, buf + len};
+  int64_t key_used = 0;
+  *n_out = 0;
+  std::vector<uint8_t> key;
+  std::vector<std::string_view> tags;
+  uint32_t wt;
+  while (uint32_t f = top.tag(&wt)) {
+    if (!(f == 1 && wt == 2)) {
+      top.skip(wt);
+      if (!top.ok) return -1;
+      continue;
+    }
+    std::string_view mbytes = top.bytes();
+    if (!top.ok) return -1;
+    WireReader m{reinterpret_cast<const uint8_t*>(mbytes.data()),
+                 reinterpret_cast<const uint8_t*>(mbytes.data()) +
+                     mbytes.size()};
+    std::string_view name;
+    tags.clear();
+    int64_t type = 0, scope = 0;
+    uint32_t mwt;
+    while (uint32_t mf = m.tag(&mwt)) {
+      switch (mf) {
+        case 1: name = m.bytes(); break;
+        case 2: tags.push_back(m.bytes()); break;
+        case 3: type = static_cast<int64_t>(m.varint()); break;
+        case 9: scope = static_cast<int64_t>(m.varint()); break;
+        default: m.skip(mwt);
+      }
+    }
+    if (!m.ok) return -1;
+    if (*n_out >= cap) return -2;
+    if (type > 255 || scope > 255) {
+      // open enum beyond the key's byte fields: klen 0 marks "no
+      // identity key"; the Python side handles this metric through the
+      // upb slow path instead of risking a cache collision
+      koff[*n_out] = key_used;
+      klen[*n_out] = 0;
+      moff[*n_out] =
+          reinterpret_cast<const uint8_t*>(mbytes.data()) - buf;
+      mlen[*n_out] = static_cast<int64_t>(mbytes.size());
+      (*n_out)++;
+      continue;
+    }
+    emit_identity_key(key, type, scope, name, tags);
+    if (key_used + static_cast<int64_t>(key.size()) > key_cap) return -2;
+    memcpy(key_buf + key_used, key.data(), key.size());
+    koff[*n_out] = key_used;
+    klen[*n_out] = static_cast<int64_t>(key.size());
+    key_used += static_cast<int64_t>(key.size());
+    moff[*n_out] =
+        reinterpret_cast<const uint8_t*>(mbytes.data()) - buf;
+    mlen[*n_out] = static_cast<int64_t>(mbytes.size());
+    (*n_out)++;
+  }
+  return top.ok ? *n_out : -1;
 }
 
 }  // extern "C"

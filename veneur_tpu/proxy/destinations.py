@@ -19,7 +19,7 @@ from typing import Callable, Dict, List, Optional
 import grpc
 
 from veneur_tpu.forward.protos import metric_pb2
-from veneur_tpu.forward.wire import send_batch
+from veneur_tpu.forward.wire import _serialize_metric, send_batch
 from veneur_tpu.proxy.ring import ConsistentRing, EmptyRingError
 from veneur_tpu.util.grpctls import GrpcTLS, secure_or_insecure_channel
 
@@ -46,9 +46,11 @@ class Destination:
         self.sent_total = 0
         self.dropped_total = 0
         self._channel = secure_or_insecure_channel(address, tls)
+        # batches hold Metric objects (the V2 ingest path) or raw wire
+        # bytes (the native V1 re-scatter): the serializer passes both
         self._send_v2 = self._channel.stream_unary(
             "/forwardrpc.Forward/SendMetricsV2",
-            request_serializer=metric_pb2.Metric.SerializeToString,
+            request_serializer=_serialize_metric,
             response_deserializer=_EMPTY_DESERIALIZER)
         # bulk path: one unary MetricList per batch instead of a
         # per-metric stream; a reference-style receiver that refuses it

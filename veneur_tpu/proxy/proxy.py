@@ -52,6 +52,12 @@ class ProxyServer:
             "received_total": 0, "routed_total": 0,
             "no_destination_total": 0, "dropped_total": 0,
         }
+        # identity-key bytes -> ring-key string: forward streams repeat
+        # the same keys every interval, so ring-key derivation (tag
+        # filtering, type naming, joining) is paid once per key
+        # lifetime. The ring key is membership-independent, so the
+        # cache survives discovery churn.
+        self._route_cache: Dict[bytes, str] = {}
         # handle_metric runs on up to max_workers gRPC threads; python
         # dict += is not atomic, so counter accuracy needs a lock
         self._stats_lock = threading.Lock()
@@ -71,7 +77,9 @@ class ProxyServer:
                 response_serializer=lambda _: b""),
             "SendMetrics": grpc.unary_unary_rpc_method_handler(
                 self.rpc_stats.timed("SendMetrics", self._send_metrics_v1),
-                request_deserializer=forward_pb2.MetricList.FromString,
+                # raw bytes: the native route parser re-scatters the
+                # body without deserializing; upb is the fallback
+                request_deserializer=lambda b: b,
                 response_serializer=lambda _: b""),
         })
         self._grpc.add_generic_rpc_handlers((handler,))
@@ -138,12 +146,78 @@ class ProxyServer:
             return
         self.destinations.set_destinations(addresses)
 
+    ROUTE_CACHE_MAX = 1_000_000
+
     # -- handlers --------------------------------------------------------
 
-    def _send_metrics_v1(self, metric_list, ctx):
-        for pbm in metric_list.metrics:
-            self.handle_metric(pbm)
+    def _send_metrics_v1(self, body, ctx):
+        if self._route_native(body) is None:
+            metric_list = forward_pb2.MetricList.FromString(body)
+            for pbm in metric_list.metrics:
+                self.handle_metric(pbm)
         return b""
+
+    def _route_native(self, body) -> Optional[int]:
+        """Re-scatter a V1 body without deserializing: the native walk
+        (vnt_route_parse) yields each metric's identity key + raw bytes;
+        the ring key derives from the identity key once per key lifetime
+        (the route cache) and destinations forward the raw bytes — both
+        V1 framing and the V2 stream serializer pass bytes through."""
+        from veneur_tpu import native
+
+        parsed = native.route_parse(body)
+        if parsed is None:
+            return None
+        keys, raws = parsed
+        cache = self._route_cache
+        fast = routed = dropped = no_dest = 0
+        try:
+            for key, raw in zip(keys, raws):
+                if not key:
+                    # wide open enum: the upb path decides (and raises
+                    # the same way the stream path would); it also does
+                    # its own received/routed accounting
+                    self.handle_metric(metric_pb2.Metric.FromString(raw))
+                    continue
+                fast += 1
+                ring_key = cache.get(key)
+                if ring_key is None:
+                    # strict decode: invalid utf-8 raises here, and the
+                    # upb re-parse below surfaces the same rejection the
+                    # old whole-body deserializer gave — the poisoned
+                    # metric never reaches a destination batch
+                    try:
+                        mtype, _scope, name, tags = \
+                            native.decode_import_key(key)
+                        type_name = metric_pb2.Type.Name(mtype).lower()
+                    except (ValueError, IndexError):
+                        fast -= 1  # slow path does its own accounting
+                        self.handle_metric(metric_pb2.Metric.FromString(raw))
+                        continue
+                    tags = [t for t in tags
+                            if not any(mm.match(t) for mm in self._ignore)]
+                    ring_key = "%s%s%s" % (name, type_name, ",".join(tags))
+                    if len(cache) >= self.ROUTE_CACHE_MAX:
+                        cache.clear()
+                    cache[key] = ring_key
+                try:
+                    dest = self.destinations.get(ring_key)
+                except EmptyRingError:
+                    no_dest += 1
+                    continue
+                if dest.send(raw):
+                    routed += 1
+                else:
+                    dropped += 1
+        finally:
+            # flushed even when a slow-path metric raises mid-batch so
+            # already-forwarded metrics stay counted
+            with self._stats_lock:
+                self.stats["received_total"] += fast
+                self.stats["routed_total"] += routed
+                self.stats["dropped_total"] += dropped
+                self.stats["no_destination_total"] += no_dest
+        return len(keys)
 
     def _send_metrics_v2(self, request_iterator, ctx):
         for pbm in request_iterator:
