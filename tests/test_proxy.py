@@ -305,10 +305,11 @@ class TestNativeRouting:
                 type=metric_pb2.Counter,
                 counter=metric_pb2.CounterValue(value=1))
             proxy._route_native(self._body([m1]))
-            (key, rk), = proxy._route_cache.items()
+            (key, point), = proxy._route_cache.items()
             # ring key excludes the ignored tag, exactly like
-            # handle_metric's derivation
-            assert rk == "ikcounterkeep:1"
+            # handle_metric's derivation (cache stores its ring point)
+            assert point == proxy.destinations.ring.point_of(
+                "ikcounterkeep:1")
         finally:
             proxy.stop()
             servers[0].stop()

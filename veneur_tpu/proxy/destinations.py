@@ -192,8 +192,14 @@ class Destinations:
                 self.ring.remove(dest.address)
 
     def get(self, key: str) -> Destination:
+        return self.get_at(self.ring.point_of(key))
+
+    def get_at(self, point: int) -> Destination:
+        """Lookup by pre-computed ring point (ring.point_of): the proxy
+        route cache stores points so the per-metric hot path skips the
+        Python fnv hash entirely."""
         with self._lock:
-            address = self.ring.get(key)
+            address = self.ring.get_at(point)
             dest = self._pool.get(address)
             if dest is None:
                 raise EmptyRingError(f"no destination for {address}")

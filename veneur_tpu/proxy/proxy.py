@@ -52,12 +52,12 @@ class ProxyServer:
             "received_total": 0, "routed_total": 0,
             "no_destination_total": 0, "dropped_total": 0,
         }
-        # identity-key bytes -> ring-key string: forward streams repeat
-        # the same keys every interval, so ring-key derivation (tag
-        # filtering, type naming, joining) is paid once per key
-        # lifetime. The ring key is membership-independent, so the
+        # identity-key bytes -> ring POINT: forward streams repeat the
+        # same keys every interval, so ring-key derivation (tag
+        # filtering, type naming, joining) AND its hash are paid once
+        # per key lifetime. Points are membership-independent, so the
         # cache survives discovery churn.
-        self._route_cache: Dict[bytes, str] = {}
+        self._route_cache: Dict[bytes, int] = {}
         # handle_metric runs on up to max_workers gRPC threads; python
         # dict += is not atomic, so counter accuracy needs a lock
         self._stats_lock = threading.Lock()
@@ -180,8 +180,8 @@ class ProxyServer:
                     self.handle_metric(metric_pb2.Metric.FromString(raw))
                     continue
                 fast += 1
-                ring_key = cache.get(key)
-                if ring_key is None:
+                point = cache.get(key)
+                if point is None:
                     # strict decode: invalid utf-8 raises here, and the
                     # upb re-parse below surfaces the same rejection the
                     # old whole-body deserializer gave — the poisoned
@@ -196,12 +196,13 @@ class ProxyServer:
                         continue
                     tags = [t for t in tags
                             if not any(mm.match(t) for mm in self._ignore)]
-                    ring_key = "%s%s%s" % (name, type_name, ",".join(tags))
+                    point = self.destinations.ring.point_of(
+                        "%s%s%s" % (name, type_name, ",".join(tags)))
                     if len(cache) >= self.ROUTE_CACHE_MAX:
                         cache.clear()
-                    cache[key] = ring_key
+                    cache[key] = point
                 try:
-                    dest = self.destinations.get(ring_key)
+                    dest = self.destinations.get_at(point)
                 except EmptyRingError:
                     no_dest += 1
                     continue

@@ -75,11 +75,21 @@ class ConsistentRing:
         return len(self._members)
 
     def get(self, key: str) -> str:
+        return self.get_at(self.point_of(key))
+
+    @staticmethod
+    def point_of(key: str) -> int:
+        """The key's ring point. Membership-independent, so callers on
+        a hot path may cache it per key and skip re-hashing (the Python
+        fnv loop dominates a lookup); get_at(point) must give the same
+        member get(key) would."""
+        return fnv.fnv1a_64(key.encode())
+
+    def get_at(self, point: int) -> str:
         with self._lock:
             if not self._points:
                 raise EmptyRingError("empty consistent-hash ring")
-            h = fnv.fnv1a_64(key.encode())
-            idx = bisect.bisect_right(self._points, h)
+            idx = bisect.bisect_right(self._points, point)
             if idx == len(self._points):
                 idx = 0
             return self._owner[self._points[idx]]
@@ -88,11 +98,11 @@ class ConsistentRing:
         """The owner and the next distinct member clockwise (for
         replicated sends; reference ring offers Get/GetTwo/GetN)."""
         with self._lock:
-            first = self.get(key)
+            point = self.point_of(key)
+            first = self.get_at(point)
             if len(self._members) < 2:
                 return first, first
-            h = fnv.fnv1a_64(key.encode())
-            idx = bisect.bisect_right(self._points, h)
+            idx = bisect.bisect_right(self._points, point)
             n = len(self._points)
             for step in range(n):
                 member = self._owner[self._points[(idx + step) % n]]
