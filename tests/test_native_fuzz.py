@@ -1,0 +1,159 @@
+"""Adversarial fuzz of the hand-rolled native wire parsers.
+
+vnt_import_count / vnt_import_parse / vnt_route_parse / vnt_ssf_parse
+read bytes straight off the network in C++; a crash there takes the
+whole server down, so beyond the structural tests they get hammered
+with mutated-valid and pure-random buffers. The contract under fuzz:
+never crash, never hang, and either parse cleanly or reject (the
+Python wrappers return None); anything the native path accepts must
+not disagree with upb about metric COUNT."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from veneur_tpu import native
+from veneur_tpu.forward.protos import forward_pb2, metric_pb2, tdigest_pb2
+from veneur_tpu.forward.wire import _frame_v1
+from veneur_tpu.ops import batch_tdigest
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native library unavailable")
+
+ROUNDS = 400
+
+
+def valid_body(rng) -> bytes:
+    metrics = []
+    for i in range(int(rng.integers(1, 6))):
+        kind = int(rng.integers(0, 4))
+        m = metric_pb2.Metric(name=f"fz.{i}", tags=[f"t:{i}"],
+                              scope=metric_pb2.Global)
+        if kind == 0:
+            m.type = metric_pb2.Counter
+            m.counter.value = int(rng.integers(-1000, 1000))
+        elif kind == 1:
+            m.type = metric_pb2.Gauge
+            m.gauge.value = float(rng.standard_normal())
+        elif kind == 2:
+            m.type = metric_pb2.Timer
+            d = tdigest_pb2.MergingDigestData(
+                compression=batch_tdigest.COMPRESSION, min=0, max=9)
+            for _ in range(int(rng.integers(1, 8))):
+                d.main_centroids.add(mean=float(rng.standard_normal()),
+                                     weight=float(rng.random() + 0.1))
+            m.histogram.t_digest.CopyFrom(d)
+        else:
+            m.type = metric_pb2.Set
+            m.set.hyper_log_log = bytes(rng.integers(
+                0, 256, int(rng.integers(0, 40)), dtype=np.uint8))
+        metrics.append(m)
+    return b"".join(_frame_v1(m.SerializeToString()) for m in metrics)
+
+
+def mutate(body: bytes, rng) -> bytes:
+    b = bytearray(body)
+    op = int(rng.integers(0, 4))
+    if op == 0 and b:  # flip random bytes
+        for _ in range(int(rng.integers(1, 8))):
+            b[int(rng.integers(0, len(b)))] = int(rng.integers(0, 256))
+    elif op == 1 and b:  # truncate
+        del b[int(rng.integers(0, len(b))):]
+    elif op == 2:  # splice random garbage
+        pos = int(rng.integers(0, len(b) + 1))
+        b[pos:pos] = bytes(rng.integers(0, 256, int(rng.integers(1, 32)),
+                                        dtype=np.uint8))
+    else:  # duplicate a slice
+        if b:
+            s = int(rng.integers(0, len(b)))
+            e = min(len(b), s + int(rng.integers(1, 64)))
+            b.extend(b[s:e])
+    return bytes(b)
+
+
+def upb_count(body: bytes):
+    try:
+        return len(forward_pb2.MetricList.FromString(body).metrics)
+    except Exception:
+        return None
+
+
+class TestImportParserFuzz:
+    def test_mutated_bodies_never_crash(self):
+        rng = np.random.default_rng(1234)
+        for _ in range(ROUNDS):
+            body = mutate(valid_body(rng), rng)
+            out = native.parse_metric_list(
+                body, batch_tdigest.C, batch_tdigest.COMPRESSION)
+            if out is not None:
+                # whatever the native path accepts, upb must agree the
+                # wire STRUCTURE is sound and the count matches
+                want = upb_count(body)
+                # proto3 allows last-field-wins / unknown fields that
+                # upb also accepts; only compare when upb parses
+                if want is not None:
+                    assert out.consumed == want
+
+    def test_pure_random_never_crashes(self):
+        rng = np.random.default_rng(99)
+        for _ in range(ROUNDS):
+            blob = bytes(rng.integers(0, 256, int(rng.integers(0, 512)),
+                                      dtype=np.uint8))
+            native.parse_metric_list(blob, batch_tdigest.C,
+                                     batch_tdigest.COMPRESSION)
+            native.route_parse(blob)
+
+    def test_route_parse_agrees_with_import_on_validity(self):
+        rng = np.random.default_rng(7)
+        for _ in range(ROUNDS):
+            body = mutate(valid_body(rng), rng)
+            imp = native.parse_metric_list(
+                body, batch_tdigest.C, batch_tdigest.COMPRESSION)
+            rt = native.route_parse(body)
+            # both walk the same frame structure: accept/reject together
+            assert (imp is None) == (rt is None), body.hex()
+
+    def test_structure_accepted_implies_upb_structure(self):
+        """The native parser must never accept a buffer whose FRAME
+        structure upb rejects (it may be stricter about nested values,
+        never looser about framing)."""
+        rng = np.random.default_rng(42)
+        looser = 0
+        for _ in range(ROUNDS):
+            body = mutate(valid_body(rng), rng)
+            out = native.parse_metric_list(
+                body, batch_tdigest.C, batch_tdigest.COMPRESSION)
+            if out is not None and upb_count(body) is None:
+                looser += 1
+        # upb additionally validates utf-8 in string fields, which the
+        # native walk defers to the stub/dispatch layer — allow a small
+        # residue but no systematic laxness
+        assert looser <= ROUNDS * 0.1, looser
+
+
+class TestSsfDecoderFuzz:
+    def test_ssf_buffer_never_crashes(self):
+        from veneur_tpu import ssf
+        from veneur_tpu.config import Config
+        from veneur_tpu.core.server import Server
+        from veneur_tpu.sinks.channel import ChannelMetricSink
+
+        cfg = Config()
+        cfg.interval = 3600
+        cfg.statsd_listen_addresses = []
+        cfg.apply_defaults()
+        server = Server(cfg, extra_metric_sinks=[ChannelMetricSink()])
+        rng = np.random.default_rng(5)
+        sp = ssf.SSFSpan(id=1, trace_id=2, name="f", service="s",
+                         start_timestamp=1, end_timestamp=2)
+        sp.metrics.append(ssf.count("c", 1))
+        base = sp.SerializeToString()
+        for _ in range(ROUNDS):
+            pkts = [mutate(base, rng) for _ in range(3)]
+            joined = b"".join(pkts)
+            lens = np.fromiter((len(p) for p in pkts), np.int64, 3)
+            offs = np.zeros(3, np.int64)
+            np.cumsum(lens[:-1], out=offs[1:])
+            server.handle_ssf_buffer(joined, offs, lens)
+        server.flush()  # whatever was accepted must still flush cleanly

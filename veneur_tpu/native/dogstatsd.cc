@@ -1640,6 +1640,93 @@ struct Centroid2 {
   double mean, weight;
 };
 
+// Shared wire-type guard for Metric-level fields: 1,2,5-8 are
+// length-delimited, 3,9 varint; anything else under those numbers is
+// unknown data to skip (upb semantics), never an error. One definition
+// so vnt_import_parse and vnt_route_parse cannot drift.
+inline bool metric_field_wiretype_mismatch(uint32_t mf, uint32_t mwt) {
+  return ((mf == 1 || mf == 2 || (mf >= 5 && mf <= 8)) && mwt != 2) ||
+         ((mf == 3 || mf == 9) && mwt != 0);
+}
+
+// THE HistogramValue{ MergingDigestData t_digest=1 } walk — the single
+// definition of "structurally valid digest" for both the import
+// decoder (out params set) and the route validator (null out params).
+// Returns false on structural corruption.
+bool walk_histogram_value(std::string_view hv,
+                          std::vector<Centroid2>* cents, double* dmin,
+                          double* dmax, double* drecip) {
+  WireReader h{reinterpret_cast<const uint8_t*>(hv.data()),
+               reinterpret_cast<const uint8_t*>(hv.data()) + hv.size()};
+  uint32_t hwt;
+  while (uint32_t hf = h.tag(&hwt)) {
+    if (!(hf == 1 && hwt == 2)) {
+      h.skip(hwt);
+      continue;
+    }
+    std::string_view dv = h.bytes();
+    if (!h.ok) return false;
+    WireReader d{reinterpret_cast<const uint8_t*>(dv.data()),
+                 reinterpret_cast<const uint8_t*>(dv.data()) + dv.size()};
+    uint32_t dwt;
+    while (uint32_t df = d.tag(&dwt)) {
+      switch (df) {
+        case 1: {  // Centroid
+          if (dwt != 2) {  // wrong wire type: unknown data
+            d.skip(dwt);
+            break;
+          }
+          std::string_view cb = d.bytes();
+          if (!d.ok) return false;
+          WireReader c{reinterpret_cast<const uint8_t*>(cb.data()),
+                       reinterpret_cast<const uint8_t*>(cb.data()) +
+                           cb.size()};
+          double mean = 0, weight = 0;
+          uint32_t ct;
+          while (uint32_t cf2 = c.tag(&ct)) {
+            if (cf2 == 1 && ct == 1) mean = c.f64();
+            else if (cf2 == 2 && ct == 1) weight = c.f64();
+            else c.skip(ct);  // samples etc.
+          }
+          if (!c.ok) return false;
+          if (cents != nullptr && weight > 0) {
+            cents->push_back({mean, weight});
+          }
+          break;
+        }
+        case 3:
+          if (dwt == 1) {
+            double v = d.f64();
+            if (dmin != nullptr) *dmin = v;
+          } else {
+            d.skip(dwt);
+          }
+          break;
+        case 4:
+          if (dwt == 1) {
+            double v = d.f64();
+            if (dmax != nullptr) *dmax = v;
+          } else {
+            d.skip(dwt);
+          }
+          break;
+        case 5:
+          if (dwt == 1) {
+            double v = d.f64();
+            if (drecip != nullptr) *drecip = v;
+          } else {
+            d.skip(dwt);
+          }
+          break;
+        default:
+          d.skip(dwt);
+      }
+    }
+    if (!d.ok) return false;
+  }
+  return h.ok;
+}
+
 }  // namespace
 
 extern "C" {
@@ -1716,6 +1803,14 @@ int64_t vnt_import_parse(
     cents.clear();
     uint32_t mwt;
     while (uint32_t mf = m.tag(&mwt)) {
+      // a field with an unexpected wire type is unknown data, not an
+      // error (upb parses by WIRE type and skips) — misreading it as
+      // the declared type would reject bodies upb accepts
+      if (metric_field_wiretype_mismatch(mf, mwt)) {
+        m.skip(mwt);
+        if (!m.ok) return -1;
+        continue;
+      }
       switch (mf) {
         case 1: name = m.bytes(); break;
         case 2: tags.push_back(m.bytes()); break;
@@ -1758,52 +1853,10 @@ int64_t vnt_import_parse(
         }
         case 7: {  // HistogramValue{ MergingDigestData t_digest=1 }
           std::string_view hv = m.bytes();
-          WireReader h{reinterpret_cast<const uint8_t*>(hv.data()),
-                       reinterpret_cast<const uint8_t*>(hv.data()) +
-                           hv.size()};
-          uint32_t hwt;
-          while (uint32_t hf = h.tag(&hwt)) {
-            if (hf == 1 && hwt == 2) {
-              std::string_view dv = h.bytes();
-              WireReader d{reinterpret_cast<const uint8_t*>(dv.data()),
-                           reinterpret_cast<const uint8_t*>(dv.data()) +
-                               dv.size()};
-              uint32_t dwt;
-              while (uint32_t df = d.tag(&dwt)) {
-                switch (df) {
-                  case 1: {  // Centroid
-                    std::string_view cb = d.bytes();
-                    WireReader c{
-                        reinterpret_cast<const uint8_t*>(cb.data()),
-                        reinterpret_cast<const uint8_t*>(cb.data()) +
-                            cb.size()};
-                    double mean = 0, weight = 0;
-                    uint32_t ct;
-                    while (uint32_t cf2 = c.tag(&ct)) {
-                      if (cf2 == 1 && ct == 1) mean = c.f64();
-                      else if (cf2 == 2 && ct == 1) weight = c.f64();
-                      else c.skip(ct);  // samples etc.
-                    }
-                    if (!c.ok) return -1;
-                    if (weight > 0) cents.push_back({mean, weight});
-                    break;
-                  }
-                  case 3: if (dwt == 1) dmin = d.f64(); else d.skip(dwt);
-                    break;
-                  case 4: if (dwt == 1) dmax = d.f64(); else d.skip(dwt);
-                    break;
-                  case 5: if (dwt == 1) drecip = d.f64();
-                    else d.skip(dwt);
-                    break;
-                  default: d.skip(dwt);
-                }
-              }
-              if (!d.ok) return -1;
-            } else {
-              h.skip(hwt);
-            }
+          if (!m.ok ||
+              !walk_histogram_value(hv, &cents, &dmin, &dmax, &drecip)) {
+            return -1;
           }
-          if (!h.ok) return -1;
           which = 7;
           break;
         }
@@ -1914,13 +1967,38 @@ int64_t vnt_import_parse(
   return top.ok ? consumed : -1;
 }
 
+namespace {
+
+// Structural validation of a Metric's value submessage (fields 5-8):
+// the proxy forwards RAW bytes, so anything it accepts lands verbatim
+// in a downstream importer's batch — one structurally-corrupt value
+// would fail whole 512-metric destination sends. upb validated these
+// nested messages when the proxy deserialized; the route parser must
+// be exactly as strict about structure (utf-8 strictness lives in the
+// Python key-decode layer).
+bool validate_value_field(std::string_view v, int field) {
+  if (field == 7) {  // HistogramValue: the shared digest walk decides
+    return walk_histogram_value(v, nullptr, nullptr, nullptr, nullptr);
+  }
+  WireReader r{reinterpret_cast<const uint8_t*>(v.data()),
+               reinterpret_cast<const uint8_t*>(v.data()) + v.size()};
+  uint32_t wt;
+  while (uint32_t f = r.tag(&wt)) {
+    r.skip(wt);
+  }
+  return r.ok;
+}
+
+}  // namespace
+
 // Proxy-side routing parse: walks a MetricList body and emits, per
 // metric, the identity key (same layout as vnt_import_parse) plus the
 // (offset, length) of the metric's own serialized bytes inside `buf` —
 // the proxy hashes the key onto its ring and forwards the RAW bytes
 // untouched, so re-scattering a 50k-metric body never deserializes a
-// Metric in Python. No values are decoded. Returns the metric count,
-// -1 on malformed input, -2 on exhausted caps.
+// Metric in Python. Value fields are structurally validated but not
+// decoded. Returns the metric count, -1 on malformed input, -2 on
+// exhausted caps.
 int64_t vnt_route_parse(const uint8_t* buf, int64_t len,
                         uint8_t* key_buf, int64_t key_cap,
                         int64_t* koff, int64_t* klen,
@@ -1948,11 +2026,27 @@ int64_t vnt_route_parse(const uint8_t* buf, int64_t len,
     int64_t type = 0, scope = 0;
     uint32_t mwt;
     while (uint32_t mf = m.tag(&mwt)) {
+      // unexpected wire type = unknown data (upb semantics), not error
+      if (metric_field_wiretype_mismatch(mf, mwt)) {
+        m.skip(mwt);
+        if (!m.ok) return -1;
+        continue;
+      }
       switch (mf) {
         case 1: name = m.bytes(); break;
         case 2: tags.push_back(m.bytes()); break;
         case 3: type = static_cast<int64_t>(m.varint()); break;
         case 9: scope = static_cast<int64_t>(m.varint()); break;
+        case 5:
+        case 6:
+        case 7:
+        case 8: {
+          std::string_view v = m.bytes();
+          if (!m.ok || !validate_value_field(v, static_cast<int>(mf))) {
+            return -1;
+          }
+          break;
+        }
         default: m.skip(mwt);
       }
     }
