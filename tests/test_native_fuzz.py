@@ -150,10 +150,7 @@ class TestSsfDecoderFuzz:
         sp.metrics.append(ssf.count("c", 1))
         base = sp.SerializeToString()
         for _ in range(ROUNDS):
-            pkts = [mutate(base, rng) for _ in range(3)]
-            joined = b"".join(pkts)
-            lens = np.fromiter((len(p) for p in pkts), np.int64, 3)
-            offs = np.zeros(3, np.int64)
-            np.cumsum(lens[:-1], out=offs[1:])
-            server.handle_ssf_buffer(joined, offs, lens)
+            # the production packet-batch entry point (it builds the
+            # joined/offs/lens buffer the native decoder consumes)
+            server.handle_ssf_batch([mutate(base, rng) for _ in range(3)])
         server.flush()  # whatever was accepted must still flush cleanly

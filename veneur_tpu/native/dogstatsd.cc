@@ -1641,8 +1641,11 @@ struct Centroid2 {
 };
 
 // Shared wire-type guard for Metric-level fields: 1,2,5-8 are
-// length-delimited, 3,9 varint; anything else under those numbers is
-// unknown data to skip (upb semantics), never an error. One definition
+// length-delimited, 3,9 varint; other SCALAR wire types under those
+// numbers are unknown data to skip (upb semantics). The long-retired
+// group wire types (3/4) still reject via skip()'s default case — a
+// strictness upb doesn't share, but proto3 serializers never emit
+// groups, and rejecting only forces the upb fallback. One definition
 // so vnt_import_parse and vnt_route_parse cannot drift.
 inline bool metric_field_wiretype_mismatch(uint32_t mf, uint32_t mwt) {
   return ((mf == 1 || mf == 2 || (mf >= 5 && mf <= 8)) && mwt != 2) ||
