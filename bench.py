@@ -53,6 +53,16 @@ BATCH_CAP = [16384]
 
 
 def set_batch_cap_for(platform: str) -> None:
+    env = os.environ.get("BENCH_BATCH_CAP")
+    if env:  # manual tuning knob for tunnel-window experiments
+        try:
+            cap = int(env)
+        except ValueError:
+            cap = 0
+        if cap > 0:
+            BATCH_CAP[0] = cap
+            return
+        log(f"ignoring invalid BENCH_BATCH_CAP={env!r}")
     BATCH_CAP[0] = 32768 if not platform.startswith("cpu") else 16384
 
 
@@ -748,7 +758,7 @@ def run_scenario_ssf(duration_s: float, num_keys: int = 10_000):
 
 
 def run_scenario_device(duration_s: float, num_keys: int = 100_000,
-                        batch: int = 65_536):
+                        batch: int = 65_536, flush_ab: bool = True):
     """Device-only throughput: samples/s through the batched apply kernels
     plus one flush pass, with pre-staged on-device COO arrays — separates
     device kernel throughput from host parse/intern overhead."""
@@ -824,7 +834,7 @@ def run_scenario_device(duration_s: float, num_keys: int = 100_000,
     # gated off in production until TPU numbers exist — measure both
     # paths here so every TPU artifact carries the comparison
     # (VERDICT r04 #3: prove the fused flush or Pallas-fuse it)
-    if jax.default_backend() in ("tpu", "axon"):
+    if flush_ab and jax.default_backend() in ("tpu", "axon"):
         from veneur_tpu.ops import pallas_tdigest
         # the kernel tiles BK rows: trim the state to a multiple so the
         # A/B runs at the default 100k shape (100000 % 128 == 32), and
@@ -956,8 +966,36 @@ def run_one(scenario: str, duration: float, keys: int, on_tpu: bool = True):
     elif scenario == "forward":
         rate = run_scenario_forward(duration, keys)
     elif scenario == "device":
-        rate, dflush = run_scenario_device(duration, clamp_keys(keys, on_tpu))
-        extra["flush_latency_s"] = round(dflush, 4)
+        if on_tpu and os.environ.get("BENCH_DEVICE_SWEEP") == "1":
+            # opt-in batch-size ladder (manual captures only: each shape
+            # is a fresh compile, too slow for the driver's budget). The
+            # tunnel adds per-dispatch RTT, so the 64k default can be
+            # overhead-bound — the sweep shows where the knee really is.
+            sweep = {}
+            rate, dflush = 0.0, None
+            for step, b in enumerate((65_536, 262_144, 1_048_576)):
+                # the first step also runs the Pallas flush A/B (it
+                # depends only on num_keys, so once is enough) — its two
+                # extra compiles need a bigger reserve
+                ab = step == 0
+                if time_left() < (90 if ab else 30):
+                    log("device sweep truncated by deadline")
+                    break
+                r, fl = run_scenario_device(
+                    max(2.0, duration / 2), clamp_keys(keys, on_tpu),
+                    batch=b, flush_ab=ab)
+                sweep[str(b)] = round(r, 1)
+                if r > rate:
+                    rate, dflush = r, fl
+            if not sweep:
+                log("device sweep pre-empted entirely; single fallback run")
+                rate, dflush = run_scenario_device(
+                    2.0, clamp_keys(keys, on_tpu))
+            extra["device_batch_sweep"] = sweep
+        else:
+            rate, dflush = run_scenario_device(
+                duration, clamp_keys(keys, on_tpu))
+        extra["flush_latency_s"] = round(dflush, 4) if dflush else None
     elif scenario == "sustained":
         rate, extra = run_scenario_sustained(
             clamp_keys(keys, on_tpu), interval_s=10.0 if on_tpu else 2.0)
@@ -1031,6 +1069,8 @@ def run_default(args, on_tpu: bool) -> None:
                 "device", 3.0 if on_tpu else 2.0, args.keys, on_tpu)
             RESULT["device_samples_per_sec"] = round(drate, 1)
             RESULT["device_flush_latency_s"] = dextra.get("flush_latency_s")
+            if "device_batch_sweep" in dextra:
+                RESULT["device_batch_sweep"] = dextra["device_batch_sweep"]
         except Exception as e:
             traceback.print_exc()
             RESULT["device_error"] = f"{type(e).__name__}: {e}"
