@@ -835,33 +835,44 @@ def run_scenario_device(duration_s: float, num_keys: int = 100_000,
     # paths here so every TPU artifact carries the comparison
     # (VERDICT r04 #3: prove the fused flush or Pallas-fuse it)
     if flush_ab and jax.default_backend() in ("tpu", "axon"):
-        from veneur_tpu.ops import pallas_tdigest
-        # the kernel tiles BK rows: trim the state to a multiple so the
-        # A/B runs at the default 100k shape (100000 % 128 == 32), and
-        # measure BOTH paths on the same trimmed state for fairness
-        kk = num_keys - num_keys % pallas_tdigest.BK
-        if kk and pallas_tdigest.available(kk):
-            try:
-                ps = tuple(percentiles)
-                histos = ({k: v[:kk] for k, v in state[2].items()}
-                          if kk != num_keys else state[2])
-                jnp_s = _time_flush(
-                    lambda: batch_tdigest.flush_export_packed(histos, ps))
-                RESULT["tdigest_flush_export_jnp_s"] = round(jnp_s, 4)
-                pal_s = _time_flush(
-                    lambda: batch_tdigest.flush_export_packed_pallas(
-                        histos, ps))
-                RESULT["tdigest_flush_export_pallas_s"] = round(pal_s, 4)
-                log(f"flush A/B at {kk} keys: jnp {jnp_s*1e3:.1f}ms"
-                    f" vs pallas {pal_s*1e3:.1f}ms")
-            except Exception as e:
-                RESULT["tdigest_flush_pallas_error"] = \
-                    f"{type(e).__name__}: {e}"
-        else:
-            RESULT["tdigest_flush_pallas_error"] = "kernel unavailable"
+        ab = measure_flush_ab(state[2], num_keys, percentiles)
+        RESULT.update(ab)
+        if "tdigest_flush_export_jnp_s" in ab:
+            jnp_ms = ab["tdigest_flush_export_jnp_s"] * 1e3
+            pal_ms = ab.get("tdigest_flush_export_pallas_s",
+                            float("nan")) * 1e3
+            log(f"flush A/B: jnp {jnp_ms:.1f}ms vs pallas {pal_ms:.1f}ms")
 
     rate = applies * batch / apply_elapsed
     return rate, flush_latency
+
+
+def measure_flush_ab(histo_state, num_keys: int, percentiles) -> dict:
+    """XLA-vs-Pallas t-digest flush-export timings (seconds) on the same
+    BK-trimmed state — the single definition of the A/B's trim/gate/
+    fairness policy, shared with scripts/kernel_microbench.py. The
+    kernel tiles BK rows, so the state is trimmed to a multiple (the
+    default 100k shape has 100000 % 128 == 32) and BOTH paths run on the
+    trimmed state."""
+    from veneur_tpu.ops import batch_tdigest, pallas_tdigest
+
+    res = {}
+    kk = num_keys - num_keys % pallas_tdigest.BK
+    if not (kk and pallas_tdigest.available(kk)):
+        res["tdigest_flush_pallas_error"] = "kernel unavailable"
+        return res
+    ps = tuple(percentiles)
+    histos = ({k: v[:kk] for k, v in histo_state.items()}
+              if kk != num_keys else histo_state)
+    try:
+        res["tdigest_flush_export_jnp_s"] = round(_time_flush(
+            lambda: batch_tdigest.flush_export_packed(histos, ps)), 4)
+        res["tdigest_flush_export_pallas_s"] = round(_time_flush(
+            lambda: batch_tdigest.flush_export_packed_pallas(
+                histos, ps)), 4)
+    except Exception as e:
+        res["tdigest_flush_pallas_error"] = f"{type(e).__name__}: {e}"
+    return res
 
 
 def _time_flush(fn, reps: int = 3) -> float:
@@ -973,24 +984,31 @@ def run_one(scenario: str, duration: float, keys: int, on_tpu: bool = True):
             # overhead-bound — the sweep shows where the knee really is.
             sweep = {}
             rate, dflush = 0.0, None
-            for step, b in enumerate((65_536, 262_144, 1_048_576)):
-                # the first step also runs the Pallas flush A/B (it
-                # depends only on num_keys, so once is enough) — its two
-                # extra compiles need a bigger reserve
-                ab = step == 0
-                if time_left() < (90 if ab else 30):
+            ab_pending = True  # the flush A/B depends only on num_keys,
+            # so it rides along with exactly one step — the first one
+            # that has the budget for its two extra compiles
+            for b in (65_536, 262_144, 1_048_576):
+                if time_left() < 30:
                     log("device sweep truncated by deadline")
                     break
-                r, fl = run_scenario_device(
-                    max(2.0, duration / 2), clamp_keys(keys, on_tpu),
-                    batch=b, flush_ab=ab)
+                ab = ab_pending and time_left() >= 90
+                try:
+                    r, fl = run_scenario_device(
+                        max(2.0, duration / 2), clamp_keys(keys, on_tpu),
+                        batch=b, flush_ab=ab)
+                except Exception as e:  # e.g. the largest shape OOMs —
+                    # keep the measurements already collected
+                    sweep[str(b)] = f"error: {type(e).__name__}: {e}"
+                    continue
+                if ab:
+                    ab_pending = False
                 sweep[str(b)] = round(r, 1)
                 if r > rate:
                     rate, dflush = r, fl
-            if not sweep:
+            if rate == 0.0:
                 log("device sweep pre-empted entirely; single fallback run")
                 rate, dflush = run_scenario_device(
-                    2.0, clamp_keys(keys, on_tpu))
+                    2.0, clamp_keys(keys, on_tpu), flush_ab=False)
             extra["device_batch_sweep"] = sweep
         else:
             rate, dflush = run_scenario_device(
