@@ -1005,10 +1005,12 @@ def run_one(scenario: str, duration: float, keys: int, on_tpu: bool = True):
                 sweep[str(b)] = round(r, 1)
                 if r > rate:
                     rate, dflush = r, fl
-            if rate == 0.0:
+            if rate == 0.0 and time_left() >= 30:
                 log("device sweep pre-empted entirely; single fallback run")
                 rate, dflush = run_scenario_device(
                     2.0, clamp_keys(keys, on_tpu), flush_ab=False)
+            elif rate == 0.0:
+                log(f"device fallback skipped: {time_left():.0f}s left")
             extra["device_batch_sweep"] = sweep
         else:
             rate, dflush = run_scenario_device(

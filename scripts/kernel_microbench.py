@@ -29,12 +29,25 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+_EMIT_LOCK = threading.Lock()
+_EMITTED = False
+
+
 def _emit(out: dict, path: str | None) -> None:
-    line = json.dumps(out)
-    print(line, flush=True)
-    if path:
-        with open(path, "w") as f:
-            f.write(line + "\n")
+    """Emit the JSON record exactly once (the success path and the
+    deadline timer race to call this). dict(out) snapshots under the
+    GIL before json.dumps walks it, so a concurrent key assignment in
+    the other thread can't blow up the serialization."""
+    global _EMITTED
+    with _EMIT_LOCK:
+        if _EMITTED:
+            return
+        _EMITTED = True
+        line = json.dumps(dict(out))
+        print(line, flush=True)
+        if path:
+            with open(path, "w") as f:
+                f.write(line + "\n")
 
 
 def main() -> int:
@@ -153,11 +166,7 @@ def main() -> int:
     except Exception as e:
         out["forward_encode_error"] = f"{type(e).__name__}: {e}"
 
-    line = json.dumps(out)
-    print(line, flush=True)
-    if args.out:
-        with open(args.out, "w") as f:
-            f.write(line + "\n")
+    _emit(out, args.out)
     return 0
 
 

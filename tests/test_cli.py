@@ -139,6 +139,19 @@ class TestVeneurCLI:
         assert veneur_main(["-f", str(p),
                             "-validate-config-strict"]) == 1
 
+    def test_go_runtime_profiler_keys_accepted_strict(self, tmp_path):
+        # reference config.go:14,35 — a migrated config carrying the Go
+        # runtime profiler rates must stay valid under strict validation
+        p = tmp_path / "cfg.yaml"
+        p.write_text("interval: 5s\nblock_profile_rate: 1000\n"
+                     "mutex_profile_fraction: 5\n")
+        assert veneur_main(["-f", str(p),
+                            "-validate-config-strict"]) == 0
+        from veneur_tpu.config import read_config
+        cfg = read_config(str(p), strict=True)
+        assert cfg.block_profile_rate == 1000
+        assert cfg.mutex_profile_fraction == 5
+
 
 class TestVeneurPrometheus:
     def test_statsd_emitter(self):

@@ -18,10 +18,13 @@ from test_server import generate_config, setup_server
 
 class TestScopedClient:
     def test_scope_tags(self):
+        # reference YAML keys (config.go VeneurMetricsScopes): timings
+        # scope by the `histogram` entry (scopedstatsd/client.go:91-110)
         packets = []
         client = ScopedClient(
             packet_cb=packets.append,
-            scopes={"gauge": "local", "count": "global"},
+            scopes={"gauge": "local", "counter": "global",
+                    "histogram": "local"},
             additional_tags=["svc:veneur"])
         client.gauge("g", 1.5, tags=["x:y"])
         client.count("c", 2)
@@ -29,7 +32,19 @@ class TestScopedClient:
         assert packets[0] == b"g:1.5|g|#x:y,svc:veneur," + \
             TAG_LOCAL_ONLY.encode()
         assert packets[1] == b"c:2|c|#svc:veneur," + TAG_GLOBAL_ONLY.encode()
-        assert packets[2] == b"t:125.000|ms|#svc:veneur"
+        assert packets[2] == b"t:125.000|ms|#svc:veneur," + \
+            TAG_LOCAL_ONLY.encode()
+
+    def test_scope_tags_alias_keys(self):
+        # the pre-parity key names keep working
+        packets = []
+        client = ScopedClient(
+            packet_cb=packets.append,
+            scopes={"count": "global", "timing": "local"})
+        client.count("c", 2)
+        client.timing("t", 0.125)
+        assert packets[0] == b"c:2|c|#" + TAG_GLOBAL_ONLY.encode()
+        assert packets[1] == b"t:125.000|ms|#" + TAG_LOCAL_ONLY.encode()
 
     def test_udp_emission(self):
         recv = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)

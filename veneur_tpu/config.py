@@ -118,6 +118,12 @@ class Config:
     # when set, jax.profiler.start_server(port) for live
     # TensorBoard capture of device profiles
     profile_server_port: int = 0
+    # Go-runtime profiler rates (reference config.go:14,35), accepted so
+    # a reference config stays valid under validate-config-strict; the
+    # Python runtime has no block/mutex profiler — the /debug/pprof
+    # endpoints (core/profiling.py) are this rebuild's analog
+    block_profile_rate: int = 0
+    mutex_profile_fraction: int = 0
     extend_tags: List[str] = field(default_factory=list)
     features: Features = field(default_factory=Features)
     flush_on_shutdown: bool = False

@@ -524,6 +524,11 @@ class Server:
         if self.config.profile_server_port:
             from veneur_tpu.core.profiling import start_profile_server
             start_profile_server(self.config.profile_server_port)
+        if self.config.block_profile_rate or self.config.mutex_profile_fraction:
+            logger.warning(
+                "block_profile_rate/mutex_profile_fraction are Go-runtime "
+                "knobs with no Python analog; accepted for config compat "
+                "only — use /debug/pprof and enable_profiling instead")
         # pre-compile the flush kernels off the ticker path so the first
         # real flush isn't delayed by XLA compilation (~20-40s on TPU);
         # kept as an attribute so callers that pre-load the store (bench,

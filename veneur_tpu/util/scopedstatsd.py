@@ -28,9 +28,16 @@ class ScopedClient:
                  packet_cb: Optional[Callable[[bytes], None]] = None,
                  scopes: Optional[Dict[str, str]] = None,
                  additional_tags: Sequence[str] = ()):
-        """scopes maps metric kind ("gauge"/"count"/"timing") to
-        "local"/"global"/"" (reference MetricScopes struct)."""
-        self.scopes = scopes or {}
+        """scopes maps metric kind to "local"/"global"/"" using the
+        reference's YAML keys — "counter"/"gauge"/"histogram" (config.go
+        VeneurMetricsScopes; timings scope by Histogram, scopedstatsd/
+        client.go:91-110). The pre-parity aliases "count"/"timing" stay
+        accepted."""
+        scopes = dict(scopes or {})
+        for ref_key, alias in (("counter", "count"), ("histogram", "timing")):
+            if ref_key not in scopes and alias in scopes:
+                scopes[ref_key] = scopes[alias]
+        self.scopes = scopes
         self.additional_tags = list(additional_tags)
         self._cb = packet_cb
         self._sock = None
@@ -44,7 +51,7 @@ class ScopedClient:
               rate: float) -> None:
         final = list(tags) + self.additional_tags
         scope_tag = _SCOPE_TAGS.get(self.scopes.get(
-            {"c": "count", "g": "gauge", "ms": "timing"}[kind], ""))
+            {"c": "counter", "g": "gauge", "ms": "histogram"}[kind], ""))
         if scope_tag:
             final.append(scope_tag)
         packet = render_metric_packet(name, value, kind, final, rate)
