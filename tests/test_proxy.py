@@ -446,3 +446,137 @@ class TestDiscovery:
         finally:
             proxy.stop()
             ft.stop()
+
+
+class TestV2ConfigShape:
+    """cmd/veneur_proxy accepts the reference v2 proxy config
+    (reference proxy/config.go) as well as the legacy shape."""
+
+    def _args(self, **over):
+        import types
+        base = dict(config=None, destinations="", listen="127.0.0.1:0",
+                    http="", discovery_interval="10s",
+                    forward_service="veneur-global", tls_cert="",
+                    tls_key="", tls_ca="", dest_tls_ca="",
+                    dest_tls_cert="", dest_tls_key="", debug=False)
+        base.update(over)
+        return types.SimpleNamespace(**base)
+
+    def test_v2_config_end_to_end(self):
+        import logging
+        import socket
+
+        from veneur_tpu.cmd.veneur_proxy import build_from_config
+
+        got = []
+        ft = ForwardTestServer(got.extend)
+        ft.start()
+        statsd_recv = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        statsd_recv.bind(("127.0.0.1", 0))
+        statsd_recv.settimeout(5.0)
+        raw = {
+            "forward_addresses": [ft.address],
+            "discovery_interval": "1s",
+            "forward_service": "svc-v2",
+            "ignore_tags": [{"kind": "prefix", "value": "host"}],
+            "send_buffer_size": 128,
+            "shutdown_timeout": "1s",
+            "statsd": {
+                "address": "127.0.0.1:%d" % statsd_recv.getsockname()[1]},
+            "runtime_metrics_interval": "200ms",
+            # v2 nested blocks parse without error even though the
+            # Go-runtime gRPC keepalive knobs have no Python analog
+            "grpc_server": {"max_connection_idle": "10m"},
+            "http": {"enable_config": False},
+        }
+        proxy, stats_loop, http_api = build_from_config(
+            raw, self._args(), logging.getLogger("test"))
+        try:
+            assert proxy.forward_service == "svc-v2"
+            assert stats_loop is not None and http_api is None
+            # ignore_tags from yaml affect the ring key: both variants
+            # of the host tag route identically (single destination, so
+            # delivery is the observable)
+            client = ForwardClient(proxy.address, deadline=5.0)
+            client.send_protos([mkmetric("v2.cfg.m", 3, ("host:a", "svc:x"))])
+            client.close()
+            assert wait_until(lambda: len(got) == 1)
+            assert got[0].name == "v2.cfg.m"
+            # the telemetry loop emits runtime gauges + rpc aggregates
+            deadline = time.time() + 5
+            names = set()
+            while time.time() < deadline:
+                try:
+                    pkt, _ = statsd_recv.recvfrom(4096)
+                except OSError:
+                    break
+                names.add(pkt.split(b":", 1)[0])
+                if b"rpc.count" in names and b"mem.rss_bytes" in names:
+                    break
+            assert b"mem.rss_bytes" in names
+            assert b"rpc.count" in names
+        finally:
+            if stats_loop:
+                stats_loop.stop()
+            proxy.stop()
+            ft.stop()
+            statsd_recv.close()
+
+    def test_dual_listener_tls_and_plaintext(self):
+        import logging
+
+        from test_grpc_tls import CLIENT_TLS, tdpath
+        from veneur_tpu.cmd.veneur_proxy import build_from_config
+
+        got = []
+        ft = ForwardTestServer(got.extend)
+        ft.start()
+        raw = {
+            "forward_addresses": [ft.address],
+            "grpc_address": "127.0.0.1:0",
+            "grpc_tls_address": "127.0.0.1:0",
+            "tls_certificate": tdpath("server.pem"),
+            "tls_key": tdpath("server.key"),
+            "tls_authority_certificate": tdpath("ca.pem"),
+        }
+        proxy, stats_loop, http_api = build_from_config(
+            raw, self._args(), logging.getLogger("test"))
+        try:
+            assert proxy.tls_port and proxy.port
+            assert proxy.tls_port != proxy.port
+            # plaintext leg
+            c1 = ForwardClient(f"127.0.0.1:{proxy.port}", deadline=5.0)
+            c1.send_protos([mkmetric("v2.plain", 1)])
+            c1.close()
+            assert wait_until(lambda: len(got) == 1)
+            # TLS leg (mutual auth, hostname pinned by the test CA)
+            c2 = ForwardClient(f"localhost:{proxy.tls_port}", deadline=5.0,
+                               tls=CLIENT_TLS)
+            c2.send_protos([mkmetric("v2.tls", 1)])
+            c2.close()
+            assert wait_until(lambda: len(got) == 2)
+        finally:
+            proxy.stop()
+            ft.stop()
+
+    def test_tls_address_without_certs_fails_loudly(self):
+        import logging
+
+        from veneur_tpu.cmd.veneur_proxy import build_from_config
+
+        raw = {"forward_addresses": ["127.0.0.1:1"],
+               "grpc_address": "127.0.0.1:0",
+               "grpc_tls_address": "127.0.0.1:0"}
+        with pytest.raises(ValueError, match="grpc_tls_address"):
+            build_from_config(raw, self._args(), logging.getLogger("test"))
+
+    def test_bad_shutdown_timeout_fails_at_startup(self):
+        import logging
+
+        from veneur_tpu.cmd.veneur_proxy import build_from_config
+
+        raw = {"forward_addresses": ["127.0.0.1:1"],
+               "grpc_address": "127.0.0.1:0",
+               "shutdown_timeout": "not-a-duration"}
+        with pytest.raises(Exception):
+            build_from_config(raw, self._args(), logging.getLogger("test"))

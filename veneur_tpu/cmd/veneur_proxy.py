@@ -57,20 +57,71 @@ def main(argv=None) -> int:
         with open(args.config) as f:
             raw = yaml.safe_load(f) or {}
 
+    proxy, stats_loop, http_api = build_from_config(raw, args, log)
+
+    # every listener is bound: report readiness to a parent mid-handoff
+    from veneur_tpu.core import restart
+    restart.mark_ready()
+
+    stop = threading.Event()
+
+    def handle_signal(signum, frame):
+        log.info("received signal %d, shutting down", signum)
+        stop.set()
+
+    signal.signal(signal.SIGINT, handle_signal)
+    signal.signal(signal.SIGTERM, handle_signal)
+
+    # SIGUSR2 graceful restart (the reference ran the proxy under
+    # einhorn too): gRPC servers bind with SO_REUSEPORT by default and
+    # the HTTP API sets it explicitly, so the replacement overlap-binds;
+    # shutdown here just unblocks the main loop, which stops the proxy
+    # after the replacement is ready. With http_address the parent polls
+    # /healthcheck/ready; without it the handoff uses the ready-file
+    # handshake (mark_ready above, written once the proxy was bound).
+    restart.install(stop.set, raw.get("http_address", args.http) or "")
+
+    stop.wait()
+    proxy.stop(grace=proxy.shutdown_grace)
+    if stats_loop is not None:
+        stats_loop.stop()
+    if http_api is not None:
+        http_api.stop()
+    return 0
+
+
+def build_from_config(raw: dict, args, log):
+    """Config dict + parsed flags -> started (proxy, stats_loop,
+    http_api). Split from main() so the v2/legacy config handling is
+    testable without signal handlers (which only install on the main
+    thread)."""
     from veneur_tpu.config import parse_duration
     from veneur_tpu.proxy.discovery import (
         ConsulDiscoverer, KubernetesDiscoverer, StaticDiscoverer)
     from veneur_tpu.proxy.proxy import ProxyServer
 
-    destinations = [d for d in (
-        raw.get("forward_address", "").split(",")
-        if raw.get("forward_address") else args.destinations.split(","))
-        if d]
+    # both reference proxy config generations are accepted: the v2
+    # shape (proxy/config.go — forward_addresses list, discovery_interval,
+    # forward_service, grpc_tls_address, ignore_tags, statsd block) and
+    # the legacy shape (example_proxy.yaml — forward_address CSV,
+    # consul_refresh_interval, consul_forward_service_name)
+    if raw.get("forward_addresses"):
+        destinations = [d for d in raw["forward_addresses"] if d]
+    else:
+        destinations = [d for d in (
+            raw.get("forward_address", "").split(",")
+            if raw.get("forward_address") else args.destinations.split(","))
+            if d]
     interval = parse_duration(
-        raw.get("consul_refresh_interval", args.discovery_interval))
+        raw.get("discovery_interval")
+        or raw.get("consul_refresh_interval", args.discovery_interval))
     listen = raw.get("grpc_address", args.listen)
-    forward_service = raw.get(
-        "consul_forward_service_name", args.forward_service)
+    forward_service = (raw.get("forward_service")
+                       or raw.get("consul_forward_service_name",
+                                  args.forward_service))
+    from veneur_tpu.util.matcher import TagMatcher
+    ignore_tags = [TagMatcher.from_config(t)
+                   for t in raw.get("ignore_tags", []) or []]
 
     # discoverer selection mirrors reference cmd/veneur-proxy/main.go:
     # consul when a consul service name / address is configured,
@@ -100,16 +151,39 @@ def main(argv=None) -> int:
         key=raw.get("forward_tls_key") or args.dest_tls_key,
         authority=(raw.get("forward_tls_authority_certificate")
                    or args.dest_tls_ca))
+    # validated before any port binds so a bad value fails at startup,
+    # not mid-shutdown after SIGTERM
+    shutdown_grace = parse_duration(raw.get("shutdown_timeout", "1s"))
     proxy = ProxyServer(
         discoverer,
         forward_service=forward_service,
         listen_address=listen,
         discovery_interval=interval,
+        ignore_tags=ignore_tags,
+        send_buffer=int(raw.get("send_buffer_size") or 4096),
         tls=tls or None,
+        tls_listen_address=raw.get("grpc_tls_address", ""),
         destination_tls=dest_tls or None)
+    proxy.shutdown_grace = shutdown_grace
     proxy.start()
     log.info("veneur-proxy listening on %s -> %s", proxy.address,
              destinations)
+
+    # self-telemetry, reference cmd/veneur-proxy/main.go:64-90: RPC
+    # aggregates + runtime gauges to the configured statsd address
+    stats_loop = None
+    statsd_cfg = raw.get("statsd") or {}
+    if statsd_cfg.get("address"):
+        from veneur_tpu.core.diagnostics import DiagnosticsLoop
+        from veneur_tpu.util.scopedstatsd import ScopedClient
+        stats_client = ScopedClient(address=statsd_cfg["address"])
+        stats_loop = DiagnosticsLoop(
+            stats_client,
+            interval=parse_duration(
+                raw.get("runtime_metrics_interval", "10s")),
+            include_device=False,  # the proxy tier never imports jax
+            extra=lambda: proxy.rpc_stats.emit(stats_client))
+        stats_loop.start()
 
     http_api = None
     http_addr = raw.get("http_address", args.http)
@@ -118,33 +192,7 @@ def main(argv=None) -> int:
         http_api = HTTPApi(raw, server=None, address=http_addr)
         http_api.start()
 
-    # every listener is bound: report readiness to a parent mid-handoff
-    from veneur_tpu.core import restart
-    restart.mark_ready()
-
-    stop = threading.Event()
-
-    def handle_signal(signum, frame):
-        log.info("received signal %d, shutting down", signum)
-        stop.set()
-
-    signal.signal(signal.SIGINT, handle_signal)
-    signal.signal(signal.SIGTERM, handle_signal)
-
-    # SIGUSR2 graceful restart (the reference ran the proxy under
-    # einhorn too): gRPC servers bind with SO_REUSEPORT by default and
-    # the HTTP API sets it explicitly, so the replacement overlap-binds;
-    # shutdown here just unblocks the main loop, which stops the proxy
-    # after the replacement is ready. With http_address the parent polls
-    # /healthcheck/ready; without it the handoff uses the ready-file
-    # handshake (mark_ready above, written once the proxy was bound).
-    restart.install(stop.set, http_addr or "")
-
-    stop.wait()
-    proxy.stop()
-    if http_api is not None:
-        http_api.stop()
-    return 0
+    return proxy, stats_loop, http_api
 
 
 if __name__ == "__main__":

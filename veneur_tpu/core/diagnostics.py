@@ -12,7 +12,7 @@ from __future__ import annotations
 import gc
 import threading
 import time
-from typing import Optional
+from typing import Callable, Optional
 
 from veneur_tpu.util.scopedstatsd import ScopedClient
 
@@ -53,10 +53,12 @@ class DiagnosticsLoop:
     """Emits `collect` every interval on a daemon thread."""
 
     def __init__(self, stats: ScopedClient, interval: float,
-                 include_device: bool = True):
+                 include_device: bool = True,
+                 extra: Optional[Callable[[], None]] = None):
         self.stats = stats
         self.interval = interval
         self.include_device = include_device
+        self.extra = extra  # e.g. the proxy's per-interval RPC aggregates
         self.start_time = time.time()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -70,6 +72,8 @@ class DiagnosticsLoop:
         while not self._stop.wait(self.interval):
             try:
                 collect(self.stats, self.start_time, self.include_device)
+                if self.extra is not None:
+                    self.extra()
             except Exception:
                 pass
 

@@ -39,10 +39,13 @@ class ProxyServer:
                  send_buffer: int = 4096, batch: int = 512,
                  max_workers: int = 8,
                  tls: Optional[GrpcTLS] = None,
+                 tls_listen_address: str = "",
                  destination_tls: Optional[GrpcTLS] = None):
         self.discoverer = discoverer
         self.forward_service = forward_service
         self.discovery_interval = discovery_interval
+        self.shutdown_grace = 1.0  # stop() grace; the CLI overrides it
+        # from shutdown_timeout
         self._ignore = list(ignore_tags or [])
         self.destinations = Destinations(
             send_buffer=send_buffer, batch=batch, tls=destination_tls)
@@ -83,9 +86,26 @@ class ProxyServer:
                 response_serializer=lambda _: b""),
         })
         self._grpc.add_generic_rpc_handlers((handler,))
-        if tls:
-            # the proxy terminates TLS on the forward plane (reference
-            # proxy/proxy.go:33-120); authority => mutual auth
+        # listener layout mirrors the reference v2 proxy (proxy/proxy.go
+        # grpc_address + grpc_tls_address): with a dedicated
+        # tls_listen_address the server binds BOTH a plaintext port on
+        # listen_address and a TLS port there; with tls but no dedicated
+        # address, TLS terminates on the single listener (legacy shape);
+        # authority => mutual auth either way
+        self.tls_port = 0
+        if tls_listen_address and not tls:
+            # half-configured TLS must fail loudly, never fall back to
+            # plaintext (same stance as util/grpctls.py)
+            raise ValueError(
+                "grpc_tls_address requires tls_certificate/tls_key")
+        if tls and tls_listen_address:
+            self.tls_port = self._grpc.add_secure_port(
+                tls_listen_address, tls.server_credentials())
+            if self.tls_port == 0:
+                raise RuntimeError(
+                    f"could not bind proxy TLS to {tls_listen_address}")
+            self.port = self._grpc.add_insecure_port(listen_address)
+        elif tls:
             self.port = self._grpc.add_secure_port(
                 listen_address, tls.server_credentials())
         else:
