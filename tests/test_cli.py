@@ -101,6 +101,93 @@ class TestEmitEndToEnd:
         finally:
             server.shutdown()
 
+    def test_emit_ssf_metric(self):
+        # reference -ssf: the metric ships as an SSF sample on a
+        # metrics-only span and lands in aggregation via extraction
+        server, observer = self._server_with_udp()
+        try:
+            host, port = server.local_addr("ssf-udp")
+            rc = emit.main(["-hostport", f"udp://{host}:{port}",
+                            "-name", "emit.ssf.c", "-count", "7",
+                            "-tag", "k:v", "-ssf"])
+            assert rc == 0
+            metric = self._wait_metric(server, observer, "emit.ssf.c")
+            assert metric.value == 7.0
+            assert "k:v" in metric.tags
+        finally:
+            server.shutdown()
+
+    def test_emit_event_sc_reference_flags(self):
+        # the reference flag set (-e_time/-e_aggr_key/-e_event_tags,
+        # -sc_time/-sc_hostname/-sc_tags) renders packets the parser
+        # accepts
+        from veneur_tpu.samplers.parser import Parser
+
+        sent = []
+        real = emit.send_packet
+        emit.send_packet = lambda hp, pkt: sent.append(pkt)
+        try:
+            rc = emit.main(["-mode", "event", "-e_title", "T",
+                            "-e_text", "B", "-e_time", "1700000000",
+                            "-e_aggr_key", "agg", "-e_event_tags",
+                            "x:1,y:2"])
+            assert rc == 0
+            rc = emit.main(["-mode", "sc", "-sc_name", "svc.ok",
+                            "-sc_status", "1", "-sc_time", "1700000000",
+                            "-sc_hostname", "h1", "-sc_tags", "z:3",
+                            "-sc_msg", "degraded"])
+            assert rc == 0
+        finally:
+            emit.send_packet = real
+        from veneur_tpu.samplers.parser import (
+            EVENT_AGGREGATION_KEY_TAG_KEY, STATUS_WARNING)
+
+        parser = Parser()
+        ev = parser.parse_event(sent[0])
+        assert ev.name == "T" and ev.message == "B"
+        assert ev.timestamp == 1700000000
+        assert ev.tags[EVENT_AGGREGATION_KEY_TAG_KEY] == "agg"
+        assert ev.tags["x"] == "1" and ev.tags["y"] == "2"
+        sc = parser.parse_service_check(sent[1])
+        assert sc.key.name == "svc.ok" and sc.value == STATUS_WARNING
+        assert sc.hostname == "h1" and "z:3" in sc.tags
+        assert sc.timestamp == 1700000000
+
+    def test_emit_span_reference_flags(self):
+        # -trace_id/-parent_span_id/-span_starttime/-span_endtime/
+        # -indicator/-error/-span_tags (reference tracing flag set)
+        from veneur_tpu.ssf.protos import ssf_pb2
+
+        sent = []
+        sock_cls = emit.socket.socket
+
+        class FakeSock:
+            def __init__(self, *a, **k):
+                pass
+
+            def sendto(self, data, addr):
+                sent.append(data)
+
+            def close(self):
+                pass
+
+        emit.socket.socket = FakeSock
+        try:
+            rc = emit.main(["-mode", "span", "-name", "em.sp",
+                            "-trace_id", "42", "-parent_span_id", "41",
+                            "-span_starttime", "1700000000",
+                            "-span_endtime", "1700000001",
+                            "-indicator", "-error",
+                            "-span_tags", "st:1"])
+            assert rc == 0
+        finally:
+            emit.socket.socket = sock_cls
+        span = ssf_pb2.SSFSpan.FromString(sent[0])
+        assert span.trace_id == 42 and span.parent_id == 41
+        assert span.indicator and span.error
+        assert span.end_timestamp - span.start_timestamp == int(1e9)
+        assert span.tags["st"] == "1"
+
     def test_emit_span_ssf(self):
         server, observer = self._server_with_udp()
         try:

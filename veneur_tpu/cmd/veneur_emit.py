@@ -51,20 +51,38 @@ def send_packet(hostport: str, packet: bytes) -> None:
             s.close()
 
 
+def _parse_when(value: str) -> int:
+    """Timestamp flag -> epoch nanoseconds. Accepts epoch seconds
+    (possibly fractional) or an ISO-8601 date/time (the reference
+    accepts free-form via dateparse; ISO is the documented subset)."""
+    try:
+        return int(float(value) * 1e9)
+    except ValueError:
+        from datetime import datetime
+        return int(datetime.fromisoformat(value).timestamp() * 1e9)
+
+
 def send_span(hostport: str, name: str, service: str, tags: List[str],
-              duration_s: float, error: bool, metrics=()) -> None:
+              duration_s: float, error: bool, metrics=(),
+              trace_id: int = 0, parent_id: int = 0,
+              start: str = "", end: str = "",
+              indicator: bool = False) -> None:
     """Send one SSF span (UDP datagram, unframed)."""
     from veneur_tpu.ssf.protos import ssf_pb2
     scheme, host, port = parse_hostport(hostport)
     now_ns = time.time_ns()
     span = ssf_pb2.SSFSpan()
     span.id = now_ns & 0x7FFFFFFF
-    span.trace_id = span.id
+    span.trace_id = trace_id or span.id
+    if parent_id:
+        span.parent_id = parent_id
     span.name = name
     span.service = service
-    span.start_timestamp = now_ns - int(duration_s * 1e9)
-    span.end_timestamp = now_ns
+    span.end_timestamp = _parse_when(end) if end else now_ns
+    span.start_timestamp = (_parse_when(start) if start
+                            else span.end_timestamp - int(duration_s * 1e9))
     span.error = error
+    span.indicator = indicator
     for t in tags:
         k, _, v = t.partition(":")
         span.tags[k] = v
@@ -77,9 +95,31 @@ def send_span(hostport: str, name: str, service: str, tags: List[str],
         s.close()
 
 
+def send_ssf_metric(hostport: str, name: str, value, mtype: str,
+                    tags: List[str], rate: float = 1.0) -> None:
+    """-ssf: ship the metric as an SSF sample attached to a metrics-only
+    span (reference main.go ToSSF / sendSSF path) instead of DogStatsD."""
+    from veneur_tpu import ssf as ssf_helpers
+    tag_map = dict(t.partition(":")[::2] for t in tags)
+    if mtype == "c":
+        sample = ssf_helpers.count(name, float(value), tags=tag_map)
+    elif mtype == "g":
+        sample = ssf_helpers.gauge(name, float(value), tags=tag_map)
+    elif mtype == "ms":
+        sample = ssf_helpers.timing(name, float(value) / 1000.0,
+                                    1e-3, tags=tag_map)
+    else:
+        sample = ssf_helpers.set_sample(name, str(value), tags=tag_map)
+    sample.sample_rate = rate
+    send_span(hostport, "", "veneur-emit", [], 0.0, False,
+              metrics=[sample])
+
+
 def send_grpc(target: str, name: str, value: float, mtype: str,
-              tags: List[str]) -> None:
-    """Emit one metric over the gRPC forward plane (mode grpc)."""
+              tags: List[str], authority: str = "") -> None:
+    """Emit one metric over the gRPC forward plane (mode grpc).
+    `authority` mirrors the reference's -proxy flag (the HTTP/2
+    :authority header, for emitting through an L7 proxy)."""
     from veneur_tpu.forward.client import ForwardClient
     from veneur_tpu.forward.protos import metric_pb2
     pbm = metric_pb2.Metric()
@@ -92,7 +132,12 @@ def send_grpc(target: str, name: str, value: float, mtype: str,
     else:
         pbm.type = metric_pb2.COUNTER
         pbm.counter.value = int(value)
-    client = ForwardClient(target)
+    channel = None
+    if authority:
+        import grpc
+        channel = grpc.insecure_channel(
+            target, options=[("grpc.default_authority", authority)])
+    client = ForwardClient(target, channel=channel)
     try:
         client.send_protos([pbm])
     finally:
@@ -140,30 +185,58 @@ def main(argv=None) -> int:
     ap.add_argument("-set", dest="set_value", default=None)
     ap.add_argument("-rate", type=float, default=1.0)
     ap.add_argument("-tag", action="append", default=[])
+    ap.add_argument("-debug", action="store_true")
+    ap.add_argument("-ssf", action="store_true",
+                    help="send the metric as an SSF sample instead of "
+                         "DogStatsD (reference -ssf)")
     ap.add_argument("-grpc", action="store_true",
                     help="emit over the gRPC forward plane")
+    ap.add_argument("-proxy", default="",
+                    help="authority override for the gRPC channel "
+                         "(reference -proxy)")
     ap.add_argument("-command", nargs=argparse.REMAINDER, default=None,
                     help="run a command; emit its wall time as a timer")
-    # events
+    # events (reference flag names; -e_aggregation_key kept as an alias
+    # of -e_aggr_key)
     ap.add_argument("-e_title", default="")
     ap.add_argument("-e_text", default="")
-    ap.add_argument("-e_aggregation_key", default="")
+    ap.add_argument("-e_time", default="")
+    ap.add_argument("-e_aggr_key", "-e_aggregation_key",
+                    dest="e_aggregation_key", default="")
     ap.add_argument("-e_priority", default="")
     ap.add_argument("-e_source_type", default="")
     ap.add_argument("-e_alert_type", default="")
     ap.add_argument("-e_hostname", default="")
+    ap.add_argument("-e_event_tags", default="",
+                    help="extra event tags, comma separated")
     # service checks
     ap.add_argument("-sc_name", default="")
     ap.add_argument("-sc_status", type=int, default=0)
     ap.add_argument("-sc_msg", default="")
-    # span mode
+    ap.add_argument("-sc_time", default="")
+    ap.add_argument("-sc_hostname", default="")
+    ap.add_argument("-sc_tags", default="",
+                    help="extra service-check tags, comma separated")
+    # span mode (-error is the reference name; -span_error kept)
     ap.add_argument("-span_service", default="veneur-emit")
-    ap.add_argument("-span_error", action="store_true")
+    ap.add_argument("-error", "-span_error", dest="span_error",
+                    action="store_true")
     ap.add_argument("-span_duration", type=float, default=0.0)
+    ap.add_argument("-trace_id", type=int, default=0)
+    ap.add_argument("-parent_span_id", type=int, default=0)
+    ap.add_argument("-span_starttime", default="")
+    ap.add_argument("-span_endtime", default="")
+    ap.add_argument("-indicator", action="store_true")
+    ap.add_argument("-span_tags", default="",
+                    help="extra span tags, comma separated")
     # load driver
     ap.add_argument("-pps", type=float, default=0.0)
     ap.add_argument("-duration", type=float, default=10.0)
     args = ap.parse_args(argv)
+
+    if args.debug:
+        import logging
+        logging.basicConfig(level=logging.DEBUG)
 
     if args.command is not None:
         start = time.perf_counter()
@@ -176,20 +249,36 @@ def main(argv=None) -> int:
         send_packet(args.hostport, packet)
         return proc.returncode
 
+    def _split(csv):
+        return [t for t in csv.split(",") if t]
+
+    def _epoch(value: str) -> str:
+        """-e_time/-sc_time -> whole epoch seconds: the DogStatsD d:
+        grammar is integer-only, so ISO/fractional input is normalized
+        here (the same forms _parse_when takes for span times) instead
+        of being sent raw for the server to reject."""
+        return str(_parse_when(value) // 1_000_000_000) if value else ""
+
     if args.mode == "event":
         send_packet(args.hostport, render_event_packet(
-            args.e_title, args.e_text, args.tag,
+            args.e_title, args.e_text, args.tag + _split(args.e_event_tags),
             args.e_aggregation_key, args.e_priority,
-            args.e_source_type, args.e_alert_type, args.e_hostname))
+            args.e_source_type, args.e_alert_type, args.e_hostname,
+            timestamp=_epoch(args.e_time)))
         return 0
     if args.mode == "sc":
         send_packet(args.hostport, render_service_check_packet(
-            args.sc_name, args.sc_status, args.tag, args.sc_msg))
+            args.sc_name, args.sc_status, args.tag + _split(args.sc_tags),
+            args.sc_msg, hostname=args.sc_hostname,
+            timestamp=_epoch(args.sc_time)))
         return 0
     if args.mode == "span":
         send_span(args.hostport, args.name or "veneur_emit.span",
-                  args.span_service, args.tag, args.span_duration,
-                  args.span_error)
+                  args.span_service, args.tag + _split(args.span_tags),
+                  args.span_duration, args.span_error,
+                  trace_id=args.trace_id, parent_id=args.parent_span_id,
+                  start=args.span_starttime, end=args.span_endtime,
+                  indicator=args.indicator)
         return 0
 
     if args.count is not None:
@@ -205,10 +294,15 @@ def main(argv=None) -> int:
         print("need one of -count/-gauge/-timing/-set", file=sys.stderr)
         return 2
 
+    if args.ssf:
+        send_ssf_metric(args.hostport, args.name, value, mtype, args.tag,
+                        args.rate)
+        return 0
     if args.grpc:
         send_grpc(args.hostport,
                   args.name, float(value),
-                  "gauge" if mtype == "g" else "counter", args.tag)
+                  "gauge" if mtype == "g" else "counter", args.tag,
+                  authority=args.proxy)
         return 0
 
     packet = render_metric_packet(args.name, value, mtype, args.tag,

@@ -25,9 +25,11 @@ def render_metric_packet(name: str, value, mtype: str,
 def render_event_packet(title: str, text: str, tags: List[str],
                         aggregation_key: str = "", priority: str = "",
                         source_type: str = "", alert_type: str = "",
-                        hostname: str = "") -> bytes:
+                        hostname: str = "", timestamp: str = "") -> bytes:
     header = f"_e{{{len(title.encode())},{len(text.encode())}}}:{title}|{text}"
     sections = []
+    if timestamp:
+        sections.append(f"d:{timestamp}")
     if aggregation_key:
         sections.append(f"k:{aggregation_key}")
     if priority:
@@ -45,8 +47,11 @@ def render_event_packet(title: str, text: str, tags: List[str],
 
 def render_service_check_packet(name: str, status: int, tags: List[str],
                                 message: str = "",
-                                hostname: str = "") -> bytes:
+                                hostname: str = "",
+                                timestamp: str = "") -> bytes:
     parts = [f"_sc|{name}|{status}"]
+    if timestamp:
+        parts.append(f"d:{timestamp}")
     if hostname:
         parts.append(f"h:{hostname}")
     if tags:
