@@ -278,3 +278,33 @@ class TestExampleConfigs:
             open(os.path.join(root, "examples", "example_proxy.yaml")))
         assert raw["grpc_address"]
         assert "forward_address" in raw
+
+
+class TestEmitSpanDuration:
+    def test_start_without_end_uses_duration(self):
+        import veneur_tpu.cmd.veneur_emit as emit
+        from veneur_tpu.ssf.protos import ssf_pb2
+
+        sent = []
+        sock_cls = emit.socket.socket
+
+        class FakeSock:
+            def __init__(self, *a, **k):
+                pass
+
+            def sendto(self, data, addr):
+                sent.append(data)
+
+            def close(self):
+                pass
+
+        emit.socket.socket = FakeSock
+        try:
+            assert emit.main(["-mode", "span", "-name", "d.sp",
+                              "-span_starttime", "1700000000",
+                              "-span_duration", "5"]) == 0
+        finally:
+            emit.socket.socket = sock_cls
+        span = ssf_pb2.SSFSpan.FromString(sent[0])
+        assert span.start_timestamp == 1700000000 * 10**9
+        assert span.end_timestamp - span.start_timestamp == 5 * 10**9

@@ -4,6 +4,8 @@ round-trips used by the cortex sink."""
 import json
 import threading
 
+import pytest
+
 import yaml
 
 import veneur_tpu
@@ -378,5 +380,81 @@ class TestGoroutinePprof:
             status, body = vhttp.get(
                 api_url(api, "/debug/pprof/goroutine"), timeout=30)
             assert status == 200 and gzip.decompress(body)
+        finally:
+            api.stop()
+
+
+class TestReferencePprofRoutes:
+    """Every pprof route the reference mounts (http.go:53-63) responds
+    with the right shape."""
+
+    def setup_method(self):
+        from veneur_tpu.core import profiling
+        profiling._heap_last_armed[0] = 0.0
+
+    def teardown_method(self):
+        import tracemalloc
+        if tracemalloc.is_tracing():
+            tracemalloc.stop()
+
+    def test_all_reference_routes_respond(self):
+        import gzip
+        api = HTTPApi(generate_config(), server=None, address="127.0.0.1:0")
+        api.start()
+        try:
+            for route in ("/debug/pprof/allocs", "/debug/pprof/block",
+                          "/debug/pprof/mutex",
+                          "/debug/pprof/threadcreate"):
+                status, body = vhttp.get(api_url(api, route), timeout=30)
+                assert status == 200, route
+                assert gzip.decompress(body), route  # valid pprof gzip
+            status, body = vhttp.get(api_url(api, "/debug/pprof/cmdline"))
+            assert status == 200 and (b"\x00" in body or b"python" in body)
+            status, body = vhttp.get(api_url(api, "/debug/pprof/symbol"))
+            assert status == 200 and body.startswith(b"num_symbols:")
+            with pytest.raises(vhttp.HTTPError) as ei:
+                vhttp.get(api_url(api, "/debug/pprof/trace"))
+            assert ei.value.status == 501
+        finally:
+            api.stop()
+
+    def test_threadcreate_carries_thread_count(self):
+        import gzip
+        import threading
+
+        from veneur_tpu.core import profiling
+        raw = gzip.decompress(profiling.threadcreate_pprof())
+        fields = list(TestPprofEndpoint._decode(raw))
+        strings = [v.decode() for tag, _, v in fields if tag == 6]
+        assert "threadcreate" in strings
+        samples = [v for tag, _, v in fields if tag == 2]
+        assert samples
+
+    def test_empty_profile_is_valid(self):
+        import gzip
+
+        from veneur_tpu.core import profiling
+        raw = gzip.decompress(profiling.empty_pprof("contentions"))
+        fields = list(TestPprofEndpoint._decode(raw))
+        strings = [v.decode() for tag, _, v in fields if tag == 6]
+        assert "contentions" in strings
+        assert not [v for tag, _, v in fields if tag == 2]  # no samples
+
+    def test_heap_allocs_back_to_back_scrape(self):
+        # a scraper walking the index fetches /heap then /allocs inside
+        # the arming-throttle window; the second serves the cached
+        # capture instead of 429ing (Go serves both freely)
+        import gzip
+
+        from veneur_tpu.core import profiling
+        api = HTTPApi(generate_config(), server=None, address="127.0.0.1:0")
+        api.start()
+        try:
+            s1, b1 = vhttp.get(api_url(api, "/debug/pprof/heap"),
+                               timeout=30)
+            s2, b2 = vhttp.get(api_url(api, "/debug/pprof/allocs"),
+                               timeout=30)
+            assert s1 == 200 and s2 == 200
+            assert gzip.decompress(b1) and gzip.decompress(b2)
         finally:
             api.stop()

@@ -78,7 +78,14 @@ def send_span(hostport: str, name: str, service: str, tags: List[str],
         span.parent_id = parent_id
     span.name = name
     span.service = service
-    span.end_timestamp = _parse_when(end) if end else now_ns
+    if end:
+        span.end_timestamp = _parse_when(end)
+    elif start:
+        # start without end: the span covers the requested duration
+        # from that start, not start..now
+        span.end_timestamp = _parse_when(start) + int(duration_s * 1e9)
+    else:
+        span.end_timestamp = now_ns
     span.start_timestamp = (_parse_when(start) if start
                             else span.end_timestamp - int(duration_s * 1e9))
     span.error = error

@@ -13,6 +13,7 @@ import dataclasses
 import json
 import os
 import socket
+import sys
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional
@@ -111,17 +112,21 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(503, str(e).encode())
                 return
             self._send(200, body, "application/octet-stream")
-        elif path == "/debug/pprof/heap":
+        elif path in ("/debug/pprof/heap", "/debug/pprof/allocs"):
             # pprof heap profile backed by tracemalloc: request-scoped by
             # default; enable_profiling keeps tracing armed so later
-            # requests see allocations since
+            # requests see allocations since. Go serves the same profile
+            # at both routes (only the default sample type differs);
+            # inside the arming-throttle window the previous capture is
+            # served so scraping the pair back-to-back works
             from veneur_tpu.core import profiling
             keep = bool(getattr(api.config, "enable_profiling", False))
             try:
-                body = profiling.heap_pprof(keep_tracing=keep)
+                body, _fresh = profiling.heap_pprof_or_cached(
+                    keep_tracing=keep)
             except profiling.HeapProfileThrottled as e:
-                # request-scoped armings are rate-limited so hammering
-                # the endpoint can't keep tracemalloc always-on
+                # rate-limited with nothing cached yet: hammering the
+                # endpoint can't keep tracemalloc always-on
                 self._send(429, str(e).encode())
                 return
             self._send(200, body, "application/octet-stream")
@@ -131,12 +136,44 @@ class _Handler(BaseHTTPRequestHandler):
             from veneur_tpu.core import profiling
             self._send(200, profiling.threads_pprof(),
                        "application/octet-stream")
+        elif path in ("/debug/pprof/block", "/debug/pprof/mutex"):
+            # no CPython contention profiler: a valid empty profile keeps
+            # pprof scrapers working (reference mounts all pprof routes)
+            from veneur_tpu.core import profiling
+            kind = "contentions" if path.endswith("block") else "mutex"
+            self._send(200, profiling.empty_pprof(kind),
+                       "application/octet-stream")
+        elif path == "/debug/pprof/threadcreate":
+            from veneur_tpu.core import profiling
+            self._send(200, profiling.threadcreate_pprof(),
+                       "application/octet-stream")
+        elif path == "/debug/pprof/cmdline":
+            # NUL-separated argv, the Go pprof cmdline contract;
+            # surrogateescape survives non-UTF-8 argv bytes (POSIX argv
+            # is bytes; CPython decodes it with surrogateescape)
+            self._send(200, b"\x00".join(
+                a.encode("utf-8", "surrogateescape")
+                for a in sys.argv), "text/plain")
+        elif path == "/debug/pprof/symbol":
+            # 0: our profiles carry pre-symbolized frames, no address
+            # lookup is ever needed (the Go handler advertises its
+            # symbolizer count the same way)
+            self._send(200, b"num_symbols: 0\n", "text/plain")
+        elif path == "/debug/pprof/trace":
+            self._send(501, b"execution trace is a Go-runtime feature "
+                            b"with no CPython analog; use "
+                            b"/debug/pprof/profile or "
+                            b"/debug/profile/device\n")
         elif path == "/debug/pprof/" or path == "/debug/pprof":
             self._send(200, (
                 b"veneur-tpu profiles:\n"
                 b"  /debug/pprof/profile?seconds=N  pprof CPU profile\n"
                 b"  /debug/pprof/heap               pprof heap profile\n"
                 b"  /debug/pprof/goroutine          thread stacks (pprof)\n"
+                b"  /debug/pprof/allocs             alias of heap\n"
+                b"  /debug/pprof/block|mutex        empty (no analog)\n"
+                b"  /debug/pprof/threadcreate       live-thread count\n"
+                b"  /debug/pprof/cmdline|symbol     pprof text protocols\n"
                 b"  /debug/profile/cpu?seconds=N    text CPU profile\n"
                 b"  /debug/profile/device?seconds=N xprof device trace\n"
                 b"  /debug/memory                   device memory JSON\n"
@@ -160,7 +197,6 @@ class _Handler(BaseHTTPRequestHandler):
             self.wfile.write(body)
         elif path == "/debug/threads":
             # faulthandler needs a real fd; format stacks directly instead
-            import sys
             import traceback
             names = {t.ident: t.name for t in threading.enumerate()}
             parts = []

@@ -304,6 +304,27 @@ def threads_pprof() -> bytes:
                         ("threads", "count"), 1, time.time())
 
 
+def empty_pprof(kind: str, unit: str = "count") -> bytes:
+    """A valid zero-sample pprof profile. Served at /debug/pprof/block
+    and /mutex: the Go runtime's contention profilers have no CPython
+    analog (no runtime hook records lock-wait stacks), and an empty
+    profile keeps `go tool pprof`-style consumers working instead of
+    breaking scrapers with a 404 (reference http.go mounts every pprof
+    route unconditionally)."""
+    return encode_pprof({}, [(kind, unit)], (kind, unit), 1, time.time())
+
+
+def threadcreate_pprof() -> bytes:
+    """/debug/pprof/threadcreate analog: CPython doesn't record which
+    stack created each thread, so this reports one synthetic sample
+    carrying the live-thread count (the headline number Go's profile is
+    scraped for)."""
+    site = (("<unavailable>", "threading.create (sites not recorded)", 0),)
+    return encode_pprof({site: [threading.active_count()]},
+                        [("threadcreate", "count")],
+                        ("threadcreate", "count"), 1, time.time())
+
+
 _heap_traced_since = [0.0]
 _heap_last_armed = [0.0]
 _heap_lock = threading.Lock()
@@ -370,9 +391,29 @@ def heap_pprof(limit: int = 10_000, keep_tracing: bool = False) -> bytes:
         else:
             prev[0] += st.count
             prev[1] += st.size
-    return encode_pprof(stacks, [("objects", "count"), ("space", "bytes")],
+    body = encode_pprof(stacks, [("objects", "count"), ("space", "bytes")],
                         ("space", "bytes"), 1,
                         _heap_traced_since[0] or time.time())
+    _heap_last_profile[0] = body
+    return body
+
+
+_heap_last_profile = [b""]
+
+
+def heap_pprof_or_cached(keep_tracing: bool = False) -> Tuple[bytes, bool]:
+    """(profile, fresh) for the /heap and /allocs routes. Go serves both
+    freely; here a back-to-back scrape of the pair would trip the
+    arming throttle on the second request, so inside the throttle
+    window the previous capture is served instead (its embedded
+    time_nanos dates it). Raises HeapProfileThrottled only when there
+    is no capture to fall back on."""
+    try:
+        return heap_pprof(keep_tracing=keep_tracing), True
+    except HeapProfileThrottled:
+        if _heap_last_profile[0]:
+            return _heap_last_profile[0], False
+        raise
 
 
 _cpu_profile_lock = threading.Lock()
