@@ -1,0 +1,34 @@
+#!/bin/bash
+# Tunnel watcher: probe the axon TPU tunnel until it answers, then
+# immediately capture the bench stages a wedge truncated out of the
+# manual artifact (device kernels + the BASELINE config suite), one
+# scenario per process so a mid-capture wedge only loses that stage.
+# Usage: tunnel_capture.sh [outdir]
+set -u
+OUT=${1:-/tmp/tpu_capture}
+mkdir -p "$OUT"
+cd "$(dirname "$0")/.."
+
+log() { echo "$(date -u +%FT%TZ) $*" >> "$OUT/watch.log"; }
+
+while true; do
+  if timeout 30 env JAX_PLATFORMS=axon python -c \
+      "import jax; d=jax.devices(); assert d and d[0].platform != 'cpu'" \
+      >/dev/null 2>&1; then
+    log "tunnel alive"
+    break
+  fi
+  log "wedged; retry in 60s"
+  sleep 60
+done
+
+for sc in device forward ssf hll timers counter; do
+  log "capturing $sc"
+  JAX_PLATFORMS=axon BENCH_DEADLINE_S=240 BENCH_DEVICE_SWEEP=1 \
+    timeout 260 python bench.py --scenario $sc --duration 4 \
+    > "$OUT/$sc.json" 2> "$OUT/$sc.err"
+  log "$sc rc=$? $(head -c 200 "$OUT/$sc.json")"
+  # a wedge mid-suite: stop burning 240s timeouts on a dead tunnel
+  grep -q '"platform": "tpu"' "$OUT/$sc.json" || { log "lost tunnel; stop"; break; }
+done
+log "capture pass done"

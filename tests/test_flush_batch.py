@@ -1,0 +1,202 @@
+"""Columnar-flush parity: flush_columnstore_batch must emit exactly the
+metrics (and forwardable state) the per-row flush_columnstore oracle
+does, across every scope/type/server-mode combination. The legacy
+per-row path stays as the readable spec (value-selection parity with
+reference samplers.go:359-514); the batch path is what the server runs
+(core/server.py flush), so these tests are the contract between them."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from veneur_tpu.core.columnstore import ColumnStore
+from veneur_tpu.core.flusher import (
+    FlushBatch, flush_columnstore, flush_columnstore_batch)
+from veneur_tpu.samplers.metrics import HistogramAggregates
+from veneur_tpu.samplers.parser import Parser
+
+PCTS = (0.5, 0.9, 0.99)
+AGGS = HistogramAggregates.from_names(
+    ["min", "max", "median", "avg", "count", "sum", "hmean"])
+
+
+def _mk_store():
+    return ColumnStore(counter_capacity=64, gauge_capacity=64,
+                       histo_capacity=64, set_capacity=32, batch_cap=128)
+
+
+def _feed(store, lines):
+    p = Parser()
+    for line in lines:
+        p.parse_metric_fast(line, store.process)
+    store.apply_all_pending()
+
+
+def _mixed_corpus():
+    lines = []
+    for i in range(6):
+        lines.append(b"c.%d:%d|c|#env:t,i:%d" % (i, i + 1, i))
+        lines.append(b"g.%d:%.2f|g|#env:t" % (i, i * 1.5))
+        lines.append(b"t.%d:%.2f|ms|#env:t" % (i, 10.0 + i))
+        lines.append(b"t.%d:%.2f|ms|#env:t" % (i, 20.0 + i))
+        lines.append(b"s.%d:user%d|s|#env:t" % (i, i))
+        lines.append(b"s.%d:user%d|s|#env:t" % (i, i + 100))
+    # explicit scope variants (veneurlocalonly / veneurglobalonly)
+    lines += [
+        b"lc:5|c|#veneurlocalonly",
+        b"gc:7|c|#veneurglobalonly",
+        b"lg:1.5|g|#veneurlocalonly",
+        b"gg:2.5|g|#veneurglobalonly",
+        b"lt:3.25|ms|#veneurlocalonly",
+        b"lt:4.25|ms|#veneurlocalonly",
+        b"gt:5.5|ms|#veneurglobalonly",
+        b"ls:a|s|#veneurlocalonly",
+        b"gs:b|s|#veneurglobalonly",
+        b"sc.ok:0|sc|#veneurlocalonly",
+    ]
+    return lines
+
+
+def _metric_key(m):
+    return (m.name, round(float(m.value), 6), tuple(sorted(m.tags)),
+            int(m.type), m.message, m.hostname)
+
+
+def _flush_pair(is_local, collect_forward, lines=None):
+    lines = lines if lines is not None else _mixed_corpus()
+    legacy_store, batch_store = _mk_store(), _mk_store()
+    _feed(legacy_store, lines)
+    _feed(batch_store, lines)
+    final, fwd_legacy = flush_columnstore(
+        legacy_store, is_local, PCTS, AGGS, collect_forward=collect_forward)
+    batch, fwd_batch = flush_columnstore_batch(
+        batch_store, is_local, PCTS, AGGS, collect_forward=collect_forward)
+    return final, fwd_legacy, batch, fwd_batch
+
+
+@pytest.mark.parametrize("is_local", [False, True])
+@pytest.mark.parametrize("collect_forward", [True, False])
+def test_batch_matches_legacy(is_local, collect_forward):
+    final, fwd_l, batch, fwd_b = _flush_pair(is_local, collect_forward)
+    assert isinstance(batch, FlushBatch)
+    assert len(batch) == len(final)
+    got = sorted(_metric_key(m) for m in batch.materialize())
+    want = sorted(_metric_key(m) for m in final)
+    assert got == want
+
+    # forwardable state parity
+    def names_vals(lst):
+        return sorted((meta.name, round(float(v), 6)) for meta, v in lst)
+    assert names_vals(fwd_b.counters) == names_vals(fwd_l.counters)
+    assert names_vals(fwd_b.gauges) == names_vals(fwd_l.gauges)
+    hb = {h[0].name: h[1:] for h in fwd_b.histograms}
+    hl = {h[0].name: h[1:] for h in fwd_l.histograms}
+    assert hb.keys() == hl.keys()
+    for k in hb:
+        for a, b in zip(hb[k], hl[k]):
+            np.testing.assert_allclose(a, b)
+    sb = {s[0].name: s[1] for s in fwd_b.sets}
+    sl = {s[0].name: s[1] for s in fwd_l.sets}
+    assert sb.keys() == sl.keys()
+    for k in sb:
+        np.testing.assert_array_equal(sb[k], sl[k])
+
+
+def test_batch_second_flush_uses_cached_names():
+    store = _mk_store()
+    lines = _mixed_corpus()
+    _feed(store, lines)
+    b1, _ = flush_columnstore_batch(store, False, PCTS, AGGS)
+    first = sorted(_metric_key(m) for m in b1.materialize())
+    _feed(store, lines)
+    b2, _ = flush_columnstore_batch(store, False, PCTS, AGGS)
+    second = sorted(_metric_key(m) for m in b2.materialize())
+    assert first == second  # identical corpus -> identical names/tags
+
+
+def test_name_cache_invalidated_on_row_recycle():
+    """A recycled+re-interned row must not leak the previous occupant's
+    cached flush name."""
+    store = _mk_store()
+    p = Parser()
+    p.parse_metric_fast(b"old.key:1|c", store.process)
+    store.apply_all_pending()
+    batch, _ = flush_columnstore_batch(store, False, PCTS, AGGS)
+    assert [m.name for m in batch.materialize()] == ["old.key"]
+    # idle long enough to tombstone, then recycle
+    for _ in range(3):
+        store.counters.reclaim_idle(1)
+        flush_columnstore_batch(store, False, PCTS, AGGS)
+    p.parse_metric_fast(b"new.key:2|c", store.process)
+    store.apply_all_pending()
+    # the new key reuses the freed row
+    batch2, _ = flush_columnstore_batch(store, False, PCTS, AGGS)
+    names = [m.name for m in batch2.materialize()]
+    assert names == ["new.key"]
+
+
+def test_empty_store_flushes_empty_batch():
+    store = _mk_store()
+    batch, fwd = flush_columnstore_batch(store, True, PCTS, AGGS)
+    assert len(batch) == 0
+    assert batch.materialize() == []
+    assert len(fwd) == 0
+
+
+def test_status_checks_flow_through_extras():
+    store = _mk_store()
+    _feed(store, [b"svc.ok:1|sc"])
+    batch, _ = flush_columnstore_batch(store, False, PCTS, AGGS)
+    mats = batch.materialize()
+    assert len(mats) == 1 and len(batch) == 1
+    assert mats[0].name == "svc.ok"
+
+
+def test_batch_flush_concurrent_with_intern_churn():
+    """Flush assembly runs lock-free against ingest by design; interning
+    (including recycled-row cache invalidation, which iterates the
+    flush-name cache dict) must not race the flusher's cache-dict
+    mutations (code-review finding: RuntimeError 'dictionary changed
+    size during iteration' in row_for)."""
+    import threading
+
+    store = ColumnStore(counter_capacity=256, gauge_capacity=256,
+                        histo_capacity=256, set_capacity=64, batch_cap=128)
+    stop = threading.Event()
+    errors = []
+
+    def churn():
+        p = Parser()
+        i = 0
+        try:
+            while not stop.is_set():
+                p.parse_metric_fast(
+                    b"churn.%d:1|c|#k:v" % (i % 700), store.process)
+                p.parse_metric_fast(
+                    b"churn.t.%d:%d|ms" % (i % 300, i % 50), store.process)
+                i += 1
+        except Exception as e:  # pragma: no cover - the regression signal
+            errors.append(e)
+
+    threads = [threading.Thread(target=churn, daemon=True)
+               for _ in range(3)]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(25):
+            flush_columnstore_batch(store, False, PCTS, AGGS)
+            for table in (store.counters, store.histos):
+                table.reclaim_idle(1)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(5)
+    assert not errors, errors
+
+
+def test_materialize_is_cached_and_shared():
+    store = _mk_store()
+    _feed(store, [b"a:1|c", b"b:2.5|g"])
+    batch, _ = flush_columnstore_batch(store, False, PCTS, AGGS)
+    assert batch.materialize() is batch.materialize()

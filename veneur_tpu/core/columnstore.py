@@ -119,6 +119,17 @@ class _BaseTable:
         self._dict_key_of: List[int] = []  # row -> rows-dict key
         self._free_rows: List[int] = []
         self.keys_dropped = 0
+        # vectorized-flush row caches (core/flusher.py batch assembly):
+        # per-row scope code for mask math, and per-row rendered flush
+        # names / tag-list refs so steady keysets format strings once per
+        # row lifetime, not once per flush. Entries are invalidated when
+        # a recycled row is re-interned (row_for) — safe against in-flush
+        # races because recycling a row emitted by flush N cannot happen
+        # before flush N+1 (reclaim's two-phase contract above), and
+        # flushes are serialized by the server's flush lock.
+        self.scope_code = np.full(capacity, -1, np.int8)
+        self._tags_cache = np.empty(capacity, object)
+        self._flush_name_cache: Dict[object, np.ndarray] = {}
         self._init_arrays()
 
     # subclasses define _init_arrays / _grow_arrays / _apply_cols / reset
@@ -176,6 +187,11 @@ class _BaseTable:
                 self._dict_key_of[row] = dict_key
                 self._last_touched[row] = self._generation
                 self._has_meta[row] = True
+                # recycled row: drop the previous occupant's cached
+                # flush names/tags before the new key's first flush
+                self._tags_cache[row] = None
+                for arr in self._flush_name_cache.values():
+                    arr[row] = None
             elif self.max_rows and len(self.rows) >= self.max_rows:
                 # hard cardinality cap: protects host memory during a
                 # within-interval key flood; the sample is dropped and
@@ -193,6 +209,7 @@ class _BaseTable:
                 # (but not yet touched) late in life would read as idle
                 # since generation 0 and tombstone on its first flush
                 self._last_touched[row] = self._generation
+            self.scope_code[row] = int(metric.scope)
             self.rows[dict_key] = row
         return row
 
@@ -250,6 +267,51 @@ class _BaseTable:
                 tomb[row] = gen
             return evicted
 
+    def flush_names(self, key, rows: np.ndarray, meta_list,
+                    render) -> np.ndarray:
+        """Rendered flush-name object array for `rows` (row ids), cached
+        for the row's lifetime under `key` (a suffix string or percentile).
+        Misses render via `render(meta)` against the caller's SNAPSHOT
+        meta list, so a concurrent re-intern can never leak another key's
+        name into this flush.
+
+        Cache-dict mutation (new key, grow-replacement) happens under the
+        buffer lock: row_for iterates .values() to invalidate recycled
+        rows and _grow re-lays-out every entry, both under that lock.
+        Element fills stay lock-free — a fill can only target a row that
+        is live in this snapshot, which the two-phase reclaim contract
+        keeps un-recyclable until the next flush, so the worst concurrent
+        outcome is a fill landing in an orphaned (pre-grow) array: a lost
+        cache entry, re-rendered next flush."""
+        with self.lock:
+            arr = self._flush_name_cache.get(key)
+            if arr is None:
+                arr = self._flush_name_cache[key] = np.empty(
+                    max(self.capacity, len(self.meta)), object)
+            elif arr.shape[0] < len(self.meta):
+                grown = np.empty(self.capacity, object)
+                grown[: arr.shape[0]] = arr
+                arr = self._flush_name_cache[key] = grown
+        sel = arr[rows]
+        miss = np.flatnonzero(np.equal(sel, None))
+        for j in miss.tolist():
+            row = int(rows[j])
+            sel[j] = arr[row] = render(meta_list[row])
+        return sel
+
+    def flush_tags(self, rows: np.ndarray, meta_list) -> np.ndarray:
+        """Per-row tag-list refs for `rows`, cached like flush_names.
+        Consumers must copy before mutating (InterMetric materialization
+        does)."""
+        with self.lock:  # a concurrent _grow replaces the array
+            arr = self._tags_cache
+        sel = arr[rows]
+        miss = np.flatnonzero(np.equal(sel, None))
+        for j in miss.tolist():
+            row = int(rows[j])
+            sel[j] = arr[row] = meta_list[row].tags
+        return sel
+
     def _grow(self):
         new_cap = self.capacity * 2
         pad = new_cap - self.capacity
@@ -261,6 +323,13 @@ class _BaseTable:
             [self._tombstone_gen, np.full(pad, -1, np.int64)])
         self._has_meta = np.concatenate(
             [self._has_meta, np.zeros(pad, bool)])
+        self.scope_code = np.concatenate(
+            [self.scope_code, np.full(pad, -1, np.int8)])
+        self._tags_cache = np.concatenate(
+            [self._tags_cache, np.empty(pad, object)])
+        for key, arr in self._flush_name_cache.items():
+            self._flush_name_cache[key] = np.concatenate(
+                [arr, np.empty(pad, object)])
         # _grow_arrays re-lays-out the device state, so it needs the state
         # lock; caller already holds the buffer lock (correct lock order)
         with self.apply_lock:
@@ -370,7 +439,12 @@ class CounterTable(_BaseTable):
                 self._import_acc = grown
             np.add.at(self._import_acc, rows, np.asarray(vals, np.float64))
 
-    def snapshot_and_reset(self) -> Tuple[np.ndarray, np.ndarray, List[RowMeta]]:
+    def snapshot_begin(self) -> dict:
+        """Dispatch-only half of snapshot_and_reset: swap + apply pending,
+        capture the pre-reset device arrays, reset state — but do NOT
+        transfer. The flusher begins every table first, then pays the
+        device sync once for all of them (over a remote device link the
+        per-table sync was a serialized round-trip each)."""
         with self.lock:
             cols = self._swap_locked()
             self.apply_lock.acquire()
@@ -380,19 +454,30 @@ class CounterTable(_BaseTable):
             import_acc = self._import_acc
             self._import_acc = np.zeros(self.capacity, np.float64)
             self.touched[:] = False
-        # readout + reset happen outside the buffer lock: samples arriving
+        # apply + reset happen outside the buffer lock: samples arriving
         # during the flush land in the fresh buffers / next-interval state
         try:
             if cols is not None:
                 self._apply_cols(cols)
-            # f64 readout recovers the exact total from the Kahan pair
-            values = (np.asarray(self.state["sum"], np.float64)
-                      - np.asarray(self.state["comp"], np.float64))
-            values[: import_acc.shape[0]] += import_acc
+            dev = (self.state["sum"], self.state["comp"])
             self.state = scalars.init_counters(self.capacity)
         finally:
             self.apply_lock.release()
-        return values, touched, meta
+        return {"dev": dev, "import_acc": import_acc,
+                "touched": touched, "meta": meta}
+
+    @staticmethod
+    def snapshot_finish(snap: dict
+                        ) -> Tuple[np.ndarray, np.ndarray, List[RowMeta]]:
+        # f64 readout recovers the exact total from the Kahan pair
+        values = (np.asarray(snap["dev"][0], np.float64)
+                  - np.asarray(snap["dev"][1], np.float64))
+        import_acc = snap["import_acc"]
+        values[: import_acc.shape[0]] += import_acc
+        return values, snap["touched"], snap["meta"]
+
+    def snapshot_and_reset(self) -> Tuple[np.ndarray, np.ndarray, List[RowMeta]]:
+        return self.snapshot_finish(self.snapshot_begin())
 
 
 class GaugeTable(_BaseTable):
@@ -449,7 +534,8 @@ class GaugeTable(_BaseTable):
         finally:
             self.apply_lock.release()
 
-    def snapshot_and_reset(self):
+    def snapshot_begin(self) -> dict:
+        """Dispatch-only snapshot half; see CounterTable.snapshot_begin."""
         with self.lock:
             cols = self._swap_locked()
             self.apply_lock.acquire()
@@ -460,11 +546,18 @@ class GaugeTable(_BaseTable):
         try:
             if cols is not None:
                 self._apply_cols(cols)
-            values = np.asarray(self.state["value"])
+            dev = self.state["value"]
             self.state = scalars.init_gauges(self.capacity)
         finally:
             self.apply_lock.release()
-        return values, touched, meta
+        return {"dev": dev, "touched": touched, "meta": meta}
+
+    @staticmethod
+    def snapshot_finish(snap: dict):
+        return np.asarray(snap["dev"]), snap["touched"], snap["meta"]
+
+    def snapshot_and_reset(self):
+        return self.snapshot_finish(self.snapshot_begin())
 
 
 class HistoTable(_BaseTable):
@@ -625,6 +718,12 @@ class HistoTable(_BaseTable):
         pre-export compact is elided (flush_quantiles folds staging
         itself); the flush then transfers a single packed (K, P+10)
         array instead of ~50 MB of centroids at K=100k."""
+        return self.snapshot_finish(
+            self.snapshot_begin(percentiles, need_export))
+
+    def snapshot_begin(self, percentiles: Tuple[float, ...],
+                       need_export: bool = True) -> dict:
+        """Dispatch-only snapshot half; see CounterTable.snapshot_begin."""
         with self.lock:
             cols = self._swap_locked()
             self.apply_lock.acquire()
@@ -641,17 +740,23 @@ class HistoTable(_BaseTable):
                 # two device->host transfers (the packed flush and the
                 # packed export) instead of compact+flush+export
                 packed, export_packed = self._flush_export(ps)
-                export = batch_tdigest.unpack_export(export_packed)
             else:
                 packed = self._flush_packed(ps)
-                export = None
+                export_packed = None
             self._applies = 0
             self._staged_counts[:] = 0
-            out = batch_tdigest.unpack_flush(packed, len(ps))
             self.state = batch_tdigest.init_state(self.capacity)
         finally:
             self.apply_lock.release()
-        return out, export, touched, meta
+        return {"packed": packed, "export_packed": export_packed,
+                "ps": ps, "touched": touched, "meta": meta}
+
+    @staticmethod
+    def snapshot_finish(snap: dict):
+        out = batch_tdigest.unpack_flush(snap["packed"], len(snap["ps"]))
+        export = (batch_tdigest.unpack_export(snap["export_packed"])
+                  if snap["export_packed"] is not None else None)
+        return out, export, snap["touched"], snap["meta"]
 
 
 class _SetRegisters:

@@ -21,7 +21,8 @@ from veneur_tpu import sinks as sinks_mod
 from veneur_tpu.config import Config, SinkConfig
 from veneur_tpu.core import networking
 from veneur_tpu.core.columnstore import ColumnStore
-from veneur_tpu.core.flusher import ForwardableState, flush_columnstore
+from veneur_tpu.core.flusher import (
+    FlushBatch, ForwardableState, flush_columnstore_batch)
 from veneur_tpu.samplers import metrics as m
 from veneur_tpu.samplers.metrics import (
     HistogramAggregates, InterMetric, MetricScope, UDPMetric,
@@ -659,7 +660,7 @@ class Server:
             # selects between two distinct JIT specializations (fold_staging
             # is a static arg), and warming the wrong one would leave the
             # first real flush paying the full compile
-            flush_columnstore(
+            flush_columnstore_batch(
                 scratch, self.is_local, self.percentiles, self.aggregates,
                 collect_forward=self.forwarder is not None)
         except Exception:
@@ -721,26 +722,28 @@ class Server:
             _start_sink_thread(
                 f"span:{sink.name()}", self._flush_span_sink_safe, sink)
 
-        final, fwd = flush_columnstore(
+        batch, fwd = flush_columnstore_batch(
             self.store, self.is_local, self.percentiles, self.aggregates,
             collect_forward=self.forwarder is not None)
-        self.stats.inc("metrics_flushed", len(final))
+        self.stats.inc("metrics_flushed", len(batch))
 
         if self.is_local and self.forwarder is not None and len(fwd):
             _start_sink_thread("forward", self._forward_safe, fwd)
 
         if self._routing is not None:
-            for metric in final:
+            # routing annotates per-metric sink sets, so it needs objects;
+            # materialize once here and every sink thread shares the list
+            for metric in batch.materialize():
                 route = set()
                 for rule in self._routing:
                     route.update(rule.route(metric.name, metric.tags))
                 metric.sinks = route
 
-        if final:
+        if len(batch):
             for sink in self.metric_sinks:
                 _start_sink_thread(
                     f"metric:{sink.name()}", self._flush_sink_safe, sink,
-                    final)
+                    batch)
 
         # bounded wait: one interval from flush start, minus time already
         # spent; stragglers keep running on their daemon threads and are
@@ -768,7 +771,7 @@ class Server:
         flush_span.finish()
         duration = time.perf_counter() - flush_start
         self.statsd.gauge("flush.total_duration_ns", int(duration * 1e9))
-        self.statsd.count("flush.metrics_total", len(final))
+        self.statsd.count("flush.metrics_total", len(batch))
         # cumulative process counters emit as gauges (they never reset)
         self.statsd.gauge("worker.metrics_processed_total",
                           int(self.stats["packets_received"]))
@@ -846,12 +849,24 @@ class Server:
         except Exception:
             logger.exception("span sink %s flush failed", sink.name())
 
-    def _flush_sink_safe(self, sink, metrics: List[InterMetric]) -> None:
+    def _flush_sink_safe(self, sink, batch: FlushBatch) -> None:
         try:
             name = sink.name()
-            selected = [mm for mm in metrics
-                        if mm.sinks is None or name in mm.sinks]
             sc = self._sink_filters.get(name)
+            if sc is None and self._routing is None:
+                # columnar fast path: no per-sink filtering and no
+                # routing annotations to honor, so the sink sees the
+                # batch directly (the default flush_batch materializes;
+                # blackhole and friends never do). getattr: duck-typed
+                # sinks that only implement flush() still work.
+                fb = getattr(sink, "flush_batch", None)
+                if fb is not None:
+                    fb(batch)
+                else:
+                    sink.flush(batch.materialize())
+                return
+            selected = [mm for mm in batch.materialize()
+                        if mm.sinks is None or name in mm.sinks]
             if sc is not None:
                 selected = _apply_sink_filters(selected, sc)
             sink.flush(selected)

@@ -197,6 +197,11 @@ class ShardedHistoTable(HistoTable):
 
     def snapshot_and_reset(self, percentiles: Tuple[float, ...],
                            need_export: bool = True):
+        return self.snapshot_finish(
+            self.snapshot_begin(percentiles, need_export))
+
+    def snapshot_begin(self, percentiles: Tuple[float, ...],
+                       need_export: bool = True) -> dict:
         with self.lock:
             cols = self._swap_locked()
             self.apply_lock.acquire()
@@ -216,12 +221,10 @@ class ShardedHistoTable(HistoTable):
                 # Routed through the pallas-aware wrappers so
                 # tpu.pallas_tdigest_flush applies to sharded stores too.
                 packed, export_packed = self._flush_export(ps, merged)
-                export = batch_tdigest.unpack_export(export_packed)
             else:
                 packed = self._flush_packed(ps, merged,
                                             fold_staging=False)
-                export = None
-            out = batch_tdigest.unpack_flush(packed, len(ps))
+                export_packed = None
             self.states = [
                 jax.device_put(batch_tdigest.init_state(self.capacity), d)
                 for d in self._devices]
@@ -229,7 +232,8 @@ class ShardedHistoTable(HistoTable):
                                   for _ in self._devices]
         finally:
             self.apply_lock.release()
-        return out, export, touched, meta
+        return {"packed": packed, "export_packed": export_packed,
+                "ps": ps, "touched": touched, "meta": meta}
 
 
 class ShardedSetTable(SetTable):

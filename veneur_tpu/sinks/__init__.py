@@ -32,6 +32,14 @@ class MetricSink(abc.ABC):
     @abc.abstractmethod
     def flush(self, metrics: List[InterMetric]) -> None: ...
 
+    def flush_batch(self, batch) -> None:
+        """Receive a columnar FlushBatch (core/flusher.py). The default
+        materializes the legacy InterMetric list (built once, shared
+        across sink threads) and calls flush(); sinks that can consume
+        columns directly (or discard them — blackhole) override this to
+        skip object materialization entirely."""
+        self.flush(batch.materialize())
+
     def flush_other_samples(self, samples: Sequence[Any]) -> None:  # noqa: B027
         """Receive events/service-check samples that aren't InterMetrics."""
 

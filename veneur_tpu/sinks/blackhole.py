@@ -19,6 +19,10 @@ class BlackholeMetricSink(MetricSink):
     def flush(self, metrics) -> None:
         pass
 
+    def flush_batch(self, batch) -> None:
+        # columnar fast path: never materialize per-metric objects
+        pass
+
 
 class BlackholeSpanSink(SpanSink):
     def __init__(self, name: str = "blackhole"):
