@@ -155,9 +155,12 @@ class TestSparseSetTable:
     registers, hot keys promote mid-interval, and both tiers produce
     identical estimates and register rows."""
 
-    def _mk(self, capacity=512, batch_cap=64):
+    def _mk(self, capacity=512, batch_cap=64, promote_samples=0,
+            max_dev_slots=0):
         from veneur_tpu.core.columnstore import SetTable
-        return SetTable(capacity, batch_cap, sparse=True)
+        return SetTable(capacity, batch_cap, sparse=True,
+                        promote_samples=promote_samples,
+                        max_dev_slots=max_dev_slots)
 
     def _stub(self, name):
         from veneur_tpu.samplers.parser import Parser
@@ -166,9 +169,11 @@ class TestSparseSetTable:
         return out[0]
 
     def test_small_sets_stay_off_device(self):
+        # explicit high threshold: the point here is the sparse tier's
+        # estimate/register parity, independent of the promote policy
         import numpy as np
         from veneur_tpu.ops import hll_ref
-        table = self._mk()
+        table = self._mk(promote_samples=2048)
         members = [b"m%d" % i for i in range(500)]
         rows, idxs, rhos = [], [], []
         stub = self._stub(b"sp.small")
@@ -213,6 +218,75 @@ class TestSparseSetTable:
         # pre-promotion backlog folded in: registers exactly match oracle
         np.testing.assert_array_equal(regs[row], oracle.regs)
         assert float(est[row]) == oracle.estimate()
+
+    def test_dev_slot_cap_keeps_overflow_keys_sparse(self):
+        """Past MAX_DEV_SLOTS (the HBM guard) hot keys stay on the host
+        tier and still estimate correctly."""
+        import numpy as np
+        from veneur_tpu.ops import hll_ref
+        table = self._mk(batch_cap=256, promote_samples=4, max_dev_slots=2)
+        rows_of = {}
+        for name in (b"cap.a", b"cap.b", b"cap.c", b"cap.d"):
+            stub = self._stub(name)
+            with table.lock:
+                rows_of[name] = table.row_for(stub)
+        oracle = {n: hll_ref.HLL() for n in rows_of}
+        cols = ([], [], [])
+        for n, row in rows_of.items():
+            for i in range(200):
+                m = b"%s-%d" % (n, i)
+                oracle[n].insert(m)
+                ix, rh = hll_ref.pos_val(hll_ref.hash_member(m))
+                cols[0].append(row); cols[1].append(ix); cols[2].append(rh)
+        table.add_batch(np.array(cols[0], np.int32),
+                        np.array(cols[1], np.int32),
+                        np.array(cols[2], np.int32))
+        table.apply_pending()
+        assert table._nslots == 2  # capped, not 4
+        est, regs, _t, _m = table.snapshot_and_reset()
+        for n, row in rows_of.items():
+            assert float(est[row]) == oracle[n].estimate(), n
+            np.testing.assert_array_equal(regs[row], oracle[n].regs)
+
+    def test_import_merge_at_slot_cap_folds_to_host_tier(self):
+        """merge_batch past MAX_DEV_SLOTS must fold imported registers
+        into the sparse tier, not scatter to slot -1 (which aliases the
+        last device row and corrupts another key)."""
+        import numpy as np
+        from veneur_tpu.ops import hll_ref
+        table = self._mk(batch_cap=256, promote_samples=4, max_dev_slots=1)
+        # occupy the single device slot with a promoted key
+        hot_stub = self._stub(b"imp.hot")
+        with table.lock:
+            hot_row = table.row_for(hot_stub)
+        hot_oracle = hll_ref.HLL()
+        cols = ([], [], [])
+        for i in range(50):
+            m = b"hot-%d" % i
+            hot_oracle.insert(m)
+            ix, rh = hll_ref.pos_val(hll_ref.hash_member(m))
+            cols[0].append(hot_row); cols[1].append(ix); cols[2].append(rh)
+        table.add_batch(np.array(cols[0], np.int32),
+                        np.array(cols[1], np.int32),
+                        np.array(cols[2], np.int32))
+        table.apply_pending()
+        assert table._slot_of[hot_row] >= 0 and table._nslots == 1
+        # import a dense sketch for a DIFFERENT key: promotion is capped
+        imp_oracle = hll_ref.HLL()
+        for i in range(300):
+            imp_oracle.insert(b"imp-%d" % i)
+        imp_stub = self._stub(b"imp.capped")
+        table.merge_batch([imp_stub], imp_oracle.regs[None, :])
+        with table.lock:
+            imp_row = table.row_for(imp_stub)
+        assert table._slot_of[imp_row] < 0  # stayed on the host tier
+        est, regs, _t, _m = table.snapshot_and_reset()
+        # the imported key estimates correctly from the host tier...
+        assert float(est[imp_row]) == imp_oracle.estimate()
+        np.testing.assert_array_equal(regs[imp_row], imp_oracle.regs)
+        # ...and the promoted key was not corrupted by a -1 scatter
+        assert float(est[hot_row]) == hot_oracle.estimate()
+        np.testing.assert_array_equal(regs[hot_row], hot_oracle.regs)
 
     def test_interval_reset_demotes(self):
         import numpy as np

@@ -100,6 +100,19 @@ class TpuConfig:
     # hard per-family cardinality cap: new keys beyond it are dropped
     # (and counted) until eviction frees rows. 0 = unlimited.
     max_rows_per_family: int = 2_000_000
+    # set-family tier crossover: a set key's samples accumulate as
+    # host-side sparse COO until the key sees this many samples within
+    # one interval, then the key promotes to a dense device row and its
+    # stream rides the scatter-max kernel. 0 = auto: 16 on a real
+    # accelerator (at sustained rates the host tier's per-flush sort is
+    # the cost, and a promoted row is 16 KB of HBM — cheap until
+    # cardinality is huge, see set_max_dev_slots), 2048 on the CPU
+    # backend where the "device" is the same host core and promoting
+    # buys nothing.
+    set_promote_samples: int = 0
+    # hard cap on promoted device rows (HBM guard: slots are 16 KB
+    # each; 65536 = 1 GB). Keys past the cap stay on the host tier.
+    set_max_dev_slots: int = 65536
     # run the t-digest flush's post-sort interpolation through the
     # fused Pallas kernel (ops/pallas_tdigest). OFF by default until
     # real-TPU validation lands; any kernel failure falls back to the

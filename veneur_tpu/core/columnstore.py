@@ -768,7 +768,13 @@ class _SetRegisters:
 
     def __init__(self, dev_regs, slot_of, sparse_rows, sparse_idx,
                  sparse_rho):
-        self._dev = dev_regs  # (nslots, M) int8 or None
+        # (nslots, M) int8 — a DEVICE array, or None. Transferred to
+        # host lazily on the first promoted-row access: a global server
+        # never reads registers, and eagerly pulling the dense bank was
+        # up to 16 KB x nslots per flush across the device link for
+        # nothing.
+        self._dev = dev_regs
+        self._dev_np = None
         self._slot_of = slot_of
         # sparse COO sorted by row; boundaries found by searchsorted
         self._rows = sparse_rows
@@ -778,7 +784,9 @@ class _SetRegisters:
     def __getitem__(self, row: int) -> np.ndarray:
         slot = int(self._slot_of[row]) if row < self._slot_of.shape[0] else -1
         if slot >= 0 and self._dev is not None:
-            return self._dev[slot]
+            if self._dev_np is None:
+                self._dev_np = np.asarray(self._dev)
+            return self._dev_np[slot]
         regs = np.zeros(batch_hll.M, np.int8)
         lo = np.searchsorted(self._rows, row, side="left")
         hi = np.searchsorted(self._rows, row, side="right")
@@ -804,12 +812,37 @@ class SetTable(_BaseTable):
     `sparse=False` (the sharded table) keeps the original all-dense
     device path: every row maps 1:1 to a device slot."""
 
-    PROMOTE_SAMPLES = 2048
+    MAX_DEV_SLOTS = 65536  # HBM guard: 16 KB/slot -> 1 GB at the cap
 
     def __init__(self, capacity: int = 256, batch_cap: int = 8192,
-                 sparse: bool = True, max_rows: int = 0):
+                 sparse: bool = True, max_rows: int = 0,
+                 promote_samples: int = 0, max_dev_slots: int = 0):
         self._sparse = sparse
+        # 0 = auto, resolved lazily at the first promotion decision (the
+        # backend probe must not run in the constructor: scratch stores
+        # and tools build tables before — or without — a healthy device)
+        self._promote_samples = promote_samples
+        if max_dev_slots > 0:
+            self.MAX_DEV_SLOTS = max_dev_slots
         super().__init__(capacity, batch_cap, max_rows=max_rows)
+
+    @property
+    def PROMOTE_SAMPLES(self) -> int:
+        """Tier-crossover threshold. Auto policy: on a real accelerator
+        the dense scatter tier is the fast path, so promote early and
+        let the host tier carry only the cold tail (the per-flush sparse
+        sort is the sustained-gate cost). On the CPU backend the
+        "device" is this same host core — promoting buys nothing and the
+        dense estimate scan is slow, so stay sparse-biased."""
+        t = self._promote_samples
+        if t <= 0:
+            import jax
+            try:
+                backend = jax.default_backend()
+            except Exception:  # backend probe failed; sparse is safe
+                backend = "cpu"
+            t = self._promote_samples = 2048 if backend == "cpu" else 16
+        return t
 
     def _init_pending(self):
         self._prow = np.full(self.batch_cap, PAD_ROW, np.int32)
@@ -845,10 +878,14 @@ class SetTable(_BaseTable):
             self.state = _pad_cap(self.state, new_cap)
 
     def _promote_locked(self, row: int) -> None:
-        """Assign a device slot (caller holds the buffer lock)."""
+        """Assign a device slot (caller holds the buffer lock). A no-op
+        at MAX_DEV_SLOTS — the key stays on the host tier (callers
+        re-read _slot_of and route accordingly)."""
+        if self._nslots >= self.MAX_DEV_SLOTS:
+            return
         if self._nslots >= self._dev_cap:
             with self.apply_lock:
-                self._dev_cap *= 2
+                self._dev_cap = min(self._dev_cap * 2, self.MAX_DEV_SLOTS)
                 self.state = _pad_cap(self.state, self._dev_cap)
         self._slot_of[row] = self._nslots
         self._slot_row.append(row)
@@ -943,40 +980,67 @@ class SetTable(_BaseTable):
 
     def merge_batch(self, stubs: List[UDPMetric], in_regs) -> None:
         """Import-path HLL merge (register max); imported rows arrive
-        dense, so they promote immediately in sparse mode."""
+        dense, so they promote immediately in sparse mode. Rows the
+        MAX_DEV_SLOTS cap refuses to promote fold into the host COO
+        tier instead (nonzero registers -> (idx, rho) pairs) — scattering
+        a -1 slot would corrupt the last device row."""
         with self.lock:
             rows = np.fromiter(
                 (self.row_for(s) for s in stubs), np.int32, len(stubs))
             ok = rows >= 0  # cardinality-capped stubs drop out
             rows = rows[ok]
             self.touched[rows] = True
+            regs_sel = np.asarray(in_regs, np.int8)[ok]
             if self._sparse:
                 for r in rows:
                     if self._slot_of[r] < 0:
                         self._promote_locked(int(r))
                 target = self._slot_of[rows]
+                capped = target < 0
+                if capped.any():
+                    for j in np.flatnonzero(capped).tolist():
+                        rowregs = regs_sel[j]
+                        nz = np.flatnonzero(rowregs)
+                        if nz.size:
+                            self._coo.append((
+                                np.full(nz.size, int(rows[j]), np.int32),
+                                nz.astype(np.int32),
+                                rowregs[nz].astype(np.int32)))
+                    keep = ~capped
+                    target, regs_sel = target[keep], regs_sel[keep]
             else:
                 target = rows
             self.apply_lock.acquire()
         try:
-            self.state = batch_hll.merge_rows(
-                self.state, target, np.asarray(in_regs, np.int8)[ok])
+            if target.size:
+                self.state = batch_hll.merge_rows(
+                    self.state, target, regs_sel)
         finally:
             self.apply_lock.release()
 
     def _host_estimates(self, rows, idx, rho):
         """Vectorized LogLog-Beta over row-grouped COO pairs; returns
         (unique_rows, estimates). Dedupe keeps the max rho per (row,
-        register), matching the device scatter-max."""
-        order = np.lexsort((rho, idx, rows))
-        r, i, q = rows[order], idx[order], rho[order]
-        last = np.ones(r.shape[0], bool)
-        last[:-1] = (r[:-1] != r[1:]) | (i[:-1] != i[1:])
-        r, i, q = r[last], i[last], q[last]
-        urows, start = np.unique(r, return_index=True)
-        nnz = np.diff(np.r_[start, r.shape[0]])
-        pow_sum = np.add.reduceat(np.power(2.0, -q.astype(np.float64)),
-                                  start)
+        register), matching the device scatter-max.
+
+        Grouping sorts ONE fused 64-bit key ((row << 14) | register)
+        instead of a 3-key lexsort — measured ~3x faster at the
+        interval-scale COO volumes the sustained gate produces."""
+        if rows.shape[0] == 0:
+            return rows, np.zeros(0, np.float32)
+        key = (rows.astype(np.int64) << 14) | idx.astype(np.int64)
+        order = np.argsort(key, kind="stable")
+        k, q = key[order], rho[order]
+        # max rho per (row, register) via reduceat over group boundaries
+        starts = np.flatnonzero(np.r_[True, k[:-1] != k[1:]])
+        qmax = np.maximum.reduceat(q, starts)
+        kk = k[starts]
+        r = (kk >> 14).astype(rows.dtype)
+        rb = np.flatnonzero(np.r_[True, r[:-1] != r[1:]])
+        urows = r[rb]
+        nnz = np.diff(np.r_[rb, r.shape[0]])
+        pow_sum = np.add.reduceat(
+            np.power(2.0, -qmax.astype(np.float64)), rb)
         ez = float(batch_hll.M) - nnz
         s = ez + pow_sum  # zero registers contribute 2^0 each
         # vectorized LogLog-Beta polynomial (hll_ref.beta14 per element)
@@ -1016,7 +1080,10 @@ class SetTable(_BaseTable):
                 self._apply_cols(cols)
             if not self._sparse:
                 estimates = np.asarray(batch_hll.estimate(self.state))
-                registers = np.asarray(self.state)
+                empty = np.zeros(0, np.int32)
+                registers = _SetRegisters(
+                    self.state, np.arange(self.capacity, dtype=np.int32),
+                    empty, empty, empty)
                 self.state = batch_hll.init_state(self._dev_cap)
                 return estimates, registers, touched, meta
 
@@ -1048,7 +1115,7 @@ class SetTable(_BaseTable):
             dev_regs = None
             if nslots:
                 dev_est = np.asarray(batch_hll.estimate(self.state))
-                dev_regs = np.asarray(self.state)
+                dev_regs = self.state  # device ref; _SetRegisters is lazy
                 estimates[np.asarray(slot_row, np.int64)] = dev_est[:nslots]
             s_rows = rows_all[~hot]
             s_idx, s_rho = idx_all[~hot], rho_all[~hot]
@@ -1118,7 +1185,8 @@ class ColumnStore:
 
     def __init__(self, counter_capacity=1024, gauge_capacity=1024,
                  histo_capacity=1024, set_capacity=256, batch_cap=8192,
-                 shard_devices=0, max_rows=0, pallas_flush=False):
+                 shard_devices=0, max_rows=0, pallas_flush=False,
+                 set_promote_samples=0, set_max_dev_slots=0):
         self.counters = CounterTable(counter_capacity, batch_cap,
                                      max_rows=max_rows)
         self.gauges = GaugeTable(gauge_capacity, batch_cap,
@@ -1140,7 +1208,9 @@ class ColumnStore:
             self.histos = HistoTable(histo_capacity, batch_cap,
                                      max_rows=max_rows)
             self.sets = SetTable(set_capacity, batch_cap,
-                                 max_rows=max_rows)
+                                 max_rows=max_rows,
+                                 promote_samples=set_promote_samples,
+                                 max_dev_slots=set_max_dev_slots)
         self.histos.pallas_flush = bool(pallas_flush)
         if pallas_flush and histo_capacity % 128:
             # pallas_tdigest.BK tiling: a non-multiple capacity silently

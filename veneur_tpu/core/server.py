@@ -152,7 +152,9 @@ class Server:
             batch_cap=config.tpu.batch_cap,
             shard_devices=config.tpu.shards,
             max_rows=config.tpu.max_rows_per_family,
-            pallas_flush=config.tpu.pallas_tdigest_flush)
+            pallas_flush=config.tpu.pallas_tdigest_flush,
+            set_promote_samples=config.tpu.set_promote_samples,
+            set_max_dev_slots=config.tpu.set_max_dev_slots)
         self._keys_dropped_reported = 0
         self.aggregates = HistogramAggregates.from_names(config.aggregates)
         self.percentiles = tuple(config.percentiles)

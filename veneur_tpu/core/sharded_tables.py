@@ -31,7 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from veneur_tpu.core.columnstore import HistoTable, SetTable
+from veneur_tpu.core.columnstore import HistoTable, SetTable, _SetRegisters
 from veneur_tpu.ops import batch_hll, batch_tdigest
 
 logger = logging.getLogger("veneur_tpu.sharded")
@@ -304,7 +304,13 @@ class ShardedSetTable(SetTable):
                 self._apply_cols(cols)
             merged = self._merged_state()
             estimates = np.asarray(batch_hll.estimate(merged))
-            registers = np.asarray(merged)
+            # lazy per-row provider (columnstore._SetRegisters): the
+            # merged (K, M) bank only crosses the device link if a
+            # consumer (the forward exporter) actually reads registers
+            empty = np.zeros(0, np.int32)
+            registers = _SetRegisters(
+                merged, np.arange(self.capacity, dtype=np.int32),
+                empty, empty, empty)
             self.states = [
                 jax.device_put(batch_hll.init_state(self.capacity), d)
                 for d in self._devices]
