@@ -302,8 +302,29 @@ class UdpRig:
 
     def warmup(self, join_warmup_thread: bool = True):
         """Intern every key (slow path) + compile every kernel path."""
+        import numpy as np
+
         server = self.server
         server.handle_packet_batch(self.datagrams)
+        # promote-early set policy (tpu.set_promote_samples): the live
+        # window would otherwise climb the device slot ladder and pay
+        # each dev-cap shape's scatter/estimate compile mid-measurement.
+        # Promote every interned set row now; _dev_cap persists across
+        # the flush below, so steady-state intervals never compile.
+        sets = server.store.sets
+        import jax
+        if getattr(sets, "_sparse", False) and len(sets.meta) > 0 and \
+                jax.default_backend() not in ("cpu",):
+            with sets.lock:
+                for row in range(min(len(sets.meta), sets.MAX_DEV_SLOTS)):
+                    if sets._slot_of[row] < 0:
+                        sets._promote_locked(row)
+            if sets._nslots:
+                # one dense-tier sample so apply_batch compiles at the
+                # settled dev cap (row 0 is promoted by the loop above;
+                # the warmup interval's flush is discarded anyway)
+                sets.add_batch(np.zeros(1, np.int32), np.zeros(1, np.int32),
+                               np.ones(1, np.int32))
         server.store.apply_all_pending()
         server.flush()
         if join_warmup_thread and server._warmup_thread is not None:

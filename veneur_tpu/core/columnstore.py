@@ -781,6 +781,15 @@ class _SetRegisters:
         self._idx = sparse_idx
         self._rho = sparse_rho
 
+    @classmethod
+    def dense(cls, state, capacity: int) -> "_SetRegisters":
+        """All-dense provider: every row maps 1:1 to a device slot (the
+        sparse tier is empty). Used by the non-sparse and sharded set
+        tables."""
+        empty = np.zeros(0, np.int32)
+        return cls(state, np.arange(capacity, dtype=np.int32),
+                   empty, empty, empty)
+
     def __getitem__(self, row: int) -> np.ndarray:
         slot = int(self._slot_of[row]) if row < self._slot_of.shape[0] else -1
         if slot >= 0 and self._dev is not None:
@@ -885,7 +894,13 @@ class SetTable(_BaseTable):
             return
         if self._nslots >= self._dev_cap:
             with self.apply_lock:
-                self._dev_cap = min(self._dev_cap * 2, self.MAX_DEV_SLOTS)
+                # 8x growth: every dev-cap size is a fresh shape
+                # specialization of the scatter/estimate kernels, and at
+                # promote-early policy the first interval climbs the
+                # whole ladder — 256->2048->16384->65536 is 3 compiles
+                # where doubling was 8 (memory overshoot is bounded by
+                # MAX_DEV_SLOTS)
+                self._dev_cap = min(self._dev_cap * 8, self.MAX_DEV_SLOTS)
                 self.state = _pad_cap(self.state, self._dev_cap)
         self._slot_of[row] = self._nslots
         self._slot_row.append(row)
@@ -954,17 +969,20 @@ class SetTable(_BaseTable):
                 sl = slice(start, start + free)
                 r, ix, rh = rows[sl], reg_idx[sl], rho[sl]
                 start += r.shape[0]
-                self._counts += np.bincount(
-                    r, minlength=self._counts.shape[0]).astype(np.int32)
                 slots = self._slot_of[r]
                 cold = slots < 0
-                hot_rows = np.unique(
-                    r[cold & (self._counts[r] >= self.PROMOTE_SAMPLES)])
-                for hr in hot_rows:
-                    self._promote_locked(int(hr))
-                if hot_rows.size:
-                    slots = self._slot_of[r]
-                    cold = slots < 0
+                if self._nslots < self.MAX_DEV_SLOTS:
+                    # (at the slot cap the promotion scan is a
+                    # guaranteed no-op; skip its per-chunk cost)
+                    self._counts += np.bincount(
+                        r, minlength=self._counts.shape[0]).astype(np.int32)
+                    hot_rows = np.unique(
+                        r[cold & (self._counts[r] >= self.PROMOTE_SAMPLES)])
+                    for hr in hot_rows:
+                        self._promote_locked(int(hr))
+                    if hot_rows.size:
+                        slots = self._slot_of[r]
+                        cold = slots < 0
                 # COO append + touched in the same hold, BEFORE the
                 # dense append below can release the lock mid-dispatch
                 if cold.any():
@@ -1028,14 +1046,14 @@ class SetTable(_BaseTable):
         interval-scale COO volumes the sustained gate produces."""
         if rows.shape[0] == 0:
             return rows, np.zeros(0, np.float32)
-        key = (rows.astype(np.int64) << 14) | idx.astype(np.int64)
+        key = (rows.astype(np.int64) << hll_ref.P) | idx.astype(np.int64)
         order = np.argsort(key, kind="stable")
         k, q = key[order], rho[order]
         # max rho per (row, register) via reduceat over group boundaries
         starts = np.flatnonzero(np.r_[True, k[:-1] != k[1:]])
         qmax = np.maximum.reduceat(q, starts)
         kk = k[starts]
-        r = (kk >> 14).astype(rows.dtype)
+        r = (kk >> hll_ref.P).astype(rows.dtype)
         rb = np.flatnonzero(np.r_[True, r[:-1] != r[1:]])
         urows = r[rb]
         nnz = np.diff(np.r_[rb, r.shape[0]])
@@ -1080,10 +1098,7 @@ class SetTable(_BaseTable):
                 self._apply_cols(cols)
             if not self._sparse:
                 estimates = np.asarray(batch_hll.estimate(self.state))
-                empty = np.zeros(0, np.int32)
-                registers = _SetRegisters(
-                    self.state, np.arange(self.capacity, dtype=np.int32),
-                    empty, empty, empty)
+                registers = _SetRegisters.dense(self.state, self.capacity)
                 self.state = batch_hll.init_state(self._dev_cap)
                 return estimates, registers, touched, meta
 

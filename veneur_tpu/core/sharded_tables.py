@@ -307,10 +307,7 @@ class ShardedSetTable(SetTable):
             # lazy per-row provider (columnstore._SetRegisters): the
             # merged (K, M) bank only crosses the device link if a
             # consumer (the forward exporter) actually reads registers
-            empty = np.zeros(0, np.int32)
-            registers = _SetRegisters(
-                merged, np.arange(self.capacity, dtype=np.int32),
-                empty, empty, empty)
+            registers = _SetRegisters.dense(merged, self.capacity)
             self.states = [
                 jax.device_put(batch_hll.init_state(self.capacity), d)
                 for d in self._devices]
