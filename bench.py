@@ -313,16 +313,11 @@ class UdpRig:
         # the flush below, so steady-state intervals never compile.
         sets = server.store.sets
         import jax
-        if getattr(sets, "_sparse", False) and len(sets.meta) > 0 and \
-                jax.default_backend() not in ("cpu",):
-            with sets.lock:
-                for row in range(min(len(sets.meta), sets.MAX_DEV_SLOTS)):
-                    if sets._slot_of[row] < 0:
-                        sets._promote_locked(row)
-            if sets._nslots:
+        if jax.default_backend() not in ("cpu",):
+            if sets.prewarm_dense():
                 # one dense-tier sample so apply_batch compiles at the
-                # settled dev cap (row 0 is promoted by the loop above;
-                # the warmup interval's flush is discarded anyway)
+                # settled dev cap (row 0 is promoted by prewarm; the
+                # warmup interval's flush is discarded anyway)
                 sets.add_batch(np.zeros(1, np.int32), np.zeros(1, np.int32),
                                np.ones(1, np.int32))
         server.store.apply_all_pending()
@@ -496,12 +491,14 @@ def run_scenario_sustained(num_keys: int = 100_000, interval_s: float = 10.0,
         log("sustained: warmup done")
     server = rig.server
     flush_times = []
+    flush_phases = []  # per-flush attribution (server.flush_phase_timings)
     orig_flush_locked = server._flush_locked
 
     def timed_flush():
         t0 = time.perf_counter()
         orig_flush_locked()
         flush_times.append(time.perf_counter() - t0)
+        flush_phases.append(dict(getattr(server, "flush_phase_timings", {})))
 
     server._flush_locked = timed_flush
     try:
@@ -540,7 +537,7 @@ def run_scenario_sustained(num_keys: int = 100_000, interval_s: float = 10.0,
     log(f"sustained: {rate:,.0f} samples/s over {elapsed:.1f}s "
         f"(offered {off_rate:,.0f}), {len(times)} flushes, "
         f"p50={p50:.3f}s p99={p99:.3f}s drain={drain_s:.2f}s")
-    return rate, {
+    extra = {
         "flush_p50_s": round(p50, 4),
         "flush_p99_s": round(p99, 4),
         "flush_count": ticker_flushes,
@@ -549,6 +546,14 @@ def run_scenario_sustained(num_keys: int = 100_000, interval_s: float = 10.0,
         "offered_samples_per_sec": round(off_rate, 1),
         "sustained_keys": num_keys,
     }
+    if flush_phases:
+        # attribution: worst flush per phase (the p99 driver) — device
+        # sync vs host assembly vs sink-thread join
+        keys = set().union(*(p.keys() for p in flush_phases))
+        extra["flush_phases_max_s"] = {
+            k: round(max(p.get(k, 0.0) for p in flush_phases), 4)
+            for k in sorted(keys)}
+    return rate, extra
 
 
 def run_pipeline(duration_s: float, num_keys: int):

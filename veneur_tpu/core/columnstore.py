@@ -886,6 +886,22 @@ class SetTable(_BaseTable):
             self._dev_cap = new_cap
             self.state = _pad_cap(self.state, new_cap)
 
+    def prewarm_dense(self) -> int:
+        """Promote every currently-interned row (up to MAX_DEV_SLOTS) so
+        the device slot ladder — and each dev-cap shape's scatter and
+        estimate compiles — is climbed NOW rather than inside a live
+        interval. Benchmark/warmup helper; the next snapshot resets slot
+        assignments but _dev_cap persists, so steady state never
+        recompiles. Returns the promoted-slot count. No-op for dense
+        tables."""
+        if not self._sparse:
+            return 0
+        with self.lock:
+            for row in range(min(len(self.meta), self.MAX_DEV_SLOTS)):
+                if self._slot_of[row] < 0:
+                    self._promote_locked(row)
+            return self._nslots
+
     def _promote_locked(self, row: int) -> None:
         """Assign a device slot (caller holds the buffer lock). A no-op
         at MAX_DEV_SLOTS — the key stays on the host tier (callers

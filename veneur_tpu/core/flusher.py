@@ -337,12 +337,16 @@ def flush_columnstore_batch(
     percentiles: Sequence[float],
     aggregates: HistogramAggregates,
     collect_forward: bool = True,
+    timings: Optional[dict] = None,
 ) -> Tuple[FlushBatch, ForwardableState]:
     """Columnar flush_columnstore: same snapshot semantics and emission
     rules (the docstring at module top), one device sync, numpy
-    assembly. Returns (FlushBatch, ForwardableState)."""
+    assembly. Returns (FlushBatch, ForwardableState). `timings`, when
+    given, receives per-phase wall seconds (dispatch / device_sync /
+    assembly) so flush-latency claims can be attributed."""
     import jax
 
+    t0 = time.perf_counter()
     now = int(time.time())
     fwd = ForwardableState()
     sections: List[FlushSection] = []
@@ -363,6 +367,7 @@ def flush_columnstore_batch(
     # them here keeps every family on the same interval boundary
     estimates, registers, s_touched, s_meta = store.sets.snapshot_and_reset()
     st_vals, st_touched, st_meta = store.statuses.snapshot_and_reset()
+    t_dispatch = time.perf_counter()
 
     # ---- phase 2: one queue drain for everything still on device -------
     handles = [h_snap["packed"], c_snap["dev"][0], c_snap["dev"][1],
@@ -374,6 +379,7 @@ def flush_columnstore_batch(
     g_vals, g_touched, g_meta = type(store.gauges).snapshot_finish(g_snap)
     out, export, h_touched, h_meta = type(store.histos).snapshot_finish(
         h_snap)
+    t_sync = time.perf_counter()
 
     # ---- counters & gauges ---------------------------------------------
     def scalar_family(table, vals, touched, meta_list, mtype, fwd_list):
@@ -508,4 +514,9 @@ def flush_columnstore_batch(
             tags=list(meta.tags), type=MetricType.STATUS,
             message=entry.message, hostname=entry.hostname))
 
+    if timings is not None:
+        t_end = time.perf_counter()
+        timings["dispatch_s"] = t_dispatch - t0
+        timings["device_sync_s"] = t_sync - t_dispatch
+        timings["assembly_s"] = t_end - t_sync
     return FlushBatch(now, sections, extras), fwd

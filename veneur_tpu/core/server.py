@@ -724,10 +724,17 @@ class Server:
             _start_sink_thread(
                 f"span:{sink.name()}", self._flush_span_sink_safe, sink)
 
+        # per-phase wall clock for flush-latency attribution; read by
+        # the bench's sustained gate (one flush at a time: _flush_lock)
+        phases = self.flush_phase_timings = {}
+        t_store = time.perf_counter()
         batch, fwd = flush_columnstore_batch(
             self.store, self.is_local, self.percentiles, self.aggregates,
-            collect_forward=self.forwarder is not None)
+            collect_forward=self.forwarder is not None,
+            timings=phases)
         self.stats.inc("metrics_flushed", len(batch))
+        phases["store_flush_s"] = time.perf_counter() - t_store
+        phases["preflush_s"] = t_store - flush_start
 
         if self.is_local and self.forwarder is not None and len(fwd):
             _start_sink_thread("forward", self._forward_safe, fwd)
@@ -755,11 +762,13 @@ class Server:
         grace = (max(self.interval, 30.0) if self._shutdown.is_set()
                  else self.interval)
         deadline = flush_start + grace
+        t_join = time.perf_counter()
         for t in threads:
             remaining = deadline - time.perf_counter()
             if remaining <= 0:
                 break
             t.join(remaining)
+        phases["sink_join_s"] = time.perf_counter() - t_join
         stuck = [t.name for t in threads if t.is_alive()]
         if stuck:
             logger.error(
