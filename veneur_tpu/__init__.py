@@ -13,4 +13,4 @@ compression and HLL register updates run as batched device ops over the
 device collectives (psum/pmax) over a `jax.sharding.Mesh`.
 """
 
-__version__ = "0.5.0"
+__version__ = "0.6.0"
