@@ -402,6 +402,7 @@ def run_pipeline_mt(duration_s: float, num_keys: int, rig: UdpRig = None,
     per = max(1.2, duration_s / max(1, len(ladder)))
     sweep = {}
     offers = {}  # label -> numeric offered rate (0 = unpaced)
+    zero_rungs = 0
     try:
         for offered in ladder:
             if time_left() < per + 8:
@@ -419,8 +420,14 @@ def run_pipeline_mt(duration_s: float, num_keys: int, rig: UdpRig = None,
                 # starves the pipeline; further rungs waste budget. A
                 # ZERO rung is a measurement artifact (one long
                 # synchronous apply swallowed the window), not a knee —
-                # keep climbing in that case.
+                # keep climbing in that case, but two in a row means the
+                # senders are starving the dispatcher outright and every
+                # higher rung will too.
                 log("mixed: past the knee; stopping ladder")
+                break
+            zero_rungs = zero_rungs + 1 if not rate else 0
+            if zero_rungs >= 2:
+                log("mixed: dispatcher starved two rungs; stopping ladder")
                 break
         # the headline/knee comes from the single-sender ladder only:
         # the sustained stage paces a single sender against it

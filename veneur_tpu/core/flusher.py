@@ -478,11 +478,20 @@ def flush_columnstore_batch(
 
         if need_export:
             exp_means, exp_weights, exp_min, exp_max, exp_recip = export
-            for row in hr[~local_only].tolist():
-                fwd.histograms.append((
-                    h_meta[row], exp_means[row].copy(),
-                    exp_weights[row].copy(), float(exp_min[row]),
-                    float(exp_max[row]), float(exp_recip[row])))
+            fr = hr[~local_only]
+            if fr.size:
+                # one bulk fancy-index copy into a COMPACT matrix, then
+                # row views into it: per-row .copy() was pure overhead on
+                # the forward config's flush path, but views into the
+                # full (K, 2C+3) export would pin ~capacity-sized memory
+                # for the lifetime of the async forward send
+                cm, cw = exp_means[fr], exp_weights[fr]
+                cmin, cmax = exp_min[fr], exp_max[fr]
+                crecip = exp_recip[fr]
+                for j, row in enumerate(fr.tolist()):
+                    fwd.histograms.append((
+                        h_meta[row], cm[j], cw[j], float(cmin[j]),
+                        float(cmax[j]), float(crecip[j])))
 
     # ---- sets -----------------------------------------------------------
     sr = _valid_rows(s_touched, s_meta)
