@@ -280,7 +280,7 @@ class UdpRig:
     the server's pipeline, not the Python emitter's."""
 
     def __init__(self, num_keys: int, datagrams, samples_per_dgram: float,
-                 **cfg_overrides):
+                 families: int = 1, **cfg_overrides):
         import socket
 
         from veneur_tpu import native
@@ -291,7 +291,8 @@ class UdpRig:
         self.spd = samples_per_dgram
         self.datagrams = datagrams
         self.server = _mk_server(
-            num_keys, statsd_listen_addresses=["udp://127.0.0.1:0"],
+            num_keys, families=families,
+            statsd_listen_addresses=["udp://127.0.0.1:0"],
             **cfg_overrides)
         self.server.start()
         addr = self.server.local_addr("udp")
@@ -395,7 +396,7 @@ def run_pipeline_mt(duration_s: float, num_keys: int, rig: UdpRig = None,
         packets, samples = make_packets(num_keys)
         datagrams = make_datagrams(packets)
         rig = UdpRig(num_keys, datagrams, samples / len(datagrams),
-                     interval=3600.0)
+                     families=4, interval=3600.0)
         log(f"mixed: warmup (intern {num_keys} keys + compile kernels)")
         rig.warmup()
         log("mixed: warmup done")
@@ -454,7 +455,7 @@ def run_pipeline_mt(duration_s: float, num_keys: int, rig: UdpRig = None,
 def _run_pipeline_inproc(duration_s: float, num_keys: int):
     """Fallback when the native library is unavailable: the old
     in-process drive through handle_packet_batch."""
-    server = _mk_server(num_keys)
+    server = _mk_server(num_keys, families=4)
     packets, samples_per_round = make_packets(num_keys)
     datagrams = make_datagrams(packets)
     server.handle_packet_batch(datagrams)
@@ -492,7 +493,8 @@ def run_scenario_sustained(num_keys: int = 100_000, interval_s: float = 10.0,
         packets, samples = make_packets(num_keys)
         datagrams = make_datagrams(packets)
         rig = UdpRig(num_keys, datagrams, samples / len(datagrams),
-                     interval=interval_s, synchronize_with_interval=False)
+                     families=4, interval=interval_s,
+                     synchronize_with_interval=False)
         log(f"sustained: warmup ({num_keys} keys)")
         rig.warmup()
         log("sustained: warmup done")
@@ -565,7 +567,7 @@ def run_scenario_sustained(num_keys: int = 100_000, interval_s: float = 10.0,
 
 def run_pipeline(duration_s: float, num_keys: int):
     """Single-threaded host pipeline (kept for comparison runs)."""
-    server = _mk_server(num_keys)
+    server = _mk_server(num_keys, families=4)
     packets, samples_per_round = make_packets(num_keys)
     datagrams = make_datagrams(packets)
     server.handle_packet_batch(datagrams)
@@ -585,7 +587,8 @@ def run_pipeline(duration_s: float, num_keys: int):
     return total_samples / elapsed, elapsed
 
 
-def _mk_server(num_keys: int, extra_span_sinks=None, **cfg_overrides):
+def _mk_server(num_keys: int, extra_span_sinks=None, families: int = 1,
+               **cfg_overrides):
     from veneur_tpu.config import Config
     from veneur_tpu.core.server import Server
     from veneur_tpu.sinks.blackhole import BlackholeMetricSink
@@ -598,10 +601,24 @@ def _mk_server(num_keys: int, extra_span_sinks=None, **cfg_overrides):
     if os.environ.get("VENEUR_TPU_PALLAS_TDIGEST_FLUSH", "").lower() in (
             "1", "true", "yes", "on"):
         cfg.tpu.pallas_tdigest_flush = True
-    cfg.tpu.counter_capacity = max(4096, num_keys)
-    cfg.tpu.gauge_capacity = max(4096, num_keys)
-    cfg.tpu.histo_capacity = max(4096, num_keys)
-    cfg.tpu.set_capacity = max(1024, num_keys // 2)
+    # families: how many sampler families the caller's corpus spreads
+    # num_keys across (make_packets: 4 via i % 4; single-family
+    # scenarios keep the exact legacy sizing). Flush kernels are
+    # capacity-proportional — the t-digest flush sorts every row, live
+    # or not — so sizing every family at num_keys for a mixed corpus
+    # quadrupled the flush's device work for nothing. Margin covers
+    # self-metrics and slack.
+    if families > 1:
+        fam = max(4096, num_keys // families + num_keys // 16 + 256)
+        cfg.tpu.counter_capacity = fam
+        cfg.tpu.gauge_capacity = fam
+        cfg.tpu.histo_capacity = fam
+        cfg.tpu.set_capacity = max(1024, fam)
+    else:
+        cfg.tpu.counter_capacity = max(4096, num_keys)
+        cfg.tpu.gauge_capacity = max(4096, num_keys)
+        cfg.tpu.histo_capacity = max(4096, num_keys)
+        cfg.tpu.set_capacity = max(1024, num_keys // 2)
     cfg.tpu.batch_cap = BATCH_CAP[0]
     for k, v in cfg_overrides.items():
         setattr(cfg, k, v)
@@ -1085,7 +1102,7 @@ def run_default(args, on_tpu: bool) -> None:
             packets, samples = make_packets(keys)
             datagrams = make_datagrams(packets)
             rig = UdpRig(keys, datagrams, samples / len(datagrams),
-                         interval=interval_s,
+                         families=4, interval=interval_s,
                          synchronize_with_interval=False)
             log(f"pipeline: warmup (intern {keys} keys + compile)")
             rig.warmup()
