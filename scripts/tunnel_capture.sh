@@ -1,8 +1,9 @@
 #!/bin/bash
 # Tunnel watcher: probe the axon TPU tunnel until it answers, then
-# immediately capture the bench stages a wedge truncated out of the
-# manual artifact (device kernels + the BASELINE config suite), one
-# scenario per process so a mid-capture wedge only loses that stage.
+# immediately capture TPU bench artifacts — the full default run first
+# (mixed + sustained@100k + device + config suite, the artifact the
+# record needs), then per-scenario extras while the tunnel stays up.
+# One scenario per process so a mid-capture wedge only loses that stage.
 # Usage: tunnel_capture.sh [outdir]
 set -u
 OUT=${1:-/tmp/tpu_capture}
@@ -22,7 +23,13 @@ while true; do
   sleep 60
 done
 
+log "capturing default (full artifact)"
+JAX_PLATFORMS=axon BENCH_DEADLINE_S=520 timeout 540 python bench.py \
+  > "$OUT/default.json" 2> "$OUT/default.err"
+log "default rc=$? $(head -c 300 "$OUT/default.json")"
+
 for sc in device forward ssf hll timers counter; do
+  grep -q '"platform": "tpu"' "$OUT/default.json" || true
   log "capturing $sc"
   JAX_PLATFORMS=axon BENCH_DEADLINE_S=240 BENCH_DEVICE_SWEEP=1 \
     timeout 260 python bench.py --scenario $sc --duration 4 \
