@@ -195,6 +195,48 @@ def test_batch_flush_concurrent_with_intern_churn():
     assert not errors, errors
 
 
+def test_unfiltered_config_sink_takes_columnar_path():
+    """A config-declared sink WITHOUT active filters must receive the
+    columnar FlushBatch (fast path); one WITH filters gets the filtered
+    InterMetric list. (Regression: _sink_filters used to hold an entry
+    for every declared sink, so yaml-declared sinks always paid
+    materialization.)"""
+    from veneur_tpu.config import Config, SinkConfig
+    from veneur_tpu.core.server import Server
+
+    cfg = Config()
+    cfg.interval = 60.0
+    cfg.statsd_listen_addresses = []
+    cfg.metric_sinks = [
+        SinkConfig(kind="blackhole", name="plain"),
+        SinkConfig(kind="blackhole", name="filtered",
+                   strip_tags=[{"kind": "prefix", "value": "secret"}]),
+        SinkConfig(kind="blackhole", name="maxtags", max_tags=1),
+    ]
+    cfg.apply_defaults()
+    server = Server(cfg)
+    calls = {}
+    for sink in server.metric_sinks:
+        name = sink.name()
+        sink.flush_batch = (
+            lambda b, n=name: calls.setdefault(n, ("batch", b)))
+        sink.flush = (
+            lambda ms, n=name: calls.setdefault(n, ("list", ms)))
+    server.handle_metric_packet(b"fb.route:1|c|#secret:x,keep:y")
+    server.store.apply_all_pending()
+    server.flush()
+    kind_plain, payload_plain = calls["plain"]
+    kind_filtered, payload_filtered = calls["filtered"]
+    assert kind_plain == "batch" and isinstance(payload_plain, FlushBatch)
+    assert kind_filtered == "list"
+    [m] = payload_filtered
+    assert m.name == "fb.route" and m.tags == ["keep:y"]
+    # max_tags alone is an active filter too (2-tag metric exceeds 1)
+    kind_maxtags, payload_maxtags = calls["maxtags"]
+    assert kind_maxtags == "list" and payload_maxtags == []
+    server.shutdown()
+
+
 def test_materialize_is_cached_and_shared():
     store = _mk_store()
     _feed(store, [b"a:1|c", b"b:2.5|g"])

@@ -172,8 +172,14 @@ class Server:
             if factory is None:
                 raise ValueError(f"unknown span sink kind: {sc.kind}")
             self.span_sinks.append(factory(sc, config))
-        self._sink_filters = {  # per-sink tag/name filtering config
-            sc.name or sc.kind: sc for sc in config.metric_sinks}
+        # per-sink tag/name filtering config — only sinks with ACTIVE
+        # filters, so unfiltered config-declared sinks still take the
+        # columnar fast path in _flush_sink_safe (an entry here forces
+        # per-metric object materialization)
+        self._sink_filters = {
+            sc.name or sc.kind: sc for sc in config.metric_sinks
+            if (sc.strip_tags or sc.add_tags or sc.max_name_length
+                or sc.max_tag_length or sc.max_tags)}
 
         from veneur_tpu import sources as sources_mod
         sources_mod.register_builtin_sources()
