@@ -237,6 +237,43 @@ def test_unfiltered_config_sink_takes_columnar_path():
     server.shutdown()
 
 
+def test_batch_flush_sharded_store_matches_single_device():
+    """The columnar flush over an 8-way sharded store (virtual CPU mesh)
+    must emit the same metrics as over a single-device store. batch_cap
+    is tiny so the round-robin actually spreads interval state across
+    shards; histogram-derived values compare with the same slack the
+    sharded-equivalence suite uses (recompress over a merged grid may
+    interpolate slightly differently)."""
+    from veneur_tpu.core.sharded_tables import ShardedHistoTable
+
+    lines = _mixed_corpus() * 3  # several batches per family
+    s1 = ColumnStore(counter_capacity=64, gauge_capacity=64,
+                     histo_capacity=64, set_capacity=32, batch_cap=16)
+    s8 = ColumnStore(counter_capacity=64, gauge_capacity=64,
+                     histo_capacity=64, set_capacity=32, batch_cap=16,
+                     shard_devices=8)
+    assert isinstance(s8.histos, ShardedHistoTable)  # no silent fallback
+    assert len(s8.histos._devices) == 8
+    _feed(s1, lines)
+    _feed(s8, lines)
+    b1, _ = flush_columnstore_batch(s1, False, PCTS, AGGS)
+    b8, _ = flush_columnstore_batch(s8, False, PCTS, AGGS)
+
+    def grouped(batch):
+        out = {}
+        for m in batch.materialize():
+            out.setdefault(
+                (m.name, int(m.type), tuple(sorted(m.tags))),
+                []).append(float(m.value))
+        return {k: sorted(v) for k, v in out.items()}
+
+    g1, g8 = grouped(b1), grouped(b8)
+    assert g1.keys() == g8.keys()
+    for k in g1:
+        np.testing.assert_allclose(g1[k], g8[k], rtol=0.05, atol=1e-6,
+                                   err_msg=str(k))
+
+
 def test_materialize_is_cached_and_shared():
     store = _mk_store()
     _feed(store, [b"a:1|c", b"b:2.5|g"])
