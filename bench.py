@@ -151,6 +151,41 @@ def _relay_listening(host: str = "127.0.0.1",
     return False
 
 
+PROBE_STATE_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), ".bench_probe_state.json")
+PROBE_STATE_FRESH_S = 300.0
+
+
+def _read_probe_state(platform: str):
+    """Recent shared probe verdict for `platform` ({"ts", "ok",
+    "platform"} written by this process and by
+    scripts/tunnel_capture.sh's probe loop), or None when absent, stale,
+    or recorded against a different backend target. A wedged tunnel
+    whose relay still LISTENS passes the instant port check but hangs
+    every jax init — without shared state each bench invocation re-pays
+    two long subprocess timeouts (~120 s of a 170 s driver budget, the
+    r04 failure shape)."""
+    try:
+        with open(PROBE_STATE_PATH) as f:
+            st = json.load(f)
+        if (st.get("platform") == platform
+                and time.time() - float(st.get("ts", 0))
+                <= PROBE_STATE_FRESH_S):
+            return st
+    except Exception:
+        pass
+    return None
+
+
+def _write_probe_state(ok: bool, platform: str) -> None:
+    try:
+        with open(PROBE_STATE_PATH, "w") as f:
+            json.dump({"ts": time.time(), "ok": bool(ok),
+                       "platform": platform}, f)
+    except Exception:
+        pass
+
+
 def initialize_backend(probe_timeouts=None) -> str:
     """Bring up the JAX backend before constructing any pipeline object so
     a backend failure is visible up front (round-1 failure modes: axon TPU
@@ -162,9 +197,23 @@ def initialize_backend(probe_timeouts=None) -> str:
     platform field in the JSON line records the fallback)."""
     import subprocess
 
+    probe_target = os.environ.get("JAX_PLATFORMS", "").split(",")[0]
     if probe_timeouts is None:
-        raw = os.environ.get("BENCH_PROBE_TIMEOUTS", "45,75")
-        probe_timeouts = [float(x) for x in raw.split(",") if x.strip()]
+        raw = os.environ.get("BENCH_PROBE_TIMEOUTS")
+        if raw is not None:
+            # explicit override; empty string = skip probing entirely
+            probe_timeouts = [float(x) for x in raw.split(",") if x.strip()]
+        else:
+            st = _read_probe_state(probe_target)
+            if st is not None and not st["ok"]:
+                # known-wedged moments ago: one short attempt (in case it
+                # just recovered) and keep the budget for the CPU stages
+                probe_timeouts = [15.0]
+                log("recent probe state: wedged; single 15s attempt")
+            elif st is not None and st["ok"]:
+                probe_timeouts = [45.0]
+            else:
+                probe_timeouts = [45.0, 75.0]
 
     fallback_reason = None
     env_platform = os.environ.get("JAX_PLATFORMS", "")
@@ -186,12 +235,15 @@ def initialize_backend(probe_timeouts=None) -> str:
         # CPU stages (tunnel provenance lands in the artifact)
         fallback_reason = "relay not listening (instant pre-check)"
         log("axon relay ports closed; skipping subprocess probes")
+        _write_probe_state(False, probe_target)
     elif not env_platform.startswith("cpu"):
+        probed = False
         for attempt, probe_timeout in enumerate(probe_timeouts, 1):
             if time_left() < probe_timeout + 45:
                 fallback_reason = fallback_reason or "probe budget exhausted"
                 log(f"probe attempt {attempt} skipped: deadline too close")
                 break
+            probed = True
             try:
                 probe = subprocess.run(
                     [sys.executable, "-c",
@@ -214,6 +266,8 @@ def initialize_backend(probe_timeouts=None) -> str:
             print(f"bench: backend probe attempt {attempt} failed rc="
                   f"{probe.returncode}: {fallback_reason}", file=sys.stderr)
             time.sleep(3 * attempt)
+        if probed:  # budget-skipped attempts are not a tunnel verdict
+            _write_probe_state(fallback_reason is None, probe_target)
 
     from veneur_tpu.util.jaxplatform import force_cpu, honor_env_platform
 
