@@ -157,19 +157,20 @@ PROBE_STATE_FRESH_S = 300.0
 
 
 def _read_probe_state(platform: str):
-    """Recent shared probe verdict for `platform` ({"ts", "ok",
-    "platform"} written by this process and by
-    scripts/tunnel_capture.sh's probe loop), or None when absent, stale,
-    or recorded against a different backend target. A wedged tunnel
-    whose relay still LISTENS passes the instant port check but hangs
-    every jax init — without shared state each bench invocation re-pays
-    two long subprocess timeouts (~120 s of a 170 s driver budget, the
-    r04 failure shape)."""
+    """Recent shared probe verdict for `platform` (a per-platform entry
+    {"ts", "ok"} written by this process and by
+    scripts/tunnel_capture.sh's probe loop through _write_probe_state),
+    or None when absent or stale. A wedged tunnel whose relay still
+    LISTENS passes the instant port check but hangs every jax init —
+    without shared state each bench invocation re-pays two long
+    subprocess timeouts (~120 s of a 170 s driver budget, the r04
+    failure shape)."""
+    if not platform:
+        return None
     try:
         with open(PROBE_STATE_PATH) as f:
-            st = json.load(f)
-        if (st.get("platform") == platform
-                and time.time() - float(st.get("ts", 0))
+            st = json.load(f).get(platform)
+        if (st is not None and time.time() - float(st.get("ts", 0))
                 <= PROBE_STATE_FRESH_S):
             return st
     except Exception:
@@ -178,10 +179,24 @@ def _read_probe_state(platform: str):
 
 
 def _write_probe_state(ok: bool, platform: str) -> None:
+    """Merge this platform's verdict into the shared state file (the one
+    authoritative writer — the capture watcher shells into it too). No-op
+    without an explicit platform: a default-backend probe says nothing
+    about any tunnel."""
+    if not platform:
+        return
+    state = {}
+    try:
+        with open(PROBE_STATE_PATH) as f:
+            state = json.load(f)
+        if not isinstance(state, dict):
+            state = {}
+    except Exception:
+        pass
+    state[platform] = {"ts": time.time(), "ok": bool(ok)}
     try:
         with open(PROBE_STATE_PATH, "w") as f:
-            json.dump({"ts": time.time(), "ok": bool(ok),
-                       "platform": platform}, f)
+            json.dump(state, f)
     except Exception:
         pass
 
@@ -198,6 +213,9 @@ def initialize_backend(probe_timeouts=None) -> str:
     import subprocess
 
     probe_target = os.environ.get("JAX_PLATFORMS", "").split(",")[0]
+    short_probe = False  # a failed SHORT attempt is not a fresh verdict:
+    # rewriting ok=false each run would keep a recovered-but-slow tunnel
+    # wedged forever (the old timestamp must age out to retry in full)
     if probe_timeouts is None:
         raw = os.environ.get("BENCH_PROBE_TIMEOUTS")
         if raw is not None:
@@ -209,6 +227,7 @@ def initialize_backend(probe_timeouts=None) -> str:
                 # known-wedged moments ago: one short attempt (in case it
                 # just recovered) and keep the budget for the CPU stages
                 probe_timeouts = [15.0]
+                short_probe = True
                 log("recent probe state: wedged; single 15s attempt")
             elif st is not None and st["ok"]:
                 probe_timeouts = [45.0]
@@ -266,7 +285,9 @@ def initialize_backend(probe_timeouts=None) -> str:
             print(f"bench: backend probe attempt {attempt} failed rc="
                   f"{probe.returncode}: {fallback_reason}", file=sys.stderr)
             time.sleep(3 * attempt)
-        if probed:  # budget-skipped attempts are not a tunnel verdict
+        if probed and (fallback_reason is None or not short_probe):
+            # budget-skipped attempts and failed SHORT probes are not a
+            # fresh verdict (see short_probe above)
             _write_probe_state(fallback_reason is None, probe_target)
 
     from veneur_tpu.util.jaxplatform import force_cpu, honor_env_platform

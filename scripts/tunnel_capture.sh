@@ -12,25 +12,19 @@ cd "$(dirname "$0")/.."
 
 log() { echo "$(date -u +%FT%TZ) $*" >> "$OUT/watch.log"; }
 
-STATE=.bench_probe_state.json  # shared with bench.py's probe backoff
+# shared probe verdict: bench._write_probe_state is the one writer
+# (cwd is the repo root, so `import bench` resolves)
+mark() { python -c "import bench; bench._write_probe_state($1, 'axon')"; }
 while true; do
   if timeout 30 env JAX_PLATFORMS=axon python -c \
       "import jax; d=jax.devices(); assert d and d[0].platform != 'cpu'" \
       >/dev/null 2>&1; then
     log "tunnel alive"
-    python - <<PYEOF
-import json, time
-json.dump({"ts": time.time(), "ok": True, "platform": "axon"},
-          open("$STATE", "w"))
-PYEOF
+    mark True
     break
   fi
   log "wedged; retry in 60s"
-  python - <<PYEOF
-import json, time
-json.dump({"ts": time.time(), "ok": False, "platform": "axon"},
-          open("$STATE", "w"))
-PYEOF
+  mark False
   sleep 60
 done
 
