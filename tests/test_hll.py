@@ -324,6 +324,41 @@ class TestSparseSetTable:
         assert float(est[hot_row]) == hot_oracle.estimate()
         np.testing.assert_array_equal(regs[hot_row], hot_oracle.regs)
 
+    def test_capacity_clamps_promotion_until_growth(self):
+        """With capacity < MAX_DEV_SLOTS the promotion limit is the row
+        capacity (slots beyond the table's rows are unreachable); when
+        the host table grows, promotion resumes and the device cap grows
+        with it."""
+        import numpy as np
+        from veneur_tpu.core.columnstore import SetTable
+        table = SetTable(capacity=8, batch_cap=64, sparse=True,
+                         promote_samples=1, max_dev_slots=65536)
+        stubs = [self._stub(b"cl.%d" % i) for i in range(8)]
+        with table.lock:
+            for s in stubs:
+                table.row_for(s)
+        table.meta = table.meta  # 8 rows interned at capacity 8
+        assert table.prewarm_dense() == 8
+        assert table._dev_cap == 8 and table._nslots == 8
+        # at the clamp: a promotion attempt is a no-op, not state growth
+        table._promote_locked(0)
+        assert table._nslots == 8
+        # interning a 9th key doubles the host table; promotion resumes
+        extra = self._stub(b"cl.extra")
+        with table.lock:
+            row9 = table.row_for(extra)
+        assert table.capacity == 16
+        assert table.prewarm_dense() == 9
+        assert table._slot_of[row9] >= 0
+        assert table._dev_cap >= 9  # device cap regrew past the old clamp
+        # and the dense tier still aggregates for the new slot
+        ix, rh = 5, 3
+        table.add_batch(np.array([row9], np.int32),
+                        np.array([ix], np.int32), np.array([rh], np.int32))
+        table.apply_pending()
+        est, regs, _t, _m = table.snapshot_and_reset()
+        assert regs[row9][ix] == rh
+
     def test_interval_reset_demotes(self):
         import numpy as np
         table = self._mk(batch_cap=256)

@@ -904,19 +904,27 @@ class SetTable(_BaseTable):
 
     def _promote_locked(self, row: int) -> None:
         """Assign a device slot (caller holds the buffer lock). A no-op
-        at MAX_DEV_SLOTS — the key stays on the host tier (callers
-        re-read _slot_of and route accordingly)."""
-        if self._nslots >= self.MAX_DEV_SLOTS:
+        at the slot limit — the key stays on the host tier (callers
+        re-read _slot_of and route accordingly). The limit is
+        MAX_DEV_SLOTS clamped to the CURRENT row capacity: slots beyond
+        the table's rows can never be assigned, and the clamp keeps the
+        growth ladder (and the per-flush estimate scan) sized to the
+        actual keyset instead of the HBM guard."""
+        limit = min(self.MAX_DEV_SLOTS, self.capacity)
+        if self._nslots >= limit:
             return
         if self._nslots >= self._dev_cap:
             with self.apply_lock:
                 # 8x growth: every dev-cap size is a fresh shape
                 # specialization of the scatter/estimate kernels, and at
                 # promote-early policy the first interval climbs the
-                # whole ladder — 256->2048->16384->65536 is 3 compiles
-                # where doubling was 8 (memory overshoot is bounded by
-                # MAX_DEV_SLOTS)
-                self._dev_cap = min(self._dev_cap * 8, self.MAX_DEV_SLOTS)
+                # whole ladder — 256->2048->16384->cap is 3 compiles
+                # where doubling was 8. When the clamp binds (capacity <
+                # MAX_DEV_SLOTS), dev-cap steps can track capacity
+                # doublings instead of the ladder — that costs no extra
+                # compile WAVES, because growing capacity re-lays-out
+                # every capacity-shaped kernel in the store anyway.
+                self._dev_cap = min(self._dev_cap * 8, limit)
                 self.state = _pad_cap(self.state, self._dev_cap)
         self._slot_of[row] = self._nslots
         self._slot_row.append(row)
@@ -987,7 +995,7 @@ class SetTable(_BaseTable):
                 start += r.shape[0]
                 slots = self._slot_of[r]
                 cold = slots < 0
-                if self._nslots < self.MAX_DEV_SLOTS:
+                if self._nslots < min(self.MAX_DEV_SLOTS, self.capacity):
                     # (at the slot cap the promotion scan is a
                     # guaranteed no-op; skip its per-chunk cost)
                     self._counts += np.bincount(
