@@ -806,14 +806,17 @@ def run_scenario_forward(duration_s: float, num_keys: int = 50_000):
 
 def run_scenario_ssf(duration_s: float, num_keys: int = 10_000):
     """BASELINE config 5 (scaled): SSF spans with attached samples ->
-    native extraction -> aggregation, plus span-sink fanout (a blackhole
-    span sink stands in for the datadog+kafka pair: it exercises the
-    full per-span worker path — lazy RawSpan decode, isolation queues,
+    native extraction -> aggregation, plus span-sink fanout (TWO
+    blackhole span sinks stand in for the datadog+kafka pair: each gets
+    its own isolation queue and worker, so the measured path is the
+    real two-sink fanout — lazy RawSpan decode, per-sink submit, queue
     overflow drops — without vendor HTTP noise)."""
     from veneur_tpu import ssf
     from veneur_tpu.sinks.blackhole import BlackholeSpanSink
-    server = _mk_server(num_keys, interval=3600.0, span_channel_capacity=8192,
-                        extra_span_sinks=[BlackholeSpanSink()])
+    server = _mk_server(
+        num_keys, interval=3600.0, span_channel_capacity=8192,
+        extra_span_sinks=[BlackholeSpanSink("datadog-standin"),
+                          BlackholeSpanSink("kafka-standin")])
     server.start()  # span workers drain the channel
     spans = []
     for i in range(2000):

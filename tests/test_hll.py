@@ -333,13 +333,13 @@ class TestSparseSetTable:
         from veneur_tpu.core.columnstore import SetTable
         table = SetTable(capacity=8, batch_cap=64, sparse=True,
                          promote_samples=1, max_dev_slots=65536)
+        # intern 8 rows at capacity 8
         stubs = [self._stub(b"cl.%d" % i) for i in range(8)]
         with table.lock:
             for s in stubs:
                 table.row_for(s)
-        table.meta = table.meta  # 8 rows interned at capacity 8
         assert table.prewarm_dense() == 8
-        assert table._dev_cap == 8 and table._nslots == 8
+        assert table._nslots == 8
         # at the clamp: a promotion attempt is a no-op, not state growth
         table._promote_locked(0)
         assert table._nslots == 8

@@ -902,29 +902,32 @@ class SetTable(_BaseTable):
                     self._promote_locked(row)
             return self._nslots
 
+    @property
+    def _slot_limit(self) -> int:
+        """How many device slots may be ASSIGNED: the HBM guard clamped
+        to the current row capacity (slots beyond the table's rows are
+        unreachable). Shared by _promote_locked and the add_batch
+        promotion-scan gate — they must agree or the scan skip would
+        drop count accumulation while promotion is still possible."""
+        return min(self.MAX_DEV_SLOTS, self.capacity)
+
     def _promote_locked(self, row: int) -> None:
         """Assign a device slot (caller holds the buffer lock). A no-op
         at the slot limit — the key stays on the host tier (callers
-        re-read _slot_of and route accordingly). The limit is
-        MAX_DEV_SLOTS clamped to the CURRENT row capacity: slots beyond
-        the table's rows can never be assigned, and the clamp keeps the
-        growth ladder (and the per-flush estimate scan) sized to the
-        actual keyset instead of the HBM guard."""
-        limit = min(self.MAX_DEV_SLOTS, self.capacity)
-        if self._nslots >= limit:
+        re-read _slot_of and route accordingly)."""
+        if self._nslots >= self._slot_limit:
             return
         if self._nslots >= self._dev_cap:
             with self.apply_lock:
-                # 8x growth: every dev-cap size is a fresh shape
-                # specialization of the scatter/estimate kernels, and at
-                # promote-early policy the first interval climbs the
-                # whole ladder — 256->2048->16384->cap is 3 compiles
-                # where doubling was 8. When the clamp binds (capacity <
-                # MAX_DEV_SLOTS), dev-cap steps can track capacity
-                # doublings instead of the ladder — that costs no extra
-                # compile WAVES, because growing capacity re-lays-out
-                # every capacity-shaped kernel in the store anyway.
-                self._dev_cap = min(self._dev_cap * 8, limit)
+                # Device-cap growth stays ON THE 8x LADDER, bounded only
+                # by the HBM guard — never clamped to capacity: sparse
+                # _grow_arrays touches no device state, so a dev cap
+                # tracking capacity doublings would pay a fresh
+                # scatter/estimate shape compile per doubling on the
+                # live ingest path (blocking under apply_lock). Ladder
+                # shapes are <= 4 total; slots past the row capacity
+                # simply idle (<= 8x overshoot, <= the guard).
+                self._dev_cap = min(self._dev_cap * 8, self.MAX_DEV_SLOTS)
                 self.state = _pad_cap(self.state, self._dev_cap)
         self._slot_of[row] = self._nslots
         self._slot_row.append(row)
@@ -995,7 +998,7 @@ class SetTable(_BaseTable):
                 start += r.shape[0]
                 slots = self._slot_of[r]
                 cold = slots < 0
-                if self._nslots < min(self.MAX_DEV_SLOTS, self.capacity):
+                if self._nslots < self._slot_limit:
                     # (at the slot cap the promotion scan is a
                     # guaranteed no-op; skip its per-chunk cost)
                     self._counts += np.bincount(
