@@ -165,6 +165,49 @@ class TestFlushDeadline:
             hung.release.set()
             server.shutdown()
 
+    def test_hung_flush_other_samples_does_not_stall_flush(self):
+        """Events/service checks are delivered inside each sink's bounded
+        flush thread — a vendor events POST that hangs must cost only
+        that sink, never the flush loop (it used to run inline)."""
+        hung = HungMetricSink()
+        hung.flush_other_samples = lambda samples: hung.release.wait(30.0)
+        observer = ChannelMetricSink()
+        server = Server(_config(), extra_metric_sinks=[observer, hung])
+        try:
+            # an event (other-sample) plus a metric
+            server.handle_metric_packet(
+                b"_e{5,4}:title|text|#env:test")
+            server.handle_metric_packet(b"bound.ev:1|c")
+            t0 = time.time()
+            server.flush()
+            assert time.time() - t0 < server.interval + 3.0
+            got = {m.name for m in observer.wait_flush()}
+            assert "bound.ev" in got  # healthy sink still delivered
+        finally:
+            hung.release.set()
+
+    def test_other_samples_delivered_without_metrics(self):
+        """A flush with ONLY events (empty metric batch) still delivers
+        them to every sink (the sink threads must start for samples
+        alone)."""
+        delivered = []
+
+        class EventSink(ChannelMetricSink):
+            def flush_other_samples(self, samples):
+                delivered.extend(samples)
+
+        sink = EventSink()
+        server = Server(_config(), extra_metric_sinks=[sink])
+        try:
+            server.handle_metric_packet(b"_e{3,2}:abc|de|#k:v")
+            deadline = time.time() + 5
+            while not delivered and time.time() < deadline:
+                server.flush()
+                time.sleep(0.05)
+            assert delivered, "event never delivered"
+        finally:
+            server.shutdown()
+
     def test_flush_timeout_is_counted(self):
         hung = HungMetricSink()
         server = Server(_config(stats_address="internal"),

@@ -697,12 +697,10 @@ class Server:
 
         with self._other_lock:
             samples, self._other_samples = self._other_samples, []
-        for sink in self.metric_sinks:
-            try:
-                sink.flush_other_samples(samples)
-            except Exception:
-                logger.exception("sink %s flush_other_samples failed",
-                                 sink.name())
+        # events/service checks are delivered inside each sink's bounded
+        # flush thread below — flush_other_samples is a vendor network
+        # call (e.g. datadog events POST) and used to run inline here,
+        # where one hung endpoint stalled the whole flush loop
 
         # every per-sink flush (span and metric) runs in its own thread and
         # the whole pass is bounded by one interval — the reference's
@@ -754,11 +752,11 @@ class Server:
                     route.update(rule.route(metric.name, metric.tags))
                 metric.sinks = route
 
-        if len(batch):
+        if len(batch) or samples:
             for sink in self.metric_sinks:
                 _start_sink_thread(
                     f"metric:{sink.name()}", self._flush_sink_safe, sink,
-                    batch)
+                    batch, samples)
 
         # bounded wait: one interval from flush start, minus time already
         # spent; stragglers keep running on their daemon threads and are
@@ -866,7 +864,16 @@ class Server:
         except Exception:
             logger.exception("span sink %s flush failed", sink.name())
 
-    def _flush_sink_safe(self, sink, batch: FlushBatch) -> None:
+    def _flush_sink_safe(self, sink, batch: FlushBatch,
+                         other_samples=()) -> None:
+        if other_samples:
+            try:
+                sink.flush_other_samples(other_samples)
+            except Exception:
+                logger.exception("sink %s flush_other_samples failed",
+                                 sink.name())
+        if not len(batch):
+            return
         try:
             name = sink.name()
             sc = self._sink_filters.get(name)
