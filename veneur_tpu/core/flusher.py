@@ -375,10 +375,9 @@ def flush_columnstore_batch(
     if h_snap["export_packed"] is not None:
         handles.append(h_snap["export_packed"])
     jax.block_until_ready(handles)
-    c_vals, c_touched, c_meta = type(store.counters).snapshot_finish(c_snap)
-    g_vals, g_touched, g_meta = type(store.gauges).snapshot_finish(g_snap)
-    out, export, h_touched, h_meta = type(store.histos).snapshot_finish(
-        h_snap)
+    c_vals, c_touched, c_meta = store.counters.snapshot_finish(c_snap)
+    g_vals, g_touched, g_meta = store.gauges.snapshot_finish(g_snap)
+    out, export, h_touched, h_meta = store.histos.snapshot_finish(h_snap)
     t_sync = time.perf_counter()
 
     # ---- counters & gauges ---------------------------------------------
@@ -391,9 +390,10 @@ def flush_columnstore_batch(
             fwd_mask = table.scope_code[rows] == global_code
             if fwd_mask.any():
                 if collect_forward:
-                    for j in np.flatnonzero(fwd_mask).tolist():
-                        fwd_list.append((meta_list[int(rows[j])],
-                                         float(vals_sel[j])))
+                    fwd_list.extend(
+                        (meta_list[r], v)
+                        for r, v in zip(rows[fwd_mask].tolist(),
+                                        vals_sel[fwd_mask].tolist()))
                 keep = ~fwd_mask
                 rows, vals_sel = rows[keep], vals_sel[keep]
         if rows.size:
@@ -424,16 +424,17 @@ def flush_columnstore_batch(
                 for k in ("lmin", "lmax", "lsum", "lweight", "lrecip",
                           "min", "max", "sum", "count", "hmean")}
         quants = np.asarray(out["quantiles"], np.float64)[hr]
+        # one tag-cache pass for every histo section; sections slice it
+        tags_hr = htab.flush_tags(hr, h_meta)
 
         def agg_section(suffix, mask, values, mtype=MetricType.GAUGE):
             if not mask.any():
                 return
-            r = hr[mask]
             sections.append(FlushSection(
                 htab.flush_names(
-                    suffix, r, h_meta,
+                    suffix, hr[mask], h_meta,
                     lambda m, s=suffix: f"{m.name}.{s}"),
-                values[mask], htab.flush_tags(r, h_meta), mtype))
+                values[mask], tags_hr[mask], mtype))
 
         lmin, lmax = cols["lmin"], cols["lmax"]
         lsum, lweight, lrecip = cols["lsum"], cols["lweight"], cols["lrecip"]
@@ -468,7 +469,7 @@ def flush_columnstore_batch(
         if full_ps and emit_ps.any():
             pr = hr[emit_ps]
             pq = quants[emit_ps]
-            ptags = htab.flush_tags(pr, h_meta)
+            ptags = tags_hr[emit_ps]
             for p in full_ps:
                 sections.append(FlushSection(
                     htab.flush_names(
