@@ -11,6 +11,8 @@ not disagree with upb about metric COUNT."""
 from __future__ import annotations
 
 import numpy as np
+import os
+
 import pytest
 
 from veneur_tpu import native
@@ -21,7 +23,8 @@ from veneur_tpu.ops import batch_tdigest
 pytestmark = pytest.mark.skipif(not native.available(),
                                 reason="native library unavailable")
 
-ROUNDS = 400
+# FUZZ_ROUNDS=20000 (etc.) runs an extended soak; default keeps CI fast
+ROUNDS = int(os.environ.get("FUZZ_ROUNDS", "400"))
 
 
 def valid_body(rng) -> bytes:
