@@ -1,0 +1,121 @@
+#!/usr/bin/env python
+"""Static self-metric inventory check.
+
+Walks every ``statsd.count/gauge/timing`` call site in ``veneur_tpu/``
+(AST, not regex, so formatting never fools it) and fails if an emitted
+metric name is missing from the README's self-metric inventory table —
+the docs and the code can't silently drift apart.
+
+Literal names must appear verbatim in the table. Names built from
+f-strings (e.g. ``f"{prefix}.count"`` in util/grpcstats.py) are matched
+as patterns: each formatted field becomes a wildcard, and at least one
+documented name must match.
+
+Usage: python scripts/check_metric_names.py [--repo DIR]
+Exit codes: 0 ok, 1 undocumented metrics found, 2 could not parse docs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import pathlib
+import re
+import sys
+
+EMIT_METHODS = {"count", "gauge", "timing"}
+# receiver spellings that denote a ScopedClient self-metrics client
+STATSD_RECEIVERS = {"statsd", "stats", "stats_client", "_statsd"}
+
+DOC_SECTION = "Self-metric inventory"
+
+
+def statsd_receiver(node: ast.AST) -> bool:
+    """True when `node` is how the codebase spells its statsd client:
+    a bare name like `statsd`/`stats`, or `self.statsd` / `api.statsd`."""
+    if isinstance(node, ast.Name):
+        return node.id in STATSD_RECEIVERS
+    if isinstance(node, ast.Attribute):
+        return node.attr in STATSD_RECEIVERS
+    return False
+
+
+def emitted_names(root: pathlib.Path):
+    """Yield (path, lineno, name, is_pattern) per statsd emission."""
+    for path in sorted(root.rglob("*.py")):
+        if "protos" in path.parts:
+            continue
+        try:
+            tree = ast.parse(path.read_text(), filename=str(path))
+        except SyntaxError as e:
+            print(f"warning: could not parse {path}: {e}", file=sys.stderr)
+            continue
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in EMIT_METHODS
+                    and statsd_receiver(node.func.value)
+                    and node.args):
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                yield path, node.lineno, arg.value, False
+            elif isinstance(arg, ast.JoinedStr):
+                parts = []
+                for piece in arg.values:
+                    if isinstance(piece, ast.Constant):
+                        parts.append(re.escape(str(piece.value)))
+                    else:
+                        parts.append(r"[^|]+")
+                yield path, node.lineno, "".join(parts), True
+            # a bare variable name can't be resolved statically; the
+            # call site it was built at is already covered above
+
+
+def documented_names(readme: pathlib.Path):
+    """Backticked names from the README's self-metric inventory table."""
+    text = readme.read_text()
+    match = re.search(rf"^##+ .*{DOC_SECTION}.*?$(.*?)(?=^## |\Z)", text,
+                      re.MULTILINE | re.DOTALL)
+    if match is None:
+        return None
+    return set(re.findall(r"`([a-zA-Z0-9_.*]+)`", match.group(1)))
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--repo", default=None,
+                        help="repo root (default: this script's parent)")
+    args = parser.parse_args(argv)
+    repo = pathlib.Path(args.repo or pathlib.Path(__file__).parent.parent)
+
+    docs = documented_names(repo / "README.md")
+    if docs is None:
+        print(f"error: README.md has no '{DOC_SECTION}' section",
+              file=sys.stderr)
+        return 2
+
+    missing = []
+    checked = 0
+    for path, lineno, name, is_pattern in emitted_names(repo / "veneur_tpu"):
+        checked += 1
+        if is_pattern:
+            pat = re.compile(f"^{name}$")
+            if not any(pat.match(doc) for doc in docs):
+                missing.append((path, lineno, f"<pattern> {name}"))
+        elif name not in docs:
+            missing.append((path, lineno, name))
+
+    if missing:
+        print(f"{len(missing)} emitted self-metric(s) missing from the "
+              f"README '{DOC_SECTION}' table:")
+        for path, lineno, name in missing:
+            print(f"  {path.relative_to(repo)}:{lineno}  {name}")
+        return 1
+    print(f"ok: {checked} statsd call sites, all documented "
+          f"({len(docs)} names in the table)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

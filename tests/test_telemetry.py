@@ -1,0 +1,522 @@
+"""Pull-side self-telemetry tests: the internal registry, Prometheus
+exposition, the flight recorder (/debug/events), the flush-round table
+(/debug/flush), per-sink flush-outcome recording, and the metric-name
+inventory lint (scripts/check_metric_names.py)."""
+
+import json
+import pathlib
+import re
+import sys
+import threading
+import time
+
+import pytest
+
+from veneur_tpu.core import telemetry
+from veneur_tpu.core.telemetry import (
+    HISTOGRAM_BOUNDS, EventRecorder, FlushRecorder, Registry, Telemetry,
+    prom_labels, prom_name,
+)
+from veneur_tpu.sinks import MetricSink
+from veneur_tpu.util import http as vhttp
+from veneur_tpu.util.scopedstatsd import NullClient, ScopedClient
+
+from test_server import generate_config, setup_server
+
+# every exposition line is a comment or name{labels} value
+_EXPO_LINE = re.compile(
+    r"^(# (TYPE|HELP) [a-zA-Z_:][a-zA-Z0-9_:]* ?.*"
+    r"|[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? -?[0-9].*"
+    r"|[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? [+-]?(Inf|NaN).*)$")
+
+
+def assert_valid_exposition(text: str) -> None:
+    assert text.endswith("\n")
+    for line in text.rstrip("\n").split("\n"):
+        assert _EXPO_LINE.match(line), f"bad exposition line: {line!r}"
+
+
+class TestRegistry:
+    def test_counter_gauge_histogram(self):
+        reg = Registry()
+        reg.count("hits", 2)
+        reg.count("hits", 3)
+        reg.gauge("level", 1.0)
+        reg.gauge("level", 7.5)  # last write wins
+        reg.observe("latency", 0.003)
+        snap = reg.snapshot()
+        assert snap["counters"]["hits"] == 5
+        assert snap["gauges"]["level"] == 7.5
+        assert snap["histograms"]["latency"] == 1
+
+    def test_statsd_tee_semantics(self):
+        reg = Registry()
+        reg.record_statsd("c", 1, "c", [], 0.1)   # sampled: scaled 1/rate
+        reg.record_statsd("g", 4.2, "g", ["a:b"], 1.0)
+        reg.record_statsd("t", 250.0, "ms", [], 1.0)  # ms in, seconds kept
+        snap = reg.snapshot()
+        assert snap["counters"]["c"] == pytest.approx(10.0)
+        assert snap["gauges"]["g|a:b"] == 4.2
+        rendered = reg.render_prometheus()
+        # 250ms lands in the (0.2, 0.5] bucket: cumulative count at
+        # le=0.5 is 1 while le=0.2 is still 0
+        assert 'veneur_t_bucket{le="0.2"} 0' in rendered
+        assert 'veneur_t_bucket{le="0.5"} 1' in rendered
+        assert "veneur_t_sum 0.25" in rendered
+        assert "veneur_t_count 1" in rendered
+
+    def test_series_cap_bounds_memory(self):
+        reg = Registry(max_series=10)
+        for i in range(1000):
+            reg.count(f"metric.{i}")
+        snap = reg.snapshot()
+        assert len(snap["counters"]) == 10
+        assert snap["series_dropped"] == 990
+        # existing series still update at the cap
+        reg.count("metric.0", 5)
+        assert reg.snapshot()["counters"]["metric.0"] == 6
+        assert "veneur_telemetry_series_dropped 990" in \
+            reg.render_prometheus()
+
+    def test_histogram_bins_are_fixed(self):
+        reg = Registry()
+        for i in range(10_000):
+            reg.observe("lat", (i % 700) * 0.01)
+        (key, hist), = reg._histograms.items()
+        assert len(hist.buckets) == len(HISTOGRAM_BOUNDS) + 1
+        assert hist.count == 10_000
+
+    def test_collectors_render_fresh(self):
+        reg = Registry()
+        live = {"n": 0}
+        reg.add_collector(lambda: [("live.counter", "counter",
+                                    float(live["n"]), ())])
+        live["n"] = 3
+        assert "veneur_live_counter_total 3" in reg.render_prometheus()
+        live["n"] = 8
+        assert "veneur_live_counter_total 8" in reg.render_prometheus()
+
+    def test_broken_collector_is_skipped(self):
+        reg = Registry()
+        reg.add_collector(lambda: 1 / 0)
+        reg.gauge("ok", 1)
+        assert "veneur_ok 1" in reg.render_prometheus()
+
+
+class TestPromFormat:
+    def test_name_sanitization(self):
+        assert prom_name("flush.total_duration_ns") == \
+            "veneur_flush_total_duration_ns"
+        assert prom_name("a-b.c d", "counter") == "veneur_a_b_c_d_total"
+        assert prom_name("worker.metrics_processed_total", "counter") == \
+            "veneur_worker_metrics_processed_total"
+        assert prom_name("1weird") == "veneur__1weird"
+
+    def test_label_escaping(self):
+        labels = prom_labels(['path:a\\b', 'msg:say "hi"\nok', 'bareflag'])
+        assert 'path="a\\\\b"' in labels
+        assert 'msg="say \\"hi\\"\\nok"' in labels
+        assert 'tag="bareflag"' in labels
+        assert prom_labels([]) == ""
+        # label keys are sanitized too
+        assert prom_labels(["bad-key:v"]) == '{bad_key="v"}'
+
+    def test_exposition_is_structurally_valid(self):
+        reg = Registry()
+        reg.count("a.total", 2, ["k:v"])
+        reg.gauge("b.value", -1.5)
+        reg.observe("c.lat", 0.42, ["sink:x", "status:ok"])
+        text = reg.render_prometheus()
+        assert_valid_exposition(text)
+        assert "# TYPE veneur_a_total counter" in text
+        assert "# TYPE veneur_b_value gauge" in text
+        assert "# TYPE veneur_c_lat histogram" in text
+        assert 'veneur_c_lat_bucket{sink="x",status="ok",le="+Inf"} 1' \
+            in text
+
+
+class TestScopedClientTee:
+    def test_scoped_client_tees_into_registry(self):
+        reg = Registry()
+        packets = []
+        client = ScopedClient(packet_cb=packets.append, registry=reg,
+                              additional_tags=["svc:veneur"])
+        client.count("c", 2, tags=["x:y"])
+        client.gauge("g", 1.5)
+        client.timing("t", 0.125)
+        assert packets  # push side unchanged
+        snap = reg.snapshot()
+        # registry keeps the caller's tags, not additional/scope tags
+        assert snap["counters"]["c|x:y"] == 2
+        assert snap["gauges"]["g"] == 1.5
+        assert snap["histograms"]["t"] == 1
+
+    def test_null_client_still_captures(self):
+        reg = Registry()
+        client = NullClient(registry=reg)
+        client.count("dropped.push", 7)
+        assert reg.snapshot()["counters"]["dropped.push"] == 7
+
+
+class TestEventRecorder:
+    def test_ring_bounds_under_soak(self):
+        rec = EventRecorder(capacity=128)
+        for i in range(10_000):
+            rec.record("tick", i=i)
+        assert len(rec) == 128
+        events = rec.snapshot()
+        assert len(events) == 128
+        assert rec.total_recorded == 10_000
+        # newest-last, oldest dropped, seq contiguous across the wrap
+        assert events[-1]["i"] == 9_999
+        assert events[0]["seq"] == 10_000 - 128 + 1
+        assert [e["seq"] for e in events] == \
+            list(range(9_873, 10_001))
+
+    def test_snapshot_limit(self):
+        rec = EventRecorder(capacity=16)
+        for i in range(5):
+            rec.record("e", i=i)
+        assert [e["i"] for e in rec.snapshot(limit=2)] == [3, 4]
+
+    def test_concurrent_recording_stays_bounded(self):
+        rec = EventRecorder(capacity=64)
+
+        def pound():
+            for i in range(2_000):
+                rec.record("x", i=i)
+
+        threads = [threading.Thread(target=pound) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(rec) == 64
+        assert rec.total_recorded == 8_000
+
+
+class TestFlushRecorder:
+    def test_bounded_rounds(self):
+        rec = FlushRecorder(capacity=8)
+        for i in range(100):
+            rec.record({"flush": i, "sinks": {}})
+        rounds = rec.snapshot()
+        assert len(rounds) == 8
+        assert rounds[-1]["flush"] == 99
+
+    def test_late_sink_outcome_lands(self):
+        rec = FlushRecorder(capacity=4)
+        outcome = {"status": "timed_out"}
+        rec.record({"flush": 1, "sinks": {"metric:slow": outcome}})
+        outcome["status"] = "ok"
+        outcome["late"] = True
+        got = rec.snapshot()[0]["sinks"]["metric:slow"]
+        assert got["status"] == "ok" and got["late"] is True
+
+
+class FailingSink(MetricSink):
+    def name(self):
+        return "failing"
+
+    def kind(self):
+        return "failing"
+
+    def flush(self, metrics):
+        raise RuntimeError("deliberate sink failure")
+
+
+class BlockingSink(MetricSink):
+    def __init__(self):
+        self.release = threading.Event()
+        self.entered = threading.Event()
+
+    def name(self):
+        return "blocking"
+
+    def kind(self):
+        return "blocking"
+
+    def flush(self, metrics):
+        self.entered.set()
+        self.release.wait(10.0)
+
+
+class TestFlushOutcomeRecording:
+    def test_ok_round_with_phases(self):
+        server, observer = setup_server()
+        server.handle_metric_packet(b"a.total:5|c")
+        server.flush()
+        rounds = server.telemetry.flushes.snapshot()
+        assert len(rounds) == 1
+        rnd = rounds[0]
+        assert rnd["flush"] == 1
+        assert rnd["metrics_flushed"] >= 1
+        for phase in ("store_flush_s", "preflush_s", "sink_join_s"):
+            assert phase in rnd["phases"]
+        chan = rnd["sinks"]["metric:channel"]
+        assert chan["status"] == "ok"
+        assert chan["duration_s"] >= 0.0
+        kinds = {e["kind"] for e in server.telemetry.events.snapshot()}
+        assert "flush" in kinds
+
+    def test_failed_sink_flush_is_recorded(self):
+        server, observer = setup_server()
+        server.metric_sinks.append(FailingSink())
+        server.handle_metric_packet(b"a.total:5|c")
+        server.flush()
+        rnd = server.telemetry.flushes.snapshot()[-1]
+        assert rnd["sinks"]["metric:failing"]["status"] == "error"
+        assert rnd["sinks"]["metric:channel"]["status"] == "ok"
+        errors = [e for e in server.telemetry.events.snapshot()
+                  if e["kind"] == "sink_error"]
+        assert errors and errors[0]["sink"] == "metric:failing"
+        # the per-sink duration self-metric carries the error status
+        snap = server.telemetry.registry.snapshot()
+        assert any(k.startswith("flush.sink_duration|")
+                   and "status:error" in k and "sink:metric:failing" in k
+                   for k in snap["histograms"])
+
+    def test_timed_out_then_skipped_then_late(self):
+        blocking = BlockingSink()
+        server, observer = setup_server()
+        server.metric_sinks.append(blocking)
+        try:
+            server.handle_metric_packet(b"a.total:1|c")
+            server.flush()  # blocks until the 0.2s interval deadline
+            assert blocking.entered.wait(5.0)
+            rnd1 = server.telemetry.flushes.snapshot()[-1]
+            assert rnd1["sinks"]["metric:blocking"]["status"] == "timed_out"
+            kinds = {e["kind"] for e in server.telemetry.events.snapshot()}
+            assert "sink_timeout" in kinds
+
+            # next round: the previous flush thread is still alive, so
+            # the sink is skipped (its own data, not the flush loop's)
+            server.handle_metric_packet(b"a.total:1|c")
+            server.flush()
+            rnd2 = server.telemetry.flushes.snapshot()[-1]
+            assert rnd2["sinks"]["metric:blocking"]["status"] == "skipped"
+            kinds = {e["kind"] for e in server.telemetry.events.snapshot()}
+            assert "sink_skipped" in kinds
+        finally:
+            blocking.release.set()
+        # the straggler finally lands its real outcome, flagged late
+        thread = server._sink_flush_threads["metric:blocking"]
+        thread.join(5.0)
+        rnd1 = server.telemetry.flushes.snapshot()[0]
+        assert rnd1["sinks"]["metric:blocking"]["status"] == "ok"
+        assert rnd1["sinks"]["metric:blocking"]["late"] is True
+
+
+def api_url(api, path):
+    host, port = api.address
+    return f"http://{host}:{port}{path}"
+
+
+class TestPullEndpoints:
+    def test_metrics_events_flush_routes(self):
+        server, observer = setup_server(http_address="127.0.0.1:0")
+        server.metric_sinks.append(FailingSink())
+        server.start()
+        try:
+            for i in range(10):
+                server.handle_metric_packet(b"req.count:1|c")
+            server.flush()
+            status, body = vhttp.get(api_url(server.http_api, "/metrics"))
+            assert status == 200
+            text = body.decode()
+            assert_valid_exposition(text)
+            # live ingest counters, scrape-time fresh
+            assert re.search(
+                r"^veneur_ingest_packets_received_total 1[0-9]*$",
+                text, re.M)
+            # flush phase timings + per-sink durations from the tee
+            assert "# TYPE veneur_flush_phase_duration histogram" in text
+            assert 'phase="store_flush_s"' in text
+            assert re.search(
+                r'veneur_flush_sink_duration_count\{sink="metric:channel",'
+                r'status="ok"\} [1-9]', text)
+            assert "veneur_flush_rounds_total" in text
+
+            status, body = vhttp.get(
+                api_url(server.http_api, "/debug/events"))
+            assert status == 200
+            events = json.loads(body)["events"]
+            kinds = [e["kind"] for e in events]
+            assert "startup" in kinds and "flush" in kinds
+            # the most recent flush round replays, including the
+            # deliberately-failed sink flush
+            flush_events = [e for e in events if e["kind"] == "flush"]
+            assert flush_events[-1]["sinks"]["metric:failing"] == "error"
+            assert any(e["kind"] == "sink_error"
+                       and e["sink"] == "metric:failing" for e in events)
+
+            status, body = vhttp.get(
+                api_url(server.http_api, "/debug/flush?n=5"))
+            assert status == 200
+            rounds = json.loads(body)["rounds"]
+            assert rounds and "phases" in rounds[-1]
+            assert rounds[-1]["sinks"]["metric:failing"]["status"] == \
+                "error"
+        finally:
+            server.shutdown()
+
+    def test_standalone_api_serves_metrics(self):
+        # proxy-style: no server object, private telemetry
+        from veneur_tpu.core.httpapi import HTTPApi
+        api = HTTPApi(generate_config(), server=None,
+                      address="127.0.0.1:0")
+        api.start()
+        try:
+            status, body = vhttp.get(api_url(api, "/metrics"))
+            assert status == 200
+            assert_valid_exposition(body.decode())
+            status, body = vhttp.get(api_url(api, "/debug/events"))
+            assert status == 200 and json.loads(body)["events"] == []
+        finally:
+            api.stop()
+
+    def test_device_memory_rows_shape(self):
+        rows = telemetry.device_memory_rows()
+        # CPU devices report no memory stats; on TPU each row must be a
+        # well-formed gauge with device+platform tags
+        assert isinstance(rows, list)
+        for name, kind, value, tags in rows:
+            assert name.startswith("device.") and kind == "gauge"
+            assert any(t.startswith("device:") for t in tags)
+
+    def test_device_rows_render_via_collector(self):
+        # exercise the scrape-time device-gauge path with fabricated
+        # rows (CPU backends return no memory_stats)
+        tel = Telemetry()
+        tel.registry.add_collector(lambda: [
+            ("device.bytes_in_use", "gauge", 123456.0,
+             ["device:0", "platform:tpu"]),
+            ("device.bytes_limit", "gauge", 1 << 30,
+             ["device:0", "platform:tpu"]),
+        ])
+        text = tel.registry.render_prometheus()
+        assert_valid_exposition(text)
+        assert ('veneur_device_bytes_in_use'
+                '{device="0",platform="tpu"} 123456') in text
+
+
+class TestRegistrySoakBounded:
+    def test_10k_event_soak_memory_bounded(self):
+        """Acceptance: registry memory stays bounded (ring buffer +
+        capped histogram bins) under a 10k-event soak."""
+        tel = Telemetry(max_series=256, event_capacity=512)
+        for i in range(10_000):
+            tel.record_event("soak", i=i)
+            tel.registry.count(f"soak.counter.{i % 1000}")
+            tel.registry.observe("soak.latency", (i % 100) * 0.001,
+                                 tags=[f"shard:{i % 50}"])
+            tel.flushes.record({"flush": i, "sinks": {}})
+        assert len(tel.events) == 512
+        assert len(tel.flushes) == 64
+        reg = tel.registry
+        assert reg._series_count() <= 256
+        assert reg.series_dropped > 0
+        # every histogram series holds the same fixed bin count
+        for hist in reg._histograms.values():
+            assert len(hist.buckets) == len(HISTOGRAM_BOUNDS) + 1
+        # the whole thing still renders
+        assert_valid_exposition(reg.render_prometheus())
+
+
+class TestDiagnosticsSatellites:
+    def test_uptime_counts_interval_delta(self):
+        from veneur_tpu.core.diagnostics import collect
+        calls = []
+
+        class FakeStatsd:
+            def gauge(self, name, value, tags=None):
+                calls.append((name, value))
+
+            def count(self, name, value, tags=None):
+                calls.append((name, value))
+
+        start = time.time() - 5.0
+        tick = collect(FakeStatsd(), start, include_device=False)
+        first = dict(calls)["uptime_ms"]
+        assert first >= 5000  # first tick: since start
+        calls.clear()
+        time.sleep(0.05)
+        collect(FakeStatsd(), start, include_device=False, last_tick=tick)
+        second = dict(calls)["uptime_ms"]
+        # delta since the previous tick, NOT the total again
+        assert 40 <= second < 2000
+
+    def test_rss_current_and_peak(self):
+        from veneur_tpu.core.diagnostics import collect
+        calls = []
+
+        class FakeStatsd:
+            def gauge(self, name, value, tags=None):
+                calls.append((name, value))
+
+            def count(self, name, value, tags=None):
+                calls.append((name, value))
+
+        collect(FakeStatsd(), time.time(), include_device=False)
+        by = dict(calls)
+        assert by["mem.rss_bytes"] > 0
+        assert by["mem.max_rss_bytes"] > 0
+        # current RSS can't exceed the high-water mark
+        assert by["mem.rss_bytes"] <= by["mem.max_rss_bytes"]
+
+    def test_loop_logs_failures_rate_limited(self, caplog):
+        from veneur_tpu.core.diagnostics import DiagnosticsLoop
+
+        class Exploding:
+            def gauge(self, *a, **kw):
+                raise RuntimeError("collector down")
+
+            def count(self, *a, **kw):
+                raise RuntimeError("collector down")
+
+        loop = DiagnosticsLoop(Exploding(), interval=0.01,
+                               include_device=False)
+        with caplog.at_level("ERROR", logger="veneur_tpu.diagnostics"):
+            loop.start()
+            time.sleep(0.25)
+            loop.stop()
+        assert loop.errors >= 3  # kept failing, kept running
+        records = [r for r in caplog.records
+                   if "diagnostics collection failed" in r.message]
+        assert len(records) == 1  # rate-limited to one log per window
+
+
+class TestMetricNameLint:
+    def _run(self, argv):
+        sys.path.insert(0, str(pathlib.Path(__file__).parent.parent
+                               / "scripts"))
+        try:
+            import check_metric_names
+            return check_metric_names.main(argv)
+        finally:
+            sys.path.pop(0)
+
+    def test_repo_inventory_is_complete(self, capsys):
+        assert self._run([]) == 0
+        assert "all documented" in capsys.readouterr().out
+
+    def test_undocumented_metric_fails(self, tmp_path, capsys):
+        pkg = tmp_path / "veneur_tpu"
+        pkg.mkdir()
+        (pkg / "mod.py").write_text(
+            "def f(statsd):\n"
+            "    statsd.count('documented.metric', 1)\n"
+            "    statsd.gauge('undocumented.metric', 2)\n")
+        (tmp_path / "README.md").write_text(
+            "## Self-metric inventory\n\n"
+            "| `documented.metric` | count |\n")
+        assert self._run(["--repo", str(tmp_path)]) == 1
+        out = capsys.readouterr().out
+        assert "undocumented.metric" in out
+        assert "documented.metric" not in \
+            out.replace("undocumented.metric", "")
+
+    def test_missing_docs_section_fails(self, tmp_path):
+        (tmp_path / "veneur_tpu").mkdir()
+        (tmp_path / "README.md").write_text("# nothing here\n")
+        assert self._run(["--repo", str(tmp_path)]) == 2

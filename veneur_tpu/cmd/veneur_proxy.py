@@ -170,13 +170,18 @@ def build_from_config(raw: dict, args, log):
              destinations)
 
     # self-telemetry, reference cmd/veneur-proxy/main.go:64-90: RPC
-    # aggregates + runtime gauges to the configured statsd address
+    # aggregates + runtime gauges to the configured statsd address, teed
+    # into a pull-side registry the proxy's /metrics serves
+    from veneur_tpu.core.telemetry import Telemetry, device_memory_rows
+    telemetry = Telemetry()
+    telemetry.registry.add_collector(device_memory_rows)
     stats_loop = None
     statsd_cfg = raw.get("statsd") or {}
     if statsd_cfg.get("address"):
         from veneur_tpu.core.diagnostics import DiagnosticsLoop
         from veneur_tpu.util.scopedstatsd import ScopedClient
-        stats_client = ScopedClient(address=statsd_cfg["address"])
+        stats_client = ScopedClient(address=statsd_cfg["address"],
+                                    registry=telemetry.registry)
         stats_loop = DiagnosticsLoop(
             stats_client,
             interval=parse_duration(
@@ -189,7 +194,8 @@ def build_from_config(raw: dict, args, log):
     http_addr = raw.get("http_address", args.http)
     if http_addr:
         from veneur_tpu.core.httpapi import HTTPApi
-        http_api = HTTPApi(raw, server=None, address=http_addr)
+        http_api = HTTPApi(raw, server=None, address=http_addr,
+                           telemetry=telemetry)
         http_api.start()
 
     return proxy, stats_loop, http_api

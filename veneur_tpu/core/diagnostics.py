@@ -2,27 +2,58 @@
 
 Behavioral parity with reference diagnostics/diagnostics_metrics.go:11-40
 (periodic Go memstats -> statsd gauges + uptime counter), translated to
-the Python/JAX runtime: RSS and CPU from `resource`, GC stats from `gc`,
-thread count, uptime, and per-device TPU/accelerator memory from
-`jax.Device.memory_stats()`.
+the Python/JAX runtime: RSS and CPU from `/proc` + `resource`, GC stats
+from `gc`, thread count, uptime, and per-device TPU/accelerator memory
+from `jax.Device.memory_stats()`.
 """
 
 from __future__ import annotations
 
 import gc
+import logging
+import os
+import sys
 import threading
 import time
 from typing import Callable, Optional
 
 from veneur_tpu.util.scopedstatsd import ScopedClient
 
+logger = logging.getLogger("veneur_tpu.diagnostics")
+
+_PAGE_SIZE = os.sysconf("SC_PAGE_SIZE") if hasattr(os, "sysconf") else 4096
+
+# getrusage reports ru_maxrss in kilobytes on Linux/BSD but bytes on macOS
+_RU_MAXRSS_SCALE = 1 if sys.platform == "darwin" else 1024
+
+
+def _current_rss_bytes() -> Optional[int]:
+    """Current resident set from /proc/self/statm (field 2, pages).
+    Returns None off Linux; the caller falls back to the rusage peak."""
+    try:
+        with open("/proc/self/statm", "rb") as f:
+            return int(f.read().split()[1]) * _PAGE_SIZE
+    except (OSError, IndexError, ValueError):
+        return None
+
 
 def collect(stats: ScopedClient, start_time: float,
-            include_device: bool = True) -> None:
-    """Emit one round of runtime gauges."""
+            include_device: bool = True,
+            last_tick: Optional[float] = None) -> float:
+    """Emit one round of runtime gauges. Returns the tick time so the
+    loop can thread it back in as `last_tick` — uptime_ms counts only
+    the interval delta (reference diagnostics_metrics.go counts the
+    interval, not the total; summing totals grows quadratically)."""
     import resource
+    now = time.time()
     ru = resource.getrusage(resource.RUSAGE_SELF)
-    stats.gauge("mem.rss_bytes", ru.ru_maxrss * 1024)
+    # ru_maxrss is the PEAK high-water mark, not the current footprint;
+    # report it under its own name and the live value from /proc
+    rss = _current_rss_bytes()
+    stats.gauge("mem.rss_bytes",
+                rss if rss is not None
+                else ru.ru_maxrss * _RU_MAXRSS_SCALE)
+    stats.gauge("mem.max_rss_bytes", ru.ru_maxrss * _RU_MAXRSS_SCALE)
     stats.gauge("cpu.user_seconds", ru.ru_utime)
     stats.gauge("cpu.system_seconds", ru.ru_stime)
     counts = gc.get_count()
@@ -35,7 +66,8 @@ def collect(stats: ScopedClient, start_time: float,
     stats.gauge("gc.collected_total",
                 sum(g["collected"] for g in gen_stats))
     stats.gauge("threads.count", threading.active_count())
-    stats.count("uptime_ms", int((time.time() - start_time) * 1000))
+    since = now - (last_tick if last_tick is not None else start_time)
+    stats.count("uptime_ms", int(max(since, 0.0) * 1000))
     if include_device:
         try:
             import jax
@@ -43,14 +75,22 @@ def collect(stats: ScopedClient, start_time: float,
                 ms = d.memory_stats() or {}
                 in_use = ms.get("bytes_in_use")
                 if in_use is not None:
+                    # same tag set as telemetry.device_memory_rows so the
+                    # scrape-time collector overwrites this teed value on
+                    # /metrics instead of duplicating the series
                     stats.gauge("device.bytes_in_use", in_use,
-                                tags=[f"device:{i}"])
+                                tags=[f"device:{i}",
+                                      f"platform:{d.platform}"])
         except Exception:
             pass
+    return now
 
 
 class DiagnosticsLoop:
     """Emits `collect` every interval on a daemon thread."""
+
+    # a persistently failing collector logs once per this many seconds
+    ERROR_LOG_INTERVAL_S = 60.0
 
     def __init__(self, stats: ScopedClient, interval: float,
                  include_device: bool = True,
@@ -60,6 +100,8 @@ class DiagnosticsLoop:
         self.include_device = include_device
         self.extra = extra  # e.g. the proxy's per-interval RPC aggregates
         self.start_time = time.time()
+        self.errors = 0
+        self._last_error_log = 0.0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -69,13 +111,24 @@ class DiagnosticsLoop:
         self._thread.start()
 
     def _loop(self) -> None:
+        last_tick: Optional[float] = None
         while not self._stop.wait(self.interval):
             try:
-                collect(self.stats, self.start_time, self.include_device)
+                last_tick = collect(self.stats, self.start_time,
+                                    self.include_device,
+                                    last_tick=last_tick)
                 if self.extra is not None:
                     self.extra()
             except Exception:
-                pass
+                # rate-limited: a collector that fails every interval
+                # stays visible without flooding the log
+                self.errors += 1
+                now = time.monotonic()
+                if now - self._last_error_log >= self.ERROR_LOG_INTERVAL_S:
+                    self._last_error_log = now
+                    logger.exception(
+                        "diagnostics collection failed (%d failures so "
+                        "far)", self.errors)
 
     def stop(self) -> None:
         self._stop.set()

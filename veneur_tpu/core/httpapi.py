@@ -5,6 +5,12 @@ Endpoint parity with reference http.go:15-65: /healthcheck, /version,
 util.StringSecret), and optional /quitquitquit (config.http_quit).
 Runs a stdlib ThreadingHTTPServer; profiling endpoints are served under
 /debug/ (JAX device memory stats in place of Go pprof heap profiles).
+
+Pull-side self-telemetry (core/telemetry.py) is served at:
+  GET /metrics       Prometheus text exposition of every self-metric
+                     plus per-device HBM gauges
+  GET /debug/events  the event flight recorder (ring buffer, ?n=N)
+  GET /debug/flush   the last N flush rounds with per-sink latency
 """
 
 from __future__ import annotations
@@ -83,6 +89,18 @@ class _Handler(BaseHTTPRequestHandler):
         elif path == "/config/yaml":
             body = yaml.safe_dump(config_to_dict(api.config)).encode()
             self._send(200, body, "application/x-yaml")
+        elif path == "/metrics":
+            body = api.telemetry.registry.render_prometheus().encode()
+            self._send(200, body,
+                       "text/plain; version=0.0.4; charset=utf-8")
+        elif path == "/debug/events":
+            limit = int(_query_float(self.path, "n", 0.0, max_value=1e6))
+            self._send(200, api.telemetry.events_json(limit),
+                       "application/json")
+        elif path == "/debug/flush":
+            limit = int(_query_float(self.path, "n", 0.0, max_value=1e6))
+            self._send(200, api.telemetry.flushes_json(limit),
+                       "application/json")
         elif path == "/debug/memory":
             self._send(200, _device_memory_report(),
                        "application/json")
@@ -177,7 +195,10 @@ class _Handler(BaseHTTPRequestHandler):
                 b"  /debug/profile/cpu?seconds=N    text CPU profile\n"
                 b"  /debug/profile/device?seconds=N xprof device trace\n"
                 b"  /debug/memory                   device memory JSON\n"
-                b"  /debug/threads                  all-thread stacks\n"))
+                b"  /debug/threads                  all-thread stacks\n"
+                b"  /debug/events?n=N               event flight recorder\n"
+                b"  /debug/flush?n=N                recent flush rounds\n"
+                b"  /metrics                        Prometheus exposition\n"))
         elif path == "/debug/profile/device":
             # jax.profiler trace (TensorBoard-loadable zip) — the TPU
             # analog of /debug/pprof/profile (reference http.go:53-63)
@@ -252,12 +273,24 @@ class HTTPApi:
 
     def __init__(self, config, server=None, address: str = "127.0.0.1:0",
                  http_quit: bool = False, on_quit=None,
-                 require_flush_for_ready: bool = False):
+                 require_flush_for_ready: bool = False, telemetry=None):
         self.config = config
         self.server = server
         self.http_quit = http_quit
         self.on_quit = on_quit
         self.require_flush_for_ready = require_flush_for_ready
+        # /metrics & the flight recorder serve the owning server's
+        # telemetry; a standalone API (proxy passes its own, tests pass
+        # none) gets a private registry so the routes always answer —
+        # device HBM gauges still render fresh at scrape time
+        if telemetry is None:
+            telemetry = getattr(server, "telemetry", None)
+        if telemetry is None:
+            from veneur_tpu.core import telemetry as telemetry_mod
+            telemetry = telemetry_mod.Telemetry()
+            telemetry.registry.add_collector(
+                telemetry_mod.device_memory_rows)
+        self.telemetry = telemetry
         host, _, port = address.rpartition(":")
         handler = type("BoundHandler", (_Handler,), {"server_ref": self})
 

@@ -220,6 +220,15 @@ class Server:
         self.import_server = None  # set in start() when grpc_address
         self.grpc_ingest_servers: List = []  # per grpc_listen_addresses
 
+        # pull-side telemetry: every statsd emission below tees into this
+        # registry, and the HTTP API serves it (/metrics, /debug/events,
+        # /debug/flush) — the expvar/flight-recorder side of the loop
+        from veneur_tpu.core import telemetry as telemetry_mod
+        self.telemetry = telemetry_mod.Telemetry()
+        self.telemetry.registry.add_collector(self._live_telemetry_rows)
+        self.telemetry.registry.add_collector(
+            telemetry_mod.device_memory_rows)
+
         # self-metrics: UDP to stats_address, or internal loopback so they
         # re-enter this server's own pipeline (reference scopedstatsd +
         # NewChannelClient server.go:518-524)
@@ -229,14 +238,16 @@ class Server:
             self.statsd = ScopedClient(
                 packet_cb=self._self_packet,
                 scopes=config.veneur_metrics_scopes,
-                additional_tags=config.veneur_metrics_additional_tags)
+                additional_tags=config.veneur_metrics_additional_tags,
+                registry=self.telemetry.registry)
         elif config.stats_address:
             self.statsd = ScopedClient(
                 address=config.stats_address,
                 scopes=config.veneur_metrics_scopes,
-                additional_tags=config.veneur_metrics_additional_tags)
+                additional_tags=config.veneur_metrics_additional_tags,
+                registry=self.telemetry.registry)
         else:
-            self.statsd = NullClient()
+            self.statsd = NullClient(registry=self.telemetry.registry)
 
         # self-tracing: every flush is a span through the internal channel
         # client into our own span pipeline (reference flusher.go:27-28)
@@ -336,6 +347,26 @@ class Server:
             self.parser.parse_metric_fast(packet, self.ingest_metric)
         except ParseError:
             pass
+
+    def _live_telemetry_rows(self):
+        """Scrape-time /metrics rows for live counters the registry does
+        not own: the locked ingest counters (which otherwise surface only
+        as per-flush gauges) and span-pipeline drop totals."""
+        rows = [(key if key.startswith("ingest") else f"ingest.{key}",
+                 "counter", float(value), ())
+                for key, value in self.stats.items()]
+        rows.append(("ingest.spans_dropped", "counter",
+                     float(self.spans_dropped), ()))
+        for worker in self._span_sink_workers:
+            tags = [f"sink:{worker.sink.name()}"]
+            rows.append(("ingest.span_sink_dropped", "counter",
+                         float(worker.dropped), tags))
+            rows.append(("ingest.span_sink_ingested", "counter",
+                         float(worker.ingested), tags))
+        rows.append(("flush.rounds", "counter", float(self.flush_count), ()))
+        rows.append(("flush.last_unix_seconds", "gauge",
+                     self.last_flush_unix, ()))
+        return rows
 
     # -- spans -----------------------------------------------------------
 
@@ -560,6 +591,9 @@ class Server:
         # listener bound, so a wedged startup never wins a handoff
         from veneur_tpu.core import restart
         restart.mark_ready()
+        self.telemetry.record_event(
+            "startup", pid=os.getpid(),
+            mode="local" if self.is_local else "global")
 
     def local_addr(self, scheme: str = "udp"):
         for listener in self._listeners:
@@ -568,6 +602,7 @@ class Server:
         return None
 
     def shutdown(self) -> None:
+        self.telemetry.record_event("shutdown", pid=os.getpid())
         self._shutdown.set()
         # stop pull sources first (bound-join) so an in-flight scrape
         # can't ingest after the final flush below
@@ -643,9 +678,15 @@ class Server:
         """Die loudly if flushes stall (reference server.go:877-919)."""
         allowed = self.config.flush_watchdog_missed_flushes * self.interval
         while not self._shutdown.wait(self.interval):
-            if time.time() - self.last_flush_unix > allowed:
+            since = time.time() - self.last_flush_unix
+            self.telemetry.record_event(
+                "watchdog_tick", since_last_flush_s=round(since, 3),
+                allowed_s=allowed)
+            if since > allowed:
                 logger.critical(
                     "flush watchdog: no flush for %ds; aborting", allowed)
+                self.telemetry.record_event(
+                    "watchdog_abort", since_last_flush_s=round(since, 3))
                 import faulthandler
                 import os
                 faulthandler.dump_traceback(all_threads=True)
@@ -707,8 +748,15 @@ class Server:
         # context deadline (server.go:869, flusher.go:553-566). A sink
         # whose previous flush is still running is skipped this interval,
         # so a hung sink costs its own data, never the flush loop or
-        # another sink's.
+        # another sink's. Each sink's outcome (duration, error, skipped,
+        # timed-out) lands in this round's flight-recorder entry.
         threads: List[threading.Thread] = []
+        round_info = {
+            "flush": self.flush_count,
+            "start_unix": self.last_flush_unix,
+            "mode": "local" if self.is_local else "global",
+            "sinks": {},
+        }
 
         def _start_sink_thread(key: str, target, *args) -> None:
             prev = self._sink_flush_threads.get(key)
@@ -717,9 +765,15 @@ class Server:
                     "sink %s: previous flush still running; skipping", key)
                 self.statsd.count("flush.sink_skipped_total", 1,
                                   tags=[f"sink:{key}"])
+                round_info["sinks"][key] = {"status": "skipped",
+                                            "duration_s": 0.0}
+                self.telemetry.record_event(
+                    "sink_skipped", sink=key, flush=round_info["flush"])
                 return
-            t = threading.Thread(target=target, args=args, daemon=True,
-                                 name=f"flush-{key}")
+            t = threading.Thread(
+                target=self._timed_sink_flush,
+                args=(key, flush_span, round_info, target) + args,
+                daemon=True, name=f"flush-{key}")
             t.start()
             self._sink_flush_threads[key] = t
             threads.append(t)
@@ -779,6 +833,15 @@ class Server:
                 "flush exceeded the %.1fs interval; still running: %s",
                 self.interval, ", ".join(stuck))
             self.statsd.count("flush.timeout_total", len(stuck))
+            for name in stuck:
+                key = name[len("flush-"):]
+                # the sink thread holds the same outcome dict: if it
+                # lands after this round is recorded, its final status
+                # overwrites timed_out (flagged `late`)
+                entry = round_info["sinks"].setdefault(key, {})
+                entry.setdefault("status", "timed_out")
+                self.telemetry.record_event(
+                    "sink_timeout", sink=key, flush=round_info["flush"])
 
         if self.import_server is not None:
             # per-RPC latency/error aggregates (reference proxy/grpcstats)
@@ -786,7 +849,21 @@ class Server:
         flush_span.finish()
         duration = time.perf_counter() - flush_start
         self.statsd.gauge("flush.total_duration_ns", int(duration * 1e9))
+        self.statsd.timing("flush.total_duration", duration)
+        for phase, secs in phases.items():
+            self.statsd.timing("flush.phase_duration", secs,
+                               tags=[f"phase:{phase}"])
         self.statsd.count("flush.metrics_total", len(batch))
+        round_info["duration_s"] = round(duration, 6)
+        round_info["metrics_flushed"] = len(batch)
+        round_info["phases"] = {k: round(v, 6) for k, v in phases.items()}
+        self.telemetry.flushes.record(round_info)
+        self.telemetry.record_event(
+            "flush", flush=round_info["flush"],
+            duration_s=round_info["duration_s"], metrics=len(batch),
+            phases=round_info["phases"],
+            sinks={k: v.get("status", "running")
+                   for k, v in round_info["sinks"].items()})
         # cumulative process counters emit as gauges (they never reset)
         self.statsd.gauge("worker.metrics_processed_total",
                           int(self.stats["packets_received"]))
@@ -852,28 +929,66 @@ class Server:
                               dropped - self._keys_dropped_reported)
             self._keys_dropped_reported = dropped
 
-    def _forward_safe(self, fwd: ForwardableState) -> None:
+    def _timed_sink_flush(self, key: str, parent_span, round_info: dict,
+                          target, *args) -> None:
+        """Body of one per-sink flush thread: a child span under the
+        flush span, wall-clock duration, the sink-outcome row shared with
+        the flight recorder, and the per-sink duration self-metric."""
+        outcome = round_info["sinks"].setdefault(key, {})
+        child = parent_span.child("flush.sink", tags={"sink": key})
+        start = time.perf_counter()
+        ok = target(*args)
+        duration = time.perf_counter() - start
+        if not ok:
+            child.error()
+        child.finish()
+        if outcome.get("status") == "timed_out":
+            # finished after its round was declared over — keep that
+            # visible while still landing the real outcome
+            outcome["late"] = True
+        outcome["status"] = "ok" if ok else "error"
+        outcome["duration_s"] = round(duration, 6)
+        self.statsd.timing(
+            "flush.sink_duration", duration,
+            tags=[f"sink:{key}", f"status:{outcome['status']}"])
+        if not ok:
+            self.telemetry.record_event(
+                "sink_error", sink=key, flush=round_info["flush"],
+                duration_s=outcome["duration_s"])
+        if key == "forward":
+            self.telemetry.record_event(
+                "forward", status=outcome["status"],
+                flush=round_info["flush"],
+                duration_s=outcome["duration_s"])
+
+    def _forward_safe(self, fwd: ForwardableState) -> bool:
         try:
             self.forwarder(fwd)
+            return True
         except Exception:
             logger.exception("forward failed")
+            return False
 
-    def _flush_span_sink_safe(self, sink) -> None:
+    def _flush_span_sink_safe(self, sink) -> bool:
         try:
             sink.flush()
+            return True
         except Exception:
             logger.exception("span sink %s flush failed", sink.name())
+            return False
 
     def _flush_sink_safe(self, sink, batch: FlushBatch,
-                         other_samples=()) -> None:
+                         other_samples=()) -> bool:
+        ok = True
         if other_samples:
             try:
                 sink.flush_other_samples(other_samples)
             except Exception:
                 logger.exception("sink %s flush_other_samples failed",
                                  sink.name())
+                ok = False
         if not len(batch):
-            return
+            return ok
         try:
             name = sink.name()
             sc = self._sink_filters.get(name)
@@ -888,14 +1003,16 @@ class Server:
                     fb(batch)
                 else:
                     sink.flush(batch.materialize())
-                return
+                return ok
             selected = [mm for mm in batch.materialize()
                         if mm.sinks is None or name in mm.sinks]
             if sc is not None:
                 selected = _apply_sink_filters(selected, sc)
             sink.flush(selected)
+            return ok
         except Exception:
             logger.exception("sink %s flush failed", sink.name())
+            return False
 
 
 def _apply_sink_filters(metrics: List[InterMetric], sc: SinkConfig

@@ -27,18 +27,25 @@ class ScopedClient:
     def __init__(self, address: str = "",
                  packet_cb: Optional[Callable[[bytes], None]] = None,
                  scopes: Optional[Dict[str, str]] = None,
-                 additional_tags: Sequence[str] = ()):
+                 additional_tags: Sequence[str] = (),
+                 registry=None):
         """scopes maps metric kind to "local"/"global"/"" using the
         reference's YAML keys — "counter"/"gauge"/"histogram" (config.go
         VeneurMetricsScopes; timings scope by Histogram, scopedstatsd/
         client.go:91-110). The pre-parity aliases "count"/"timing" stay
-        accepted."""
+        accepted.
+
+        `registry` is an optional core.telemetry.Registry every emission
+        tees into (with the caller's tags, before scope/additional tags)
+        so the pull endpoints see each self-metric without any call-site
+        rewrites — including on NullClient, which drops the push half."""
         scopes = dict(scopes or {})
         for ref_key, alias in (("counter", "count"), ("histogram", "timing")):
             if ref_key not in scopes and alias in scopes:
                 scopes[ref_key] = scopes[alias]
         self.scopes = scopes
         self.additional_tags = list(additional_tags)
+        self.registry = registry
         self._cb = packet_cb
         self._sock = None
         self._addr = None
@@ -65,14 +72,21 @@ class ScopedClient:
 
     def count(self, name: str, value: int = 1,
               tags: Sequence[str] = (), rate: float = 1.0) -> None:
+        if self.registry is not None:
+            self.registry.record_statsd(name, int(value), "c", tags, rate)
         self._emit(name, int(value), "c", tags, rate)
 
     def gauge(self, name: str, value: float,
               tags: Sequence[str] = (), rate: float = 1.0) -> None:
+        if self.registry is not None:
+            self.registry.record_statsd(name, value, "g", tags, rate)
         self._emit(name, value, "g", tags, rate)
 
     def timing(self, name: str, seconds: float,
                tags: Sequence[str] = (), rate: float = 1.0) -> None:
+        if self.registry is not None:
+            self.registry.record_statsd(
+                name, seconds * 1000, "ms", tags, rate)
         self._emit(name, f"{seconds * 1000:.3f}", "ms", tags, rate)
 
     def timer(self, name: str, tags: Sequence[str] = ()):
@@ -96,10 +110,12 @@ class ScopedClient:
 
 
 class NullClient(ScopedClient):
-    """Drops everything (trace.NeutralizeClient analog for tests)."""
+    """Drops every packet (trace.NeutralizeClient analog for tests); a
+    registry, when given, still captures — the pull endpoints stay live
+    even with no stats_address configured."""
 
-    def __init__(self):
-        super().__init__()
+    def __init__(self, registry=None):
+        super().__init__(registry=registry)
 
     def _emit(self, *a, **kw) -> None:
         pass
