@@ -11,6 +11,12 @@ f-strings (e.g. ``f"{prefix}.count"`` in util/grpcstats.py) are matched
 as patterns: each formatted field becomes a wildcard, and at least one
 documented name must match.
 
+Registry-collector rows are covered too: a literal
+``rows.append(("name", "counter"|"gauge", value, tags))`` site (the
+shape every telemetry collector emits — resilience breaker gauges,
+forward client counters, proxy destination rows) is checked exactly
+like a statsd call site.
+
 Usage: python scripts/check_metric_names.py [--repo DIR]
 Exit codes: 0 ok, 1 undocumented metrics found, 2 could not parse docs.
 """
@@ -23,9 +29,12 @@ import pathlib
 import re
 import sys
 
-EMIT_METHODS = {"count", "gauge", "timing"}
-# receiver spellings that denote a ScopedClient self-metrics client
-STATSD_RECEIVERS = {"statsd", "stats", "stats_client", "_statsd"}
+EMIT_METHODS = {"count", "gauge", "timing", "observe"}
+# receiver spellings that denote a ScopedClient self-metrics client or
+# the pull-side registry itself (resilience/chaos rows write there
+# directly, bypassing statsd)
+STATSD_RECEIVERS = {"statsd", "stats", "stats_client", "_statsd",
+                    "registry"}
 
 DOC_SECTION = "Self-metric inventory"
 
@@ -52,8 +61,20 @@ def emitted_names(root: pathlib.Path):
             continue
         for node in ast.walk(tree):
             if not (isinstance(node, ast.Call)
-                    and isinstance(node.func, ast.Attribute)
-                    and node.func.attr in EMIT_METHODS
+                    and isinstance(node.func, ast.Attribute)):
+                continue
+            # collector-row shape: xs.append(("name", "counter", v, tags))
+            if (node.func.attr == "append" and len(node.args) == 1
+                    and isinstance(node.args[0], ast.Tuple)
+                    and len(node.args[0].elts) == 4):
+                name_el, kind_el = node.args[0].elts[:2]
+                if (isinstance(name_el, ast.Constant)
+                        and isinstance(name_el.value, str)
+                        and isinstance(kind_el, ast.Constant)
+                        and kind_el.value in ("counter", "gauge")):
+                    yield path, node.lineno, name_el.value, False
+                continue
+            if not (node.func.attr in EMIT_METHODS
                     and statsd_receiver(node.func.value)
                     and node.args):
                 continue

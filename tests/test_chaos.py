@@ -1,0 +1,322 @@
+"""Fault-injection tests: chaos seam behavior, forward retry/carryover
+under injected faults, and the lossless-carryover soak the acceptance
+criteria pin (20 flush rounds at 30 % forward faults, zero counter
+loss)."""
+
+import time
+
+import pytest
+
+from veneur_tpu.config import Config
+from veneur_tpu.core.server import Server
+from veneur_tpu.sinks.channel import ChannelMetricSink
+from veneur_tpu.testing.forwardtest import ForwardTestServer
+from veneur_tpu.util import chaos as chaos_mod
+from veneur_tpu.util.chaos import Chaos, ChaosError
+
+pytestmark = pytest.mark.chaos
+
+
+def make_config(**overrides) -> Config:
+    cfg = Config()
+    cfg.interval = 10.0
+    cfg.hostname = "test"
+    cfg.tpu.counter_capacity = 128
+    cfg.tpu.gauge_capacity = 128
+    cfg.tpu.histo_capacity = 128
+    cfg.tpu.set_capacity = 64
+    cfg.tpu.batch_cap = 512
+    for k, v in overrides.items():
+        setattr(cfg, k, v)
+    return cfg.apply_defaults()
+
+
+def wait_until(fn, timeout=10.0, step=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(step)
+    return False
+
+
+class TestChaosPlan:
+    def test_disabled_is_noop(self):
+        c = Chaos(enabled=False, error_rate=1.0)
+        for _ in range(10):
+            c.inject("forward_send")
+        assert not c.injected_errors
+
+    def test_error_rate_one_always_raises(self):
+        c = Chaos(error_rate=1.0, seams=("forward_send",))
+        with pytest.raises(ChaosError) as ei:
+            c.inject("forward_send")
+        assert ei.value.seam == "forward_send"
+        assert c.injected_errors["forward_send"] == 1
+
+    def test_seam_filtering(self):
+        c = Chaos(error_rate=1.0, seams=("sink_flush",))
+        c.inject("forward_send")  # not planted: no-op
+        with pytest.raises(ChaosError):
+            c.inject("sink_flush")
+
+    def test_seeded_determinism(self):
+        def run(seed):
+            c = Chaos(error_rate=0.3, seed=seed)
+            out = []
+            for _ in range(50):
+                try:
+                    c.inject("forward_send")
+                    out.append(0)
+                except ChaosError:
+                    out.append(1)
+            return out
+
+        assert run(7) == run(7)
+        assert run(7) != run(8)
+
+    def test_delay_injection(self):
+        slept = []
+        c = Chaos(delay_rate=1.0, delay=0.123, sleep=slept.append)
+        c.inject("sink_flush")
+        assert slept == [0.123]
+        assert c.injected_delays["sink_flush"] == 1
+
+    def test_from_config(self):
+        cfg = make_config(chaos_enabled=True, chaos_error_rate=0.25,
+                          chaos_seams=["http_post"], chaos_seed=3)
+        c = Chaos.from_config(cfg)
+        assert c.error_rate == 0.25 and c.seams == frozenset(["http_post"])
+        assert Chaos.from_config(make_config()) is None
+
+    def test_http_post_seam(self):
+        chaos_mod.install(Chaos(error_rate=1.0, seams=("http_post",)))
+        try:
+            from veneur_tpu.util import http as http_mod
+            with pytest.raises(ChaosError):
+                # the seam fires before any socket is touched
+                http_mod.post("http://127.0.0.1:1/never", b"{}")
+        finally:
+            chaos_mod.install(None)
+
+    def test_telemetry_rows(self):
+        c = Chaos(error_rate=1.0, seams=("sink_flush",))
+        with pytest.raises(ChaosError):
+            c.inject("sink_flush")
+        rows = c.telemetry_rows()
+        assert ("chaos.injected_errors", "counter", 1.0,
+                ["seam:sink_flush"]) in rows
+
+
+class TestHttpRetry:
+    def test_post_with_retry_honors_retry_after(self, monkeypatch):
+        from veneur_tpu.util import http as http_mod
+        from veneur_tpu.util.resilience import RetryPolicy
+
+        calls = []
+        sleeps = []
+
+        def fake_post(url, body, **kwargs):
+            calls.append(1)
+            if len(calls) < 3:
+                raise http_mod.HTTPError(429, b"slow down",
+                                         retry_after=0.01)
+            return 200, b"ok"
+
+        monkeypatch.setattr(http_mod, "post", fake_post)
+        monkeypatch.setattr(http_mod.time, "sleep", sleeps.append)
+        status, body = http_mod.post_with_retry(
+            "http://x/", b"{}", retry=RetryPolicy(max_attempts=5,
+                                                  base_delay=0.001),
+            budget=5.0)
+        assert status == 200 and len(calls) == 3
+        assert all(s >= 0.01 for s in sleeps)
+
+    def test_post_with_retry_structural_fails_fast(self, monkeypatch):
+        from veneur_tpu.util import http as http_mod
+
+        calls = []
+
+        def fake_post(url, body, **kwargs):
+            calls.append(1)
+            raise http_mod.HTTPError(401, b"no auth")
+
+        monkeypatch.setattr(http_mod, "post", fake_post)
+        with pytest.raises(http_mod.HTTPError):
+            http_mod.post_with_retry("http://x/", b"{}", budget=5.0)
+        assert len(calls) == 1
+
+    def test_retryable_classification(self):
+        from veneur_tpu.util.http import HTTPError
+        assert HTTPError(429).retryable and HTTPError(503).retryable
+        assert not HTTPError(400).retryable
+        assert not HTTPError(500).retryable
+
+
+class TestForwardChaos:
+    def _counter_sum(self, received, name):
+        return sum(p.counter.value for p in received if p.name == name)
+
+    def _run_rounds(self, rounds, error_rate, seed=7, per_round=5):
+        """Drive `rounds` flush intervals of counter deltas through a
+        local server whose forward seam injects faults; returns (total
+        received by the global tier, total sent)."""
+        received = []
+        ft = ForwardTestServer(received.extend)
+        ft.start()
+        server = None
+        try:
+            cfg = make_config(
+                forward_address=ft.address,
+                chaos_enabled=error_rate > 0,
+                chaos_error_rate=error_rate,
+                chaos_seams=["forward_send"],
+                chaos_seed=seed,
+                # retries off: carryover alone must preserve the stream
+                forward_retry_max_attempts=1,
+                # the soak must never shed or trip the breaker — losses
+                # would be legitimate then, and we are pinning zero loss
+                carryover_max_intervals=1000,
+                circuit_breaker_failure_threshold=10_000)
+            server = Server(cfg, extra_metric_sinks=[ChannelMetricSink()])
+            server.start()
+            sent = 0
+            for i in range(rounds):
+                delta = per_round + i  # distinct per-interval deltas
+                server.handle_metric_packet(
+                    b"soak.count:%d|c|#veneurglobalonly" % delta)
+                sent += delta
+                server.flush()
+            # drain: chaos off, remaining carryover must deliver
+            if server.chaos is not None:
+                server.chaos.enabled = False
+            server.flush()
+            assert wait_until(
+                lambda: server.forward_client.carryover.depth == 0)
+            # the gRPC handler delivers asynchronously; settle on a total
+            wait_until(
+                lambda: self._counter_sum(received, "soak.count") >= sent,
+                timeout=5.0)
+            return self._counter_sum(received, "soak.count"), sent
+        finally:
+            if server is not None:
+                server.shutdown()
+            ft.stop()
+
+    def test_forward_fault_then_recovery_is_lossless(self):
+        """Fast pin of the acceptance property (5 rounds, 50 % faults):
+        every counter delta survives via carryover."""
+        got, sent = self._run_rounds(rounds=5, error_rate=0.5)
+        assert got == sent
+
+    def test_forward_chaos_increments_error_stats(self):
+        got, sent = self._run_rounds(rounds=4, error_rate=1.0, seed=1)
+        assert got == sent  # all delivered on the final clean drain
+
+    @pytest.mark.slow
+    def test_soak_20_rounds_30pct_faults_zero_counter_loss(self):
+        """The acceptance soak: 20 flush rounds at 30 % injected fault
+        rate — total counter values received by the global tier equal
+        the no-fault run exactly."""
+        got_chaos, sent_chaos = self._run_rounds(rounds=20, error_rate=0.3)
+        got_clean, sent_clean = self._run_rounds(rounds=20, error_rate=0.0)
+        assert sent_chaos == sent_clean
+        assert got_clean == sent_clean      # control: no-fault baseline
+        assert got_chaos == sent_chaos      # zero loss under 30 % faults
+        assert got_chaos == got_clean
+
+
+class TestForwardBreakerAndCarryoverStats:
+    def test_breaker_opens_and_refuses_then_recovers(self):
+        """Forward breaker: consecutive failures open it; while open the
+        client sheds straight to carryover without dialing; the half-open
+        probe closes it and the carried state delivers."""
+        from veneur_tpu.forward.client import ForwardClient
+        from veneur_tpu.util.resilience import (
+            OPEN, Carryover, CircuitBreaker, RetryPolicy)
+
+        received = []
+        ft = ForwardTestServer(received.extend)
+        ft.start()
+        try:
+            chaos = Chaos(error_rate=1.0, seams=("forward_send",))
+            client = ForwardClient(
+                ft.address, deadline=5.0,
+                retry=RetryPolicy(max_attempts=1),
+                breaker=CircuitBreaker(failure_threshold=2,
+                                       recovery_time=0.05, name="fwd"),
+                carryover=Carryover(max_intervals=100),
+                chaos=chaos)
+            from veneur_tpu.core.columnstore import RowMeta
+            from veneur_tpu.core.flusher import ForwardableState
+            from veneur_tpu.samplers.metrics import MetricScope
+
+            def _mk_meta(name):
+                return RowMeta(name=name, tags=[], joined_tags="",
+                               digest32=1, scope=MetricScope.GLOBAL_ONLY,
+                               wire_type="counter")
+
+            def one(value):
+                return ForwardableState(
+                    counters=[(_mk_meta("brk.cnt"), value)])
+
+            assert client.forward(one(1.0)) == 0
+            assert client.forward(one(2.0)) == 0
+            assert client.breaker.state == OPEN
+            assert client.forward(one(3.0)) == 0   # refused, no dial
+            assert client.stats["breaker_refused_total"] == 1
+            assert client.carryover.depth == 3
+            chaos.enabled = False
+            time.sleep(0.1)                        # past recovery_time
+            assert client.forward(one(4.0)) == 1   # half-open probe wins
+            assert client.breaker.state == "closed"
+            assert client.carryover.depth == 0
+            assert wait_until(lambda: sum(
+                p.counter.value for p in received
+                if p.name == "brk.cnt") == 10.0)
+            client.close()
+        finally:
+            ft.stop()
+
+    def test_forward_client_stats_in_registry(self):
+        """Satellite: ForwardClient.stats surface in /metrics."""
+        received = []
+        ft = ForwardTestServer(received.extend)
+        ft.start()
+        server = None
+        try:
+            cfg = make_config(forward_address=ft.address)
+            server = Server(cfg, extra_metric_sinks=[ChannelMetricSink()])
+            server.start()
+            server.handle_metric_packet(b"st.c:3|c|#veneurglobalonly")
+            server.flush()
+            assert wait_until(lambda: len(received) >= 1)
+            exposition = server.telemetry.registry.render_prometheus()
+            assert "veneur_forward_forwarded_total 1" in exposition
+            assert "veneur_forward_errors_send_total 0" in exposition
+            assert ('veneur_resilience_breaker_state{target="forward"} 0'
+                    in exposition)
+            assert "veneur_resilience_carryover_depth 0" in exposition
+        finally:
+            if server is not None:
+                server.shutdown()
+            ft.stop()
+
+    def test_chaos_sink_flush_seam_feeds_spill(self):
+        """sink_flush seam: an injected fault fails the sink thread, the
+        batch spills, and the next clean flush delivers it."""
+        sink = ChannelMetricSink()
+        cfg = make_config(chaos_enabled=True, chaos_error_rate=1.0,
+                          chaos_seams=["sink_flush"], interval=2.0)
+        server = Server(cfg, extra_metric_sinks=[sink])
+        try:
+            server.handle_metric_packet(b"cs.a:1|c")
+            server.flush()
+            assert server._sink_spill  # injected failure spilled it
+            server.chaos.enabled = False
+            server.handle_metric_packet(b"cs.b:1|c")
+            server.flush()
+            names = {m.name for m in sink.drain()}
+            assert {"cs.a", "cs.b"} <= names
+        finally:
+            server.shutdown()

@@ -1,0 +1,567 @@
+"""Egress resilience layer tests: retry backoff budgets, circuit breaker
+state transitions, lossless carryover merges, sink thread caps and
+spill (util/resilience.py + the core/server.py and proxy wiring)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from veneur_tpu.config import Config
+from veneur_tpu.core.flusher import ForwardableState
+from veneur_tpu.core.server import Server
+from veneur_tpu.ops.batch_tdigest import C, COMPRESSION
+from veneur_tpu.ops.tdigest_ref import MergingDigest
+from veneur_tpu.sinks.channel import ChannelMetricSink
+from veneur_tpu.util.resilience import (
+    CLOSED, HALF_OPEN, OPEN, Carryover, CircuitBreaker, RetryPolicy,
+    merge_centroids, merge_forwardable)
+
+
+def make_config(**overrides) -> Config:
+    cfg = Config()
+    cfg.interval = 10.0
+    cfg.hostname = "test"
+    cfg.tpu.counter_capacity = 128
+    cfg.tpu.gauge_capacity = 128
+    cfg.tpu.histo_capacity = 128
+    cfg.tpu.set_capacity = 64
+    cfg.tpu.batch_cap = 512
+    for k, v in overrides.items():
+        setattr(cfg, k, v)
+    return cfg.apply_defaults()
+
+
+def _mk_meta(name, wire_type="counter", tags=()):
+    from veneur_tpu.core.columnstore import RowMeta
+    from veneur_tpu.samplers.metrics import MetricScope
+    return RowMeta(name=name, tags=list(tags), joined_tags=",".join(tags),
+                   digest32=1, scope=MetricScope.GLOBAL_ONLY,
+                   wire_type=wire_type)
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 1000.0
+
+    def __call__(self):
+        return self.now
+
+    def sleep(self, s):
+        self.now += s
+
+
+# -------------------------------------------------------------------------
+# RetryPolicy
+# -------------------------------------------------------------------------
+
+
+class TestRetryPolicy:
+    def test_delay_count_bounded_by_attempts(self):
+        clock = FakeClock()
+        policy = RetryPolicy(max_attempts=4, base_delay=0.1, max_delay=1.0,
+                             clock=clock)
+        assert len(list(policy.delays(budget=1e9))) == 3
+
+    def test_delays_respect_budget(self):
+        clock = FakeClock()
+        policy = RetryPolicy(max_attempts=50, base_delay=1.0, max_delay=1.0,
+                             clock=clock)
+        spent = 0.0
+        for delay in policy.delays(budget=3.0):
+            clock.sleep(delay)
+            spent += delay
+        assert spent <= 3.0
+
+    def test_delays_grow_up_to_cap(self):
+        class TopRng:  # always the top of the uniform range
+            def uniform(self, a, b):
+                return b
+
+        clock = FakeClock()
+        policy = RetryPolicy(max_attempts=5, base_delay=0.1, max_delay=0.5,
+                             multiplier=2.0, rng=TopRng(), clock=clock)
+        assert list(policy.delays(budget=1e9)) == \
+            pytest.approx([0.1, 0.2, 0.4, 0.5])
+
+
+# -------------------------------------------------------------------------
+# CircuitBreaker
+# -------------------------------------------------------------------------
+
+
+class TestCircuitBreaker:
+    def test_full_transition_cycle(self):
+        """closed -> open -> half-open -> closed, the satellite's pinned
+        sequence."""
+        clock = FakeClock()
+        transitions = []
+        breaker = CircuitBreaker(
+            failure_threshold=3, recovery_time=30.0, clock=clock,
+            name="t", on_transition=lambda n, o, new: transitions.append(
+                (o, new)))
+        assert breaker.state == CLOSED
+        for _ in range(2):
+            breaker.record_failure()
+        assert breaker.state == CLOSED          # under threshold
+        breaker.record_failure()
+        assert breaker.state == OPEN
+        assert not breaker.allow()
+        clock.sleep(29.0)
+        assert breaker.state == OPEN            # still cooling down
+        clock.sleep(1.5)
+        assert breaker.state == HALF_OPEN
+        assert breaker.allow()                  # the single probe
+        breaker.record_success()
+        assert breaker.state == CLOSED
+        assert transitions == [(CLOSED, OPEN), (OPEN, HALF_OPEN),
+                               (HALF_OPEN, CLOSED)]
+
+    def test_half_open_single_probe(self):
+        clock = FakeClock()
+        breaker = CircuitBreaker(failure_threshold=1, recovery_time=1.0,
+                                 clock=clock)
+        breaker.record_failure()
+        clock.sleep(2.0)
+        assert breaker.allow() is True      # first caller wins the probe
+        assert breaker.allow() is False     # everyone else refused
+        assert breaker.refused_total >= 1
+
+    def test_half_open_probe_failure_reopens(self):
+        clock = FakeClock()
+        breaker = CircuitBreaker(failure_threshold=1, recovery_time=1.0,
+                                 clock=clock)
+        breaker.record_failure()
+        clock.sleep(2.0)
+        assert breaker.allow()
+        breaker.record_failure()
+        assert breaker.state == OPEN
+        assert breaker.open_total == 2
+
+    def test_success_resets_failure_streak(self):
+        breaker = CircuitBreaker(failure_threshold=3)
+        for _ in range(10):
+            breaker.record_failure()
+            breaker.record_failure()
+            breaker.record_success()
+        assert breaker.state == CLOSED
+
+    def test_is_dispatchable_does_not_consume_probe(self):
+        clock = FakeClock()
+        breaker = CircuitBreaker(failure_threshold=1, recovery_time=1.0,
+                                 clock=clock)
+        breaker.record_failure()
+        assert breaker.is_dispatchable is False    # open
+        clock.sleep(2.0)
+        assert breaker.is_dispatchable is True     # half-open
+        assert breaker.allow() is True             # probe still available
+
+    def test_state_codes(self):
+        clock = FakeClock()
+        breaker = CircuitBreaker(failure_threshold=1, recovery_time=1.0,
+                                 clock=clock)
+        assert breaker.state_code == 0
+        breaker.record_failure()
+        assert breaker.state_code == 1
+        clock.sleep(2.0)
+        assert breaker.state_code == 2
+
+    def test_thread_safety_smoke(self):
+        breaker = CircuitBreaker(failure_threshold=5, recovery_time=0.0)
+        errs = []
+
+        def pound():
+            try:
+                for _ in range(500):
+                    if breaker.allow():
+                        breaker.record_success()
+                    breaker.record_failure()
+                    _ = breaker.state_code
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        threads = [threading.Thread(target=pound) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs
+
+
+# -------------------------------------------------------------------------
+# Carryover merge semantics
+# -------------------------------------------------------------------------
+
+
+def _digest_row(values, weight=1.0):
+    """Build a (means, weights) C-slot f32 row from raw samples."""
+    means = np.zeros(C, np.float32)
+    weights = np.zeros(C, np.float32)
+    means[:len(values)] = values
+    weights[:len(values)] = weight
+    return means, weights
+
+
+class TestMergeSemantics:
+    def test_counters_sum(self):
+        newer = ForwardableState(counters=[(_mk_meta("a"), 3.0),
+                                           (_mk_meta("b"), 1.0)])
+        older = ForwardableState(counters=[(_mk_meta("a"), 4.0),
+                                           (_mk_meta("c"), 7.0)])
+        merge_forwardable(newer, older)
+        got = {m.name: v for m, v in newer.counters}
+        assert got == {"a": 7.0, "b": 1.0, "c": 7.0}
+
+    def test_gauges_last_write_wins(self):
+        newer = ForwardableState(gauges=[(_mk_meta("g", "gauge"), 5.0)])
+        older = ForwardableState(gauges=[(_mk_meta("g", "gauge"), 99.0),
+                                         (_mk_meta("old", "gauge"), 2.0)])
+        merge_forwardable(newer, older)
+        got = {m.name: v for m, v in newer.gauges}
+        # the newer interval's value wins; an old-only gauge is carried
+        assert got == {"g": 5.0, "old": 2.0}
+
+    def test_sets_register_max(self):
+        a = np.zeros(16, np.uint8)
+        b = np.zeros(16, np.uint8)
+        a[2], b[2], b[7] = 5, 3, 9
+        newer = ForwardableState(sets=[(_mk_meta("s", "set"), a)])
+        older = ForwardableState(sets=[(_mk_meta("s", "set"), b)])
+        merge_forwardable(newer, older)
+        merged = newer.sets[0][1]
+        assert merged[2] == 5 and merged[7] == 9
+
+    def test_tags_distinguish_rows(self):
+        newer = ForwardableState(
+            counters=[(_mk_meta("a", tags=("env:prod",)), 1.0)])
+        older = ForwardableState(
+            counters=[(_mk_meta("a", tags=("env:dev",)), 10.0)])
+        merge_forwardable(newer, older)
+        assert len(newer.counters) == 2
+
+    def test_digest_merge_conserves_weight_min_max_recip(self):
+        m1, w1 = _digest_row([1.0, 2.0, 3.0])
+        m2, w2 = _digest_row([10.0, 20.0])
+        newer = ForwardableState(
+            histograms=[(_mk_meta("h", "histogram"), m1, w1, 1.0, 3.0, 0.5)])
+        older = ForwardableState(
+            histograms=[(_mk_meta("h", "histogram"), m2, w2, 10.0, 20.0,
+                         0.15)])
+        merge_forwardable(newer, older)
+        meta, mm, ww, dmin, dmax, drecip = newer.histograms[0]
+        assert ww.sum() == pytest.approx(5.0)
+        assert (dmin, dmax) == (1.0, 20.0)
+        assert drecip == pytest.approx(0.65)
+        assert mm.shape == (C,) and mm.dtype == np.float32
+
+    def test_merge_centroids_matches_reference_quantiles(self):
+        """Concatenate-and-recompress must stay in the same accuracy
+        class as the scalar reference digest over the union stream."""
+        rng = np.random.default_rng(11)
+        s1 = rng.normal(100.0, 15.0, 400)
+        s2 = rng.normal(140.0, 5.0, 300)
+        d1, d2 = MergingDigest(COMPRESSION), MergingDigest(COMPRESSION)
+        for v in s1:
+            d1.add(float(v))
+        for v in s2:
+            d2.add(float(v))
+        d1._merge_all_temps()
+        d2._merge_all_temps()
+        mm, ww = merge_centroids(
+            np.array(d1.means), np.array(d1.weights),
+            np.array(d2.means), np.array(d2.weights), C, COMPRESSION)
+        assert ww.sum() == pytest.approx(700.0)
+        merged = MergingDigest.from_centroids(
+            mm[ww > 0].tolist(), ww[ww > 0].tolist(),
+            float(min(s1.min(), s2.min())), float(max(s1.max(), s2.max())),
+            compression=COMPRESSION)
+        both = np.sort(np.concatenate([s1, s2]))
+        for q in (0.25, 0.5, 0.9, 0.99):
+            want = both[int(q * len(both))]
+            assert merged.quantile(q) == pytest.approx(want, rel=0.05), q
+
+    def test_merge_centroids_empty_sides(self):
+        m, w = _digest_row([5.0])
+        zm, zw = np.zeros(C, np.float32), np.zeros(C, np.float32)
+        mm, ww = merge_centroids(m, w, zm, zw, C, COMPRESSION)
+        assert ww.sum() == pytest.approx(1.0)
+        mm, ww = merge_centroids(zm, zw, zm, zw, C, COMPRESSION)
+        assert ww.sum() == 0.0
+
+
+class TestCarryover:
+    def test_stash_drain_roundtrip(self):
+        co = Carryover(max_intervals=3)
+        failed = ForwardableState(counters=[(_mk_meta("a"), 2.0)])
+        co.stash(failed)
+        assert co.depth == 1
+        nxt = ForwardableState(counters=[(_mk_meta("a"), 3.0)])
+        merged = co.drain_into(nxt)
+        assert merged.counters[0][1] == 5.0
+        assert co.drain_into(ForwardableState()).counters == []  # cleared
+        co.clear_age()
+        assert co.depth == 0
+
+    def test_shed_beyond_bound(self):
+        co = Carryover(max_intervals=2)
+        for i in range(2):
+            co.stash(co.drain_into(
+                ForwardableState(counters=[(_mk_meta("a"), 1.0)])))
+        assert co.depth == 2 and co.shed_total == 0
+        co.stash(co.drain_into(
+            ForwardableState(counters=[(_mk_meta("a"), 1.0)])))
+        # third consecutive failure exceeds the bound: everything sheds
+        assert co.shed_total > 0
+        assert co.depth == 0
+        assert len(co.drain_into(ForwardableState())) == 0
+
+    def test_zero_intervals_disables(self):
+        co = Carryover(max_intervals=0)
+        co.stash(ForwardableState(counters=[(_mk_meta("a"), 1.0)]))
+        assert co.depth == 0 and co.shed_total == 1
+        assert len(co.drain_into(ForwardableState())) == 0
+
+    def test_fail_then_succeed_equals_never_failing(self):
+        """The satellite's equivalence pin: two intervals delivered as
+        one carryover-merged send carry exactly the same counters and
+        the same recompressed digest as merging the intervals directly."""
+        def interval(seed, count_val):
+            rng = np.random.default_rng(seed)
+            means = np.zeros(C, np.float32)
+            weights = np.zeros(C, np.float32)
+            n = 40
+            means[:n] = rng.normal(50, 10, n).astype(np.float32)
+            weights[:n] = 1.0
+            return ForwardableState(
+                counters=[(_mk_meta("cnt"), count_val)],
+                histograms=[(_mk_meta("h", "histogram"), means, weights,
+                             float(means[:n].min()), float(means[:n].max()),
+                             0.0)])
+
+        # path A: interval 1 fails, is stashed, merges into interval 2
+        co = Carryover(max_intervals=5)
+        co.stash(interval(1, 10.0))
+        delivered = co.drain_into(interval(2, 7.0))
+        # path B: the same two intervals merged directly (never "failed")
+        control = merge_forwardable(interval(2, 7.0), interval(1, 10.0))
+
+        assert delivered.counters[0][1] == control.counters[0][1] == 17.0
+        _, am, aw, amin, amax, _ = delivered.histograms[0]
+        _, bm, bw, bmin, bmax, _ = control.histograms[0]
+        np.testing.assert_array_equal(am, bm)
+        np.testing.assert_array_equal(aw, bw)
+        assert (amin, amax) == (bmin, bmax)
+
+
+# -------------------------------------------------------------------------
+# Server sink wiring: thread cap, pileup accounting, breaker, spill
+# -------------------------------------------------------------------------
+
+
+class HangingSink:
+    """A metric sink whose flush never returns (until released)."""
+
+    def __init__(self):
+        self.release = threading.Event()
+        self.calls = 0
+
+    def name(self):
+        return "hang"
+
+    def start(self, server):
+        pass
+
+    def stop(self):
+        pass
+
+    def flush(self, metrics):
+        self.calls += 1
+        self.release.wait(timeout=60.0)
+
+    def flush_other_samples(self, samples):
+        pass
+
+
+class FailingSink:
+    """Fails `fail_times` flushes, then records what it receives."""
+
+    def __init__(self, fail_times):
+        self.fail_times = fail_times
+        self.calls = 0
+        self.received = []
+
+    def name(self):
+        return "flaky"
+
+    def start(self, server):
+        pass
+
+    def stop(self):
+        pass
+
+    def flush(self, metrics):
+        self.calls += 1
+        if self.calls <= self.fail_times:
+            raise RuntimeError("sink down")
+        self.received.extend(metrics)
+
+    def flush_other_samples(self, samples):
+        pass
+
+
+def _live_flush_threads(key):
+    return [t for t in threading.enumerate()
+            if t.is_alive() and t.name == f"flush-{key}"]
+
+
+class TestServerSinkResilience:
+    def test_hung_sink_capped_at_one_thread_and_breaker_opens(self):
+        """The acceptance pin: a permanently-down sink ends at exactly
+        one live flush thread plus an OPEN breaker gauge in /metrics —
+        no per-interval thread growth."""
+        sink = HangingSink()
+        cfg = make_config(interval=0.4,
+                          circuit_breaker_failure_threshold=3)
+        server = Server(cfg, extra_metric_sinks=[sink])
+        try:
+            for i in range(5):
+                server.handle_metric_packet(b"hang.c:1|c")
+                server.flush()
+            assert len(_live_flush_threads("metric:hang")) == 1
+            breaker = server._sink_breakers["metric:hang"]
+            assert breaker.state == OPEN
+            assert server._sink_skip_depth["metric:hang"] >= 3
+            exposition = server.telemetry.registry.render_prometheus()
+            assert ('veneur_resilience_breaker_state{target="metric:hang"}'
+                    ' 1') in exposition
+            assert "veneur_flush_sink_pileup_depth" in exposition
+        finally:
+            sink.release.set()
+            server.shutdown()
+
+    def test_failed_batch_spills_one_interval_then_delivers(self):
+        sink = FailingSink(fail_times=1)
+        cfg = make_config(interval=2.0)
+        server = Server(cfg, extra_metric_sinks=[sink])
+        try:
+            server.handle_metric_packet(b"spill.a:1|c")
+            server.flush()          # fails; the batch spills
+            assert "metric:flaky" in server._sink_spill
+            server.handle_metric_packet(b"spill.b:1|c")
+            server.flush()          # spill + new batch both delivered
+            names = {m.name for m in sink.received}
+            assert {"spill.a", "spill.b"} <= names
+            assert "metric:flaky" not in server._sink_spill
+        finally:
+            server.shutdown()
+
+    def test_spill_is_bounded_to_one_interval(self):
+        sink = FailingSink(fail_times=2)
+        cfg = make_config(interval=2.0)
+        server = Server(cfg, extra_metric_sinks=[sink])
+        try:
+            server.handle_metric_packet(b"shed.a:1|c")
+            server.flush()          # fail 1: a spills
+            server.handle_metric_packet(b"shed.b:1|c")
+            server.flush()          # fail 2: a sheds, b spills
+            spilled = {m.name for m in
+                       server._sink_spill.get("metric:flaky", [])}
+            assert spilled == {"shed.b"}
+            snap = server.telemetry.registry.snapshot()
+            shed = [v for k, v in snap["counters"].items()
+                    if k.startswith("flush.spill_shed_total")]
+            assert shed and shed[0] >= 1.0
+            server.handle_metric_packet(b"shed.c:1|c")
+            server.flush()          # success: b (spill) + c delivered
+            names = {m.name for m in sink.received}
+            assert {"shed.b", "shed.c"} <= names
+            assert "shed.a" not in names  # the shed interval is gone
+        finally:
+            server.shutdown()
+
+    def test_sink_breaker_open_skips_dispatch(self):
+        sink = FailingSink(fail_times=3)
+        cfg = make_config(interval=2.0,
+                          circuit_breaker_failure_threshold=3,
+                          circuit_breaker_recovery=3600.0)
+        server = Server(cfg, extra_metric_sinks=[sink])
+        try:
+            for i in range(3):
+                server.handle_metric_packet(b"brk.x:1|c")
+                server.flush()
+            assert server._sink_breakers["metric:flaky"].state == OPEN
+            calls_before = sink.calls
+            server.handle_metric_packet(b"brk.y:1|c")
+            server.flush()
+            assert sink.calls == calls_before  # dispatch skipped
+            snap = server.telemetry.registry.snapshot()
+            opens = [v for k, v in snap["counters"].items()
+                     if k.startswith("flush.sink_breaker_open_total")]
+            assert opens and opens[0] >= 1.0
+        finally:
+            server.shutdown()
+
+
+# -------------------------------------------------------------------------
+# Proxy destination breaker
+# -------------------------------------------------------------------------
+
+
+class TestDestinationBreaker:
+    def test_open_breaker_sheds_without_blocking(self):
+        from veneur_tpu.forward.protos import metric_pb2
+        from veneur_tpu.proxy.destinations import Destination
+
+        dest = Destination("127.0.0.1:1", on_close=lambda d: None,
+                           send_buffer=4, flush_interval=5.0,
+                           max_consecutive_failures=1)
+        try:
+            dest.breaker.record_failure()  # opens (threshold 1)
+            pbm = metric_pb2.Metric(name="x", type=metric_pb2.Counter)
+            start = time.monotonic()
+            assert dest.send(pbm) is False
+            # pre-breaker behavior stalled up to flush_interval (5 s)
+            assert time.monotonic() - start < 1.0
+            assert dest.shed_open_total == 1
+            assert dest.dropped_total == 1
+        finally:
+            dest.close()
+
+    def test_sender_failures_open_breaker_and_close_destination(self):
+        from veneur_tpu.forward.protos import metric_pb2
+        from veneur_tpu.proxy.destinations import Destination
+
+        closed = []
+        dest = Destination("127.0.0.1:1", on_close=closed.append,
+                           send_buffer=64, flush_interval=0.05,
+                           max_consecutive_failures=2)
+        try:
+            pbm = metric_pb2.Metric(name="x", type=metric_pb2.Counter)
+            # two waves so the sender sees two failed batches (a single
+            # burst drains into ONE batch = one breaker failure)
+            dest.send(pbm)
+            deadline = time.time() + 10.0
+            while dest.dropped_total < 1 and time.time() < deadline:
+                time.sleep(0.05)
+            dest.send(pbm)
+            while not dest.closed.is_set() and time.time() < deadline:
+                time.sleep(0.05)
+            assert dest.closed.is_set()
+            assert closed and closed[0] is dest
+            assert dest.breaker.open_total >= 1
+        finally:
+            dest.close()
+
+    def test_destinations_telemetry_rows(self):
+        from veneur_tpu.proxy.destinations import Destinations
+
+        pool = Destinations()
+        pool.set_destinations(["127.0.0.1:1"])
+        try:
+            rows = pool.telemetry_rows()
+            names = {r[0] for r in rows}
+            assert "resilience.breaker_state" in names
+            assert "proxy.dest.queue_depth" in names
+        finally:
+            pool.clear()

@@ -163,7 +163,9 @@ def build_from_config(raw: dict, args, log):
         send_buffer=int(raw.get("send_buffer_size") or 4096),
         tls=tls or None,
         tls_listen_address=raw.get("grpc_tls_address", ""),
-        destination_tls=dest_tls or None)
+        destination_tls=dest_tls or None,
+        max_consecutive_failures=int(
+            raw.get("circuit_breaker_failure_threshold") or 3))
     proxy.shutdown_grace = shutdown_grace
     proxy.start()
     log.info("veneur-proxy listening on %s -> %s", proxy.address,
@@ -175,6 +177,9 @@ def build_from_config(raw: dict, args, log):
     from veneur_tpu.core.telemetry import Telemetry, device_memory_rows
     telemetry = Telemetry()
     telemetry.registry.add_collector(device_memory_rows)
+    # routing + per-destination breaker/queue rows (proxy.*, proxy.dest.*,
+    # resilience.breaker_state) rendered fresh at scrape time
+    telemetry.registry.add_collector(proxy.telemetry_rows)
     stats_loop = None
     statsd_cfg = raw.get("statsd") or {}
     if statsd_cfg.get("address"):

@@ -143,6 +143,31 @@ class Config:
     flush_watchdog_missed_flushes: int = 0
     forward_address: str = ""
     forward_only: bool = False
+    # -- egress resilience (util/resilience.py) -------------------------
+    # forward retry: jittered exponential backoff, total spend bounded by
+    # the flush interval (a retry storm can never blow the flush budget)
+    forward_retry_max_attempts: int = 3
+    forward_retry_base: float = 0.2    # duration; first backoff cap
+    forward_retry_max: float = 2.0     # duration; per-retry backoff cap
+    # per-destination/per-sink circuit breakers: consecutive failures to
+    # open, and how long to stay open before the single half-open probe
+    circuit_breaker_failure_threshold: int = 3
+    circuit_breaker_recovery: float = 30.0  # duration
+    # failed forward intervals merge (losslessly — counters sum, digests
+    # recompress, HLL registers max) into the next snapshot, for at most
+    # this many consecutive intervals; beyond it the state is shed loudly.
+    # 0 disables carryover (fail-and-forget, the pre-resilience behavior).
+    carryover_max_intervals: int = 3
+    # -- fault injection (util/chaos.py) --------------------------------
+    # deterministic (seeded) probabilistic faults at the egress seams
+    # (forward_send, sink_flush, http_post); VENEUR_CHAOS_* env overlay
+    # reaches every field, so a soak can be driven without a config file
+    chaos_enabled: bool = False
+    chaos_error_rate: float = 0.0
+    chaos_delay_rate: float = 0.0
+    chaos_delay: float = 0.0           # duration per injected delay
+    chaos_seams: List[str] = field(default_factory=list)  # empty = all
+    chaos_seed: int = 0
     grpc_address: str = ""
     grpc_listen_addresses: List[str] = field(default_factory=list)
     hostname: str = ""
@@ -228,7 +253,8 @@ _LIST_TYPES = {
     "sources": SourceConfig,
 }
 _SECRET_FIELDS = {"sentry_dsn", "tls_key"}
-_DURATION_FIELDS = {"interval"}
+_DURATION_FIELDS = {"interval", "forward_retry_base", "forward_retry_max",
+                    "circuit_breaker_recovery", "chaos_delay"}
 
 
 def _coerce(name: str, value: Any) -> Any:

@@ -272,8 +272,23 @@ class Server:
         self._listeners: List[networking.Listener] = []
         self._flush_lock = threading.Lock()
         # last flush thread per sink: a sink whose previous flush is still
-        # running gets skipped (bounds leaked threads to one per hung sink)
+        # running gets skipped — the hard cap is ONE concurrent flush
+        # thread per sink, so a permanently hung sink costs one thread,
+        # not one per interval
         self._sink_flush_threads: Dict[str, threading.Thread] = {}
+        # consecutive skipped intervals per sink (the pileup depth a hung
+        # sink would have caused without the cap); logged and exported
+        self._sink_skip_depth: Dict[str, int] = {}
+        # egress resilience: per-sink circuit breakers (shared
+        # util/resilience.py implementation, same knobs as the forward
+        # breaker) and the bounded one-interval spill of a failed metric
+        # sink's InterMetric batch
+        from veneur_tpu.util import chaos as chaos_mod
+        from veneur_tpu.util.resilience import CircuitBreaker
+        self._breaker_cls = CircuitBreaker
+        self._sink_breakers: Dict[str, CircuitBreaker] = {}
+        self._sink_spill: Dict[str, List[InterMetric]] = {}
+        self.chaos = chaos_mod.Chaos.from_config(config)
         self._flush_thread: Optional[threading.Thread] = None
         self._watchdog_thread: Optional[threading.Thread] = None
         self._shutdown = threading.Event()
@@ -366,6 +381,21 @@ class Server:
         rows.append(("flush.rounds", "counter", float(self.flush_count), ()))
         rows.append(("flush.last_unix_seconds", "gauge",
                      self.last_flush_unix, ()))
+        # egress resilience: per-sink breaker state (0 closed / 1 open /
+        # 2 half-open), pileup depth behind the 1-thread cap, and the
+        # pending spill size
+        for key, breaker in list(self._sink_breakers.items()):
+            tags = [f"target:{key}"]
+            rows.append(("resilience.breaker_state", "gauge",
+                         float(breaker.state_code), tags))
+            rows.append(("resilience.breaker_opens", "counter",
+                         float(breaker.open_total), tags))
+        for key, depth in list(self._sink_skip_depth.items()):
+            rows.append(("flush.sink_pileup_depth", "gauge", float(depth),
+                         [f"sink:{key}"]))
+        for key, spill in list(self._sink_spill.items()):
+            rows.append(("flush.spill_pending", "gauge", float(len(spill)),
+                         [f"sink:{key}"]))
         return rows
 
     # -- spans -----------------------------------------------------------
@@ -515,15 +545,38 @@ class Server:
         if self.config.forward_address and self.forwarder is None:
             from veneur_tpu.forward.client import ForwardClient
             from veneur_tpu.util.grpctls import GrpcTLS
+            from veneur_tpu.util.resilience import (Carryover, RetryPolicy)
             fwd_tls = GrpcTLS(
                 certificate=self.config.forward_tls_certificate,
                 key=(self.config.forward_tls_key.reveal()
                      if self.config.forward_tls_key else ""),
                 authority=self.config.forward_tls_authority_certificate)
+            cfg = self.config
             self.forward_client = ForwardClient(
-                self.config.forward_address, deadline=self.interval,
-                tls=fwd_tls or None)
+                cfg.forward_address, deadline=self.interval,
+                tls=fwd_tls or None,
+                retry=RetryPolicy(
+                    max_attempts=cfg.forward_retry_max_attempts,
+                    base_delay=cfg.forward_retry_base,
+                    max_delay=cfg.forward_retry_max),
+                breaker=self._breaker_cls(
+                    failure_threshold=cfg.circuit_breaker_failure_threshold,
+                    recovery_time=cfg.circuit_breaker_recovery,
+                    name="forward", on_transition=self._breaker_transition),
+                carryover=Carryover(cfg.carryover_max_intervals),
+                chaos=self.chaos)
             self.forwarder = self.forward_client.forward
+            self.telemetry.registry.add_collector(
+                self.forward_client.telemetry_rows)
+        if self.chaos is not None:
+            # make the plan visible to the object-less seams (http_post)
+            from veneur_tpu.util import chaos as chaos_mod
+            chaos_mod.install(self.chaos)
+            self.telemetry.registry.add_collector(self.chaos.telemetry_rows)
+            self.telemetry.record_event(
+                "chaos_enabled", error_rate=self.chaos.error_rate,
+                delay_rate=self.chaos.delay_rate,
+                seams=sorted(self.chaos.seams))
         for addr in self.config.grpc_listen_addresses:
             from veneur_tpu.core.grpc_ingest import GrpcIngestServer
             gi = GrpcIngestServer(self, addr)
@@ -601,9 +654,31 @@ class Server:
                 return listener.address
         return None
 
+    def _breaker_transition(self, name: str, old: str, new: str) -> None:
+        """Flight-recorder hook for every breaker edge (forward + sinks)."""
+        self.telemetry.record_event(
+            "breaker_transition", target=name, old=old, new=new)
+
+    def _sink_breaker(self, key: str):
+        """Get-or-create the per-sink breaker (same knobs as forward)."""
+        breaker = self._sink_breakers.get(key)
+        if breaker is None:
+            breaker = self._sink_breakers[key] = self._breaker_cls(
+                failure_threshold=
+                self.config.circuit_breaker_failure_threshold,
+                recovery_time=self.config.circuit_breaker_recovery,
+                name=key, on_transition=self._breaker_transition)
+        return breaker
+
     def shutdown(self) -> None:
         self.telemetry.record_event("shutdown", pid=os.getpid())
         self._shutdown.set()
+        if self.chaos is not None:
+            # only clear the global seam if WE installed this plan (two
+            # servers in one test process chaos independently)
+            from veneur_tpu.util import chaos as chaos_mod
+            if chaos_mod.active() is self.chaos:
+                chaos_mod.install(None)
         # stop pull sources first (bound-join) so an in-flight scrape
         # can't ingest after the final flush below
         for source in self.sources:
@@ -758,18 +833,52 @@ class Server:
             "sinks": {},
         }
 
-        def _start_sink_thread(key: str, target, *args) -> None:
+        def _start_sink_thread(key: str, target, *args) -> bool:
+            """Dispatch one sink flush thread; returns False when the
+            interval was NOT dispatched (skip or open breaker) so the
+            forward path can stash its state into carryover instead of
+            dropping it."""
             prev = self._sink_flush_threads.get(key)
             if prev is not None and prev.is_alive():
+                # hard cap: one concurrent flush thread per sink. The
+                # depth counts what the pileup WOULD be if each interval
+                # re-created a thread against the hung sink.
+                depth = self._sink_skip_depth.get(key, 0) + 1
+                self._sink_skip_depth[key] = depth
                 logger.warning(
-                    "sink %s: previous flush still running; skipping", key)
+                    "sink %s: previous flush still running; skipping "
+                    "(pileup depth %d, capped at 1 thread)", key, depth)
                 self.statsd.count("flush.sink_skipped_total", 1,
                                   tags=[f"sink:{key}"])
                 round_info["sinks"][key] = {"status": "skipped",
+                                            "duration_s": 0.0,
+                                            "pileup_depth": depth}
+                # every skipped interval is a delivery failure the hung
+                # thread will never report; feeding the breaker here is
+                # what takes a permanently-down sink to OPEN. The
+                # forward path is exempt: ForwardClient owns its own
+                # breaker (which stashes to carryover instead of
+                # dropping), and two breakers on one series would fight
+                # over the /metrics gauge.
+                if key != "forward":
+                    self._sink_breaker(key).record_failure()
+                self.telemetry.record_event(
+                    "sink_skipped", sink=key, flush=round_info["flush"],
+                    pileup_depth=depth)
+                return False
+            self._sink_skip_depth.pop(key, None)
+            if key != "forward" and not self._sink_breaker(key).allow():
+                # open breaker: don't even spawn the thread — a sick
+                # sink's interval is dropped (counted) until the
+                # half-open probe closes it again
+                self.statsd.count("flush.sink_breaker_open_total", 1,
+                                  tags=[f"sink:{key}"])
+                round_info["sinks"][key] = {"status": "breaker_open",
                                             "duration_s": 0.0}
                 self.telemetry.record_event(
-                    "sink_skipped", sink=key, flush=round_info["flush"])
-                return
+                    "sink_breaker_open", sink=key,
+                    flush=round_info["flush"])
+                return False
             t = threading.Thread(
                 target=self._timed_sink_flush,
                 args=(key, flush_span, round_info, target) + args,
@@ -777,6 +886,7 @@ class Server:
             t.start()
             self._sink_flush_threads[key] = t
             threads.append(t)
+            return True
 
         for sink in self.span_sinks:
             _start_sink_thread(
@@ -794,8 +904,20 @@ class Server:
         phases["store_flush_s"] = time.perf_counter() - t_store
         phases["preflush_s"] = t_store - flush_start
 
-        if self.is_local and self.forwarder is not None and len(fwd):
-            _start_sink_thread("forward", self._forward_safe, fwd)
+        # dispatch even with an empty snapshot when a previous interval's
+        # failed state is pending — otherwise a quiet interval would
+        # strand the carryover until new traffic arrives
+        pending_carryover = (self.forward_client is not None
+                             and self.forward_client.carryover.depth > 0)
+        if self.is_local and self.forwarder is not None and (
+                len(fwd) or pending_carryover):
+            if not _start_sink_thread("forward", self._forward_safe, fwd) \
+                    and self.forward_client is not None and len(fwd):
+                # undispatched interval (previous forward still hung):
+                # the snapshot is mergeable state, so it carries over
+                # exactly like a failed send instead of being dropped
+                self.forward_client.carryover.stash(fwd)
+                self.statsd.count("flush.forward_undispatched_total", 1)
 
         if self._routing is not None:
             # routing annotates per-metric sink sets, so it needs objects;
@@ -806,11 +928,14 @@ class Server:
                     route.update(rule.route(metric.name, metric.tags))
                 metric.sinks = route
 
-        if len(batch) or samples:
-            for sink in self.metric_sinks:
+        for sink in self.metric_sinks:
+            key = f"metric:{sink.name()}"
+            # per-sink gate: another sink's pending spill must not
+            # dispatch this one — a no-op flush would still thread-spawn
+            # and (worse) count as a probe against this sink's breaker
+            if len(batch) or samples or key in self._sink_spill:
                 _start_sink_thread(
-                    f"metric:{sink.name()}", self._flush_sink_safe, sink,
-                    batch, samples)
+                    key, self._flush_sink_safe, key, sink, batch, samples)
 
         # bounded wait: one interval from flush start, minus time already
         # spent; stragglers keep running on their daemon threads and are
@@ -840,6 +965,13 @@ class Server:
                 # overwrites timed_out (flagged `late`)
                 entry = round_info["sinks"].setdefault(key, {})
                 entry.setdefault("status", "timed_out")
+                # a hang is a failure the sink thread will never report
+                # itself: feed the breaker here so a permanently-down
+                # sink ends at ONE live thread + an OPEN breaker instead
+                # of silent per-interval skips (forward exempt: the
+                # client's breaker + carryover own that path)
+                if key != "forward":
+                    self._sink_breaker(key).record_failure()
                 self.telemetry.record_event(
                     "sink_timeout", sink=key, flush=round_info["flush"])
 
@@ -939,19 +1071,35 @@ class Server:
         start = time.perf_counter()
         ok = target(*args)
         duration = time.perf_counter() - start
-        if not ok:
+        was_timed_out = outcome.get("status") == "timed_out"
+        breaker = self._sink_breakers.get(key)
+        # ok is None when the sink was never exercised (nothing to
+        # deliver): feeding the breaker then would let a quiet interval
+        # reset a sick sink's failure streak or close its half-open
+        # breaker without a real probe. A hung flush that finally fails
+        # also stays silent — the deadline sweep already counted that
+        # delivery failure, and counting it twice would open the breaker
+        # after ~threshold/2 sick intervals.
+        if breaker is not None and ok is not None:
+            if ok:
+                # a late success after a timed_out round still closes
+                # the breaker — the sink proved it can deliver again
+                breaker.record_success()
+            elif not was_timed_out:
+                breaker.record_failure()
+        if ok is False:
             child.error()
         child.finish()
-        if outcome.get("status") == "timed_out":
+        if was_timed_out:
             # finished after its round was declared over — keep that
             # visible while still landing the real outcome
             outcome["late"] = True
-        outcome["status"] = "ok" if ok else "error"
+        outcome["status"] = "error" if ok is False else "ok"
         outcome["duration_s"] = round(duration, 6)
         self.statsd.timing(
             "flush.sink_duration", duration,
             tags=[f"sink:{key}", f"status:{outcome['status']}"])
-        if not ok:
+        if ok is False:
             self.telemetry.record_event(
                 "sink_error", sink=key, flush=round_info["flush"],
                 duration_s=outcome["duration_s"])
@@ -977,8 +1125,11 @@ class Server:
             logger.exception("span sink %s flush failed", sink.name())
             return False
 
-    def _flush_sink_safe(self, sink, batch: FlushBatch,
-                         other_samples=()) -> bool:
+    def _flush_sink_safe(self, key: str, sink, batch: FlushBatch,
+                         other_samples=()) -> Optional[bool]:
+        """Returns True/False for a delivery attempt, None when the sink
+        was never exercised (nothing to flush) — None must not feed the
+        sink's breaker."""
         ok = True
         if other_samples:
             try:
@@ -987,14 +1138,23 @@ class Server:
                 logger.exception("sink %s flush_other_samples failed",
                                  sink.name())
                 ok = False
-        if not len(batch):
-            return ok
+        # bounded retry spill: a batch that failed LAST interval gets
+        # exactly one more delivery attempt, prepended to this one
+        spill = self._sink_spill.pop(key, None)
+        if spill:
+            self.statsd.count("flush.spill_retry_total", len(spill),
+                              tags=[f"sink:{key}"])
+        if not len(batch) and not spill:
+            return ok if other_samples else None
+        name = sink.name()
+        sc = self._sink_filters.get(name)
+        current: Optional[List[InterMetric]] = None
         try:
-            name = sink.name()
-            sc = self._sink_filters.get(name)
-            if sc is None and self._routing is None:
-                # columnar fast path: no per-sink filtering and no
-                # routing annotations to honor, so the sink sees the
+            if self.chaos is not None:
+                self.chaos.inject("sink_flush")
+            if sc is None and self._routing is None and not spill:
+                # columnar fast path: no per-sink filtering, no routing
+                # annotations, no spill to prepend, so the sink sees the
                 # batch directly (the default flush_batch materializes;
                 # blackhole and friends never do). getattr: duck-typed
                 # sinks that only implement flush() still work.
@@ -1008,10 +1168,37 @@ class Server:
                         if mm.sinks is None or name in mm.sinks]
             if sc is not None:
                 selected = _apply_sink_filters(selected, sc)
-            sink.flush(selected)
+            current = selected
+            sink.flush(spill + selected if spill else selected)
             return ok
         except Exception:
             logger.exception("sink %s flush failed", sink.name())
+            # keep THIS interval's metrics for one retry next interval;
+            # a spill that just failed its retry is shed (loudly) so the
+            # buffer never exceeds one interval of data
+            if spill:
+                self.statsd.count("flush.spill_shed_total", len(spill),
+                                  tags=[f"sink:{key}"])
+                logger.error(
+                    "sink %s: shedding %d spilled metrics after a failed "
+                    "retry (one-interval spill bound)", key, len(spill))
+            if current is None:
+                # failed before per-sink selection (chaos seam, filter
+                # error): spill only this sink's routed+filtered share,
+                # or the next interval would deliver it metrics that
+                # routing excluded — and double-deliver them elsewhere
+                try:
+                    current = [mm for mm in batch.materialize()
+                               if mm.sinks is None or name in mm.sinks]
+                    if sc is not None:
+                        current = _apply_sink_filters(current, sc)
+                except Exception:
+                    logger.exception(
+                        "sink %s: selection failed while spilling; "
+                        "shedding the interval", key)
+                    current = []
+            if current:
+                self._sink_spill[key] = current
             return False
 
 

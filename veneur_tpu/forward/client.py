@@ -1,14 +1,24 @@
 """Forward client: streams the flush's mergeable state to the global tier.
 
-Parity with reference flusher.go:516-591 (forward/forwardGrpc): one
-SendMetricsV2 client-stream per flush, deadline-bounded by the interval,
-errors classified and counted but never retried — the next interval's data
-supersedes.
+Parity with reference flusher.go:516-591 (forward/forwardGrpc) — one
+SendMetricsV2 client-stream per flush, deadline-bounded by the interval —
+hardened with the shared resilience layer (util/resilience.py):
+
+* transient failures (UNAVAILABLE, DEADLINE_EXCEEDED, injected chaos)
+  retry with jittered backoff inside the flush-interval budget;
+* a circuit breaker stops hammering a down global tier (single half-open
+  probe per recovery window);
+* a FAILED interval's state is not dropped: counters are deltas, so a
+  dropped forward is permanently lost counts. Because every forwarded
+  family merges associatively, the failed snapshot is carried over and
+  merged into the next interval's snapshot (bounded, loud shedding
+  beyond the bound).
 """
 
 from __future__ import annotations
 
 import logging
+import time
 from typing import Dict, Optional
 
 import grpc
@@ -17,11 +27,20 @@ from veneur_tpu.core.flusher import ForwardableState
 from veneur_tpu.forward.convert import forwardable_to_wire
 from veneur_tpu.forward.wire import (_frame_v1, _serialize_metric,
                                      send_batch)
+from veneur_tpu.util import chaos as chaos_mod
+from veneur_tpu.util.chaos import ChaosError
 from veneur_tpu.util.grpctls import GrpcTLS, secure_or_insecure_channel
+from veneur_tpu.util.resilience import Carryover, CircuitBreaker, RetryPolicy
 
 logger = logging.getLogger("veneur_tpu.forward.client")
 
 _EMPTY_DESERIALIZER = lambda b: b  # google.protobuf.Empty carries nothing
+
+# transient transport states worth another attempt inside the budget;
+# anything else (UNIMPLEMENTED, INVALID_ARGUMENT, ...) is structural and
+# fails fast
+_RETRYABLE_CODES = (grpc.StatusCode.UNAVAILABLE,
+                    grpc.StatusCode.DEADLINE_EXCEEDED)
 
 
 class ForwardClient:
@@ -30,9 +49,22 @@ class ForwardClient:
 
     def __init__(self, address: str, deadline: float = 10.0,
                  channel: Optional[grpc.Channel] = None,
-                 tls: Optional[GrpcTLS] = None):
+                 tls: Optional[GrpcTLS] = None,
+                 retry: Optional[RetryPolicy] = None,
+                 breaker: Optional[CircuitBreaker] = None,
+                 carryover: Optional[Carryover] = None,
+                 chaos: Optional[chaos_mod.Chaos] = None):
         self.address = address
         self.deadline = deadline
+        # resilience: callers that want fail-and-forget (veneur-emit's
+        # one-shot send) pass retry/carryover explicitly disabled via
+        # RetryPolicy(max_attempts=1) / Carryover(0); the server wires
+        # these from its forward_retry_* / circuit_breaker_* /
+        # carryover_max_intervals config
+        self.retry = retry or RetryPolicy()
+        self.breaker = breaker or CircuitBreaker(name=f"forward:{address}")
+        self.carryover = carryover or Carryover()
+        self.chaos = chaos
         self._channel = channel or secure_or_insecure_channel(
             address, tls,
             # the V1 bulk body scales with key count (~36 MB at 50k keys)
@@ -56,42 +88,110 @@ class ForwardClient:
         self.stats: Dict[str, int] = {
             "forwarded_total": 0, "errors_deadline": 0,
             "errors_unavailable": 0, "errors_send": 0,
+            "retries_total": 0, "breaker_refused_total": 0,
         }
+
+    def _inject_chaos(self) -> None:
+        c = self.chaos or chaos_mod.active()
+        if c is not None:
+            c.inject("forward_send")
 
     def forward(self, fwd: ForwardableState) -> int:
         """Serialize and send one flush's state; returns count sent.
+
+        Any pending carryover from failed intervals is first merged into
+        `fwd` (counters sum, digests recompress, HLL registers max), so a
+        success delivers everything owed. On final failure the MERGED
+        state is stashed back; nothing is lost until the carryover bound
+        sheds it.
+
         Serialization goes through the native digest encoder
         (convert.forwardable_to_wire) — the per-centroid Python proto
         loop capped the plane at 883 keys/s (BENCH_r04). Transport
         prefers one unary SendMetrics (MetricList) — per-message stream
         overhead at 50k keys costs seconds — falling back to the V2
         stream for importers that reject V1."""
+        fwd = self.carryover.drain_into(fwd)
+        if not len(fwd):
+            return 0
+        if not self.breaker.allow():
+            self.stats["breaker_refused_total"] += 1
+            self.carryover.stash(fwd)
+            logger.warning(
+                "forward breaker %s to %s: carrying %d metrics over",
+                self.breaker.state, self.address, len(fwd))
+            return 0
         protos = forwardable_to_wire(fwd)
         if not protos:
             return 0
-        try:
-            # a single flush body scales with key count (~36 MB at 50k
-            # keys), so RESOURCE_EXHAUSTED here is structural, not
-            # transient — both codes pin the client to V2
-            self._v1_ok = send_batch(
-                self._send_v1, self._send_v2, protos, self.deadline,
-                self._v1_ok,
-                pin_codes=(grpc.StatusCode.UNIMPLEMENTED,
-                           grpc.StatusCode.RESOURCE_EXHAUSTED))
-        except grpc.RpcError as e:
-            code = e.code() if hasattr(e, "code") else None
-            if code == grpc.StatusCode.DEADLINE_EXCEEDED:
-                self.stats["errors_deadline"] += 1
-            elif code == grpc.StatusCode.UNAVAILABLE:
-                self.stats["errors_unavailable"] += 1
-            else:
-                self.stats["errors_send"] += 1
-            logger.warning("could not forward %d metrics to %s: %s",
-                           len(protos), self.address, code)
-            return 0
+        deadline_ts = time.monotonic() + self.deadline
+        delays = self.retry.delays(self.deadline)
+        while True:
+            try:
+                self._inject_chaos()
+                # per-attempt timeout is the REMAINING budget: a slow
+                # first attempt leaves correspondingly less for retries
+                timeout = max(0.05, deadline_ts - time.monotonic())
+                # a single flush body scales with key count (~36 MB at
+                # 50k keys), so RESOURCE_EXHAUSTED here is structural,
+                # not transient — both codes pin the client to V2
+                self._v1_ok = send_batch(
+                    self._send_v1, self._send_v2, protos, timeout,
+                    self._v1_ok,
+                    pin_codes=(grpc.StatusCode.UNIMPLEMENTED,
+                               grpc.StatusCode.RESOURCE_EXHAUSTED))
+                break
+            except (grpc.RpcError, ChaosError) as e:
+                code = e.code() if hasattr(e, "code") else None
+                retryable = (isinstance(e, ChaosError)
+                             or code in _RETRYABLE_CODES)
+                delay = next(delays, None) if retryable else None
+                if delay is None:
+                    self._record_failure(code, fwd, len(protos))
+                    return 0
+                self.stats["retries_total"] += 1
+                logger.info(
+                    "forward to %s failed (%s); retrying in %.2fs",
+                    self.address, code or e, delay)
+                if delay > 0:
+                    time.sleep(delay)
+        self.breaker.record_success()
+        self.carryover.clear_age()
         self.stats["forwarded_total"] += len(protos)
         logger.debug("forwarded %d metrics to %s", len(protos), self.address)
         return len(protos)
+
+    def _record_failure(self, code, fwd: ForwardableState,
+                        n_protos: int) -> None:
+        if code == grpc.StatusCode.DEADLINE_EXCEEDED:
+            self.stats["errors_deadline"] += 1
+        elif code == grpc.StatusCode.UNAVAILABLE:
+            self.stats["errors_unavailable"] += 1
+        else:
+            self.stats["errors_send"] += 1
+        self.breaker.record_failure()
+        self.carryover.stash(fwd)
+        logger.warning(
+            "could not forward %d metrics to %s: %s (carryover depth %d)",
+            n_protos, self.address, code, self.carryover.depth)
+
+    def telemetry_rows(self):
+        """(name, kind, value, tags) rows for the /metrics registry: the
+        send/error counters that used to be a private dict, plus breaker
+        and carryover state."""
+        rows = [(f"forward.{key}", "counter", float(value), ())
+                for key, value in self.stats.items()]
+        rows.append(("resilience.breaker_state", "gauge",
+                     float(self.breaker.state_code), ["target:forward"]))
+        rows.append(("resilience.breaker_opens", "counter",
+                     float(self.breaker.open_total), ["target:forward"]))
+        rows.append(("resilience.carryover_depth", "gauge",
+                     float(self.carryover.depth), ()))
+        rows.append(("resilience.carryover_merged", "counter",
+                     float(self.carryover.merged_total), ()))
+        rows.append(("resilience.carryover_shed", "counter",
+                     float(self.carryover.shed_total), ()))
+        return rows
 
     def send_protos(self, protos) -> int:
         """Stream pre-built metricpb Metrics (veneur-emit's grpc mode)."""

@@ -22,6 +22,7 @@ from veneur_tpu.forward.protos import metric_pb2
 from veneur_tpu.forward.wire import _serialize_metric, send_batch
 from veneur_tpu.proxy.ring import ConsistentRing, EmptyRingError
 from veneur_tpu.util.grpctls import GrpcTLS, secure_or_insecure_channel
+from veneur_tpu.util.resilience import CircuitBreaker
 
 logger = logging.getLogger("veneur_tpu.proxy.destinations")
 
@@ -34,17 +35,24 @@ class Destination:
                  send_buffer: int = 4096, batch: int = 512,
                  flush_interval: float = 0.5,
                  max_consecutive_failures: int = 3,
-                 tls: Optional[GrpcTLS] = None):
+                 tls: Optional[GrpcTLS] = None,
+                 breaker: Optional[CircuitBreaker] = None):
         self.address = address
         self._on_close = on_close
         self._queue: "queue.Queue" = queue.Queue(maxsize=send_buffer)
         self._batch = batch
         self._flush_interval = flush_interval
-        self._max_failures = max_consecutive_failures
-        self._failures = 0
+        # shared breaker replaces the old ad-hoc _failures counter: the
+        # sender thread feeds it; opening it closes the destination
+        # (ring removal — traffic re-shards onto the survivors until
+        # discovery re-adds the address, reference destinations.go:99)
+        self.breaker = breaker or CircuitBreaker(
+            failure_threshold=max_consecutive_failures,
+            name=f"proxy-dest:{address}")
         self.closed = threading.Event()
         self.sent_total = 0
         self.dropped_total = 0
+        self.shed_open_total = 0  # immediate sheds while the breaker is open
         self._channel = secure_or_insecure_channel(address, tls)
         # batches hold Metric objects (the V2 ingest path) or raw wire
         # bytes (the native V1 re-scatter): the serializer passes both
@@ -74,15 +82,33 @@ class Destination:
         destination channel stalls that gRPC handler goroutine. One sick
         destination therefore slows (but doesn't kill) streams whose
         metrics hash to it; the bound is one flush_interval per metric,
-        after which the metric drops."""
+        after which the metric drops.
+
+        A sick destination sheds immediately instead: with the breaker
+        OPEN (or the queue full while the destination is mid failure
+        streak) there is nothing to apply backpressure FOR — the old
+        behavior stalled the gRPC handler a full flush_interval per
+        metric that hashed here, for the whole window between the first
+        failure and the breaker tripping."""
         if self.closed.is_set():
             self.dropped_total += 1
+            return False
+        if not self.breaker.is_dispatchable:
+            self.dropped_total += 1
+            self.shed_open_total += 1
             return False
         try:
             self._queue.put_nowait(metric)
             return True
         except queue.Full:
             pass
+        if self.breaker.consecutive_failures > 0:
+            # failing-but-not-yet-open: the queue is full because the
+            # sender can't drain it — blocking would stall the handler
+            # without ever creating room
+            self.dropped_total += 1
+            self.shed_open_total += 1
+            return False
         try:
             self._queue.put(metric, timeout=self._flush_interval)
             return True
@@ -119,15 +145,14 @@ class Destination:
                     pin_codes=(grpc.StatusCode.UNIMPLEMENTED,),
                     retry_codes=(grpc.StatusCode.RESOURCE_EXHAUSTED,))
                 self.sent_total += len(batch)
-                self._failures = 0
+                self.breaker.record_success()
             except grpc.RpcError as e:
-                self._failures += 1
+                self.breaker.record_failure()
                 self.dropped_total += len(batch)
                 code = e.code() if hasattr(e, "code") else None
-                logger.warning("send to %s failed (%s), failure %d/%d",
-                               self.address, code, self._failures,
-                               self._max_failures)
-                if self._failures >= self._max_failures:
+                logger.warning("send to %s failed (%s), breaker %s",
+                               self.address, code, self.breaker.state)
+                if not self.breaker.is_dispatchable:
                     self.close(notify=True)
                     return
 
@@ -148,7 +173,8 @@ class Destinations:
 
     def __init__(self, send_buffer: int = 4096, batch: int = 512,
                  flush_interval: float = 0.5,
-                 tls: Optional[GrpcTLS] = None):
+                 tls: Optional[GrpcTLS] = None,
+                 max_consecutive_failures: int = 3):
         self._lock = threading.RLock()
         self._pool: Dict[str, Destination] = {}
         self.ring = ConsistentRing()
@@ -156,6 +182,7 @@ class Destinations:
         self._batch = batch
         self._flush_interval = flush_interval
         self._tls = tls
+        self._max_failures = max_consecutive_failures
 
     def set_destinations(self, addresses: List[str]) -> None:
         """Reconcile the pool with a fresh discovery result."""
@@ -169,7 +196,8 @@ class Destinations:
                     self._pool[address] = Destination(
                         address, self._on_destination_closed,
                         send_buffer=self._send_buffer, batch=self._batch,
-                        flush_interval=self._flush_interval, tls=self._tls)
+                        flush_interval=self._flush_interval, tls=self._tls,
+                        max_consecutive_failures=self._max_failures)
                     self.ring.add(address)
 
     def addresses(self) -> List[str]:
@@ -208,6 +236,27 @@ class Destinations:
     def size(self) -> int:
         with self._lock:
             return len(self._pool)
+
+    def telemetry_rows(self) -> List[tuple]:
+        """(name, kind, value, tags) rows for the proxy's /metrics
+        registry: per-destination send/drop/shed totals, queue depth,
+        and breaker state."""
+        with self._lock:
+            pool = list(self._pool.values())
+        rows: List[tuple] = []
+        for dest in pool:
+            tags = [f"destination:{dest.address}"]
+            rows.append(("proxy.dest.sent", "counter",
+                         float(dest.sent_total), tags))
+            rows.append(("proxy.dest.dropped", "counter",
+                         float(dest.dropped_total), tags))
+            rows.append(("proxy.dest.shed_open", "counter",
+                         float(dest.shed_open_total), tags))
+            rows.append(("proxy.dest.queue_depth", "gauge",
+                         float(dest._queue.qsize()), tags))
+            rows.append(("resilience.breaker_state", "gauge",
+                         float(dest.breaker.state_code), tags))
+        return rows
 
     def clear(self) -> None:
         with self._lock:

@@ -40,7 +40,8 @@ class ProxyServer:
                  max_workers: int = 8,
                  tls: Optional[GrpcTLS] = None,
                  tls_listen_address: str = "",
-                 destination_tls: Optional[GrpcTLS] = None):
+                 destination_tls: Optional[GrpcTLS] = None,
+                 max_consecutive_failures: int = 3):
         self.discoverer = discoverer
         self.forward_service = forward_service
         self.discovery_interval = discovery_interval
@@ -48,7 +49,8 @@ class ProxyServer:
         # from shutdown_timeout
         self._ignore = list(ignore_tags or [])
         self.destinations = Destinations(
-            send_buffer=send_buffer, batch=batch, tls=destination_tls)
+            send_buffer=send_buffer, batch=batch, tls=destination_tls,
+            max_consecutive_failures=max_consecutive_failures)
         # per-RPC latency/error aggregates (reference proxy/grpcstats)
         self.rpc_stats = RpcStats()
         self.stats: Dict[str, int] = {
@@ -143,6 +145,18 @@ class ProxyServer:
     def healthy(self) -> bool:
         """False while no destinations are connected (handlers.go:30-38)."""
         return self.destinations.size() > 0
+
+    def telemetry_rows(self) -> List[tuple]:
+        """Scrape-time rows for /metrics: routing counters plus the
+        per-destination pool/breaker rows (proxy.dest.*,
+        resilience.breaker_state)."""
+        with self._stats_lock:
+            rows = [(f"proxy.{key}", "counter", float(value), ())
+                    for key, value in self.stats.items()]
+        rows.append(("proxy.destinations", "gauge",
+                     float(self.destinations.size()), ()))
+        rows.extend(self.destinations.telemetry_rows())
+        return rows
 
     # -- discovery -------------------------------------------------------
 

@@ -13,17 +13,47 @@ from __future__ import annotations
 
 import gzip
 import json
+import time
 import urllib.error
 import urllib.request
 import zlib
 from typing import Any, Dict, Optional, Tuple
 
+# vendor responses worth another attempt: throttling (429) and transient
+# unavailability (503); everything else (auth, bad payload, 5xx bugs) is
+# structural and retrying it only doubles the damage
+RETRYABLE_STATUSES = frozenset((429, 503))
+
 
 class HTTPError(Exception):
-    def __init__(self, status: int, body: bytes = b""):
+    def __init__(self, status: int, body: bytes = b"",
+                 retry_after: Optional[float] = None):
         super().__init__(f"HTTP {status}: {body[:200]!r}")
         self.status = status
         self.body = body
+        # parsed Retry-After (seconds), when the server sent one
+        self.retry_after = retry_after
+
+    @property
+    def retryable(self) -> bool:
+        return self.status in RETRYABLE_STATUSES
+
+
+def _parse_retry_after(value: Optional[str]) -> Optional[float]:
+    """Retry-After per RFC 9110: delta-seconds or an HTTP-date."""
+    if not value:
+        return None
+    value = value.strip()
+    try:
+        return max(0.0, float(value))
+    except ValueError:
+        pass
+    try:
+        from email.utils import parsedate_to_datetime
+        when = parsedate_to_datetime(value)
+        return max(0.0, when.timestamp() - time.time())
+    except (TypeError, ValueError):
+        return None
 
 
 def snappy_encode(data: bytes) -> bytes:
@@ -143,11 +173,48 @@ def post(url: str, body: bytes, *,
     if proxy_url:
         opener = urllib.request.build_opener(urllib.request.ProxyHandler(
             {"http": proxy_url, "https": proxy_url})).open
+    # fault-injection seam: no-op unless a chaos plan is installed
+    from veneur_tpu.util import chaos as chaos_mod
+    chaos_mod.inject("http_post")
     try:
         with opener(req, timeout=timeout) as resp:
             return resp.status, resp.read()
     except urllib.error.HTTPError as e:
-        raise HTTPError(e.code, e.read()) from e
+        raise HTTPError(e.code, e.read(),
+                        retry_after=_parse_retry_after(
+                            e.headers.get("Retry-After"))) from e
+
+
+def post_with_retry(url: str, body: bytes, *,
+                    retry=None, budget: float = 10.0,
+                    **kwargs) -> Tuple[int, bytes]:
+    """`post` with the shared backoff policy (util/resilience.py):
+    retries 429/503 (honoring Retry-After), connection errors, and
+    injected chaos, never spending more than `budget` seconds total —
+    sinks call this from their per-sink flush thread, whose own bound is
+    one flush interval."""
+    from veneur_tpu.util.chaos import ChaosError
+    from veneur_tpu.util.resilience import RetryPolicy
+    retry = retry or RetryPolicy()
+    deadline = time.monotonic() + budget
+    delays = retry.delays(budget)
+    while True:
+        try:
+            return post(url, body, **kwargs)
+        except (HTTPError, urllib.error.URLError, ChaosError) as e:
+            retryable = (isinstance(e, (urllib.error.URLError, ChaosError))
+                         or getattr(e, "retryable", False))
+            delay = next(delays, None) if retryable else None
+            if delay is None:
+                raise
+            # a server-provided Retry-After overrides (extends) backoff,
+            # still inside the budget
+            retry_after = getattr(e, "retry_after", None)
+            if retry_after:
+                delay = max(delay, retry_after)
+            if time.monotonic() + delay >= deadline:
+                raise
+            time.sleep(delay)
 
 
 def post_json(url: str, obj: Any, *, headers: Optional[Dict[str, str]] = None,
