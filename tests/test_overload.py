@@ -1,0 +1,733 @@
+"""Ingest admission control, overload degradation & pipeline supervision
+(core/overload.py): unit coverage of the token bucket / watermark ladder
+/ kernel-drop parsing / supervisor, server-level shed-ladder semantics,
+the /healthcheck/ready degradation surface, and the acceptance soak —
+20 rounds at 30 % injected ingest faults under a hard memory watermark
+with exact loss accounting."""
+
+import logging
+import socket
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from veneur_tpu.config import Config
+from veneur_tpu.core import overload as ov
+from veneur_tpu.core.overload import (
+    DEGRADED, OK, SHEDDING, KernelDropMonitor, OverloadManager, Supervisor,
+    TokenBucket, WatermarkMonitor)
+from veneur_tpu.core.server import Server
+from veneur_tpu.sinks.channel import ChannelMetricSink
+from veneur_tpu.util.chaos import Chaos
+
+pytestmark = pytest.mark.chaos
+
+
+def make_config(**overrides) -> Config:
+    cfg = Config()
+    cfg.interval = 10.0
+    cfg.hostname = "test"
+    cfg.tpu.counter_capacity = 128
+    cfg.tpu.gauge_capacity = 128
+    cfg.tpu.histo_capacity = 128
+    cfg.tpu.set_capacity = 64
+    cfg.tpu.batch_cap = 512
+    for k, v in overrides.items():
+        setattr(cfg, k, v)
+    return cfg.apply_defaults()
+
+
+def wait_until(fn, timeout=10.0, step=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(step)
+    return False
+
+
+def by_name(metrics):
+    out = {}
+    for metric in metrics:
+        out.setdefault(metric.name, []).append(metric)
+    return out
+
+
+class FakeClock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+class TestTokenBucket:
+    def test_zero_rate_admits_everything(self):
+        b = TokenBucket(0, 0)
+        assert all(b.admit() for _ in range(10_000))
+
+    def test_burst_then_refusal(self):
+        clock = FakeClock()
+        b = TokenBucket(rate=10, burst=5, clock=clock)
+        assert sum(b.admit() for _ in range(10)) == 5
+
+    def test_refill_over_time(self):
+        clock = FakeClock()
+        b = TokenBucket(rate=10, burst=5, clock=clock)
+        for _ in range(5):
+            b.admit()
+        assert not b.admit()
+        clock.t += 0.5  # refills 5 tokens
+        assert sum(b.admit() for _ in range(10)) == 5
+
+    def test_batch_admission_all_or_nothing(self):
+        clock = FakeClock()
+        b = TokenBucket(rate=10, burst=10, clock=clock)
+        assert b.admit(8)
+        assert not b.admit(8)  # only 2 left
+        assert b.admit(2)
+
+
+class TestKernelDropMonitor:
+    PROC = (
+        "  sl  local_address rem_address   st tx_queue rx_queue tr "
+        "tm->when retrnsmt   uid  timeout inode ref pointer drops\n"
+        "   0: 0100007F:1F90 00000000:0000 07 00000000:00000000 00:00000000"
+        " 00000000  1000        0 12345 2 ffff000000000000 7\n"
+        "   1: 00000000:0035 00000000:0000 07 00000000:00000000 00:00000000"
+        " 00000000  1000        0 99999 2 ffff000000000000 0\n")
+
+    def test_parse_proc_udp(self):
+        drops = KernelDropMonitor.parse_proc_udp(self.PROC)
+        assert drops == {12345: 7, 99999: 0}
+
+    def test_poll_accumulates_deltas_not_absolutes(self, monkeypatch):
+        mon = KernelDropMonitor()
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.bind(("127.0.0.1", 0))
+            import os
+            inode = os.fstat(s.fileno()).st_ino
+            mon.watch_socket(s, "udp:test")
+            readings = iter([{inode: 10}, {inode: 10}, {inode: 17},
+                             {inode: 17}])
+            monkeypatch.setattr(mon, "_read_proc",
+                                lambda: next(readings))
+            # first sighting: pre-existing drops are baseline, not ours
+            assert mon.poll() == 0
+            assert mon.poll() == 0
+            assert mon.poll() == 7
+            assert mon.poll() == 0
+            assert mon.totals() == {"udp:test": 7}
+
+    def test_real_proc_poll_is_harmless(self):
+        # on Linux this reads the real /proc/net/udp; elsewhere it is a
+        # no-op — either way nothing raises and totals stay consistent
+        mon = KernelDropMonitor()
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.bind(("127.0.0.1", 0))
+            mon.watch_socket(s, "udp:real")
+            mon.poll()
+            mon.poll()
+            assert mon.totals().get("udp:real", 0) >= 0
+
+
+class TestWatermarkMonitor:
+    def test_ladder_transitions_and_recovery(self):
+        edges = []
+        mon = WatermarkMonitor(soft_bytes=100, hard_bytes=200,
+                               on_transition=lambda o, n, r:
+                               edges.append((o, n)))
+        assert mon.observe(50) == OK
+        assert mon.observe(150) == DEGRADED
+        assert mon.observe(250) == SHEDDING
+        # recovery is immediate: one reading below soft returns to ok
+        assert mon.observe(50) == OK
+        assert edges == [(OK, DEGRADED), (DEGRADED, SHEDDING),
+                         (SHEDDING, OK)]
+        assert mon.transitions == 3
+
+    def test_disabled_watermarks_never_leave_ok(self):
+        mon = WatermarkMonitor(soft_bytes=0, hard_bytes=0)
+        assert mon.observe(10**15) == OK
+
+    def test_tick_includes_chaos_pressure(self):
+        chaos = Chaos(ingest_rss_bytes=0)
+        mon = WatermarkMonitor(soft_bytes=1, hard_bytes=10**14,
+                               pressure=chaos.simulated_rss_bytes)
+        assert mon.tick() == DEGRADED  # real RSS alone clears 1 byte
+        chaos.set_simulated_rss(10**14)
+        assert mon.tick() == SHEDDING
+        chaos.set_simulated_rss(0)
+        assert mon.tick() == DEGRADED
+
+
+class TestSupervisor:
+    def test_stall_detected_and_recovers(self, caplog):
+        clock = FakeClock()
+        stalls = []
+        sup = Supervisor(deadline=1.0, escalation_deadline=0.0,
+                         on_stall=lambda n, a: stalls.append(n),
+                         escalate=lambda n, a: pytest.fail("escalated"),
+                         clock=clock)
+        sup.register("pump")
+        assert sup.check() == []
+        clock.t += 2.0
+        with caplog.at_level(logging.ERROR, "veneur_tpu.overload"):
+            assert sup.check() == ["pump"]
+        assert any("pump stalled" in r.message for r in caplog.records)
+        assert stalls == ["pump"]
+        assert sup.stall_counts == {"pump": 1}
+        # flagged once, not once per poll
+        assert sup.check() == []
+        # a heartbeat clears the stall; the next stall re-flags
+        sup.beat("pump")
+        assert sup.stalled_components() == []
+        clock.t += 2.0
+        assert sup.check() == ["pump"]
+        assert sup.stall_counts == {"pump": 2}
+
+    def test_per_component_deadline_override(self):
+        clock = FakeClock()
+        sup = Supervisor(deadline=0.5, clock=clock)
+        sup.register("fast")
+        sup.register("slow", deadline=10.0)
+        clock.t += 1.0
+        assert sup.check() == ["fast"]  # slow's override not exceeded
+
+    def test_default_escalation_reports_through_crash_machinery(
+            self, monkeypatch):
+        """_hard_abort notifies the registered crash reporters (the
+        Sentry seam) before the hard exit."""
+        from veneur_tpu.core.overload import _hard_abort
+        from veneur_tpu.util import crash
+
+        reported = []
+        exits = []
+        crash.register_reporter(lambda exc, tb: reported.append(str(exc)))
+        monkeypatch.setattr(ov.os, "_exit", exits.append)
+        try:
+            _hard_abort("pump", 12.0)
+        finally:
+            crash.clear_reporters()
+        assert exits == [3]
+        assert reported and "pump stalled for 12.0s" in reported[0]
+
+    def test_escalation_after_deadline(self):
+        clock = FakeClock()
+        escalated = []
+        sup = Supervisor(deadline=1.0, escalation_deadline=5.0,
+                         escalate=lambda n, a: escalated.append(n),
+                         clock=clock)
+        sup.register("pump")
+        clock.t += 2.0
+        sup.check()  # flagged, but not yet escalated
+        assert escalated == []
+        clock.t += 5.0
+        sup.check()
+        assert escalated == ["pump"]
+
+    def test_probe_advances_surface_as_stalls(self):
+        clock = FakeClock()
+        sup = Supervisor(deadline=100.0, clock=clock)
+        value = [0]
+        sup.add_probe("pump-native", lambda: value[0])
+        sup.check()
+        assert sup.probe_stalls == {"pump-native": 0}
+        value[0] = 3
+        sup.check()
+        assert sup.probe_stalls == {"pump-native": 3}
+        value[0] = 5
+        sup.check()
+        assert sup.probe_stalls == {"pump-native": 5}
+
+    def test_unregister_drops_probes_too(self):
+        """A probe closure keeps its owner (the native Pump) alive: a
+        closed listener's unregister must remove it, or the pump leaks
+        and a restart double-registers under the same name."""
+        clock = FakeClock()
+        sup = Supervisor(deadline=100.0, clock=clock)
+        sup.register("pump")
+        sup.add_probe("pump", lambda: 5)
+        sup.check()
+        sup.unregister("pump")
+        assert sup._probes == []
+        assert sup.probe_stalls == {}
+        sup.check()  # no stale probe polled
+        assert sup.probe_stalls == {}
+
+    def test_disabled_supervisor_never_starts(self):
+        sup = Supervisor(deadline=0.0)
+        sup.start()
+        assert sup._thread is None
+        sup.stop()
+
+
+class TestShedLadder:
+    """Server-level: the priority ladder drops spans first, then
+    histogram/set samples, and never counter/gauge deltas."""
+
+    def _pressured_server(self, state_bytes, **overrides):
+        cfg = make_config(
+            chaos_enabled=True,
+            overload_watermark_soft_bytes=10**13,
+            overload_watermark_hard_bytes=2 * 10**13,
+            overload_watermark_poll=0.05, **overrides)
+        server = Server(cfg, extra_metric_sinks=[ChannelMetricSink()])
+        if state_bytes:
+            server.chaos.set_simulated_rss(state_bytes)
+        server.overload.watermarks.tick()  # apply without the thread
+        return server
+
+    def test_shedding_keeps_counters_and_gauges_sheds_histo_set(self):
+        server = self._pressured_server(3 * 10**13)
+        try:
+            assert server.overload.state == SHEDDING
+            server.handle_metric_packet(b"lad.c:5|c")
+            server.handle_metric_packet(b"lad.g:7|g")
+            server.handle_metric_packet(b"lad.h:1|ms")
+            server.handle_metric_packet(b"lad.s:x|s")
+            server.flush()
+            got = by_name(server.metric_sinks[0].wait_flush())
+            assert got["lad.c"][0].value == 5.0
+            assert got["lad.g"][0].value == 7.0
+            assert not any(n.startswith(("lad.h", "lad.s")) for n in got)
+            shed = server.overload.shed_total
+            assert shed.get("histogram|overload") == 1
+            assert shed.get("set|overload") == 1
+        finally:
+            server.shutdown()
+
+    def test_any_degradation_pauses_span_ingest(self):
+        server = self._pressured_server(int(1.5 * 10**13))
+        try:
+            assert server.overload.state == DEGRADED
+            before = server.span_chan.qsize()
+            server.ingest_span(object())
+            assert server.span_chan.qsize() == before  # shed, not queued
+            assert server.overload.shed_total.get("span|overload") == 1
+        finally:
+            server.shutdown()
+
+    def test_degraded_tightens_histogram_sampling(self):
+        server = self._pressured_server(
+            int(1.5 * 10**13), overload_watermark_degraded_keep=0.25)
+        try:
+            assert server.overload.state == DEGRADED
+            for _ in range(100):
+                server.handle_metric_packet(b"deg.h:1|ms")
+            shed = server.overload.shed_total.get("histogram|degraded", 0)
+            assert shed == 75  # keep-1-in-4 is deterministic
+        finally:
+            server.shutdown()
+
+    def test_ok_state_sheds_nothing(self):
+        server = self._pressured_server(0)
+        try:
+            assert server.overload.state == OK
+            server.handle_metric_packet(b"ok.h:1|ms")
+            server.handle_metric_packet(b"ok.s:x|s")
+            server.ingest_span(object())
+            assert server.overload.shed_total == {}
+        finally:
+            server.shutdown()
+
+    def test_over_limit_statsd_packet_keeps_counters(self):
+        """Rate-limited packets parse in essential-only mode: histogram
+        and set samples shed, counter/gauge deltas kept."""
+        cfg = make_config(ingest_rate_limit_statsd=1.0,
+                          ingest_rate_limit_burst=1.0)
+        server = Server(cfg, extra_metric_sinks=[ChannelMetricSink()])
+        try:
+            # the bucket holds exactly 1 token: first packet is clean,
+            # the rest are over-limit
+            batches = [b"rl.c:1|c\nrl.h:1|ms" for _ in range(5)]
+            server.handle_packet_batch(batches)
+            server.flush()
+            got = by_name(server.metric_sinks[0].wait_flush())
+            assert got["rl.c"][0].value == 5.0         # every delta kept
+            hist = [n for n in got if n.startswith("rl.h")]
+            shed = server.overload.shed_total.get("histogram|rate_limit", 0)
+            assert shed == 4                            # over-limit sheds
+            assert any("count" in n for n in hist)      # clean one kept
+        finally:
+            server.shutdown()
+
+    def test_span_rate_limit_sheds_and_counts(self):
+        cfg = make_config(ingest_rate_limit_spans=1.0,
+                          ingest_rate_limit_burst=1.0)
+        server = Server(cfg, extra_metric_sinks=[ChannelMetricSink()])
+        try:
+            for _ in range(4):
+                server.ingest_span(object())
+            assert server.span_chan.qsize() == 1
+            assert server.overload.shed_total.get("span|rate_limit") == 3
+        finally:
+            server.shutdown()
+
+
+class TestChaosIngestFaults:
+    def test_mangle_is_seeded_deterministic(self):
+        def run(seed):
+            c = Chaos(seed=seed, ingest_drop_rate=0.2,
+                      ingest_truncate_rate=0.2, ingest_duplicate_rate=0.2)
+            return c.mangle_packets([b"pkt.a:1|c"] * 200)
+
+        assert run(3) == run(3)
+        assert run(3) != run(4)
+
+    def test_fault_accounting_is_exact(self):
+        c = Chaos(seed=11, ingest_drop_rate=0.3, ingest_truncate_rate=0.2,
+                  ingest_duplicate_rate=0.1)
+        sent = [b"acc.c:1|c"] * 1000
+        out = c.mangle_packets(sent)
+        pf = c.packet_faults
+        assert len(out) == (1000 - pf.get("drop", 0)
+                            - 0  # truncated packets survive, shorter
+                            + pf.get("duplicate", 0))
+        truncated = [p for p in out if p != b"acc.c:1|c"]
+        assert len(truncated) == pf.get("truncate", 0)
+        assert all(len(p) < len(b"acc.c:1|c") for p in truncated)
+
+    def test_one_byte_packets_never_count_phantom_truncates(self):
+        c = Chaos(seed=5, ingest_truncate_rate=1.0)
+        out = c.mangle_packets([b"x"] * 50)
+        assert out == [b"x"] * 50  # can't shorten: passed untouched
+        assert c.packet_faults.get("truncate", 0) == 0
+
+    def test_truncate_always_shortens(self):
+        c = Chaos(seed=6, ingest_truncate_rate=1.0)
+        out = c.mangle_packets([b"some.metric:1|c"] * 200)
+        assert len(out) == 200
+        assert all(1 <= len(p) < len(b"some.metric:1|c") for p in out)
+
+    def test_no_faults_planned_is_identity(self):
+        c = Chaos(seed=1)
+        batch = [b"x:1|c"]
+        assert c.mangle_packets(batch) is batch
+
+    def test_telemetry_rows_include_packet_faults(self):
+        c = Chaos(seed=2, ingest_drop_rate=1.0)
+        c.mangle_packets([b"x:1|c"])
+        rows = c.telemetry_rows()
+        assert ("chaos.packet_faults", "counter", 1.0,
+                ["action:drop"]) in rows
+
+
+class TestReadyDegradation:
+    def _http_get(self, addr, path):
+        host, port = addr
+        try:
+            with urllib.request.urlopen(
+                    f"http://{host}:{port}{path}", timeout=5) as r:
+                return r.status, r.read()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read()
+
+    def test_ready_answers_503_with_reason_while_shedding(self):
+        cfg = make_config(http_address="127.0.0.1:0", chaos_enabled=True,
+                          overload_watermark_soft_bytes=10**13,
+                          overload_watermark_hard_bytes=2 * 10**13,
+                          overload_watermark_poll=0.05)
+        server = Server(cfg, extra_metric_sinks=[ChannelMetricSink()])
+        server.start()
+        try:
+            addr = server.http_api.address
+            status, body = self._http_get(addr, "/healthcheck/ready")
+            assert status == 200
+            server.chaos.set_simulated_rss(3 * 10**13)
+            assert wait_until(
+                lambda: server.overload.state == SHEDDING, timeout=5.0)
+            status, body = self._http_get(addr, "/healthcheck/ready")
+            assert status == 503
+            import json
+            payload = json.loads(body)
+            assert payload["ready"] is False
+            assert "shedding" in payload["reason"]
+            # /metrics carries the ladder state for scrapers
+            _, metrics = self._http_get(addr, "/metrics")
+            assert b"veneur_overload_state 2" in metrics
+            # release: back to ok within one poll interval, ready again
+            server.chaos.set_simulated_rss(0)
+            assert wait_until(
+                lambda: server.overload.state == OK, timeout=5.0)
+            status, _ = self._http_get(addr, "/healthcheck/ready")
+            assert status == 200
+        finally:
+            server.shutdown()
+
+    def test_ready_fails_while_flush_watchdog_tripped(self):
+        # interval 60s: neither the flush loop (which would reset
+        # last_flush_unix) nor the watchdog thread (which would abort
+        # the whole process, os._exit) ticks during the test window
+        cfg = make_config(http_address="127.0.0.1:0", interval=60.0,
+                          flush_watchdog_missed_flushes=2)
+        server = Server(cfg, extra_metric_sinks=[ChannelMetricSink()])
+        server.start()
+        try:
+            addr = server.http_api.address
+            status, _ = self._http_get(addr, "/healthcheck/ready")
+            assert status == 200
+            # simulate a wedged flush loop: the last flush recedes past
+            # the 2-interval watchdog budget
+            server.last_flush_unix = time.time() - 2.1 * 60.0
+            status, body = self._http_get(addr, "/healthcheck/ready")
+            assert status == 503
+            assert b"watchdog" in body
+        finally:
+            server.shutdown()
+
+    def test_overload_transitions_hit_the_flight_recorder(self):
+        cfg = make_config(chaos_enabled=True,
+                          overload_watermark_soft_bytes=10**13,
+                          overload_watermark_hard_bytes=2 * 10**13)
+        server = Server(cfg, extra_metric_sinks=[ChannelMetricSink()])
+        try:
+            server.chaos.set_simulated_rss(3 * 10**13)
+            server.overload.watermarks.tick()
+            events = server.telemetry.events.snapshot(
+                kind="overload_state")
+            assert events and events[-1]["new"] == SHEDDING
+        finally:
+            server.shutdown()
+
+
+class TestSupervisorInServer:
+    def test_stalled_pipeline_thread_detected_and_exported(self, caplog):
+        """Acceptance pin: a deliberately stalled ingest-pipeline thread
+        is detected within supervisor_deadline, logged at ERROR, and
+        exported as a stall metric."""
+        # deadline must clear the span worker's idle beat period (the
+        # 0.5 s queue-poll timeout), or a healthy-but-idle worker could
+        # be flagged before the wedge even lands
+        cfg = make_config(supervisor_deadline=1.0, supervisor_poll=0.05)
+        server = Server(cfg, extra_metric_sinks=[ChannelMetricSink()])
+        release = threading.Event()
+
+        def wedge(span):
+            release.wait(20.0)
+
+        server.metric_extraction.ingest = wedge  # stalls the span worker
+        server.start()
+        try:
+            with caplog.at_level(logging.ERROR, "veneur_tpu.overload"):
+                server.ingest_span(object())
+                deadline = (cfg.supervisor_deadline
+                            + 4 * cfg.supervisor_poll + 1.0)
+                assert wait_until(
+                    lambda: server.overload.supervisor.stall_counts.get(
+                        "span-worker-0", 0) >= 1, timeout=deadline), \
+                    "supervisor never flagged the wedged span worker"
+                # the counter increments just before the log call: wait
+                # for the record too rather than racing it
+                assert wait_until(lambda: any(
+                    "span-worker-0 stalled" in r.getMessage()
+                    for r in caplog.records), timeout=2.0)
+            exposition = server.telemetry.registry.render_prometheus()
+            assert ('veneur_supervisor_stalls_total'
+                    '{component="span-worker-0"}') in exposition
+            events = server.telemetry.events.snapshot(
+                kind="pipeline_stall")
+            assert events and events[-1]["component"] == "span-worker-0"
+        finally:
+            release.set()
+            server.shutdown()
+
+    def test_healthy_server_reports_no_stalls(self):
+        cfg = make_config(supervisor_deadline=2.0, supervisor_poll=0.05,
+                          interval=0.2)
+        server = Server(cfg, extra_metric_sinks=[ChannelMetricSink()])
+        server.start()
+        try:
+            time.sleep(1.2)  # several supervision passes
+            assert server.overload.supervisor.stall_counts == {}
+        finally:
+            server.shutdown()
+
+
+class TestOverloadSoak:
+    """The acceptance soak: 20 rounds at 30 % injected ingest faults
+    (drop/truncate/duplicate) under a hard memory watermark. Pins:
+    - shedding engages within one poll interval of crossing the hard
+      watermark, and releases within one interval of pressure release;
+    - counter deltas from every admitted packet are lossless;
+    - every shed histogram sample is accounted for in ingest.shed_total.
+    """
+
+    COUNTERS_PER_ROUND = 50
+    HISTOS_PER_ROUND = 20
+
+    def _fault_deltas(self, chaos, before):
+        after = dict(chaos.packet_faults)
+        delta = {k: after.get(k, 0) - before.get(k, 0)
+                 for k in ("drop", "truncate", "duplicate")}
+        return after, delta
+
+    @pytest.mark.slow
+    def test_soak_20_rounds_30pct_ingest_faults_under_watermark(self):
+        poll = 0.05
+        cfg = make_config(
+            chaos_enabled=True, chaos_seed=42,
+            chaos_ingest_drop_rate=0.15,
+            chaos_ingest_truncate_rate=0.10,
+            chaos_ingest_duplicate_rate=0.05,   # 30 % total fault rate
+            overload_watermark_soft_bytes=10**13,
+            overload_watermark_hard_bytes=2 * 10**13,
+            overload_watermark_poll=poll)
+        sink = ChannelMetricSink()
+        server = Server(cfg, extra_metric_sinks=[sink])
+        server.start()
+        chaos = server.chaos
+        expected_counters = 0.0
+        expected_histo_count = 0.0
+        expected_shed = 0
+        pf = dict(chaos.packet_faults)
+        try:
+            for rnd in range(20):
+                if rnd == 8:
+                    # cross the hard watermark: shedding must engage
+                    # within one poll interval
+                    chaos.set_simulated_rss(3 * 10**13)
+                    assert wait_until(
+                        lambda: server.overload.state == SHEDDING,
+                        timeout=10 * poll + 1.0), \
+                        "hard watermark exceeded for more than one interval"
+                if rnd == 15:
+                    # release: back to ok within one interval
+                    chaos.set_simulated_rss(0)
+                    assert wait_until(
+                        lambda: server.overload.state == OK,
+                        timeout=10 * poll + 1.0), \
+                        "did not return to ok within one interval"
+                state = server.overload.state
+                server.handle_packet_batch(
+                    [b"soak.c:1|c"] * self.COUNTERS_PER_ROUND)
+                pf, d = self._fault_deltas(chaos, pf)
+                # every truncation of b"soak.c:1|c" is a parse error, so
+                # admitted = sent - dropped - truncated + duplicated
+                expected_counters += (self.COUNTERS_PER_ROUND - d["drop"]
+                                      - d["truncate"] + d["duplicate"])
+                # single-char type on purpose: every possible truncation
+                # of this packet is a parse error (b"...|m" would parse
+                # as a valid timer), keeping the loss accounting exact
+                server.handle_packet_batch(
+                    [b"soak.h:1|h"] * self.HISTOS_PER_ROUND)
+                pf, d = self._fault_deltas(chaos, pf)
+                surviving = (self.HISTOS_PER_ROUND - d["drop"]
+                             - d["truncate"] + d["duplicate"])
+                if state == SHEDDING:
+                    expected_shed += surviving
+                else:
+                    expected_histo_count += surviving
+                server.flush()
+            flushed = sink.drain()
+            got = by_name(flushed)
+            counter_total = sum(
+                m.value for m in got.get("soak.c", []))
+            assert counter_total == expected_counters, \
+                "admitted counter deltas were not lossless"
+            histo_count = sum(
+                m.value for m in got.get("soak.h.count", []))
+            assert histo_count == expected_histo_count
+            shed = server.overload.shed_total.get("histogram|overload", 0)
+            assert shed == expected_shed, \
+                "shed histogram samples not fully accounted"
+            # the ladder surfaced in /metrics and the flight recorder
+            exposition = server.telemetry.registry.render_prometheus()
+            assert "veneur_ingest_shed_total" in exposition
+            assert "veneur_chaos_packet_faults_total" in exposition
+            transitions = server.telemetry.events.snapshot(
+                kind="overload_state")
+            assert [e["new"] for e in transitions] == [SHEDDING, OK]
+        finally:
+            server.shutdown()
+
+
+class TestOverloadManagerLifecycle:
+    def test_monitor_thread_polls_watermarks(self):
+        cfg = make_config(chaos_enabled=True,
+                          overload_watermark_soft_bytes=10**13,
+                          overload_watermark_hard_bytes=2 * 10**13,
+                          overload_watermark_poll=0.05)
+        mgr = OverloadManager(cfg, chaos=Chaos(ingest_rss_bytes=3 * 10**13))
+        mgr.start()
+        try:
+            assert wait_until(lambda: mgr.state == SHEDDING, timeout=5.0)
+        finally:
+            mgr.stop()
+
+    def test_oversized_span_batch_is_not_shed_forever(self):
+        """A native SSF batch larger than one burst must still admit
+        when the bucket is full — the ask clamps to capacity instead of
+        turning the rate limit into a hard per-batch size cap."""
+        mgr = OverloadManager(make_config(ingest_rate_limit_spans=100.0,
+                                          ingest_rate_limit_burst=1.0))
+        assert mgr.admit_spans(150)          # full bucket: clamped admit
+        assert not mgr.admit_spans(150)      # drained: shed + counted
+        assert mgr.shed_total.get("span|rate_limit") == 150
+
+    def test_burst_knob_accepts_duration_strings(self):
+        from veneur_tpu.config import read_config
+        cfg = read_config(overrides={"ingest_rate_limit_burst": "500ms",
+                                     "supervisor_deadline": "30s"})
+        assert cfg.ingest_rate_limit_burst == 0.5
+        assert cfg.supervisor_deadline == 30.0
+
+    def test_telemetry_rows_shape(self):
+        mgr = OverloadManager(make_config())
+        mgr.shed(ov.CLASS_SPAN, 3, reason="rate_limit")
+        rows = mgr.telemetry_rows()
+        names = {r[0] for r in rows}
+        assert {"overload.state", "overload.rss_bytes",
+                "ingest.shed_total"} <= names
+        assert ("ingest.shed_total", "counter", 3.0,
+                ["class:span", "reason:rate_limit"]) in rows
+
+    def test_stop_is_idempotent_and_threadless_by_default(self):
+        mgr = OverloadManager(make_config())
+        mgr.stop()
+        mgr.stop()
+
+
+class TestIngestDropCounters:
+    """Satellite: the TCP over-long drop and undecodable SSF span drop
+    are counted in server stats and surface in /metrics."""
+
+    def test_tcp_overlong_line_is_counted(self):
+        cfg = make_config(
+            statsd_listen_addresses=["tcp://127.0.0.1:0"],
+            metric_max_length=64)
+        server = Server(cfg, extra_metric_sinks=[ChannelMetricSink()])
+        server.start()
+        try:
+            addr = server.local_addr("tcp")
+            with socket.create_connection(addr, timeout=5) as s:
+                s.sendall(b"x" * 200)  # no newline: over-long buffer
+                assert wait_until(
+                    lambda: server.stats["tcp_overlong_dropped"] == 1)
+            exposition = server.telemetry.registry.render_prometheus()
+            assert "veneur_ingest_tcp_overlong_dropped_total 1" in exposition
+        finally:
+            server.shutdown()
+
+    def test_undecodable_ssf_span_is_counted(self):
+        cfg = make_config(ssf_listen_addresses=["tcp://127.0.0.1:0"])
+        server = Server(cfg, extra_metric_sinks=[ChannelMetricSink()])
+        server.start()
+        try:
+            addr = server.local_addr("ssf-tcp")
+            import struct
+            # valid frame (version 0 + length header), garbage protobuf
+            # body: framing survives, decode fails -> counted drop
+            body = b"\xff\xff\xff\xff\xff"
+            frame = b"\x00" + struct.pack(">I", len(body)) + body
+            with socket.create_connection(addr, timeout=5) as s:
+                s.sendall(frame)
+                assert wait_until(
+                    lambda: server.stats["ssf_undecodable_dropped"] == 1)
+            exposition = server.telemetry.registry.render_prometheus()
+            assert ("veneur_ingest_ssf_undecodable_dropped_total 1"
+                    in exposition)
+        finally:
+            server.shutdown()

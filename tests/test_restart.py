@@ -117,6 +117,91 @@ class TestInstallContract:
         assert restart._wait_ready("", LiveChild(), timeout=0.5,
                                    ready_file=rf) is False
 
+    def test_restart_ready_file_wedged_child_loses(self, monkeypatch,
+                                                   tmp_path):
+        """Full _restart coverage of the SIGUSR2 ready-file handoff: a
+        child that stays alive but never reports its listeners bound
+        (wedged in startup) must NOT win — shutdown is never called, the
+        old process keeps serving, and the handshake file is cleaned
+        up."""
+        from veneur_tpu.core import restart
+
+        spawned = {}
+
+        class WedgedChild:
+            pid = 7777
+
+            def poll(self):
+                return None  # alive forever, never writes the file
+
+        def fake_popen(cmd, env=None):
+            spawned["cmd"], spawned["env"] = cmd, env
+            return WedgedChild()
+
+        monkeypatch.setattr(restart.subprocess, "Popen", fake_popen)
+        # _restart passes no timeout; bound the real _wait_ready so the
+        # wedged child times out in test time, not 60 s
+        real_wait = restart._wait_ready
+        monkeypatch.setattr(
+            restart, "_wait_ready",
+            lambda addr, child, ready_file="": real_wait(
+                addr, child, timeout=0.6, ready_file=ready_file))
+        calls = []
+        restart._restart(lambda: calls.append("shutdown"), "", ["prog"])
+        assert calls == []  # the old process keeps serving
+        # the handshake went through the environment, single-use file
+        env = spawned["env"]
+        ready_file = env[restart.READY_FILE_ENV]
+        assert ready_file.startswith("/") and not os.path.exists(ready_file)
+
+    def test_restart_ready_file_bound_child_wins(self, monkeypatch):
+        """The complementary path: a child that writes its pid (its
+        Server.start() completed, listeners bound) wins the handoff —
+        shutdown runs and the handshake file is removed."""
+        from veneur_tpu.core import restart
+
+        spawned = {}
+
+        class BoundChild:
+            pid = 8888
+
+            def poll(self):
+                # "bind the listeners": write our pid the first time the
+                # parent polls us, like Server.start()'s mark_ready()
+                rf = spawned["env"][restart.READY_FILE_ENV]
+                with open(rf, "w") as f:
+                    f.write(str(self.pid))
+                return None
+
+        def fake_popen(cmd, env=None):
+            spawned["env"] = env
+            return BoundChild()
+
+        monkeypatch.setattr(restart.subprocess, "Popen", fake_popen)
+        real_wait = restart._wait_ready
+        monkeypatch.setattr(
+            restart, "_wait_ready",
+            lambda addr, child, ready_file="": real_wait(
+                addr, child, timeout=5.0, ready_file=ready_file))
+        calls = []
+        restart._restart(lambda: calls.append("shutdown"), "", ["prog"])
+        assert calls == ["shutdown"]
+        assert not os.path.exists(spawned["env"][restart.READY_FILE_ENV])
+
+    def test_mark_ready_is_single_use(self, tmp_path, monkeypatch):
+        """mark_ready pops the env var: descendants must never inherit
+        the handshake path and re-create it later (TOCTOU guard)."""
+        from veneur_tpu.core import restart
+
+        rf = tmp_path / "ready"
+        monkeypatch.setenv(restart.READY_FILE_ENV, str(rf))
+        restart.mark_ready()
+        assert rf.read_text() == str(os.getpid())
+        assert restart.READY_FILE_ENV not in os.environ
+        rf.unlink()
+        restart.mark_ready()  # second call: env popped, no-op
+        assert not rf.exists()
+
     def test_server_start_writes_ready_file(self, tmp_path, monkeypatch):
         from veneur_tpu.config import Config
         from veneur_tpu.core.server import Server

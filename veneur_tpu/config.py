@@ -158,6 +158,33 @@ class Config:
     # this many consecutive intervals; beyond it the state is shed loudly.
     # 0 disables carryover (fail-and-forget, the pre-resilience behavior).
     carryover_max_intervals: int = 3
+    # -- ingest admission control (core/overload.py) --------------------
+    # per-plane token-bucket rate limits, in packets/second (0 =
+    # unlimited). An over-limit statsd packet is parsed in
+    # essential-only mode (histogram/set samples shed, counter/gauge
+    # deltas kept); an over-limit span is dropped and counted.
+    ingest_rate_limit_statsd: float = 0.0
+    ingest_rate_limit_spans: float = 0.0
+    # bucket capacity = rate * this many seconds of burst headroom
+    ingest_rate_limit_burst: float = 1.0
+    # -- memory watermarks (core/overload.py) ---------------------------
+    # RSS thresholds stepping the server ok -> degraded -> shedding
+    # (0 = disabled). Degraded pauses span ingest and keeps only
+    # `degraded_keep` of histogram/set samples; shedding drops all
+    # histogram/set samples. Counter/gauge deltas are never shed.
+    overload_watermark_soft_bytes: int = 0
+    overload_watermark_hard_bytes: int = 0
+    overload_watermark_poll: float = 1.0   # duration between RSS polls
+    overload_watermark_degraded_keep: float = 0.25
+    # -- pipeline supervision (core/overload.py) ------------------------
+    # a pipeline thread (ingest pump dispatch, span workers, flush loop)
+    # whose heartbeat goes stale past supervisor_deadline is flagged
+    # (ERROR log + supervisor.stalls_total); one stalled past
+    # supervisor_escalation_deadline aborts the process so the external
+    # supervisor restarts it (0 disables each behavior).
+    supervisor_deadline: float = 0.0       # duration; 0 = supervision off
+    supervisor_poll: float = 1.0           # duration between checks
+    supervisor_escalation_deadline: float = 0.0  # duration; 0 = never abort
     # -- fault injection (util/chaos.py) --------------------------------
     # deterministic (seeded) probabilistic faults at the egress seams
     # (forward_send, sink_flush, http_post); VENEUR_CHAOS_* env overlay
@@ -168,6 +195,13 @@ class Config:
     chaos_delay: float = 0.0           # duration per injected delay
     chaos_seams: List[str] = field(default_factory=list)  # empty = all
     chaos_seed: int = 0
+    # ingest-side chaos: per-packet drop/truncate/duplicate rolls applied
+    # by the server's packet intake, and simulated memory pressure added
+    # to real RSS by the overload watermark monitor
+    chaos_ingest_drop_rate: float = 0.0
+    chaos_ingest_truncate_rate: float = 0.0
+    chaos_ingest_duplicate_rate: float = 0.0
+    chaos_ingest_rss_bytes: int = 0
     grpc_address: str = ""
     grpc_listen_addresses: List[str] = field(default_factory=list)
     hostname: str = ""
@@ -254,7 +288,10 @@ _LIST_TYPES = {
 }
 _SECRET_FIELDS = {"sentry_dsn", "tls_key"}
 _DURATION_FIELDS = {"interval", "forward_retry_base", "forward_retry_max",
-                    "circuit_breaker_recovery", "chaos_delay"}
+                    "circuit_breaker_recovery", "chaos_delay",
+                    "ingest_rate_limit_burst", "overload_watermark_poll",
+                    "supervisor_deadline", "supervisor_poll",
+                    "supervisor_escalation_deadline"}
 
 
 def _coerce(name: str, value: Any) -> Any:

@@ -21,20 +21,17 @@ from veneur_tpu.util.scopedstatsd import ScopedClient
 
 logger = logging.getLogger("veneur_tpu.diagnostics")
 
-_PAGE_SIZE = os.sysconf("SC_PAGE_SIZE") if hasattr(os, "sysconf") else 4096
-
 # getrusage reports ru_maxrss in kilobytes on Linux/BSD but bytes on macOS
 _RU_MAXRSS_SCALE = 1 if sys.platform == "darwin" else 1024
 
 
 def _current_rss_bytes() -> Optional[int]:
     """Current resident set from /proc/self/statm (field 2, pages).
-    Returns None off Linux; the caller falls back to the rusage peak."""
-    try:
-        with open("/proc/self/statm", "rb") as f:
-            return int(f.read().split()[1]) * _PAGE_SIZE
-    except (OSError, IndexError, ValueError):
-        return None
+    Returns None off Linux; the caller falls back to the rusage peak.
+    Shared with the overload watermark monitor — one reader, two
+    consumers, identical numbers in /metrics and the ladder."""
+    from veneur_tpu.core.overload import current_rss_bytes
+    return current_rss_bytes()
 
 
 def collect(stats: ScopedClient, start_time: float,

@@ -75,10 +75,25 @@ class _Handler(BaseHTTPRequestHandler):
             # whenever the API is up, like the reference)
             self._send(200, b"ok\n")
         elif path == "/healthcheck/ready":
-            ready = api.server is None or api.server.flush_count > 0 \
-                or not api.require_flush_for_ready
-            self._send(200 if ready else 503,
-                       b"ready\n" if ready else b"not ready\n")
+            # the full readiness ladder: listener/flush state as before,
+            # plus the server's own degradation verdict — shedding
+            # overload state or a tripped flush watchdog answer 503 with
+            # a JSON reason, so orchestrators stop routing to an
+            # instance that is wedged or actively dropping data
+            ready, reason = True, ""
+            if api.server is not None:
+                if api.require_flush_for_ready and not api.server.flush_count:
+                    ready, reason = False, "no flush completed yet"
+                else:
+                    rs = getattr(api.server, "ready_state", None)
+                    if rs is not None:
+                        ready, reason = rs()
+            if ready:
+                self._send(200, b"ready\n")
+            else:
+                self._send(503, json.dumps(
+                    {"ready": False, "reason": reason}).encode() + b"\n",
+                    "application/json")
         elif path == "/version":
             self._send(200, veneur_tpu.__version__.encode())
         elif path == "/builddate":
@@ -95,7 +110,8 @@ class _Handler(BaseHTTPRequestHandler):
                        "text/plain; version=0.0.4; charset=utf-8")
         elif path == "/debug/events":
             limit = int(_query_float(self.path, "n", 0.0, max_value=1e6))
-            self._send(200, api.telemetry.events_json(limit),
+            kind = _query_str(self.path, "kind")
+            self._send(200, api.telemetry.events_json(limit, kind=kind),
                        "application/json")
         elif path == "/debug/flush":
             limit = int(_query_float(self.path, "n", 0.0, max_value=1e6))
@@ -237,6 +253,12 @@ class _Handler(BaseHTTPRequestHandler):
             threading.Thread(target=api.quit, daemon=True).start()
         else:
             self._send(404, b"not found\n")
+
+
+def _query_str(path: str, key: str, default: str = "") -> str:
+    from urllib.parse import parse_qs, urlparse
+    vals = parse_qs(urlparse(path).query).get(key)
+    return vals[0] if vals else default
 
 
 def _query_float(path: str, key: str, default: float,

@@ -93,18 +93,24 @@ class BatchIngester:
             self._tls.parser = p
         return p
 
-    def ingest_buffer(self, buf: bytes) -> int:
+    def ingest_buffer(self, buf: bytes,
+                      shed_nonessential: bool = False) -> int:
         """Parse and aggregate one newline-joined packet buffer; returns
-        the number of samples taken (native + slow path not counted)."""
+        the number of samples taken (native + slow path not counted).
+        `shed_nonessential` is the over-limit (rate-limited) intake
+        mode: the buffer still rides the columnar fast path — shedding
+        load must not COST more CPU per packet than admitting it — but
+        its histogram/set columns are dropped (counted) and only the
+        counter/gauge columns land."""
         parser = self._parser()
-        return self._ingest(parser.parse(buf))
+        return self._ingest(parser.parse(buf), shed_nonessential)
 
     def ingest_ptr(self, ptr, length: int) -> int:
         """Zero-copy variant over a native reader's joined buffer."""
         parser = self._parser()
         return self._ingest(parser.parse_ptr(ptr, length))
 
-    def _ingest(self, res) -> int:
+    def _ingest(self, res, shed_nonessential: bool = False) -> int:
         store = self.store
         server = self.server
         # native lines count as received; unknown lines are counted in the
@@ -125,6 +131,9 @@ class BatchIngester:
             gauge_lines: list = []
             line_no = 0
 
+            essential_cb = (server._ingest_metric_essential
+                            if shed_nonessential else server.ingest_metric)
+
             def capture(metric):
                 if metric.key.type == m.GAUGE:
                     row = store.gauges.intern(metric)
@@ -135,7 +144,7 @@ class BatchIngester:
                     gauge_lines.append(line_no)
                     store.count_processed(1)
                 else:
-                    server.ingest_metric(metric)
+                    essential_cb(metric)
 
             from veneur_tpu.samplers.parser import ParseError
             for line, line_no in zip(unknown, res.unknown_lines):
@@ -170,11 +179,46 @@ class BatchIngester:
             store.gauges.add_batch(all_rows[order], all_vals[order])
         elif len(res.g_rows):
             store.gauges.add_batch(res.g_rows, res.g_vals)
-        if len(res.h_rows):
-            store.histos.add_batch(res.h_rows, res.h_vals, res.h_wts)
-        if len(res.s_rows):
-            store.sets.add_batch(res.s_rows, res.s_idx, res.s_rho)
+        self._add_histo_set(res, shed_nonessential)
         return res.samples
+
+    def _add_histo_set(self, res, shed_nonessential: bool = False) -> None:
+        """Append the histogram/set columns, applying the overload shed
+        ladder in batch form: shedding (or over-limit intake) drops the
+        columns whole, degraded stride-subsamples them (precision shed,
+        counters untouched — the SALSA ladder). Every shed sample is
+        counted."""
+        store = self.store
+        overload = getattr(self.server, "overload", None)
+        if shed_nonessential and overload is not None:
+            keep = 0.0
+        else:
+            keep = overload.histo_set_keep() if overload is not None else 1.0
+        if keep >= 1.0:
+            if len(res.h_rows):
+                store.histos.add_batch(res.h_rows, res.h_vals, res.h_wts)
+            if len(res.s_rows):
+                store.sets.add_batch(res.s_rows, res.s_idx, res.s_rho)
+            return
+        from veneur_tpu.core import overload as overload_mod
+        stride = max(1, round(1.0 / keep)) if keep > 0 else 0
+        shed_reason = "rate_limit" if shed_nonessential else "overload"
+        for cls, rows, cols in (
+                (overload_mod.CLASS_HISTOGRAM, res.h_rows,
+                 (res.h_vals, res.h_wts)),
+                (overload_mod.CLASS_SET, res.s_rows,
+                 (res.s_idx, res.s_rho))):
+            n = len(rows)
+            if not n:
+                continue
+            if stride == 0:
+                overload.shed(cls, n, reason=shed_reason)
+                continue
+            kept = rows[::stride]
+            overload.shed(cls, n - len(kept), reason="degraded")
+            table = (store.histos if cls == overload_mod.CLASS_HISTOGRAM
+                     else store.sets)
+            table.add_batch(kept, cols[0][::stride], cols[1][::stride])
 
     def _register_line(self, line: bytes) -> None:
         """After the slow path interned a metric line's key, teach the
@@ -308,10 +352,7 @@ class BatchIngester:
             store.gauges.add_batch(all_rows[order], all_vals[order])
         elif len(res.g_rows):
             store.gauges.add_batch(res.g_rows, res.g_vals)
-        if len(res.h_rows):
-            store.histos.add_batch(res.h_rows, res.h_vals, res.h_wts)
-        if len(res.s_rows):
-            store.sets.add_batch(res.s_rows, res.s_idx, res.s_rho)
+        self._add_histo_set(res)
 
         # derived-metric replays the native path owed us
         for idx in np.nonzero((flags & native.SSF_NEEDS_UNIQ) != 0)[0]:
@@ -390,9 +431,22 @@ class BatchIngester:
     def run_pump_dispatch(self, pump, listener) -> None:
         """Dispatcher thread body: drain sealed chunks into the column
         store until the listener closes, then stop the readers and flush
-        whatever they sealed on the way out."""
+        whatever they sealed on the way out. Heartbeats the pipeline
+        supervisor every loop (the 200 ms chunk wait bounds the beat
+        interval) and registers the native stall counter as a probe."""
         server = self.server
+        supervisor = None
+        # per-listener component name: two listeners run two pumps, and
+        # one wedged dispatcher must not hide behind the other's beats
+        sup_name = f"ingest-pump:{listener.address}"
+        overload = getattr(server, "overload", None)
+        if overload is not None:
+            supervisor = overload.supervisor
+            supervisor.register(sup_name)
+            supervisor.add_probe(sup_name, pump.stalls)
         while not listener.closed:
+            if supervisor is not None:
+                supervisor.beat(sup_name)
             self._dispatch_one(pump, server, timeout_ms=200)
         # readers may be blocked waiting for a free chunk: keep draining
         # while they wind down so their partial chunks (and the samples in
@@ -408,6 +462,9 @@ class BatchIngester:
             logger.warning("pump discarded %d in-flight lines at shutdown",
                            lost)
             server.stats.inc("parse_errors", lost)
+        if supervisor is not None:
+            # a deliberately-closed listener is not a stall
+            supervisor.unregister(sup_name)
         # native memory is freed by Pump.__del__ once the listener drops
         # its reference: freeing here would race Listener.close()'s own
         # concurrent stop() call

@@ -63,6 +63,17 @@ def _new_udp_socket(host: str, port: int, rcvbuf: int,
     return sock
 
 
+def _watch_kernel_drops(server, socks, label: str) -> None:
+    """Register bound UDP sockets with the overload manager's kernel-
+    drop monitor (/proc/net/udp polling by inode), so rx-queue overflow
+    the process never sees becomes `ingest.kernel_drops` in /metrics."""
+    overload = getattr(server, "overload", None)
+    if overload is None:
+        return
+    for sock in socks:
+        overload.kernel_drops.watch_socket(sock, label)
+
+
 def start_statsd(address: str, server, num_readers: int = 1,
                  rcvbuf: int = 2 * 1024 * 1024) -> List[Listener]:
     """Start DogStatsD listeners for one address URL
@@ -145,6 +156,7 @@ def _start_statsd_udp(u, server, num_readers: int, rcvbuf: int) -> Listener:
         sock = _new_udp_socket(host, bound_port, rcvbuf, reuseport=True)
         listener.add_socket(sock)
         socks.append(sock)
+    _watch_kernel_drops(server, socks, f"statsd-udp:{bound_port}")
     ing = getattr(server, "_ingester", None)
     if ing is not None and not os.environ.get("VENEUR_TPU_DISABLE_PUMP"):
         pump = ing.start_pump(socks)
@@ -265,8 +277,12 @@ def _start_statsd_tcp(u, server) -> Listener:
 
 def _read_tcp_lines(conn, server, listener: Listener) -> None:
     """Newline-delimited stream reader (reference server.go:1323-1340),
-    bounding line length at metric_max_length."""
+    bounding line length at metric_max_length. The statsd plane's
+    admission bucket applies per line (TCP has no datagrams, so the
+    line is the unit of intake): an over-limit line parses in
+    essential-only mode, same ladder as an over-limit UDP packet."""
     max_len = server.config.metric_max_length
+    overload = getattr(server, "overload", None)
     buf = b""
     with conn:
         while not listener.closed:
@@ -283,8 +299,15 @@ def _read_tcp_lines(conn, server, listener: Listener) -> None:
                     break
                 line, buf = buf[:nl], buf[nl + 1:]
                 if line:
-                    server.handle_metric_packet(line)
+                    shed = (overload is not None
+                            and not overload.admit_statsd_packet())
+                    server.handle_metric_packet(
+                        line, shed_nonessential=shed)
             if len(buf) > max_len:
+                # counted, not just logged: a client streaming unframed
+                # garbage shows up in /metrics as ingest.tcp_overlong_
+                # dropped instead of only in a log nobody tails
+                server.stats.inc("tcp_overlong_dropped")
                 logger.warning("dropping over-long TCP line (%d bytes)",
                                len(buf))
                 return
@@ -335,6 +358,8 @@ def _start_ssf_udp(u, server, rcvbuf: int) -> Listener:
     sock = _new_udp_socket(host, u.port or 0, rcvbuf, reuseport=False)
     threads: List[threading.Thread] = []
     listener = Listener("ssf-udp", sock.getsockname(), sock, threads)
+    _watch_kernel_drops(server, [sock],
+                        f"ssf-udp:{sock.getsockname()[1]}")
     # per-read buffer size follows trace_max_length_bytes (reference
     # server.go:498's packetPool), clamped to the UDP datagram ceiling
     max_read = min(max(int(server.config.trace_max_length_bytes), 1),
@@ -438,7 +463,9 @@ def _read_ssf_frames(conn, server, listener: Listener) -> None:
                     span = protocol.read_ssf(stream, max_length=max_len)
                 except protocol.SSFDecodeError as e:
                     # frame boundary is intact; skip the bad span, keep
-                    # reading
+                    # reading — counted so a client shipping corrupt
+                    # spans is visible in /metrics, not just debug logs
+                    server.stats.inc("ssf_undecodable_dropped")
                     logger.debug("dropping undecodable SSF span: %s", e)
                     continue
                 except protocol.FramingError as e:

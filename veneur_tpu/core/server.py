@@ -32,6 +32,16 @@ from veneur_tpu.util.matcher import SinkRoutingMatcher
 
 logger = logging.getLogger("veneur_tpu.server")
 
+# wire type -> overload shed class (the priority ladder's middle rung;
+# counter/gauge/status samples never appear here — they are always kept)
+from veneur_tpu.core import overload as overload_mod  # noqa: E402
+
+_SHED_CLASS = {
+    m.HISTOGRAM: overload_mod.CLASS_HISTOGRAM,
+    m.TIMER: overload_mod.CLASS_HISTOGRAM,
+    m.SET: overload_mod.CLASS_SET,
+}
+
 
 class RawSpan:
     """A span still in wire form: the native SSF path already extracted
@@ -289,6 +299,16 @@ class Server:
         self._sink_breakers: Dict[str, CircuitBreaker] = {}
         self._sink_spill: Dict[str, List[InterMetric]] = {}
         self.chaos = chaos_mod.Chaos.from_config(config)
+        # ingest-side resilience: admission buckets, the ok/degraded/
+        # shedding watermark ladder, kernel-drop polling, and the
+        # pipeline supervisor (core/overload.py — PR 2's egress layer
+        # mirrored onto ingest)
+        from veneur_tpu.core.overload import OverloadManager
+        self.overload = OverloadManager(
+            config, chaos=self.chaos,
+            on_transition=self._overload_transition,
+            on_stall=self._supervisor_stall)
+        self.telemetry.registry.add_collector(self.overload.telemetry_rows)
         self._flush_thread: Optional[threading.Thread] = None
         self._watchdog_thread: Optional[threading.Thread] = None
         self._shutdown = threading.Event()
@@ -300,7 +320,8 @@ class Server:
         # locked counters: increments arrive from many reader threads
         from veneur_tpu.util.stats import StatCounters
         self.stats = StatCounters(
-            "packets_received", "parse_errors", "metrics_flushed")
+            "packets_received", "parse_errors", "metrics_flushed",
+            "tcp_overlong_dropped", "ssf_undecodable_dropped")
 
     # -- identity --------------------------------------------------------
 
@@ -313,23 +334,45 @@ class Server:
     def handle_packet_batch(self, datagrams) -> None:
         """Fast path: parse a batch of datagrams through the native batch
         parser straight into the column store. Falls back to the
-        per-packet Python path when the native library is unavailable."""
+        per-packet Python path when the native library is unavailable.
+        Chaos ingest faults (drop/truncate/duplicate) and admission
+        control apply here — one token per datagram; an over-limit
+        datagram still parses, but in essential-only mode (histogram/set
+        samples shed, counter/gauge deltas kept)."""
+        chaos = self.chaos
+        if chaos is not None and chaos.ingest_faults_planned:
+            datagrams = chaos.mangle_packets(datagrams)
         if self._ingester is None:
             for dgram in datagrams:
                 self.handle_packet_buffer(dgram)
             return
         good = []
+        over = []
         for dgram in datagrams:
             if len(dgram) > self.config.metric_max_length:
                 self.stats.inc("parse_errors")
+            elif not self.overload.admit_statsd_packet():
+                over.append(dgram)
             else:
                 good.append(dgram)
         if good:
             self._ingester.ingest_buffer(b"\n".join(good))
+        if over:
+            # over-limit datagrams STAY on the columnar fast path —
+            # shedding load must not cost more CPU per packet than
+            # admitting it — but their histogram/set columns are shed
+            # (counted) and only counter/gauge deltas land
+            self._ingester.ingest_buffer(b"\n".join(over),
+                                         shed_nonessential=True)
 
-    def handle_metric_packet(self, packet: bytes) -> None:
-        """Dispatch one datagram/line (reference server.go:949-1000)."""
+    def handle_metric_packet(self, packet: bytes,
+                             shed_nonessential: bool = False) -> None:
+        """Dispatch one datagram/line (reference server.go:949-1000).
+        With `shed_nonessential` (over-limit packet) histogram/set
+        samples are shed; counter/gauge deltas are always kept."""
         self.stats.inc("packets_received")
+        cb = (self._ingest_metric_essential if shed_nonessential
+              else self.ingest_metric)
         try:
             if packet.startswith(b"_sc"):
                 metric = self.parser.parse_service_check(packet)
@@ -339,21 +382,40 @@ class Server:
                 with self._other_lock:
                     self._other_samples.append(event)
             else:
-                self.parser.parse_metric_fast(packet, self.ingest_metric)
+                self.parser.parse_metric_fast(packet, cb)
         except ParseError as e:
             self.stats.inc("parse_errors")
             logger.debug("could not parse packet %r: %s", packet[:100], e)
 
-    def handle_packet_buffer(self, buf: bytes) -> None:
+    def handle_packet_buffer(self, buf: bytes,
+                             shed_nonessential: bool = False) -> None:
         """Newline-split a multi-metric datagram (server.go:1116-1140)."""
         if len(buf) > self.config.metric_max_length:
             self.stats.inc("parse_errors")
             return
+        if not shed_nonessential and not self.overload.admit_statsd_packet():
+            shed_nonessential = True
         for line in buf.split(b"\n"):
             if line:
-                self.handle_metric_packet(line)
+                self.handle_metric_packet(
+                    line, shed_nonessential=shed_nonessential)
 
     def ingest_metric(self, metric: UDPMetric) -> None:
+        """The single Python-path chokepoint into the column store: the
+        overload shed ladder applies here (histogram/set samples are
+        shed under memory pressure; counter/gauge deltas never are)."""
+        cls = _SHED_CLASS.get(metric.key.type)
+        if cls is not None and not self.overload.admit_sample(cls):
+            return
+        self.store.process(metric)
+
+    def _ingest_metric_essential(self, metric: UDPMetric) -> None:
+        """Essential-only intake for over-limit packets: histogram/set
+        samples are shed (counted), counter/gauge deltas admitted."""
+        cls = _SHED_CLASS.get(metric.key.type)
+        if cls is not None and not self.overload.admit_sample(
+                cls, over_limit=True):
+            return
         self.store.process(metric)
 
     def _self_packet(self, packet: bytes) -> None:
@@ -449,18 +511,34 @@ class Server:
                 self.stats.inc("parse_errors", len(offs))
                 return
             if self._span_sink_workers:
+                # batch admission decides the span-OBJECT handoff only:
+                # the native extraction above already ran, so the
+                # counter/gauge deltas embedded in SSF samples are never
+                # lost (extraction precedes the span channel on this
+                # path, exactly as before admission control existed).
+                # Admitting AFTER decode — and only when span sinks
+                # exist — keeps tokens and shed counts tied to spans
+                # that would actually have been handed off.
                 import numpy as np
-                for i in np.nonzero(decoded)[0]:
-                    start = int(offs[i])
-                    self.ingest_span(
-                        RawSpan(buf[start:start + int(lens[i])]))
+                idxs = np.nonzero(decoded)[0]
+                if len(idxs) and self.overload.admit_spans(len(idxs)):
+                    for i in idxs:
+                        start = int(offs[i])
+                        self.ingest_span(
+                            RawSpan(buf[start:start + int(lens[i])]),
+                            preadmitted=True)
             return
         for off, ln in zip(offs, lens):
             self.handle_ssf_packet(buf[int(off):int(off) + int(ln)])
 
-    def ingest_span(self, span) -> None:
+    def ingest_span(self, span, preadmitted: bool = False) -> None:
         """Enqueue a span for the worker pool; drops (and counts) when the
-        channel is saturated rather than blocking ingest."""
+        channel is saturated rather than blocking ingest. Spans are the
+        FIRST rung of the overload shed ladder: any degradation state
+        (or an exhausted span-plane token bucket) sheds them here —
+        `preadmitted` spans already passed batch admission upstream."""
+        if not preadmitted and not self.overload.admit_span():
+            return
         try:
             self.span_chan.put_nowait(span)
         except queue.Full:
@@ -476,7 +554,10 @@ class Server:
         of the None sentinels) before exiting; the timed get covers the
         case where a full channel swallowed the sentinels."""
         from veneur_tpu import protocol
+        beat = self.overload.supervisor.beat
+        name = threading.current_thread().name
         while True:
+            beat(name)
             try:
                 first = self.span_chan.get(timeout=0.5)
             except queue.Empty:
@@ -533,6 +614,7 @@ class Server:
         for i in range(max(1, self.config.num_span_workers)):
             t = threading.Thread(target=guarded(self._span_worker_loop),
                                  name=f"span-worker-{i}", daemon=True)
+            self.overload.supervisor.register(t.name)
             t.start()
             self._span_workers.append(t)
         for addr in self.config.statsd_listen_addresses:
@@ -634,7 +716,18 @@ class Server:
         self._flush_thread = threading.Thread(
             target=guarded(self._flush_loop), name="flush-ticker",
             daemon=True)
+        # the flush loop beats once per interval, so its deadline must
+        # clear the interval no matter how tight the global deadline is
+        # — and floors at 60s because a first flush legitimately blocks
+        # on XLA compilation for tens of seconds (the flush watchdog and
+        # the readiness ladder are the tight-bound wedge detectors for
+        # this component; the supervisor is its long-stop)
+        self.overload.supervisor.register(
+            "flush-loop", deadline=max(
+                self.overload.supervisor.deadline, 2.5 * self.interval,
+                60.0))
         self._flush_thread.start()
+        self.overload.start()
         if self.config.flush_watchdog_missed_flushes > 0:
             self._watchdog_thread = threading.Thread(
                 target=self._flush_watchdog, name="flush-watchdog", daemon=True)
@@ -670,9 +763,39 @@ class Server:
                 name=key, on_transition=self._breaker_transition)
         return breaker
 
+    def _overload_transition(self, old: str, new: str, rss: int) -> None:
+        """Flight-recorder + log hook for every watermark ladder edge."""
+        self.telemetry.record_event(
+            "overload_state", old=old, new=new, rss_bytes=rss)
+
+    def _supervisor_stall(self, component: str, age: float) -> None:
+        """Flight-recorder hook for every freshly-detected stall."""
+        self.telemetry.record_event(
+            "pipeline_stall", component=component,
+            heartbeat_age_s=round(age, 3))
+
+    def ready_state(self):
+        """(ready, reason) for /healthcheck/ready: not ready while the
+        overload ladder is shedding, or while the flush watchdog's
+        budget is blown (a wedged flush loop means this instance is
+        about to abort — orchestrators should stop routing to it)."""
+        if self.overload.state == overload_mod.SHEDDING:
+            return False, (f"overload state {overload_mod.SHEDDING} "
+                           f"(rss {self.overload.watermarks.last_rss} bytes)")
+        if self.config.flush_watchdog_missed_flushes > 0:
+            allowed = self.config.flush_watchdog_missed_flushes * self.interval
+            since = time.time() - self.last_flush_unix
+            if since > allowed:
+                return False, (f"flush watchdog tripped: no flush for "
+                               f"{since:.1f}s (allowed {allowed:.1f}s)")
+        return True, ""
+
     def shutdown(self) -> None:
         self.telemetry.record_event("shutdown", pid=os.getpid())
         self._shutdown.set()
+        # stop supervision first: pipeline threads exiting on the
+        # shutdown path must not be flagged (or escalated) as stalls
+        self.overload.stop()
         if self.chaos is not None:
             # only clear the global seam if WE installed this plan (two
             # servers in one test process chaos independently)
@@ -739,15 +862,20 @@ class Server:
         return interval - (now % interval)
 
     def _flush_loop(self) -> None:
+        beat = self.overload.supervisor.beat
         while not self._shutdown.is_set():
             delay = (self._tick_delay() if self.config.synchronize_with_interval
                      else self.interval)
             if self._shutdown.wait(delay):
                 return
+            beat("flush-loop")
             try:
                 self.flush()
             except Exception:
                 logger.exception("flush failed")
+            # beat on completion too: a slow-but-finishing flush (cold
+            # compile) clears its staleness the moment it lands
+            beat("flush-loop")
 
     def _flush_watchdog(self) -> None:
         """Die loudly if flushes stall (reference server.go:877-919)."""

@@ -286,10 +286,16 @@ class EventRecorder:
             self._events.append(event)
         return event
 
-    def snapshot(self, limit: int = 0) -> List[dict]:
-        """Newest-last; `limit` > 0 keeps only the most recent events."""
+    def snapshot(self, limit: int = 0, kind: str = "") -> List[dict]:
+        """Newest-last; `limit` > 0 keeps only the most recent events;
+        `kind` filters to one event kind (e.g. overload_state,
+        pipeline_stall) BEFORE the limit applies, so an operator can
+        pull the last N ladder transitions even when chatty events
+        (watchdog ticks, flush rounds) dominate the ring."""
         with self._lock:
             events = list(self._events)
+        if kind:
+            events = [e for e in events if e.get("kind") == kind]
         return events[-limit:] if limit > 0 else events
 
     @property
@@ -348,11 +354,11 @@ class Telemetry:
     def record_event(self, kind: str, **fields) -> dict:
         return self.events.record(kind, **fields)
 
-    def events_json(self, limit: int = 0) -> bytes:
+    def events_json(self, limit: int = 0, kind: str = "") -> bytes:
         return json.dumps({
             "capacity": self.events.capacity,
             "total_recorded": self.events.total_recorded,
-            "events": self.events.snapshot(limit),
+            "events": self.events.snapshot(limit, kind=kind),
         }, indent=2, default=str).encode()
 
     def flushes_json(self, limit: int = 0) -> bytes:

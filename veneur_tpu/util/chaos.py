@@ -1,10 +1,23 @@
-"""Config/env-driven fault injection at the egress seams.
+"""Config/env-driven fault injection at the egress AND ingest seams.
 
 Every resilience behavior (retry, breaker trip/recover, carryover,
-spill) must be testable deterministically, without a flaky network under
-the test. This module plants three seams — `forward_send`, `sink_flush`,
-`http_post` — and injects probabilistic errors and delays at them from a
-SEEDED generator, so a 30 %-fault soak replays identically run to run.
+spill, admission shed, watermark ladder) must be testable
+deterministically, without a flaky network under the test. This module
+plants three egress seams — `forward_send`, `sink_flush`, `http_post` —
+and injects probabilistic errors and delays at them from a SEEDED
+generator, so a 30 %-fault soak replays identically run to run.
+
+Ingest-side chaos (PR 3) rides the same plan object:
+
+- `mangle_packets(batch)`: per-packet drop / truncate / duplicate rolls
+  (`chaos_ingest_drop_rate` / `chaos_ingest_truncate_rate` /
+  `chaos_ingest_duplicate_rate`), applied by the server's packet intake
+  before parsing — the UDP pathologies (loss, runt datagrams,
+  duplication) without a lossy network under the test. At most one
+  action per packet, so a soak can account exactly for every fault.
+- `simulated_rss_bytes()`: extra bytes (`chaos_ingest_rss_bytes`,
+  settable at runtime via `set_simulated_rss`) the overload watermark
+  monitor adds to real RSS — memory pressure on demand, no ballooning.
 
 Two ways to turn it on:
 
@@ -49,17 +62,29 @@ class Chaos:
     def __init__(self, enabled: bool = True, error_rate: float = 0.0,
                  delay_rate: float = 0.0, delay: float = 0.0,
                  seams: Sequence[str] = SEAMS, seed: int = 0,
+                 ingest_drop_rate: float = 0.0,
+                 ingest_truncate_rate: float = 0.0,
+                 ingest_duplicate_rate: float = 0.0,
+                 ingest_rss_bytes: int = 0,
                  sleep=time.sleep):
         self.enabled = bool(enabled)
         self.error_rate = min(1.0, max(0.0, float(error_rate)))
         self.delay_rate = min(1.0, max(0.0, float(delay_rate)))
         self.delay = max(0.0, float(delay))
         self.seams = frozenset(seams or SEAMS)
+        self.ingest_drop_rate = min(1.0, max(0.0, float(ingest_drop_rate)))
+        self.ingest_truncate_rate = min(
+            1.0, max(0.0, float(ingest_truncate_rate)))
+        self.ingest_duplicate_rate = min(
+            1.0, max(0.0, float(ingest_duplicate_rate)))
+        self._ingest_rss_bytes = max(0, int(ingest_rss_bytes))
         self._rng = random.Random(seed)
         self._sleep = sleep
         self._lock = threading.Lock()
         self.injected_errors: Dict[str, int] = {}
         self.injected_delays: Dict[str, int] = {}
+        # per-action packet fault counts (drop/truncate/duplicate)
+        self.packet_faults: Dict[str, int] = {}
 
     @classmethod
     def from_config(cls, config) -> Optional["Chaos"]:
@@ -71,7 +96,15 @@ class Chaos:
                    delay_rate=config.chaos_delay_rate,
                    delay=config.chaos_delay,
                    seams=config.chaos_seams or SEAMS,
-                   seed=config.chaos_seed)
+                   seed=config.chaos_seed,
+                   ingest_drop_rate=getattr(
+                       config, "chaos_ingest_drop_rate", 0.0),
+                   ingest_truncate_rate=getattr(
+                       config, "chaos_ingest_truncate_rate", 0.0),
+                   ingest_duplicate_rate=getattr(
+                       config, "chaos_ingest_duplicate_rate", 0.0),
+                   ingest_rss_bytes=getattr(
+                       config, "chaos_ingest_rss_bytes", 0))
 
     def inject(self, seam: str) -> None:
         """Run the seam: maybe sleep, maybe raise ChaosError. Called on
@@ -93,6 +126,68 @@ class Chaos:
         if fail:
             raise ChaosError(seam)
 
+    # -- ingest-side faults ------------------------------------------------
+
+    @property
+    def ingest_faults_planned(self) -> bool:
+        return (self.ingest_drop_rate > 0 or self.ingest_truncate_rate > 0
+                or self.ingest_duplicate_rate > 0)
+
+    def mangle_packets(self, batch):
+        """Apply per-packet drop/truncate/duplicate rolls to a list of
+        raw datagrams; returns the surviving (possibly mangled) batch.
+        Exactly ONE action fires per packet (one uniform roll against
+        stacked rate bands), so a soak's accounting is exact:
+        surviving = sent - dropped + duplicated, of which `truncated`
+        survive shortened by at least one byte (a single-metric line
+        whose every prefix is invalid therefore parse-errors)."""
+        if not self.enabled or not self.ingest_faults_planned:
+            return batch
+        out = []
+        d, t = self.ingest_drop_rate, self.ingest_truncate_rate
+        u = self.ingest_duplicate_rate
+        for pkt in batch:
+            with self._lock:
+                roll = self._rng.random()
+                if roll < d:
+                    action = "drop"
+                elif roll < d + t:
+                    if len(pkt) < 2:
+                        # 1-byte packets can't shorten; pass untouched
+                        # rather than counting a fault that wasn't
+                        out.append(pkt)
+                        continue
+                    action = "truncate"
+                elif roll < d + t + u:
+                    action = "duplicate"
+                else:
+                    out.append(pkt)
+                    continue
+                self.packet_faults[action] = \
+                    self.packet_faults.get(action, 0) + 1
+                cut = (1 + self._rng.randrange(len(pkt) - 1)
+                       if action == "truncate" else 0)
+            if action == "truncate":
+                # runt datagram: cut mid-line, never the full packet
+                out.append(pkt[:cut])
+            elif action == "duplicate":
+                out.append(pkt)
+                out.append(pkt)
+            # drop: the packet simply vanishes (counted above)
+        return out
+
+    def simulated_rss_bytes(self) -> int:
+        """Extra bytes the watermark monitor adds to real RSS."""
+        if not self.enabled:
+            return 0
+        with self._lock:
+            return self._ingest_rss_bytes
+
+    def set_simulated_rss(self, nbytes: int) -> None:
+        """Dial memory pressure up/down at runtime (soak control)."""
+        with self._lock:
+            self._ingest_rss_bytes = max(0, int(nbytes))
+
     def telemetry_rows(self):
         """(name, kind, value, tags) rows for the /metrics collectors."""
         with self._lock:
@@ -102,6 +197,9 @@ class Chaos:
             rows.extend(("chaos.injected_delays", "counter", float(n),
                          [f"seam:{seam}"])
                         for seam, n in self.injected_delays.items())
+            rows.extend(("chaos.packet_faults", "counter", float(n),
+                         [f"action:{action}"])
+                        for action, n in self.packet_faults.items())
         return rows
 
 
