@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""Cardinality storm driver: mint N unique tag values at a given rate.
+
+Reproduces a tag-cardinality explosion against a dev server so the
+observatory (`GET /debug/cardinality`), the `columnstore.*` capacity
+telemetry, and the `cardinality_soft_limit` / `cardinality_hard_limit`
+shed rung can be exercised end to end:
+
+    # start a dev server with tight limits, then:
+    python scripts/cardinality_storm.py \
+        --hostport udp://127.0.0.1:8126 \
+        --name storm.metric --tag-key user_id \
+        --keys 100000 --pps 20000 --duration 30
+
+    # watch it land:
+    curl 'http://127.0.0.1:8127/debug/cardinality?name=storm.metric'
+    curl -s http://127.0.0.1:8127/metrics | grep -E 'cardinality|shed'
+
+Each packet is `<name>:1|<type>|#<tag-key>:v<i>` with `i` walking
+0..keys-1 (wrapping, so a long storm keeps touching the same key set —
+steady-state churn — while a short one is pure minting). `--spray`
+additionally randomizes a second tag so every packet is a unique series
+(the worst case: nothing ever re-interns).
+"""
+
+from __future__ import annotations
+
+import argparse
+import random
+import socket
+import sys
+import time
+
+
+def parse_hostport(hostport: str):
+    scheme, rest = "udp", hostport
+    if "://" in hostport:
+        scheme, rest = hostport.split("://", 1)
+    host, _, port = rest.rpartition(":")
+    return scheme, host or "127.0.0.1", int(port)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="cardinality_storm", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--hostport", default="udp://127.0.0.1:8126")
+    ap.add_argument("--name", default="cardinality.storm",
+                    help="metric name every minted key shares")
+    ap.add_argument("--tag-key", default="storm_id",
+                    help="the exploding tag key")
+    ap.add_argument("--keys", type=int, default=10000,
+                    help="distinct tag values to mint (wraps)")
+    ap.add_argument("--pps", type=float, default=5000.0,
+                    help="target packets/second")
+    ap.add_argument("--duration", type=float, default=10.0,
+                    help="storm length in seconds")
+    ap.add_argument("--type", default="c", choices=["c", "g", "ms", "s"],
+                    help="metric type of the storm samples")
+    ap.add_argument("--spray", action="store_true",
+                    help="add a random second tag so EVERY packet is a "
+                         "unique series (pure mint load, never wraps)")
+    ap.add_argument("--extra-tag", action="append", default=[],
+                    help="static tag(s) on every packet (k:v)")
+    args = ap.parse_args(argv)
+
+    scheme, host, port = parse_hostport(args.hostport)
+    if scheme != "udp":
+        print("storm mode supports udp only", file=sys.stderr)
+        return 2
+    static = ("," + ",".join(args.extra_tag)) if args.extra_tag else ""
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    rng = random.Random()
+
+    sent = 0
+    start = time.perf_counter()
+    end = start + args.duration
+    batch = max(1, int(args.pps // 100))  # pace in ~10ms slices
+    try:
+        while True:
+            now = time.perf_counter()
+            if now >= end:
+                break
+            if sent > (now - start) * args.pps:
+                time.sleep(min(0.01, (sent - (now - start) * args.pps)
+                               / max(args.pps, 1.0)))
+                continue
+            for _ in range(batch):
+                i = sent % args.keys
+                tags = f"{args.tag_key}:v{i}{static}"
+                if args.spray:
+                    tags += f",spray:{rng.getrandbits(48):x}"
+                packet = f"{args.name}:1|{args.type}|#{tags}".encode()
+                sock.sendto(packet, (host, port))
+                sent += 1
+    finally:
+        sock.close()
+    elapsed = time.perf_counter() - start
+    minted = sent if args.spray else min(sent, args.keys)
+    print(f"storm: sent {sent} packets at {sent / elapsed:.0f}/s "
+          f"({minted} unique series minted, tag key {args.tag_key!r})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -11,11 +11,14 @@ f-strings (e.g. ``f"{prefix}.count"`` in util/grpcstats.py) are matched
 as patterns: each formatted field becomes a wildcard, and at least one
 documented name must match.
 
-Registry-collector rows are covered too: a literal
-``rows.append(("name", "counter"|"gauge", value, tags))`` site (the
-shape every telemetry collector emits — resilience breaker gauges,
-forward client counters, proxy destination rows) is checked exactly
-like a statsd call site.
+Registry-collector rows are covered too: ANY literal 4-tuple whose
+second element is ``"counter"`` or ``"gauge"`` — the
+``(name, kind, value, tags)`` shape every telemetry collector emits
+(resilience breaker gauges, forward client counters, proxy destination
+rows, the columnstore/cardinality capacity rows) — is checked exactly
+like a statsd call site, wherever it appears: ``rows.append(...)``,
+``rows.extend([...])``, list-literal returns, and comprehensions all
+count. F-string names become wildcard patterns, like statsd sites.
 
 Usage: python scripts/check_metric_names.py [--repo DIR]
 Exit codes: 0 ok, 1 undocumented metrics found, 2 could not parse docs.
@@ -60,37 +63,45 @@ def emitted_names(root: pathlib.Path):
             print(f"warning: could not parse {path}: {e}", file=sys.stderr)
             continue
         for node in ast.walk(tree):
+            # collector-row shape, wherever the tuple literal appears
+            # (append/extend args, list literals, comprehensions):
+            # ("name", "counter"|"gauge", value, tags)
+            if isinstance(node, ast.Tuple) and len(node.elts) == 4:
+                name_el, kind_el = node.elts[:2]
+                if (isinstance(kind_el, ast.Constant)
+                        and kind_el.value in ("counter", "gauge")):
+                    resolved = _name_or_pattern(name_el)
+                    if resolved is not None:
+                        yield (path, node.lineno) + resolved
+                continue
             if not (isinstance(node, ast.Call)
                     and isinstance(node.func, ast.Attribute)):
-                continue
-            # collector-row shape: xs.append(("name", "counter", v, tags))
-            if (node.func.attr == "append" and len(node.args) == 1
-                    and isinstance(node.args[0], ast.Tuple)
-                    and len(node.args[0].elts) == 4):
-                name_el, kind_el = node.args[0].elts[:2]
-                if (isinstance(name_el, ast.Constant)
-                        and isinstance(name_el.value, str)
-                        and isinstance(kind_el, ast.Constant)
-                        and kind_el.value in ("counter", "gauge")):
-                    yield path, node.lineno, name_el.value, False
                 continue
             if not (node.func.attr in EMIT_METHODS
                     and statsd_receiver(node.func.value)
                     and node.args):
                 continue
-            arg = node.args[0]
-            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
-                yield path, node.lineno, arg.value, False
-            elif isinstance(arg, ast.JoinedStr):
-                parts = []
-                for piece in arg.values:
-                    if isinstance(piece, ast.Constant):
-                        parts.append(re.escape(str(piece.value)))
-                    else:
-                        parts.append(r"[^|]+")
-                yield path, node.lineno, "".join(parts), True
+            resolved = _name_or_pattern(node.args[0])
+            if resolved is not None:
+                yield (path, node.lineno) + resolved
             # a bare variable name can't be resolved statically; the
             # call site it was built at is already covered above
+
+
+def _name_or_pattern(arg: ast.AST):
+    """(name, is_pattern) for a literal string or f-string metric-name
+    node; None when the name can't be resolved statically."""
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value, False
+    if isinstance(arg, ast.JoinedStr):
+        parts = []
+        for piece in arg.values:
+            if isinstance(piece, ast.Constant):
+                parts.append(re.escape(str(piece.value)))
+            else:
+                parts.append(r"[^|]+")
+        return "".join(parts), True
+    return None
 
 
 def documented_names(readme: pathlib.Path):

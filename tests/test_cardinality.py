@@ -1,0 +1,498 @@
+"""Cardinality observatory tests: the heavy-hitter tracker (exact on
+small, bounded on large, SALSA-style decay), per-tag-key HLL diagnosis,
+the /debug/cardinality endpoint shape, capacity-resize events, the
+registry-overflow attribution, the proxy's per-destination forwarded-key
+estimates, and the cardinality shed-rung storm soak (exact accounting of
+rejected mints, zero loss for pre-existing keys, immediate recovery)."""
+
+from __future__ import annotations
+
+import json
+import time
+
+import pytest
+
+from veneur_tpu.core.cardinality import (
+    MAX_TAG_KEYS, CardinalityAccountant, SpaceSaving, TagCardinality,
+)
+from veneur_tpu.core.columnstore import CounterTable
+from veneur_tpu.core.httpapi import HTTPApi
+from veneur_tpu.core.telemetry import Registry
+from veneur_tpu.samplers.parser import Parser
+from veneur_tpu.util import http as vhttp
+
+from test_server import generate_config, setup_server
+
+
+def mk_metric(name: str, tags=(), mtype: bytes = b"c", value: float = 1.0):
+    out = []
+    line = b"%s:%f|%s" % (name.encode(), value, mtype)
+    if tags:
+        line += b"|#" + ",".join(tags).encode()
+    Parser().parse_metric_fast(line, out.append)
+    return out[0]
+
+
+def by_name(metrics):
+    out = {}
+    for metric in metrics:
+        out.setdefault(metric.name, []).append(metric)
+    return out
+
+
+class TestSpaceSaving:
+    def test_exact_on_small(self):
+        ss = SpaceSaving(capacity=32)
+        for i in range(10):
+            for _ in range(i + 1):
+                rec = ss.get_or_track(f"name.{i}")
+                rec.weight += 1
+                rec.mints_total += 1
+        assert len(ss.records) == 10
+        assert ss.evictions == 0
+        top = ss.top(3)
+        assert [r.name for r in top] == ["name.9", "name.8", "name.7"]
+        assert top[0].mints_total == 10
+        assert top[0].error == 0.0  # never evicted -> exact
+
+    def test_bounded_on_large(self):
+        ss = SpaceSaving(capacity=16)
+        # one genuine heavy hitter among a spray of singletons
+        for i in range(500):
+            rec = ss.get_or_track(f"spray.{i}")
+            rec.weight += 1
+            if i % 2 == 0:
+                heavy = ss.get_or_track("heavy")
+                heavy.weight += 1
+        assert len(ss.records) <= 16  # hard memory bound
+        assert ss.evictions > 0
+        top = ss.top(1)[0]
+        assert top.name == "heavy"
+        # space-saving guarantee: the heavy hitter's score is never
+        # underestimated (weight >= true count)
+        assert top.weight >= 250
+
+    def test_live_rows_pin_residency(self):
+        ss = SpaceSaving(capacity=8)
+        owner = ss.get_or_track("owner")
+        owner.live_rows = 100
+        for i in range(50):
+            rec = ss.get_or_track(f"churn.{i}")
+            rec.weight += 1
+        assert "owner" in ss.records  # live rows outscore churn weight
+
+    def test_decay_releases_quiet_names(self):
+        ss = SpaceSaving(capacity=32)
+        rec = ss.get_or_track("quiet")
+        rec.weight = 0.6
+        busy = ss.get_or_track("busy")
+        busy.weight = 100.0
+        ss.decay(0.5)
+        assert "quiet" not in ss.records  # 0.3 < 0.5 and no live rows
+        assert ss.records["busy"].weight == pytest.approx(50.0)
+
+
+class TestTagCardinality:
+    def test_estimates_which_tag_explodes(self):
+        tc = TagCardinality(max_names=2)
+        tc.start("boom")
+        for i in range(2000):
+            tc.observe("boom", [f"user:u{i}", "region:eu", "flag"])
+        report = tc.report("boom")
+        est = report["tag_keys"]
+        assert est["region"] == 1
+        assert est["flag"] == 1  # bare tag -> one distinct (empty) value
+        assert abs(est["user"] - 2000) / 2000 < 0.05  # p=14 ~0.8% stderr
+        assert tc.report("unknown") is None
+
+    def test_tag_key_bound(self):
+        tc = TagCardinality(max_names=1)
+        tc.start("wide")
+        tc.observe("wide", [f"k{i}:v" for i in range(MAX_TAG_KEYS + 10)])
+        report = tc.report("wide")
+        assert len(report["tag_keys"]) == MAX_TAG_KEYS
+        assert report["tag_keys_overflow"] == 10
+
+    def test_name_slots_bounded_and_idle_released(self):
+        tc = TagCardinality(max_names=1)
+        tc.start("a")
+        tc.start("b")  # over the cap: not tracked
+        assert tc.tracked_names() == ["a"]
+        for _ in range(6):
+            tc.roll_interval()  # idle past TAG_IDLE_INTERVALS
+        assert tc.tracked_names() == []
+        tc.start("b")  # slot free again
+        assert tc.tracked_names() == ["b"]
+
+
+class TestAccountant:
+    def test_hard_limit_exact_accounting(self):
+        sheds = []
+        acct = CardinalityAccountant(
+            hard_limit=10,
+            on_shed=lambda fam, n, reason: sheds.append((fam, n, reason)))
+        admitted = sum(
+            acct.admit_mint("counter", "storm", [f"u:{i}"])
+            for i in range(100))
+        assert admitted == 10
+        assert len(sheds) == 90
+        assert all(s == ("counter", 1, "cardinality") for s in sheds)
+        # other names are untouched by storm's budget
+        assert acct.admit_mint("counter", "calm", ["k:v"])
+
+    def test_soft_limit_degrades_one_in_n(self):
+        acct = CardinalityAccountant(soft_limit=10, degraded_keep=0.25)
+        admitted = sum(
+            acct.admit_mint("counter", "warm", [])
+            for i in range(10 + 40))
+        # 10 under the limit + exactly 1-in-4 of the 40 past it
+        assert admitted == 10 + 10
+
+    def test_recovery_is_immediate_on_roll(self):
+        acct = CardinalityAccountant(hard_limit=5)
+        for i in range(20):
+            acct.admit_mint("counter", "storm", [])
+        assert not acct.admit_mint("counter", "storm", [])
+        assert acct.limits_report()["over_hard"] == ["storm"]
+        acct.roll_interval()  # budgets reset at the flush boundary
+        assert acct.limits_report()["over_hard"] == []
+        assert acct.admit_mint("counter", "storm", [])
+
+    def test_live_rows_track_mints_and_evictions(self):
+        acct = CardinalityAccountant()
+        for _ in range(3):
+            assert acct.admit_mint("counter", "app.reqs", [])
+            acct.note_mint("counter", "app.reqs")
+        rec = acct.tracker.records["app.reqs"]
+        assert rec.live_rows == 3
+        assert rec.families == {"counter": 3}
+        acct.note_evicted("counter", ["app.reqs", "app.reqs"])
+        assert rec.live_rows == 1
+        assert rec.families == {"counter": 1}
+
+    def test_tag_tracking_starts_at_threshold(self):
+        acct = CardinalityAccountant(hll_min_mints=5, hll_names=2)
+        for i in range(20):
+            acct.admit_mint("set", "boom", [f"id:{i}"])
+        report = acct.name_report("boom")
+        assert report["tracked"]
+        # values observed only after tracking started still dominate
+        assert report["tags"]["tag_keys"]["id"] >= 10
+        rows = dict()
+        for name, kind, value, tags in acct.telemetry_rows():
+            rows[name] = value
+        assert rows["cardinality.tag_tracked_names"] == 1.0
+        assert rows["cardinality.names_tracked"] == 1.0
+
+
+class TestTableIntegration:
+    def test_row_for_respects_accountant(self):
+        acct = CardinalityAccountant(hard_limit=3)
+        t = CounterTable(64)
+        t.cardinality = acct
+        t.family = "counter"
+        rows = [t.intern(mk_metric("storm", [f"u:{i}"])) for i in range(10)]
+        assert sum(r >= 0 for r in rows) == 3
+        assert sum(r < 0 for r in rows) == 7
+        # existing keys always re-intern (updates are never gated)
+        assert t.intern(mk_metric("storm", ["u:0"])) == rows[0]
+        assert acct.tracker.records["storm"].live_rows == 3
+        assert t.minted_total == 3
+
+    def test_eviction_decrements_live_rows(self):
+        acct = CardinalityAccountant()
+        t = CounterTable(64)
+        t.cardinality = acct
+        t.family = "counter"
+        t.add(mk_metric("fleeting"))
+        assert acct.tracker.records["fleeting"].live_rows == 1
+        t.snapshot_and_reset()
+        t.snapshot_and_reset()
+        t.snapshot_and_reset()
+        evicted = t.reclaim_idle(2)
+        assert evicted and t.tombstoned_total == 1
+        assert acct.tracker.records["fleeting"].live_rows == 0
+
+
+class TestShardedMergeRejection:
+    def test_sharded_merges_filter_rejected_mints(self):
+        """A cardinality-rejected stub (row_for -> -1) must drop out of
+        the sharded import merges — scattering -1 would negative-index
+        the LAST device row, corrupting an unrelated series."""
+        import numpy as np
+        from veneur_tpu.core import sharded_tables
+        from veneur_tpu.ops import batch_hll
+        devices = sharded_tables.local_shard_devices(2)
+        if len(devices) < 2:
+            pytest.skip("needs >= 2 local devices (virtual CPU mesh)")
+        acct = CardinalityAccountant(hard_limit=1)
+        t = sharded_tables.ShardedSetTable(8, 64, devices)
+        t.cardinality = acct
+        t.family = "set"
+        stubs = [mk_metric("storm", ["u:1"], b"s"),
+                 mk_metric("storm", ["u:2"], b"s")]  # 2nd mint rejected
+        regs = np.zeros((2, batch_hll.M), np.int8)
+        regs[:, 7] = 5
+        t.merge_batch(stubs, regs)
+        assert not t.touched[-1]  # last row untouched (no -1 scatter)
+        assert t.touched[0] and len(t.rows) == 1
+
+        th = sharded_tables.ShardedHistoTable(8, 64, devices)
+        th.cardinality = CardinalityAccountant(hard_limit=1)
+        th.family = "histogram"
+        hstubs = [mk_metric("storm", ["u:1"], b"ms"),
+                  mk_metric("storm", ["u:2"], b"ms")]
+        from veneur_tpu.ops import batch_tdigest
+        means = np.zeros((2, batch_tdigest.C), np.float32)
+        weights = np.zeros((2, batch_tdigest.C), np.float32)
+        weights[:, 0] = 1.0
+        th.merge_batch(hstubs, means, weights, [0.0, 0.0], [1.0, 1.0],
+                       [1.0, 1.0])
+        assert not th.touched[-1]
+        assert th.touched[0] and len(th.rows) == 1
+
+
+class TestRegistryOverflowAttribution:
+    def test_dropped_series_tagged_by_name(self):
+        reg = Registry(max_series=2)
+        reg.count("a", 1)
+        reg.count("b", 1)
+        reg.count("noisy", 1)   # over the cap
+        reg.count("noisy", 1)
+        reg.gauge("other", 2.0)
+        assert reg.series_dropped == 3
+        assert reg.dropped_by_name == {"noisy": 2, "other": 1}
+        text = reg.render_prometheus()
+        assert 'veneur_telemetry_series_dropped_by_name_total' \
+            '{name="noisy"} 2' in text
+        assert reg.snapshot()["series_dropped_by_name"]["noisy"] == 2
+
+    def test_attribution_itself_is_bounded(self):
+        from veneur_tpu.core import telemetry as tmod
+        reg = Registry(max_series=1)
+        reg.count("keep", 1)
+        for i in range(tmod.MAX_DROPPED_NAMES + 25):
+            reg.count(f"spray.{i}", 1)
+        assert len(reg.dropped_by_name) == tmod.MAX_DROPPED_NAMES + 1
+        assert reg.dropped_by_name["_other"] == 25
+
+
+class TestServerObservatory:
+    def test_resize_emits_event_and_metrics(self):
+        server, _observer = setup_server()
+        try:
+            cap = server.store.counters.capacity
+            for i in range(cap + 8):
+                server.handle_metric_packet(b"grow.%d:1|c" % i)
+            events = server.telemetry.events.snapshot(
+                kind="columnstore_resize")
+            assert len(events) == 1
+            ev = events[0]
+            assert ev["family"] == "counter"
+            assert ev["old_capacity"] == cap
+            assert ev["new_capacity"] == cap * 2
+            assert ev["duration_s"] > 0
+            # the jit retrace for the new capacity lands on the next
+            # batch apply and is timed + recorded as its own event
+            server.store.counters.apply_pending()
+            rec = server.telemetry.events.snapshot(
+                kind="columnstore_recompile")
+            assert len(rec) == 1 and rec[0]["duration_s"] > 0
+            text = server.telemetry.registry.render_prometheus()
+            assert ('veneur_columnstore_resize_total'
+                    '{family="counter"} 1') in text
+            assert 'veneur_columnstore_resize_seconds_total' in text
+            assert 'veneur_columnstore_row_capacity' in text
+        finally:
+            server.shutdown()
+
+    def test_cardinality_report_shape(self):
+        server, observer = setup_server(
+            cardinality_hard_limit=1000, cardinality_hll_min_mints=2)
+        try:
+            for i in range(32):
+                server.handle_metric_packet(b"hot.name:1|c|#user:u%d" % i)
+            server.handle_metric_packet(b"cold.name:7|g")
+            report = server.cardinality_report(top=5)
+            assert report["total_names"] >= 2
+            top = report["top"]
+            assert top[0]["name"] == "hot.name"
+            assert top[0]["live_rows"] == 32
+            assert top[0]["mints_interval"] == 32
+            assert "tags" in top[0]  # tag tracking kicked in at 2 mints
+            assert top[0]["families"] == {"counter": 32}
+            assert report["limits"]["hard_limit"] == 1000
+            assert report["tables"]["counter"]["live_rows"] >= 32
+            # drill-down merges exact store rows with the tracker record
+            detail = server.cardinality_report(name="hot.name")
+            assert detail["tracked"] and detail["live_rows"] == 32
+            # tracking starts at the 2nd mint, so >= 31 values observed
+            assert abs(detail["tags"]["tag_keys"]["user"] - 31) <= 2
+            # mint RATE appears after one interval rollover
+            server.flush()
+            observer.wait_flush()
+            detail = server.cardinality_report(name="hot.name")
+            assert detail["mints_last_interval"] == 32
+            assert detail["mint_rate_per_s"] > 0
+        finally:
+            server.shutdown()
+
+    def test_hard_capped_offender_still_tops_report(self):
+        """A storm the hard limit is successfully capping has FEW
+        admitted rows — the report must still surface it (by mint
+        activity), not hide it behind a large steady keyset."""
+        server, _observer = setup_server(cardinality_hard_limit=5,
+                                         cardinality_hll_min_mints=8)
+        try:
+            for i in range(40):
+                server.handle_metric_packet(b"steady.big:1|c|#h:%d" % i)
+            for i in range(200):
+                server.handle_metric_packet(b"storm.capped:1|c|#u:%d" % i)
+            report = server.cardinality_report(top=2)
+            names = [r["name"] for r in report["top"]]
+            assert names[0] == "storm.capped"  # 5 rows but 200 mints
+            row = report["top"][0]
+            assert row["live_rows"] == 5
+            assert row["mints_interval"] == 200
+            assert "tags" in row  # the diagnosis rides along
+        finally:
+            server.shutdown()
+
+    def test_debug_cardinality_endpoint(self):
+        server, _observer = setup_server(cardinality_hll_min_mints=2)
+        api = HTTPApi(server.config, server=server, address="127.0.0.1:0")
+        api.start()
+        try:
+            for i in range(16):
+                server.handle_metric_packet(b"api.storm:1|c|#k:v%d" % i)
+            host, port = api.address
+            status, body = vhttp.get(
+                f"http://{host}:{port}/debug/cardinality?top=1")
+            assert status == 200
+            payload = json.loads(body)
+            assert len(payload["top"]) == 1
+            assert payload["top"][0]["name"] == "api.storm"
+            assert payload["top"][0]["live_rows"] == 16
+            assert "tables" in payload and "limits" in payload
+            status, body = vhttp.get(
+                f"http://{host}:{port}/debug/cardinality?name=api.storm")
+            detail = json.loads(body)
+            assert detail["name"] == "api.storm"
+            assert abs(detail["tags"]["tag_keys"]["k"] - 15) <= 2
+        finally:
+            api.stop()
+            server.shutdown()
+
+
+class TestProxyForwardedKeys:
+    """The proxy side of the observatory: per-destination forwarded-key
+    HLL estimates on /metrics and /debug/cardinality."""
+
+    @staticmethod
+    def _mkmetric(name, tags=()):
+        from veneur_tpu.forward.protos import metric_pb2
+        pbm = metric_pb2.Metric(name=name, type=metric_pb2.Counter,
+                                scope=metric_pb2.Global)
+        pbm.tags.extend(tags)
+        pbm.counter.value = 1
+        return pbm
+
+    def test_per_destination_key_estimates(self):
+        from veneur_tpu.proxy.proxy import create_static_proxy
+        from veneur_tpu.testing.forwardtest import ForwardTestServer
+        received = []
+        backend = ForwardTestServer(received.append)
+        backend.start()
+        proxy = create_static_proxy([backend.address])
+        proxy.start()
+        try:
+            for _round in range(2):  # repeats must not inflate distinct
+                for i in range(64):
+                    proxy.handle_metric(
+                        self._mkmetric("proxied.reqs", [f"u:{i}"]))
+            report = proxy.cardinality_report()
+            dest = report["destinations"][0]
+            assert dest["address"] == backend.address
+            assert abs(dest["forwarded_keys_estimate"] - 64) <= 3
+            assert report["routing"]["received_total"] == 128
+            rows = [r for r in proxy.telemetry_rows()
+                    if r[0] == "proxy.dest.forwarded_keys"]
+            assert len(rows) == 1
+            assert abs(rows[0][2] - 64) <= 3
+            # name filter drills to one destination
+            assert proxy.cardinality_report(
+                name="no.such:1234")["destinations"] == []
+        finally:
+            proxy.stop()
+            backend.stop()
+
+
+@pytest.mark.storm
+class TestStormSoak:
+    """The shed-rung acceptance soak: a tag explosion past
+    cardinality_hard_limit is rejected with exact accounting, never
+    touches pre-existing keys, and recovers the moment it stops."""
+
+    STORM = 600
+    LIMIT = 50
+    PRE = 12
+
+    def test_storm_shed_exact_zero_loss_and_recovery(self):
+        server, observer = setup_server(
+            cardinality_hard_limit=self.LIMIT,
+            cardinality_hll_min_mints=16)
+        try:
+            # interval 1: a healthy steady keyset
+            for i in range(self.PRE):
+                server.handle_metric_packet(b"steady.reqs:1|c|#h:%d" % i)
+            server.flush()
+            assert len(observer.wait_flush()) == self.PRE
+
+            # interval 2: the storm, interleaved with steady updates
+            for i in range(self.STORM):
+                server.handle_metric_packet(b"bad.tags:1|c|#u:%d" % i)
+                if i % 50 == 0:
+                    for j in range(self.PRE):
+                        server.handle_metric_packet(
+                            b"steady.reqs:1|c|#h:%d" % j)
+
+            # exact accounting: every rejected mint is one shed sample
+            rejected = self.STORM - self.LIMIT
+            assert server.overload.shed_total == {
+                "counter|cardinality": rejected}
+            report = server.cardinality_report(name="bad.tags")
+            assert report["mints_interval"] == self.STORM
+            assert report["live_rows"] == self.LIMIT
+            # the diagnosis names the exploding tag
+            est = report["tags"]["tag_keys"]["u"]
+            assert abs(est - self.STORM) / self.STORM < 0.05
+
+            server.flush()
+            got = by_name(observer.wait_flush())
+            # zero loss for pre-existing keys: every steady row kept
+            # every update (12 rows x value 12 = the 600/50 interleaves)
+            assert len(got["steady.reqs"]) == self.PRE
+            assert all(m.value == self.STORM / 50
+                       for m in got["steady.reqs"])
+            assert len(got["bad.tags"]) == self.LIMIT
+
+            # the ladder edges are on the flight recorder
+            kinds = [e["kind"] for e in server.telemetry.events.snapshot()]
+            assert "cardinality_hard_limit" in kinds
+            assert "cardinality_recovered" in kinds
+
+            # recovery: the flush rolled the interval -> new keys mint
+            # again immediately, and sheds do not move
+            for i in range(self.STORM, self.STORM + 20):
+                server.handle_metric_packet(b"bad.tags:1|c|#u:%d" % i)
+            assert server.overload.shed_total == {
+                "counter|cardinality": rejected}
+            server.flush()
+            got = by_name(observer.wait_flush())
+            assert len(got["bad.tags"]) == 20
+            # /metrics carries the shed with the cardinality reason tag
+            text = server.telemetry.registry.render_prometheus()
+            assert (f'veneur_ingest_shed_total{{class="counter",'
+                    f'reason="cardinality"}} {rejected}') in text
+        finally:
+            server.shutdown()

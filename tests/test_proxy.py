@@ -305,9 +305,10 @@ class TestNativeRouting:
                 type=metric_pb2.Counter,
                 counter=metric_pb2.CounterValue(value=1))
             proxy._route_native(self._body([m1]))
-            (key, point), = proxy._route_cache.items()
+            (key, (point, _khash)), = proxy._route_cache.items()
             # ring key excludes the ignored tag, exactly like
-            # handle_metric's derivation (cache stores its ring point)
+            # handle_metric's derivation (cache stores its ring point
+            # plus the per-key HLL hash for forwarded-key cardinality)
             assert point == proxy.destinations.ring.point_of(
                 "ikcounterkeep:1")
         finally:

@@ -200,7 +200,8 @@ def build_from_config(raw: dict, args, log):
     if http_addr:
         from veneur_tpu.core.httpapi import HTTPApi
         http_api = HTTPApi(raw, server=None, address=http_addr,
-                           telemetry=telemetry)
+                           telemetry=telemetry,
+                           cardinality=proxy.cardinality_report)
         http_api.start()
 
     return proxy, stats_loop, http_api

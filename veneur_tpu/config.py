@@ -167,6 +167,25 @@ class Config:
     ingest_rate_limit_spans: float = 0.0
     # bucket capacity = rate * this many seconds of burst headroom
     ingest_rate_limit_burst: float = 1.0
+    # -- cardinality watermarks (core/cardinality.py) -------------------
+    # per-NAME new-key mint budgets per flush interval (0 = disabled).
+    # Past soft, further mints for that name are admitted 1-in-N
+    # (cardinality_degraded_keep); past hard, they are rejected and
+    # counted in ingest.shed_total{reason:cardinality}. Existing rows
+    # always keep updating — only new keys are gated; budgets reset
+    # every flush, so recovery after a storm is immediate.
+    cardinality_soft_limit: int = 0
+    cardinality_hard_limit: int = 0
+    cardinality_degraded_keep: float = 0.1
+    # heavy-hitter tracker capacity (bounded memory: names tracked for
+    # /debug/cardinality and the mint budgets)
+    cardinality_top_k: int = 512
+    # per-tag-key HLL tracking: at most this many offender names get
+    # per-tag-key distinct-value estimates (16 KB per tag key, <= 16
+    # tag keys per name), started once a name mints this many keys in
+    # one interval
+    cardinality_hll_names: int = 8
+    cardinality_hll_min_mints: int = 64
     # -- memory watermarks (core/overload.py) ---------------------------
     # RSS thresholds stepping the server ok -> degraded -> shedding
     # (0 = disabled). Degraded pauses span ingest and keeps only

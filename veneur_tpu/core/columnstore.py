@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
@@ -94,6 +95,10 @@ class _BaseTable:
     setting it later lets a snapshot emit a touched-but-valueless row.
     """
 
+    # family label for self-telemetry rows and the cardinality
+    # accountant's shed classes; overwritten per instance by ColumnStore
+    family = "unknown"
+
     def __init__(self, capacity: int = 1024, batch_cap: int = 8192,
                  max_rows: int = 0):
         self.capacity = capacity
@@ -104,6 +109,30 @@ class _BaseTable:
         self.touched = np.zeros(capacity, bool)
         self.lock = threading.Lock()
         self.apply_lock = threading.Lock()
+        # cardinality observatory (core/cardinality.py): duck-typed
+        # accountant consulted on every mint (admit_mint/note_mint) and
+        # fed evictions; None = unlimited, account nothing
+        self.cardinality = None
+        # capacity/churn accounting, exported by ColumnStore.telemetry_rows
+        # and /debug/cardinality: every counter below is monotonic and
+        # mutated only under `lock` (resize/recompile under apply rules
+        # documented at the mutation sites)
+        self.minted_total = 0
+        self.tombstoned_total = 0
+        self.recycled_total = 0
+        self.dispatch_total = 0
+        self.resize_total = 0
+        self.resize_seconds_total = 0.0
+        self.resize_last_seconds = 0.0
+        self.recompile_seconds_total = 0.0
+        self.recompile_last_seconds = 0.0
+        self._recompile_pending = False
+        # on_resize(family, old_capacity, new_capacity, seconds) — the
+        # server's flight-recorder hook. Fired while holding the buffer
+        # lock, so it must not emit statsd (an internal-loopback
+        # self-metric would re-enter this very table's lock); recording
+        # a telemetry event (its own lock only) is safe.
+        self.on_resize = None
         # idle-row reclamation state (the TPU build's answer to the
         # reference's per-interval map swap, worker.go:470-489: row
         # IDENTITY persists here for fast-path reuse, so under key churn
@@ -165,7 +194,36 @@ class _BaseTable:
         self.apply_lock.acquire()
         self.lock.release()
         try:
-            self._apply_cols(cols)
+            if self._recompile_pending:
+                # first batch apply after a capacity doubling: the jit
+                # kernels retrace+recompile for the new shape here. Time
+                # it (block once — compile is the cost being measured)
+                # so the TPU-specific resize tax is attributable.
+                self._recompile_pending = False
+                t0 = time.perf_counter()
+                self._apply_cols(cols)
+                # sharded tables keep per-device state in `states`
+                dev_state = getattr(self, "state",
+                                    getattr(self, "states", None))
+                if dev_state is not None:
+                    try:
+                        jax.block_until_ready(jax.tree.leaves(dev_state))
+                    except Exception:
+                        logger.exception(
+                            "post-resize recompile sync failed")
+                elapsed = time.perf_counter() - t0
+                self.recompile_last_seconds = elapsed
+                self.recompile_seconds_total += elapsed
+                hook = self.on_resize
+                if hook is not None:
+                    try:
+                        hook(self.family, self.capacity, self.capacity,
+                             elapsed, kind="recompile")
+                    except Exception:
+                        logger.exception("resize hook failed")
+            else:
+                self._apply_cols(cols)
+            self.dispatch_total += 1
         finally:
             self.apply_lock.release()
             self.lock.acquire()
@@ -177,6 +235,16 @@ class _BaseTable:
         dict_key = (metric.digest64 << 2) | int(metric.scope)
         row = self.rows.get(dict_key)
         if row is None:
+            # cardinality watermark rung: a NEW key consults the
+            # accountant's per-name mint budget before any allocation.
+            # Existing rows never come through here, so a storm can only
+            # starve its own new keys — pre-existing series keep
+            # updating. The accountant counts every rejection
+            # (ingest.shed_total reason:cardinality*).
+            card = self.cardinality
+            if card is not None and not card.admit_mint(
+                    self.family, metric.key.name, metric.tags):
+                return -1
             meta = RowMeta(
                 name=metric.key.name, tags=list(metric.tags),
                 joined_tags=metric.key.joined_tags, digest32=metric.digest,
@@ -211,6 +279,9 @@ class _BaseTable:
                 self._last_touched[row] = self._generation
             self.scope_code[row] = int(metric.scope)
             self.rows[dict_key] = row
+            self.minted_total += 1
+            if card is not None:
+                card.note_mint(self.family, metric.key.name)
         return row
 
     def _note_generation_locked(self) -> None:
@@ -235,6 +306,7 @@ class _BaseTable:
         Returns the list of rows tombstoned in this call."""
         if idle_intervals <= 0:
             return []
+        evicted_names: List[str] = []
         with self.lock:
             gen = self._generation
             n = len(self.meta)
@@ -258,6 +330,7 @@ class _BaseTable:
                 self.meta[row] = None
                 self._has_meta[row] = False
                 self._free_rows.append(row)
+                self.recycled_total += 1
             # phase 1
             cand = ((tomb < 0) & (gen - last >= idle_intervals)
                     & self._has_meta[:n])
@@ -265,7 +338,15 @@ class _BaseTable:
             for row in evicted:
                 self.rows.pop(self._dict_key_of[row], None)
                 tomb[row] = gen
-            return evicted
+                meta = self.meta[row]
+                if meta is not None:
+                    evicted_names.append(meta.name)
+            self.tombstoned_total += len(evicted)
+        # live-row accounting outside the buffer lock: the eviction list
+        # can be large under churn, and the accountant only needs names
+        if evicted_names and self.cardinality is not None:
+            self.cardinality.note_evicted(self.family, evicted_names)
+        return evicted
 
     def flush_names(self, key, rows: np.ndarray, meta_list,
                     render) -> np.ndarray:
@@ -313,6 +394,7 @@ class _BaseTable:
         return sel
 
     def _grow(self):
+        t0 = time.perf_counter()
         new_cap = self.capacity * 2
         pad = new_cap - self.capacity
         self.touched = np.concatenate(
@@ -334,7 +416,23 @@ class _BaseTable:
         # lock; caller already holds the buffer lock (correct lock order)
         with self.apply_lock:
             self._grow_arrays(new_cap)
-        self.capacity = new_cap
+        old_cap, self.capacity = self.capacity, new_cap
+        # capacity doublings are permanent HBM growth AND a pending jit
+        # recompile (every kernel specializes on capacity; the retrace
+        # lands on the next batch apply, timed in _dispatch_pending_locked)
+        elapsed = time.perf_counter() - t0
+        self.resize_total += 1
+        self.resize_last_seconds = elapsed
+        self.resize_seconds_total += elapsed
+        self._recompile_pending = True
+        logger.info("%s table capacity %d -> %d (%.3fs relayout)",
+                    self.family, old_cap, new_cap, elapsed)
+        hook = self.on_resize
+        if hook is not None:
+            try:
+                hook(self.family, old_cap, new_cap, elapsed, kind="resize")
+            except Exception:
+                logger.exception("resize hook failed")
 
     def _append_batch(self, columns, touch_rows=None) -> None:
         """Vectorized append of parallel sample columns into the typed
@@ -1263,8 +1361,125 @@ class ColumnStore:
                 "%d is not a multiple of 128; flushes use the jnp path",
                 histo_capacity)
         self.statuses = StatusTable(max_rows=max_rows)
+        for family, table in self.tables():
+            table.family = family
         self.processed = 0
         self._processed_lock = threading.Lock()
+
+    def tables(self):
+        """(family, table) pairs, every device family plus statuses."""
+        return (("counter", self.counters), ("gauge", self.gauges),
+                ("histogram", self.histos), ("set", self.sets),
+                ("status", self.statuses))
+
+    def attach_cardinality(self, accountant) -> None:
+        """Wire the cardinality accountant (core/cardinality.py) into
+        every table's interning path."""
+        for _family, table in self.tables():
+            table.cardinality = accountant
+
+    def attach_resize_hook(self, hook) -> None:
+        """hook(family, old_cap, new_cap, seconds, kind=...) fires on
+        every capacity doubling (kind="resize", under the buffer lock —
+        see _BaseTable.on_resize for what the hook may safely do) and on
+        the first post-resize batch apply (kind="recompile")."""
+        for _family, table in self.tables():
+            table.on_resize = hook
+
+    def telemetry_rows(self) -> List[tuple]:
+        """(name, kind, value, tags) scrape-time rows: per-family row
+        capacity/occupancy, batch-buffer state, resize/recompile cost,
+        and key-churn counters — the capacity picture that previously
+        existed only as in-memory attributes. Reads are lock-free (GIL
+        point reads of monotonic counters and gauges; a torn gauge is
+        one scrape stale, never corrupt)."""
+        rows: List[tuple] = []
+        for family, t in self.tables():
+            tags = [f"family:{family}"]
+            rows.append(("columnstore.row_capacity", "gauge",
+                         float(t.capacity), tags))
+            rows.append(("columnstore.live_rows", "gauge",
+                         float(len(t.rows)), tags))
+            rows.append(("columnstore.free_rows", "gauge",
+                         float(len(t._free_rows)), tags))
+            rows.append(("columnstore.keys_minted_total", "counter",
+                         float(t.minted_total), tags))
+            rows.append(("columnstore.keys_tombstoned_total", "counter",
+                         float(t.tombstoned_total), tags))
+            rows.append(("columnstore.keys_recycled_total", "counter",
+                         float(t.recycled_total), tags))
+            rows.append(("columnstore.keys_dropped_total", "counter",
+                         float(t.keys_dropped), tags))
+            rows.append(("columnstore.resize_total", "counter",
+                         float(t.resize_total), tags))
+            rows.append(("columnstore.resize_seconds_total", "counter",
+                         t.resize_seconds_total, tags))
+            rows.append(("columnstore.resize_last_seconds", "gauge",
+                         t.resize_last_seconds, tags))
+            rows.append(("columnstore.recompile_seconds_total", "counter",
+                         t.recompile_seconds_total, tags))
+            rows.append(("columnstore.recompile_last_seconds", "gauge",
+                         t.recompile_last_seconds, tags))
+            rows.append(("columnstore.batch_dispatch_total", "counter",
+                         float(t.dispatch_total), tags))
+            pending = getattr(t, "_n", None)
+            if pending is not None:  # statuses have no batch buffers
+                rows.append(("columnstore.batch_cap", "gauge",
+                             float(t.batch_cap), tags))
+                rows.append(("columnstore.pending_samples", "gauge",
+                             float(pending), tags))
+            nslots = getattr(t, "_nslots", None)
+            if nslots is not None:  # sparse set table: promoted HBM rows
+                rows.append(("columnstore.set_dev_slots", "gauge",
+                             float(nslots), tags))
+        return rows
+
+    def capacity_report(self) -> dict:
+        """Per-family capacity/churn snapshot for /debug/cardinality."""
+        out = {}
+        for family, t in self.tables():
+            out[family] = {
+                "row_capacity": t.capacity,
+                "live_rows": len(t.rows),
+                "allocated_rows": len(t.meta),
+                "free_rows": len(t._free_rows),
+                "minted_total": t.minted_total,
+                "tombstoned_total": t.tombstoned_total,
+                "recycled_total": t.recycled_total,
+                "keys_dropped_total": t.keys_dropped,
+                "resize_total": t.resize_total,
+                "resize_seconds_total": round(t.resize_seconds_total, 6),
+                "resize_last_seconds": round(t.resize_last_seconds, 6),
+                "recompile_seconds_total": round(
+                    t.recompile_seconds_total, 6),
+                "recompile_last_seconds": round(
+                    t.recompile_last_seconds, 6),
+                "batch_dispatch_total": t.dispatch_total,
+            }
+        return out
+
+    def live_rows_by_name(self) -> Dict[str, dict]:
+        """On-demand exact per-name series accounting: walks every
+        table's meta under its buffer lock (pointer-copy only; the
+        group-by runs outside the lock). Capacity-proportional — this is
+        the /debug/cardinality drill-down path, never the hot path."""
+        per_name: Dict[str, dict] = {}
+        for family, t in self.tables():
+            with t.lock:
+                metas = list(t.meta)
+                touched = t.touched.copy()
+            for row, meta in enumerate(metas):
+                if meta is None:
+                    continue
+                entry = per_name.setdefault(
+                    meta.name, {"live_rows": 0, "touched_rows": 0,
+                                "families": {}})
+                entry["live_rows"] += 1
+                entry["families"][family] = \
+                    entry["families"].get(family, 0) + 1
+                if row < touched.shape[0] and touched[row]:
+                    entry["touched_rows"] += 1
+        return per_name
 
     def count_processed(self, n: int) -> None:
         """Locked sample-count increment (readers race on += otherwise)."""

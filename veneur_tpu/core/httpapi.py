@@ -117,6 +117,24 @@ class _Handler(BaseHTTPRequestHandler):
             limit = int(_query_float(self.path, "n", 0.0, max_value=1e6))
             self._send(200, api.telemetry.flushes_json(limit),
                        "application/json")
+        elif path == "/debug/cardinality":
+            # series-cardinality observatory: top-N names by live rows
+            # with mint rates and per-tag-key HLL estimates for the top
+            # offenders; ?name= drills into one name. Served by the
+            # server (core/server.py cardinality_report) and the proxy
+            # (per-destination forwarded-key estimates).
+            source = api.cardinality_source
+            if source is None:
+                source = getattr(api.server, "cardinality_report", None)
+            if source is None:
+                self._send(404, b"no cardinality source\n")
+                return
+            top = int(_query_float(self.path, "top", 20.0,
+                                   max_value=10000.0))
+            name = _query_str(self.path, "name")
+            body = json.dumps(source(top=top, name=name), indent=2,
+                              default=str).encode()
+            self._send(200, body, "application/json")
         elif path == "/debug/memory":
             self._send(200, _device_memory_report(),
                        "application/json")
@@ -214,6 +232,7 @@ class _Handler(BaseHTTPRequestHandler):
                 b"  /debug/threads                  all-thread stacks\n"
                 b"  /debug/events?n=N               event flight recorder\n"
                 b"  /debug/flush?n=N                recent flush rounds\n"
+                b"  /debug/cardinality?top=N&name=  series cardinality\n"
                 b"  /metrics                        Prometheus exposition\n"))
         elif path == "/debug/profile/device":
             # jax.profiler trace (TensorBoard-loadable zip) — the TPU
@@ -295,12 +314,17 @@ class HTTPApi:
 
     def __init__(self, config, server=None, address: str = "127.0.0.1:0",
                  http_quit: bool = False, on_quit=None,
-                 require_flush_for_ready: bool = False, telemetry=None):
+                 require_flush_for_ready: bool = False, telemetry=None,
+                 cardinality=None):
         self.config = config
         self.server = server
         self.http_quit = http_quit
         self.on_quit = on_quit
         self.require_flush_for_ready = require_flush_for_ready
+        # /debug/cardinality source: a callable(top=N, name="") -> dict.
+        # The owning server's cardinality_report is used by default; a
+        # standalone API (the proxy) passes its own.
+        self.cardinality_source = cardinality
         # /metrics & the flight recorder serve the owning server's
         # telemetry; a standalone API (proxy passes its own, tests pass
         # none) gets a private registry so the routes always answer —

@@ -309,6 +309,26 @@ class Server:
             on_transition=self._overload_transition,
             on_stall=self._supervisor_stall)
         self.telemetry.registry.add_collector(self.overload.telemetry_rows)
+        # cardinality observatory (core/cardinality.py): heavy-hitter
+        # series accounting fed from the column store's interning path,
+        # per-tag-key HLL diagnosis of top offenders, and the
+        # cardinality rung of the shed ladder (rejected mints land in
+        # ingest.shed_total via overload.shed, reason:cardinality*)
+        from veneur_tpu.core.cardinality import CardinalityAccountant
+        self.cardinality = CardinalityAccountant(
+            soft_limit=config.cardinality_soft_limit,
+            hard_limit=config.cardinality_hard_limit,
+            degraded_keep=config.cardinality_degraded_keep,
+            top_k=config.cardinality_top_k,
+            hll_names=config.cardinality_hll_names,
+            hll_min_mints=config.cardinality_hll_min_mints,
+            on_shed=self.overload.shed,
+            on_event=self.telemetry.record_event)
+        self.store.attach_cardinality(self.cardinality)
+        self.store.attach_resize_hook(self._store_resize)
+        self.telemetry.registry.add_collector(self.store.telemetry_rows)
+        self.telemetry.registry.add_collector(
+            self.cardinality.telemetry_rows)
         self._flush_thread: Optional[threading.Thread] = None
         self._watchdog_thread: Optional[threading.Thread] = None
         self._shutdown = threading.Event()
@@ -774,6 +794,70 @@ class Server:
             "pipeline_stall", component=component,
             heartbeat_age_s=round(age, 3))
 
+    def _store_resize(self, family: str, old_cap: int, new_cap: int,
+                      seconds: float, kind: str = "resize") -> None:
+        """Flight-recorder hook for every column-store capacity doubling
+        (kind=resize: the array re-layout, fired under the table's
+        buffer lock — event recording only, never statsd) and for the
+        first post-resize batch apply (kind=recompile: the jit retrace
+        the new capacity forces, the TPU-specific cost)."""
+        self.telemetry.record_event(
+            f"columnstore_{kind}", family=family, old_capacity=old_cap,
+            new_capacity=new_cap, duration_s=round(seconds, 6))
+
+    def cardinality_report(self, top: int = 20, name: str = "") -> dict:
+        """The /debug/cardinality payload. With `name`, a single-name
+        drill-down (exact per-family rows + tag-key HLL estimates);
+        otherwise the top-N names by live series, per-table capacity/
+        churn stats, and the watermark state. The per-name scan is
+        capacity-proportional — operator-triggered only."""
+        if name:
+            detail = self.cardinality.name_report(name)
+            exact = self.store.live_rows_by_name().get(name)
+            if exact is not None:
+                detail.update(exact)
+            else:
+                detail.setdefault("live_rows", 0)
+            return detail
+        per_name = self.store.live_rows_by_name()
+        tracked = {r["name"]: r for r in self.cardinality.top(top)}
+        # candidates = top names by exact live rows UNION the tracker's
+        # top by mint activity: a hard-capped storm offender has few
+        # ADMITTED rows (the cap is working), but its mint rate is the
+        # very thing the operator came to see — ranking by live rows
+        # alone would hide it behind any large steady keyset
+        by_rows = sorted(
+            per_name, key=lambda nm: (per_name[nm]["live_rows"],
+                                      per_name[nm]["touched_rows"]),
+            reverse=True)[:max(0, top)]
+        top_list = []
+        for nm in set(by_rows) | set(tracked):
+            row = {"name": nm}
+            row.update(per_name.get(
+                nm, {"live_rows": 0, "touched_rows": 0, "families": {}}))
+            rec = tracked.get(nm)
+            if rec is not None:
+                for field in ("mints_interval", "mints_last_interval",
+                              "mint_rate_per_s", "shed_total"):
+                    row[field] = rec[field]
+            tag_report = self.cardinality.tag_report(nm)
+            if tag_report is not None:
+                row["tags"] = tag_report
+            top_list.append(row)
+        top_list.sort(
+            key=lambda r: (r["live_rows"] + r.get("mints_interval", 0)
+                           + r.get("mints_last_interval", 0)),
+            reverse=True)
+        del top_list[max(0, top):]
+        return {
+            "generated_unix": round(time.time(), 3),
+            "interval_s": round(self.cardinality.interval_s, 3),
+            "total_names": len(per_name),
+            "tables": self.store.capacity_report(),
+            "top": top_list,
+            "limits": self.cardinality.limits_report(),
+        }
+
     def ready_state(self):
         """(ready, reason) for /healthcheck/ready: not ready while the
         overload ladder is shedding, or while the flush watchdog's
@@ -1188,6 +1272,10 @@ class Server:
             self.statsd.count("intern.keys_dropped_total",
                               dropped - self._keys_dropped_reported)
             self._keys_dropped_reported = dropped
+        # interval rollover AFTER reclaim so eviction-driven live-count
+        # decrements land in the interval they happened in; this resets
+        # the per-name mint budgets (the shed rung's immediate recovery)
+        self.cardinality.roll_interval()
 
     def _timed_sink_flush(self, key: str, parent_span, round_info: dict,
                           target, *args) -> None:

@@ -172,13 +172,17 @@ class ShardedHistoTable(HistoTable):
         with self.lock:
             rows = np.fromiter(
                 (self.row_for(s) for s in stubs), np.int32, len(stubs))
+            # cardinality-capped/rejected stubs drop out: scattering a
+            # -1 row would negative-index the LAST device row
+            ok = rows >= 0
+            rows = rows[ok]
             self.touched[rows] = True
             self.apply_lock.acquire()
         try:
             i = self._next
             self._next = (i + 1) % len(self._devices)
             dev = self._devices[i]
-            put = lambda a, t: jax.device_put(np.asarray(a, t), dev)
+            put = lambda a, t: jax.device_put(np.asarray(a, t)[ok], dev)
             self.states[i] = batch_tdigest.merge_centroid_rows(
                 self.states[i], jax.device_put(rows, dev),
                 put(in_means, np.float32), put(in_weights, np.float32),
@@ -275,6 +279,10 @@ class ShardedSetTable(SetTable):
         with self.lock:
             rows = np.fromiter(
                 (self.row_for(s) for s in stubs), np.int32, len(stubs))
+            # cardinality-capped/rejected stubs drop out: scattering a
+            # -1 row would negative-index the LAST device row
+            ok = rows >= 0
+            rows = rows[ok]
             self.touched[rows] = True
             self.apply_lock.acquire()
         try:
@@ -283,7 +291,7 @@ class ShardedSetTable(SetTable):
             dev = self._devices[i]
             self.states[i] = batch_hll.merge_rows(
                 self.states[i], jax.device_put(rows, dev),
-                jax.device_put(np.asarray(in_regs, np.int8), dev))
+                jax.device_put(np.asarray(in_regs, np.int8)[ok], dev))
         finally:
             self.apply_lock.release()
 

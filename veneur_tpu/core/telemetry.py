@@ -25,10 +25,13 @@ from __future__ import annotations
 
 import bisect
 import json
+import logging
 import threading
 import time
 from collections import deque
 from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+logger = logging.getLogger("veneur_tpu.telemetry")
 
 # Fixed histogram bucket ladder (seconds-oriented, but unit-agnostic):
 # 1-2-5 decades from 100µs to 100s. 19 bins + overflow, allocated once
@@ -41,6 +44,12 @@ HISTOGRAM_BOUNDS: Tuple[float, ...] = tuple(
 # Series cap: a registry is fed by self-metrics only (bounded-cardinality
 # names + tags), so the cap exists to bound a bug, not normal operation.
 DEFAULT_MAX_SERIES = 4096
+
+# Overflow attribution cap: at most this many distinct metric NAMES get
+# their own series_dropped_by_name counter; later names pool into the
+# "_other" bucket. Bounds the debugging aid the same way the registry
+# itself is bounded.
+MAX_DROPPED_NAMES = 64
 
 
 def _tags_key(tags: Sequence[str]) -> Tuple[str, ...]:
@@ -79,6 +88,11 @@ class Registry:
         self._gauges: Dict[Tuple[str, Tuple[str, ...]], float] = {}
         self._histograms: Dict[Tuple[str, Tuple[str, ...]], _Histogram] = {}
         self.series_dropped = 0
+        # overflow attribution: name -> drops since the cap was hit, so
+        # a silent lossy drop becomes debuggable (which emitter blew the
+        # cap?). Bounded at MAX_DROPPED_NAMES; the first drop per name
+        # is logged once (rate-limited by construction).
+        self.dropped_by_name: Dict[str, int] = {}
         # collectors: zero-arg callables returning (name, kind, value,
         # tags) rows rendered fresh at scrape time (live counters the
         # registry doesn't own, device memory, ...)
@@ -90,6 +104,18 @@ class Registry:
         key = (name, _tags_key(tags))
         if key not in table and self._series_count() >= self.max_series:
             self.series_dropped += 1
+            dropped = self.dropped_by_name
+            if name in dropped:
+                dropped[name] += 1
+            elif len(dropped) < MAX_DROPPED_NAMES:
+                dropped[name] = 1
+                logger.warning(
+                    "telemetry registry full (max_series=%d): dropping "
+                    "new series for %r (first drop for this name; "
+                    "telemetry.series_dropped_by_name counts the rest)",
+                    self.max_series, name)
+            else:
+                dropped["_other"] = dropped.get("_other", 0) + 1
             return None
         return key
 
@@ -158,6 +184,7 @@ class Registry:
                 "histograms": {self._flat(k): h.count
                                for k, h in self._histograms.items()},
                 "series_dropped": self.series_dropped,
+                "series_dropped_by_name": dict(self.dropped_by_name),
             }
 
     @staticmethod
@@ -175,6 +202,7 @@ class Registry:
                           for k, h in self._histograms.items()}
             collectors = list(self._collectors)
             dropped = self.series_dropped
+            dropped_by_name = dict(self.dropped_by_name)
         for fn in collectors:
             try:
                 for name, kind, value, tags in fn():
@@ -186,6 +214,9 @@ class Registry:
             except Exception:
                 continue
         gauges[("telemetry.series_dropped", ())] = float(dropped)
+        for name, n in dropped_by_name.items():
+            counters[("telemetry.series_dropped_by_name",
+                      (f"name:{name}",))] = float(n)
 
         out: List[str] = []
         for table, ptype in ((counters, "counter"), (gauges, "gauge")):

@@ -20,6 +20,7 @@ import grpc
 
 from veneur_tpu.forward.protos import metric_pb2
 from veneur_tpu.forward.wire import _serialize_metric, send_batch
+from veneur_tpu.ops import hll_ref
 from veneur_tpu.proxy.ring import ConsistentRing, EmptyRingError
 from veneur_tpu.util.grpctls import GrpcTLS, secure_or_insecure_channel
 from veneur_tpu.util.resilience import CircuitBreaker
@@ -53,6 +54,11 @@ class Destination:
         self.sent_total = 0
         self.dropped_total = 0
         self.shed_open_total = 0  # immediate sheds while the breaker is open
+        # distinct forwarded metric keys, as a p=14 HLL over the ring-key
+        # hash (the proxy's side of the cardinality observatory: which
+        # destination is absorbing a key explosion). Fed by note_key on
+        # the routing path; cumulative for the destination's lifetime.
+        self.key_hll = hll_ref.HLL()
         self._channel = secure_or_insecure_channel(address, tls)
         # batches hold Metric objects (the V2 ingest path) or raw wire
         # bytes (the native V1 re-scatter): the serializer passes both
@@ -71,6 +77,13 @@ class Destination:
         self._thread = threading.Thread(
             target=self._run, name=f"proxy-dest-{address}", daemon=True)
         self._thread.start()
+
+    def note_key(self, key_hash: int) -> None:
+        """Record one routed metric key (pre-hashed 64-bit). Lock-free
+        register max: concurrent updates may lose a race, which can only
+        UNDER-estimate by a hair — a counter-style lock on the per-metric
+        routing path would cost more than the estimate is worth."""
+        self.key_hll.insert_hash(key_hash)
 
     def send(self, metric: metric_pb2.Metric) -> bool:
         """Non-blocking enqueue first; fall back to a short blocking wait;
@@ -254,6 +267,8 @@ class Destinations:
                          float(dest.shed_open_total), tags))
             rows.append(("proxy.dest.queue_depth", "gauge",
                          float(dest._queue.qsize()), tags))
+            rows.append(("proxy.dest.forwarded_keys", "gauge",
+                         dest.key_hll.estimate(), tags))
             rows.append(("resilience.breaker_state", "gauge",
                          float(dest.breaker.state_code), tags))
         return rows

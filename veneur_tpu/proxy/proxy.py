@@ -20,6 +20,7 @@ from typing import Dict, List, Optional
 import grpc
 
 from veneur_tpu.forward.protos import forward_pb2, metric_pb2
+from veneur_tpu.ops import hll_ref
 from veneur_tpu.proxy.destinations import Destinations
 from veneur_tpu.proxy.discovery import Discoverer, StaticDiscoverer
 from veneur_tpu.proxy.ring import EmptyRingError
@@ -57,12 +58,17 @@ class ProxyServer:
             "received_total": 0, "routed_total": 0,
             "no_destination_total": 0, "dropped_total": 0,
         }
-        # identity-key bytes -> ring POINT: forward streams repeat the
-        # same keys every interval, so ring-key derivation (tag
-        # filtering, type naming, joining) AND its hash are paid once
-        # per key lifetime. Points are membership-independent, so the
-        # cache survives discovery churn.
-        self._route_cache: Dict[bytes, int] = {}
+        # identity-key bytes -> (ring POINT, 64-bit key hash): forward
+        # streams repeat the same keys every interval, so ring-key
+        # derivation (tag filtering, type naming, joining), its ring
+        # hash, AND the HLL key hash (per-destination forwarded-key
+        # cardinality) are paid once per key lifetime. Points are
+        # membership-independent, so the cache survives discovery churn.
+        self._route_cache: Dict[bytes, tuple] = {}
+        # the upb/V2 path's equivalent, keyed by the derived ring-key
+        # string (kept separate: identity-key bytes and derived strings
+        # are different namespaces)
+        self._v2_route_cache: Dict[str, tuple] = {}
         # handle_metric runs on up to max_workers gRPC threads; python
         # dict += is not atomic, so counter accuracy needs a lock
         self._stats_lock = threading.Lock()
@@ -158,6 +164,39 @@ class ProxyServer:
         rows.extend(self.destinations.telemetry_rows())
         return rows
 
+    def cardinality_report(self, top: int = 20, name: str = "") -> dict:
+        """/debug/cardinality on the proxy tier: per-destination
+        forwarded-key HLL estimates (cumulative per destination
+        lifetime), so an operator can see which global instance a key
+        explosion hashes onto. `name` filters to one destination
+        address; `top` bounds the list (largest key count first)."""
+        import time
+        with self.destinations._lock:
+            pool = list(self.destinations._pool.values())
+        dests = []
+        for dest in pool:
+            if name and dest.address != name:
+                continue
+            dests.append({
+                "address": dest.address,
+                "forwarded_keys_estimate": int(dest.key_hll.estimate()),
+                "sent_total": dest.sent_total,
+                "dropped_total": dest.dropped_total,
+                "queue_depth": dest._queue.qsize(),
+                "breaker_state": dest.breaker.state,
+            })
+        dests.sort(key=lambda d: d["forwarded_keys_estimate"],
+                   reverse=True)
+        with self._stats_lock:
+            stats = dict(self.stats)
+        return {
+            "generated_unix": round(time.time(), 3),
+            "routing": stats,
+            "route_cache_size": (len(self._route_cache)
+                                 + len(self._v2_route_cache)),
+            "destinations": dests[:max(0, top)],
+        }
+
     # -- discovery -------------------------------------------------------
 
     def _discovery_loop(self) -> None:
@@ -214,8 +253,8 @@ class ProxyServer:
                     self.handle_metric(metric_pb2.Metric.FromString(raw))
                     continue
                 fast += 1
-                point = cache.get(key)
-                if point is None:
+                cached = cache.get(key)
+                if cached is None:
                     # strict decode: invalid utf-8 raises here, and the
                     # upb re-parse below surfaces the same rejection the
                     # old whole-body deserializer gave — the poisoned
@@ -230,16 +269,23 @@ class ProxyServer:
                         continue
                     tags = [t for t in tags
                             if not any(mm.match(t) for mm in self._ignore)]
-                    point = self.destinations.ring.point_of(
-                        "%s%s%s" % (name, type_name, ",".join(tags)))
+                    ring_key = "%s%s%s" % (name, type_name, ",".join(tags))
+                    point = self.destinations.ring.point_of(ring_key)
                     if len(cache) >= self.ROUTE_CACHE_MAX:
                         cache.clear()
-                    cache[key] = point
+                    # HLL key hash over the DERIVED ring key — the same
+                    # basis handle_metric hashes, so forwarded-key
+                    # estimates agree across ingest paths — paid once
+                    # per key lifetime
+                    cached = cache[key] = (
+                        point, hll_ref.hash_member(ring_key.encode()))
+                point, key_hash = cached
                 try:
                     dest = self.destinations.get_at(point)
                 except EmptyRingError:
                     no_dest += 1
                     continue
+                dest.note_key(key_hash)
                 if dest.send(raw):
                     routed += 1
                 else:
@@ -269,12 +315,24 @@ class ProxyServer:
         key = "%s%s%s" % (pbm.name,
                           metric_pb2.Type.Name(pbm.type).lower(),
                           ",".join(tags))
+        # same once-per-key-lifetime amortization as the native path:
+        # the ring hash and the HLL key hash are both pure-Python and
+        # both repeat every interval for a steady key stream
+        cached = self._v2_route_cache.get(key)
+        if cached is None:
+            if len(self._v2_route_cache) >= self.ROUTE_CACHE_MAX:
+                self._v2_route_cache.clear()
+            cached = self._v2_route_cache[key] = (
+                self.destinations.ring.point_of(key),
+                hll_ref.hash_member(key.encode()))
+        point, key_hash = cached
         try:
-            dest = self.destinations.get(key)
+            dest = self.destinations.get_at(point)
         except EmptyRingError:
             with self._stats_lock:
                 self.stats["no_destination_total"] += 1
             return
+        dest.note_key(key_hash)
         routed = dest.send(pbm)
         with self._stats_lock:
             self.stats["routed_total" if routed else "dropped_total"] += 1
