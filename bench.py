@@ -30,6 +30,7 @@ METRIC_NAMES = {
     "timers": "timer_samples_per_sec",
     "hll": "hll_samples_per_sec",
     "forward": "forwarded_digest_keys_per_sec",
+    "llhist": "llhist_samples_per_sec",
     "ssf": "ssf_extracted_samples_per_sec",
     "device": "device_samples_per_sec",
     "sustained": "sustained_samples_per_sec",
@@ -1059,6 +1060,21 @@ def run_scenario_tdigest(duration_s: float, num_keys: int = 100_000,
                              "tdigest_keys": num_keys}
 
 
+def run_scenario_llhist(duration_s: float, num_keys: int = 1000):
+    """BASELINE config 6: Circllhist stress — multi-value `|l` packets
+    (the exact-merge log-linear family). The type is outside the native
+    parser's grammar, so this measures the Python parse path + the
+    host binning + the device scatter-add."""
+    import numpy as np
+    rng = np.random.default_rng(6)
+    packets = []
+    for i in range(num_keys):
+        vals = b":".join(b"%.3f" % v for v in rng.lognormal(3, 1, 8))
+        packets.append(b"bench.llh.%d:%s|l" % (i, vals))
+    return _run_udp_scenario(duration_s, packets, num_keys * 8,
+                             num_keys * 2)
+
+
 def run_scenario_hll(duration_s: float, num_keys: int = 10_000,
                      cardinality: int = 100):
     """BASELINE config 3: mixed keys at tag cardinality 100 — HLL stress
@@ -1077,7 +1093,7 @@ def run_scenario_hll(duration_s: float, num_keys: int = 10_000,
 
 
 SCENARIOS = ["default", "mixed", "single", "counter", "timers", "hll",
-             "forward", "ssf", "device", "sustained", "tdigest"]
+             "llhist", "forward", "ssf", "device", "sustained", "tdigest"]
 
 
 def clamp_keys(keys: int, on_tpu: bool) -> int:
@@ -1102,6 +1118,8 @@ def run_one(scenario: str, duration: float, keys: int, on_tpu: bool = True):
         rate = run_scenario_timers(duration, min(keys, 1000))
     elif scenario == "hll":
         rate = run_scenario_hll(duration, keys)
+    elif scenario == "llhist":
+        rate = run_scenario_llhist(duration, min(keys, 1000))
     elif scenario == "forward":
         rate = run_scenario_forward(duration, keys)
     elif scenario == "device":
@@ -1232,6 +1250,7 @@ def run_default(args, on_tpu: bool) -> None:
         ("counter", lambda d: run_scenario_counter(d), 20),
         ("timers", lambda d: run_scenario_timers(d, 1000), 20),
         ("hll", lambda d: run_scenario_hll(d, 10_000), 25),
+        ("llhist", lambda d: run_scenario_llhist(d, 1000), 25),
         ("ssf", lambda d: run_scenario_ssf(d, 10_000), 30),
         ("forward", lambda d: run_scenario_forward(
             d, 50_000 if on_tpu else 10_000), 35),

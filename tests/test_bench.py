@@ -72,3 +72,15 @@ class TestBenchContract:
         proc = single_proc
         assert "bench[" in proc.stderr
         assert "backend=" in proc.stderr
+
+    def test_llhist_scenario_smoke(self):
+        """The llhist BASELINE config must run and emit its contract
+        line (the log-linear family rides the Python parse path, so
+        this also smoke-tests `|l` ingest end to end)."""
+        proc = run_bench("--scenario", "llhist", "--duration", "1",
+                         "--keys", "200", "--deadline", "150")
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        obj = last_json_line(proc.stdout)
+        assert obj["metric"] == "llhist_samples_per_sec"
+        assert obj["value"] > 0
+        assert obj["unit"] == "samples/s"

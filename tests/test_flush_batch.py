@@ -42,6 +42,8 @@ def _mixed_corpus():
         lines.append(b"t.%d:%.2f|ms|#env:t" % (i, 20.0 + i))
         lines.append(b"s.%d:user%d|s|#env:t" % (i, i))
         lines.append(b"s.%d:user%d|s|#env:t" % (i, i + 100))
+        lines.append(b"ll.%d:%.2f|l|#env:t" % (i, 5.0 + i))
+        lines.append(b"ll.%d:%.2f|l|#env:t" % (i, 50.0 + i))
     # explicit scope variants (veneurlocalonly / veneurglobalonly)
     lines += [
         b"lc:5|c|#veneurlocalonly",
@@ -53,6 +55,8 @@ def _mixed_corpus():
         b"gt:5.5|ms|#veneurglobalonly",
         b"ls:a|s|#veneurlocalonly",
         b"gs:b|s|#veneurglobalonly",
+        b"lll:7.5|l|#veneurlocalonly",
+        b"gll:8.5|l|#veneurglobalonly",
         b"sc.ok:0|sc|#veneurlocalonly",
     ]
     return lines

@@ -1,0 +1,508 @@
+"""OTLP ingest plane: wire decoding (protobuf + JSON), the shared
+cumulative->delta semantics (counter-reset 0-clamp pin, shared with the
+OpenMetrics source), exponential-histogram -> llhist mapping, and the
+acceptance round trip: an OTLP/HTTP POST of ExponentialHistogram points
+flushes to correct Prometheus `_bucket`/`_sum`/`_count` output."""
+
+from __future__ import annotations
+
+import json
+import re
+import struct
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from veneur_tpu.samplers import metrics as m
+from veneur_tpu.sources import CumulativeDeltaCache
+from veneur_tpu.sources.otlp import (
+    OTLPSource, TEMPORALITY_CUMULATIVE, TEMPORALITY_DELTA, _EHistCache,
+    parse_export_json, parse_export_request)
+
+pytestmark = pytest.mark.otlp
+
+
+# -- tiny protobuf writer (mirror of the source's generic reader) ----------
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    while n >= 0x80:
+        out.append((n & 0x7F) | 0x80)
+        n >>= 7
+    out.append(n)
+    return bytes(out)
+
+
+def _ld(field: int, payload: bytes) -> bytes:
+    return _varint((field << 3) | 2) + _varint(len(payload)) + payload
+
+
+def _vi(field: int, value: int) -> bytes:
+    return _varint(field << 3) + _varint(value)
+
+
+def _f64(field: int, value: float) -> bytes:
+    return bytes([(field << 3) | 1]) + struct.pack("<d", value)
+
+
+def _fx64(field: int, value: int) -> bytes:
+    return bytes([(field << 3) | 1]) + struct.pack("<Q", value)
+
+
+def _zz(v: int) -> int:
+    return (v << 1) ^ (v >> 63)
+
+
+def _attr(key: str, value: str) -> bytes:
+    return _ld(1, _ld(1, key.encode()) + _ld(2, _ld(1, value.encode())))
+
+
+def _np_attr(key: str, value: str) -> bytes:
+    """KeyValue serialized as a NumberDataPoint.attributes entry."""
+    kv = _ld(1, key.encode()) + _ld(2, _ld(1, value.encode()))
+    return _ld(7, kv)
+
+
+def _metric_gauge(name: str, points) -> bytes:
+    g = b"".join(_ld(1, p) for p in points)
+    return _ld(1, name.encode()) + _ld(5, g)
+
+
+def _metric_sum(name: str, points, temporality: int,
+                monotonic: bool) -> bytes:
+    s = b"".join(_ld(1, p) for p in points)
+    s += _vi(2, temporality) + _vi(3, 1 if monotonic else 0)
+    return _ld(1, name.encode()) + _ld(7, s)
+
+
+def _buckets(offset: int, counts) -> bytes:
+    return _vi(1, _zz(offset)) + _ld(2, b"".join(_varint(c) for c in counts))
+
+
+def _ehist_point(scale: int, zero_count: int, pos, neg,
+                 attrs: bytes = b"") -> bytes:
+    out = attrs
+    out += _vi(6, _zz(scale))
+    out += _fx64(7, zero_count)
+    out += _ld(8, _buckets(*pos))
+    if neg is not None:
+        out += _ld(9, _buckets(*neg))
+    return out
+
+
+def _ehp_attr(key: str, value: str) -> bytes:
+    kv = _ld(1, key.encode()) + _ld(2, _ld(1, value.encode()))
+    return _ld(1, kv)  # attributes are field 1 on EHDP
+
+
+def _metric_ehist(name: str, points, temporality: int) -> bytes:
+    eh = b"".join(_ld(1, p) for p in points) + _vi(2, temporality)
+    return _ld(1, name.encode()) + _ld(10, eh)
+
+
+def _request(*metrics: bytes) -> bytes:
+    sm = b"".join(_ld(2, mm) for mm in metrics)
+    return _ld(1, _ld(2, sm))
+
+
+# -- shared delta semantics (the counter-reset pin) ------------------------
+
+
+class TestCumulativeDeltaCache:
+    def test_first_observation_primes(self):
+        c = CumulativeDeltaCache()
+        assert c.delta(("x",), 100.0) is None
+
+    def test_growth_emits_delta(self):
+        c = CumulativeDeltaCache()
+        c.delta(("x",), 100.0)
+        assert c.delta(("x",), 130.0) == 30.0
+        assert c.delta(("x",), 130.0) == 0.0
+
+    def test_reset_emits_new_count_never_negative(self):
+        """The counter-reset pin: a restarted exporter's new cumulative
+        count is real traffic (emit it), and a broken exporter that goes
+        NEGATIVE must clamp to 0 — never a negative spike."""
+        c = CumulativeDeltaCache()
+        c.delta(("x",), 100.0)
+        assert c.delta(("x",), 7.0) == 7.0      # reset: new count
+        assert c.delta(("x",), -50.0) == 0.0    # broken: 0-clamped
+        # and the negative value primes, so recovery is a plain delta
+        assert c.delta(("x",), -20.0) == 30.0
+
+    def test_bounded_cache_clears_wholesale(self):
+        c = CumulativeDeltaCache(max_series=2)
+        c.delta(("a",), 1.0)
+        c.delta(("b",), 1.0)
+        c.delta(("c",), 1.0)  # clears, then primes c
+        assert c.delta(("c",), 4.0) == 3.0
+        assert c.delta(("a",), 9.0) is None  # was evicted, re-primes
+
+    def test_openmetrics_source_shares_the_semantics(self):
+        from veneur_tpu.sources.openmetrics import OpenMetricsSource
+        src = OpenMetricsSource("om", url="http://unused", scrape_interval=60)
+        assert src._counter_delta("n", ["a:b"], 10.0) is None
+        assert src._counter_delta("n", ["a:b"], 25.0) == 15.0
+        assert src._counter_delta("n", ["a:b"], 3.0) == 3.0   # reset
+        assert src._counter_delta("n", ["a:b"], -1.0) == 0.0  # 0-clamp
+
+
+# -- wire decoding ----------------------------------------------------------
+
+
+class TestProtoDecoding:
+    def test_gauge_sum_ehist(self):
+        body = _request(
+            _metric_gauge("cpu", [_np_attr("core", "0") + _f64(4, 0.5)]),
+            _metric_sum("reqs", [struct.pack("<B", (6 << 3) | 1)
+                                 + struct.pack("<q", 42)],
+                        TEMPORALITY_CUMULATIVE, True),
+            _metric_ehist("lat", [_ehist_point(3, 2, (10, [5, 0, 3]),
+                                               (-2, [1]))],
+                          TEMPORALITY_DELTA),
+        )
+        points = list(parse_export_request(body))
+        kinds = [p[0] for p in points]
+        assert kinds == ["gauge", "sum", "ehist"]
+        g = points[0]
+        assert g[1] == "cpu" and g[2] == {"core": "0"} and g[3] == 0.5
+        s = points[1]
+        assert s[3] == 42.0 and s[4] == TEMPORALITY_CUMULATIVE and s[5]
+        _, name, pt, temp = points[2]
+        assert name == "lat" and temp == TEMPORALITY_DELTA
+        assert pt["scale"] == 3 and pt["zero_count"] == 2
+        assert pt["pos"] == (10, [5, 0, 3])
+        assert pt["neg"] == (-2, [1])
+
+    def test_unsupported_kinds_reported(self):
+        hist = _ld(1, b"h") + _ld(9, b"")
+        summary = _ld(1, b"s") + _ld(11, b"")
+        points = list(parse_export_request(_request(hist, summary)))
+        assert points == [("unsupported", "histogram"),
+                          ("unsupported", "summary")]
+
+    def test_json_equivalence(self):
+        doc = {"resourceMetrics": [{"scopeMetrics": [{"metrics": [
+            {"name": "cpu", "gauge": {"dataPoints": [
+                {"asDouble": 0.5,
+                 "attributes": [{"key": "core",
+                                 "value": {"intValue": "0"}}]}]}},
+            {"name": "reqs", "sum": {
+                "isMonotonic": True,
+                "aggregationTemporality":
+                    "AGGREGATION_TEMPORALITY_CUMULATIVE",
+                "dataPoints": [{"asInt": "42"}]}},
+            {"name": "lat", "exponentialHistogram": {
+                "aggregationTemporality": 1,
+                "dataPoints": [{"scale": 3, "zeroCount": "2",
+                                "positive": {"offset": 10,
+                                             "bucketCounts":
+                                                 ["5", "0", "3"]},
+                                "negative": {"offset": -2,
+                                             "bucketCounts": ["1"]}}]}},
+        ]}]}]}
+        points = list(parse_export_json(json.dumps(doc).encode()))
+        assert [p[0] for p in points] == ["gauge", "sum", "ehist"]
+        assert points[0][2] == {"core": "0"} and points[0][3] == 0.5
+        assert points[1][3] == 42.0
+        pt = points[2][2]
+        assert pt["pos"] == (10, [5, 0, 3]) and pt["zero_count"] == 2
+
+
+class TestEHistCache:
+    def test_cumulative_to_delta(self):
+        c = _EHistCache()
+        p1 = {"attrs": {}, "scale": 3, "zero_count": 2,
+              "pos": (10, [5, 3]), "neg": (0, [])}
+        assert c.delta(("k",), p1) is p1  # primes: current stands
+        p2 = {"attrs": {}, "scale": 3, "zero_count": 5,
+              "pos": (10, [9, 3]), "neg": (0, [])}
+        d = c.delta(("k",), p2)
+        assert d["zero_count"] == 3 and d["pos"] == (10, [4, 0])
+
+    def test_reset_and_upscale_stand_as_is(self):
+        c = _EHistCache()
+        p1 = {"attrs": {}, "scale": 3, "zero_count": 2,
+              "pos": (10, [5, 3]), "neg": (0, [])}
+        c.delta(("k",), p1)
+        shrunk = {"attrs": {}, "scale": 3, "zero_count": 2,
+                  "pos": (10, [1, 3]), "neg": (0, [])}
+        assert c.delta(("k",), shrunk) is shrunk  # bucket shrank: reset
+        upscaled = {"attrs": {}, "scale": 5, "zero_count": 9,
+                    "pos": (40, [1]), "neg": (0, [])}
+        assert c.delta(("k",), upscaled) is upscaled  # finer = restart
+
+    def test_downscale_is_not_a_reset(self):
+        """An SDK downscale (coarser bins as the range grows) preserves
+        the cumulative history: the previous point re-buckets onto the
+        new scale and the delta excludes everything already counted —
+        treating it as a reset would double-ingest the history."""
+        c = _EHistCache()
+        p1 = {"attrs": {}, "scale": 3, "zero_count": 2,
+              "pos": (10, [5, 3, 0, 7]), "neg": (0, [])}
+        c.delta(("k",), p1)
+        # scale 3 -> 1 (d=2): prev indexes 10..13 -> coarse 2 (10,11)
+        # and 3 (12,13): [8, 7]. New cumulative adds 4 to coarse bin 2
+        # and a new coarse bin 4 with 9.
+        p2 = {"attrs": {}, "scale": 1, "zero_count": 2,
+              "pos": (2, [12, 7, 9]), "neg": (0, [])}
+        d = c.delta(("k",), p2)
+        assert d["zero_count"] == 0
+        assert d["pos"] == (2, [4, 0, 9])
+
+    def test_downscale_rebucket_math(self):
+        # negative offsets floor-shift: indexes -3,-2,-1,0 at d=1 map
+        # to coarse -2,-1,-1,0
+        off, counts = _EHistCache._downscale((-3, [1, 2, 3, 4]), 1)
+        assert off == -2
+        assert counts == [1, 2 + 3, 4]
+        assert _EHistCache._downscale((5, []), 2) == (0, [])
+        assert _EHistCache._downscale((5, [7]), 0) == (5, [7])
+
+
+# -- the HTTP plane ---------------------------------------------------------
+
+
+class TestWeightChunking:
+    def test_counts_past_the_rate_floor_chunk(self):
+        """A bucket count past 1e9 would be silently capped by the
+        columnstore's 1e-9 sample-rate floor; the source must chunk it
+        so the total weight survives."""
+        src = OTLPSource("chunk", listen_address="127.0.0.1:0")
+
+        class I:
+            metrics = []
+
+            def ingest_metric(self, mm):
+                self.metrics.append(mm)
+        ingest = I()
+        src._ingest = ingest
+        src._ingest_ehist(
+            "big", {"attrs": {}, "scale": 0, "zero_count": 2_500_000_000,
+                    "pos": (0, [3]), "neg": (0, [])}, [])
+        weights = [round(1 / mm.sample_rate) for mm in ingest.metrics]
+        zero_w = [w for mm, w in zip(ingest.metrics, weights)
+                  if mm.value == 0.0]
+        assert sum(zero_w) == 2_500_000_000
+        assert max(weights) <= 10 ** 9
+        # every chunk survives the columnstore's rate floor exactly
+        assert all(round(1 / max(1 / w, 1e-9)) == w for w in weights)
+
+
+class CollectingIngest:
+    def __init__(self):
+        self.metrics = []
+
+    def ingest_metric(self, metric):
+        self.metrics.append(metric)
+
+    def by_name(self):
+        out = {}
+        for mm in self.metrics:
+            out.setdefault(mm.name, []).append(mm)
+        return out
+
+
+@pytest.fixture
+def otlp_source():
+    src = OTLPSource("otlp-test", listen_address="127.0.0.1:0")
+    ingest = CollectingIngest()
+    t = threading.Thread(target=src.start, args=(ingest,), daemon=True)
+    t.start()
+    assert src._started.wait(5)
+    # serve_forever is up once the socket exists; port is bound in start
+    for _ in range(100):
+        if src.port:
+            break
+    yield src, ingest
+    src.stop()
+    t.join(5)
+
+
+def _post(src, body, ctype):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{src.port}/v1/metrics", data=body,
+        headers={"Content-Type": ctype})
+    return urllib.request.urlopen(req, timeout=5)
+
+
+class TestHTTPPlane:
+    def test_protobuf_post(self, otlp_source):
+        src, ingest = otlp_source
+        body = _request(
+            _metric_gauge("otlp.cpu", [_np_attr("core", "1")
+                                       + _f64(4, 0.25)]),
+            _metric_ehist("otlp.lat",
+                          [_ehist_point(3, 1, (0, [4]), None)],
+                          TEMPORALITY_DELTA))
+        resp = _post(src, body, "application/x-protobuf")
+        assert resp.status == 200
+        got = ingest.by_name()
+        assert got["otlp.cpu"][0].value == 0.25
+        assert got["otlp.cpu"][0].key.type == m.GAUGE
+        assert "core:1" in got["otlp.cpu"][0].tags
+        lat = got["otlp.lat"]
+        # zero bucket (count 1) + one positive bucket (count 4)
+        assert {mm.key.type for mm in lat} == {m.LLHIST}
+        weights = sorted(round(1 / mm.sample_rate) for mm in lat)
+        assert weights == [1, 4]
+
+    def test_json_post_and_cumulative_sum(self, otlp_source):
+        src, ingest = otlp_source
+        doc = {"resourceMetrics": [{"scopeMetrics": [{"metrics": [
+            {"name": "otlp.reqs", "sum": {
+                "isMonotonic": True,
+                "aggregationTemporality":
+                    "AGGREGATION_TEMPORALITY_CUMULATIVE",
+                "dataPoints": [{"asInt": "100"}]}}]}]}]}
+        resp = _post(src, json.dumps(doc).encode(), "application/json")
+        assert resp.status == 200 and resp.read() == b"{}"
+        assert "otlp.reqs" not in ingest.by_name()  # primed
+        doc["resourceMetrics"][0]["scopeMetrics"][0]["metrics"][0][
+            "sum"]["dataPoints"][0]["asInt"] = "125"
+        _post(src, json.dumps(doc).encode(), "application/json")
+        got = ingest.by_name()["otlp.reqs"]
+        assert got[0].key.type == m.COUNTER and got[0].value == 25.0
+
+    def test_bad_body_is_400(self, otlp_source):
+        src, _ = otlp_source
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(src, b"{not json", "application/json")
+        assert ei.value.code == 400
+
+    def test_unknown_path_is_404(self, otlp_source):
+        src, _ = otlp_source
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{src.port}/v1/traces", data=b"",
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=5)
+        assert ei.value.code == 404
+
+
+# -- acceptance: OTLP -> flush -> Prometheus/Cortex ------------------------
+
+
+class TestAcceptanceRoundTrip:
+    def _mk_server(self, extra_sinks):
+        from veneur_tpu.config import Config, SourceConfig
+        from veneur_tpu.core.server import Server
+
+        cfg = Config()
+        cfg.interval = 3600.0
+        cfg.statsd_listen_addresses = []
+        cfg.sources = [SourceConfig(
+            kind="otlp", name="otlp",
+            config={"listen_address": "127.0.0.1:0"})]
+        cfg.apply_defaults()
+        return Server(cfg, extra_metric_sinks=extra_sinks)
+
+    def test_exponential_histogram_to_prometheus(self):
+        """THE acceptance pin: an OTLP/HTTP POST of an
+        ExponentialHistogram round-trips to correct `_bucket`/`_sum`/
+        `_count` Prometheus exposition on flush."""
+        from veneur_tpu.sinks.prometheus import PrometheusMetricSink
+
+        prom = PrometheusMetricSink("prom")
+        server = self._mk_server([prom])
+        server.start()
+        try:
+            src = server.sources[0]
+            assert src._started.wait(5)
+            # scale 3, zero_count 2, buckets: idx 10 -> 5 @ 2^(10.5/8),
+            # idx 12 -> 3 @ 2^(12.5/8)
+            body = _request(_metric_ehist(
+                "rpc.latency",
+                [_ehist_point(3, 2, (10, [5, 0, 3]), None,
+                              attrs=_ehp_attr("svc", "api"))],
+                TEMPORALITY_DELTA))
+            _post(src, body, "application/x-protobuf")
+            server.store.apply_all_pending()
+            server.flush()
+            expo = prom._exposition
+            # count: 2 + 5 + 3
+            assert re.search(
+                r'rpc_latency_count\{svc="api"\} 10\.0', expo), expo
+            assert re.search(
+                r'rpc_latency_sum\{svc="api"\} ', expo), expo
+            buckets = re.findall(
+                r'rpc_latency_bucket\{svc="api",le="([^"]+)"\} ([0-9.]+)',
+                expo)
+            by_le = dict(buckets)
+            assert by_le["+Inf"] == "10.0"
+            # zero bucket: le="0" covers the 2 zero samples
+            assert by_le["0"] == "2.0"
+            # representatives: 2^(10.5/8)=2.48.. and 2^(12.5/8)=2.95..
+            # land in llhist bins with upper edges 2.5 and 3.0
+            assert by_le["2.5"] == "7.0"
+            assert by_le["3"] == "10.0"
+            # cumulative over ascending le
+            vals = [float(v) for _, v in sorted(
+                buckets, key=lambda kv: float(kv[0])
+                if kv[0] != "+Inf" else np.inf)]
+            assert vals == sorted(vals)
+        finally:
+            server.shutdown()
+
+    def test_exponential_histogram_to_cortex(self):
+        """Same flush through the Cortex remote-write encoder: decoded
+        WriteRequest series carry the _bucket/_sum/_count names."""
+        from veneur_tpu.sinks.cortex import (CortexMetricSink,
+                                             decode_write_request)
+        from veneur_tpu.util import http as vhttp
+
+        captured = []
+        sink = CortexMetricSink("cortex", url="http://unused.invalid/w",
+                                hostname="h")
+        server = self._mk_server([sink])
+        orig_post = vhttp.post
+        vhttp.post = lambda url, body, **kw: captured.append(body) or (200, b"")
+        server.start()
+        try:
+            src = server.sources[0]
+            assert src._started.wait(5)
+            body = _request(_metric_ehist(
+                "rpc.latency", [_ehist_point(3, 0, (10, [5]), None)],
+                TEMPORALITY_DELTA))
+            _post(src, body, "application/x-protobuf")
+            server.store.apply_all_pending()
+            server.flush()
+            assert captured, "cortex sink posted nothing"
+            series = []
+            for b in captured:
+                series.extend(decode_write_request(vhttp.snappy_decode(b)))
+            names = {labels["__name__"] for labels, _v, _t in series}
+            assert {"rpc_latency_bucket", "rpc_latency_sum",
+                    "rpc_latency_count"} <= names
+            bucket_les = {labels["le"]: v for labels, v, _ in series
+                          if labels["__name__"] == "rpc_latency_bucket"}
+            assert bucket_les["+Inf"] == 5.0
+        finally:
+            vhttp.post = orig_post
+            server.shutdown()
+
+
+# -- exposition escaping round trip (satellite) -----------------------------
+
+
+class TestExpositionEscaping:
+    def test_label_values_roundtrip(self):
+        from veneur_tpu.samplers.metrics import InterMetric, MetricType
+        from veneur_tpu.sinks.prometheus import render_exposition
+        from veneur_tpu.sources.openmetrics import parse_exposition
+
+        nasty = ['back\\slash', 'quo"te', 'new\nline', 'mix\\"\n\\\\end',
+                 'trailing\\']
+        metrics = [
+            InterMetric(name=f"esc_{i}", timestamp=0, value=float(i),
+                        tags=[f"k:{v}"], type=MetricType.GAUGE)
+            for i, v in enumerate(nasty)
+        ]
+        text = render_exposition(metrics)
+        assert len(text.splitlines()) == len(nasty)  # \n escaped
+        parsed = {name: labels["k"]
+                  for _t, name, labels, _v in parse_exposition(text)}
+        for i, v in enumerate(nasty):
+            assert parsed[f"esc_{i}"] == v, (parsed[f"esc_{i}"], v)

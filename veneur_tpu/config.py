@@ -83,6 +83,9 @@ class TpuConfig:
     gauge_capacity: int = 4096
     histo_capacity: int = 4096
     set_capacity: int = 1024
+    # log-linear histogram rows (each is ~18 KB of int32 bins on
+    # device); size to the llhist-keyed cardinality, not total keys
+    llhist_capacity: int = 1024
     batch_cap: int = 8192
     # local devices to shard the HBM-heavy families (histograms, HLL
     # sets) across; ingest round-robins batches, flush merges over ICI
@@ -143,6 +146,13 @@ class Config:
     flush_watchdog_missed_flushes: int = 0
     forward_address: str = ""
     forward_only: bool = False
+    # which sketch family aggregates DogStatsD histogram/timer samples:
+    # "tdigest" (reference parity: approximate percentiles, compressed
+    # merges) or "circllhist" (log-linear bins: globally-EXACT
+    # percentiles through the forward tier, one-bin-width quantile
+    # error). Explicit `|l` samples and OTLP exponential histograms
+    # always use the circllhist family regardless of this switch.
+    histogram_encoding: str = "tdigest"
     # -- egress resilience (util/resilience.py) -------------------------
     # forward retry: jittered exponential backoff, total spend bounded by
     # the flush interval (a retry storm can never blow the flush budget)

@@ -37,7 +37,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from veneur_tpu.ops import batch_hll, batch_tdigest, hll_ref, scalars
+from veneur_tpu.ops import (batch_hll, batch_llhist, batch_tdigest,
+                            hll_ref, llhist_ref, scalars)
 from veneur_tpu.samplers import metrics as m
 from veneur_tpu.samplers.metrics import MetricScope, UDPMetric
 
@@ -1273,6 +1274,159 @@ class SetTable(_BaseTable):
         return estimates, registers, touched, meta
 
 
+class LLHistTable(_BaseTable):
+    """Circllhist log-linear histograms: a dense (K, BINS) int32
+    register table (veneur_tpu.ops.batch_llhist). The host bins values
+    (ops/llhist_ref.bin_index — the same code the scalar reference
+    runs, so the two can never disagree) into (row, bin, weight)
+    triples; the device applies them as one scatter-add per batch.
+    Merges — import, carryover, interval — are register additions,
+    which is the family's whole point: the forward tier's global
+    percentile is bit-identical to a single node that saw every sample.
+
+    Weights are integral (1/sample_rate rounds to the nearest count);
+    clamp accounting (values outside the representable magnitude
+    window) is surfaced as the llhist.samples/llhist.clamped rows in
+    ColumnStore.telemetry_rows."""
+
+    def _init_arrays(self):
+        self._prow = np.full(self.batch_cap, PAD_ROW, np.int32)
+        self._pbin = np.zeros(self.batch_cap, np.int32)
+        self._pwt = np.zeros(self.batch_cap, np.int32)
+        self._pcols = (self._prow, self._pbin, self._pwt)
+        self._n = 0
+        self.state = batch_llhist.init_state(self.capacity)
+        # monotonic sample/clamp accounting (mutated under `lock`)
+        self.samples_total = 0
+        self.clamped_total = 0
+
+    def _grow_arrays(self, new_cap):
+        self.state = _pad_cap(self.state, new_cap)
+
+    def add(self, metric: UDPMetric):
+        value = float(metric.value)
+        bin_idx = int(llhist_ref.bin_index(value))
+        weight = max(1, round(1.0 / max(metric.sample_rate, 1e-9)))
+        with self.lock:
+            row = self.row_for(metric)
+            if row < 0:
+                return
+            self.touched[row] = True
+            self.samples_total += weight
+            if llhist_ref.clamped_mask(value):
+                self.clamped_total += weight
+            n = self._n
+            self._prow[n] = row
+            self._pbin[n] = bin_idx
+            self._pwt[n] = weight
+            self._n = n + 1
+            if self._n >= self.batch_cap:
+                self._dispatch_pending_locked()
+
+    def _apply_cols(self, cols):
+        rows, bins, wts = cols
+        self.state = batch_llhist.apply_batch(self.state, rows, bins, wts)
+
+    def apply_pending(self):
+        with self.lock:
+            self._dispatch_pending_locked()
+
+    def add_batch(self, rows, vals, weights) -> None:
+        """Batch fast path: pre-interned rows, raw values (binned here),
+        weights are 1/sample_rate floats."""
+        bins, wts = batch_llhist.bin_batch_host(vals, weights)
+        with self.lock:
+            self.samples_total += int(wts.sum())
+            self.clamped_total += int(
+                wts[llhist_ref.clamped_mask(vals)].sum())
+            self._append_batch((np.asarray(rows, np.int32), bins, wts))
+
+    def merge_batch(self, stubs: List[UDPMetric], in_bins) -> None:
+        """Import-path merge: register add. Interning atomic under the
+        buffer lock; the state update rides the apply ticket so it
+        orders after any already-swapped local batches."""
+        with self.lock:
+            rows = np.fromiter(
+                (self.row_for(s) for s in stubs), np.int32, len(stubs))
+            ok = rows >= 0  # cardinality-capped stubs drop out
+            rows = rows[ok]
+            self.touched[rows] = True
+            padded = batch_llhist.pad_rows_to_device(
+                np.asarray(in_bins)[ok])
+            self.samples_total += int(padded.sum())
+            self.apply_lock.acquire()
+        try:
+            if rows.size:
+                self.state = batch_llhist.merge_rows(
+                    self.state, rows, padded)
+        finally:
+            self.apply_lock.release()
+
+    def snapshot_begin(self, percentiles: Tuple[float, ...],
+                       need_bins: bool = True) -> dict:
+        """Dispatch-only snapshot half (see CounterTable.snapshot_begin):
+        swap+apply pending, dispatch the readout, capture the touched
+        rows' raw bins (gathered on device, so only live rows cross the
+        link — the full table at 100k keys would be ~2 GB), reset.
+        `need_bins=False` (a server that neither forwards nor exports
+        buckets) skips the register transfer entirely."""
+        with self.lock:
+            # idle-family fast path: every mutation path sets touched,
+            # so no pending samples + no touched rows means the state
+            # is still the all-zero array the last reset left — skip
+            # the capacity-proportional readout dispatch, the register
+            # gather, and the table reallocation entirely. The
+            # generation still advances so idle-row reclamation of a
+            # gone-quiet keyset keeps working.
+            if self._n == 0 and not self.touched.any():
+                self._note_generation_locked()
+                return {"packed": None, "bins_dev": None,
+                        "touched": self.touched.copy(),
+                        "meta": list(self.meta)}
+            cols = self._swap_locked()
+            self.apply_lock.acquire()
+            self._note_generation_locked()
+            touched = self.touched.copy()
+            meta = list(self.meta)
+            self.touched[:] = False
+        try:
+            if cols is not None:
+                self._apply_cols(cols)
+            ps = tuple(percentiles)
+            packed = batch_llhist.flush_packed(self.state, ps)
+            rows = np.flatnonzero(touched)
+            bins_dev = None
+            if need_bins and rows.size:
+                bins_dev = jnp.take(self.state,
+                                    jnp.asarray(rows, jnp.int32), axis=0)
+            self.state = batch_llhist.init_state(self.capacity)
+        finally:
+            self.apply_lock.release()
+        return {"packed": packed, "bins_dev": bins_dev,
+                "touched": touched, "meta": meta}
+
+    @staticmethod
+    def snapshot_finish(snap: dict):
+        """Returns (readout dict of np arrays over all rows, bins int64
+        (n_touched, BINS) aligned with the touched rows in ascending
+        order, touched, meta)."""
+        if snap["packed"] is None:  # idle-family fast path
+            return ({}, np.zeros((0, llhist_ref.BINS), np.int64),
+                    snap["touched"], snap["meta"])
+        out = {k: np.asarray(v) for k, v in snap["packed"].items()}
+        if snap["bins_dev"] is not None:
+            bins = np.asarray(snap["bins_dev"])[:, :llhist_ref.BINS]
+            bins = bins.astype(np.int64)
+        else:
+            bins = np.zeros((0, llhist_ref.BINS), np.int64)
+        return out, bins, snap["touched"], snap["meta"]
+
+    def snapshot_and_reset(self, percentiles: Tuple[float, ...],
+                           need_bins: bool = True):
+        return self.snapshot_finish(
+            self.snapshot_begin(percentiles, need_bins))
+
+
 @dataclass
 class StatusEntry:
     value: float = 0.0
@@ -1326,11 +1480,23 @@ class ColumnStore:
     def __init__(self, counter_capacity=1024, gauge_capacity=1024,
                  histo_capacity=1024, set_capacity=256, batch_cap=8192,
                  shard_devices=0, max_rows=0, pallas_flush=False,
-                 set_promote_samples=0, set_max_dev_slots=0):
+                 set_promote_samples=0, set_max_dev_slots=0,
+                 llhist_capacity=1024, histogram_encoding="tdigest"):
         self.counters = CounterTable(counter_capacity, batch_cap,
                                      max_rows=max_rows)
         self.gauges = GaugeTable(gauge_capacity, batch_cap,
                                  max_rows=max_rows)
+        # histogram_encoding chooses the family DogStatsD histogram/timer
+        # samples aggregate in: "tdigest" (reference parity, approximate
+        # merges) or "circllhist" (log-linear bins, exact merges).
+        # Explicit `|l` samples and OTLP exponential histograms always
+        # land in the llhist family regardless.
+        if histogram_encoding not in ("tdigest", "circllhist"):
+            raise ValueError(
+                f"unknown histogram_encoding: {histogram_encoding!r}")
+        self.histogram_encoding = histogram_encoding
+        self.llhists = LLHistTable(llhist_capacity, batch_cap,
+                                   max_rows=max_rows)
         devices = None
         if shard_devices and shard_devices > 1:
             from veneur_tpu.core import sharded_tables
@@ -1369,8 +1535,8 @@ class ColumnStore:
     def tables(self):
         """(family, table) pairs, every device family plus statuses."""
         return (("counter", self.counters), ("gauge", self.gauges),
-                ("histogram", self.histos), ("set", self.sets),
-                ("status", self.statuses))
+                ("histogram", self.histos), ("llhist", self.llhists),
+                ("set", self.sets), ("status", self.statuses))
 
     def attach_cardinality(self, accountant) -> None:
         """Wire the cardinality accountant (core/cardinality.py) into
@@ -1432,6 +1598,13 @@ class ColumnStore:
             if nslots is not None:  # sparse set table: promoted HBM rows
                 rows.append(("columnstore.set_dev_slots", "gauge",
                              float(nslots), tags))
+        # llhist accuracy accounting: samples binned, and how many fell
+        # outside the representable magnitude window (collapsed to the
+        # zero bin / clamped into a top bin)
+        rows.append(("llhist.samples_total", "counter",
+                     float(self.llhists.samples_total), ()))
+        rows.append(("llhist.clamped_total", "counter",
+                     float(self.llhists.clamped_total), ()))
         return rows
 
     def capacity_report(self) -> dict:
@@ -1495,7 +1668,12 @@ class ColumnStore:
         elif t == m.GAUGE:
             self.gauges.add(metric)
         elif t in (m.HISTOGRAM, m.TIMER):
-            self.histos.add(metric)
+            if self.histogram_encoding == "circllhist":
+                self.llhists.add(metric)
+            else:
+                self.histos.add(metric)
+        elif t == m.LLHIST:
+            self.llhists.add(metric)
         elif t == m.SET:
             self.sets.add(metric)
         elif t == m.STATUS:
@@ -1508,6 +1686,7 @@ class ColumnStore:
         self.counters.apply_pending()
         self.gauges.apply_pending()
         self.histos.apply_pending()
+        self.llhists.apply_pending()
         self.sets.apply_pending()
 
     def unique_timeseries(self) -> int:
@@ -1515,8 +1694,8 @@ class ColumnStore:
         this with a per-worker HLL over key digests (worker.go:305-347);
         the column store's touched masks make it exact for free."""
         total = 0
-        for table in (self.counters, self.gauges, self.histos, self.sets,
-                      self.statuses):
+        for table in (self.counters, self.gauges, self.histos,
+                      self.llhists, self.sets, self.statuses):
             with table.lock:
                 total += int(np.count_nonzero(table.touched))
         return total

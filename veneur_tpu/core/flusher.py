@@ -45,15 +45,101 @@ class ForwardableState:
         field(default_factory=list)
     # (meta, registers)
     sets: List[Tuple[RowMeta, np.ndarray]] = field(default_factory=list)
+    # (meta, llhist bins int64) — exact-merge family: registers ADD
+    llhists: List[Tuple[RowMeta, np.ndarray]] = field(default_factory=list)
 
     def __len__(self):
         return (len(self.counters) + len(self.gauges) + len(self.histograms)
-                + len(self.sets))
+                + len(self.sets) + len(self.llhists))
 
 
 def _percentile_name(name: str, p: float) -> str:
     # reference naming truncates: 0.999 -> "99percentile" (samplers.go:498)
     return f"{name}.{int(p * 100)}percentile"
+
+
+def _fmt_le(bound: float) -> str:
+    return "+Inf" if math.isinf(bound) else format(bound, ".12g")
+
+
+def _flush_llhist_family(store, is_local: bool, percentiles, now: int,
+                         final: List[InterMetric],
+                         fwd: "ForwardableState",
+                         collect_forward: bool) -> None:
+    """Snapshot + emit the llhist family (shared verbatim by the legacy
+    and columnar flush paths, so they cannot diverge).
+
+    Scoping mirrors the t-digest family: a local server forwards the
+    bins of mixed/global rows (no local emission — the global tier owns
+    the exact distribution) and fully flushes local-only rows; a global
+    server fully flushes everything it holds. A full flush emits the
+    configured percentiles, the midpoint sum, the exact count, and the
+    Prometheus-histogram-shaped cumulative buckets
+    (`<name>.bucket{le:...}` + `+Inf`), which the Prometheus and Cortex
+    sinks render as `_bucket`/`_sum`/`_count` series."""
+    from veneur_tpu.ops import llhist_ref
+
+    table = store.llhists
+    ps = tuple(percentiles)
+    need_export = is_local and collect_forward
+    # bins are needed for forwarding AND for bucket emission; only a
+    # local server with forwarding disabled could skip them, and that
+    # configuration still emits local-only rows' buckets — so always on
+    out, bins, touched, meta_list = table.snapshot_and_reset(ps)
+    rows = np.flatnonzero(touched)
+    if rows.size == 0:
+        return
+    quants = out["quantiles"][rows]
+    # count and sum are derived from the HOST-side int64 bins, not the
+    # device readout: the count must equal the le:+Inf bucket exactly
+    # (both are the same registers), and f64 midpoint math keeps the
+    # sum consistent with what a downstream re-aggregation would get
+    counts = bins.sum(axis=1)
+    sums = bins.astype(np.float64) @ llhist_ref.BIN_MID
+    order = llhist_ref.ORDER
+    upper = llhist_ref.UPPER_SORTED
+    for i, row in enumerate(rows.tolist()):
+        meta = meta_list[row]
+        if meta is None:  # recycled mid-interval (reclaim straggler)
+            continue
+        scope = meta.scope
+        if is_local and scope != MetricScope.LOCAL_ONLY:
+            if need_export:
+                fwd.llhists.append((meta, bins[i]))
+            continue
+        names = meta.flush_names
+        if names is None:
+            names = meta.flush_names = {}
+        tags = list(meta.tags)
+        for j, p in enumerate(ps):
+            nm = names.get(p)
+            if nm is None:
+                nm = names[p] = _percentile_name(meta.name, p)
+            final.append(InterMetric(
+                name=nm, timestamp=now, value=float(quants[i, j]),
+                tags=list(tags), type=MetricType.GAUGE))
+        for suffix, value, mtype in (
+                ("sum", float(sums[i]), MetricType.GAUGE),
+                ("count", float(counts[i]), MetricType.COUNTER)):
+            nm = names.get(suffix)
+            if nm is None:
+                nm = names[suffix] = f"{meta.name}.{suffix}"
+            final.append(InterMetric(
+                name=nm, timestamp=now, value=value,
+                tags=list(tags), type=mtype))
+        bname = names.get("bucket")
+        if bname is None:
+            bname = names["bucket"] = f"{meta.name}.bucket"
+        c_sorted = bins[i][order]
+        csum = np.cumsum(c_sorted)
+        for k in np.flatnonzero(c_sorted).tolist():
+            final.append(InterMetric(
+                name=bname, timestamp=now, value=float(csum[k]),
+                tags=tags + [f"le:{_fmt_le(upper[k])}"],
+                type=MetricType.COUNTER))
+        final.append(InterMetric(
+            name=bname, timestamp=now, value=float(csum[-1]),
+            tags=tags + ["le:+Inf"], type=MetricType.COUNTER))
 
 
 def flush_columnstore(
@@ -143,6 +229,10 @@ def flush_columnstore(
         final.extend(_flush_histo_row(
             meta, i, cols, quants[i], ps_index, now, ps, agg_bits,
             use_global))
+
+    # ---- log-linear histograms ----------------------------------------
+    _flush_llhist_family(store, is_local, percentiles, now, final, fwd,
+                         collect_forward)
 
     # ---- sets ----------------------------------------------------------
     estimates, registers, s_touched, s_meta = store.sets.snapshot_and_reset()
@@ -512,8 +602,15 @@ def flush_columnstore_batch(
                 np.asarray(estimates, np.float64)[er],
                 stab.flush_tags(er, s_meta), MetricType.GAUGE))
 
-    # ---- status checks --------------------------------------------------
+    # ---- log-linear histograms ------------------------------------------
+    # per-row variable-length bucket emission doesn't columnarize; the
+    # family flows through `extras` via the same helper the legacy path
+    # runs, so the two paths are parity-equal by construction
     extras: List[InterMetric] = []
+    _flush_llhist_family(store, is_local, full_ps, now, extras, fwd,
+                         collect_forward)
+
+    # ---- status checks --------------------------------------------------
     for row in np.flatnonzero(st_touched).tolist():
         meta = st_meta[row]
         if meta is None:  # recycled mid-interval (reclaim straggler)

@@ -39,6 +39,7 @@ from veneur_tpu.core import overload as overload_mod  # noqa: E402
 _SHED_CLASS = {
     m.HISTOGRAM: overload_mod.CLASS_HISTOGRAM,
     m.TIMER: overload_mod.CLASS_HISTOGRAM,
+    m.LLHIST: overload_mod.CLASS_HISTOGRAM,
     m.SET: overload_mod.CLASS_SET,
 }
 
@@ -164,7 +165,9 @@ class Server:
             max_rows=config.tpu.max_rows_per_family,
             pallas_flush=config.tpu.pallas_tdigest_flush,
             set_promote_samples=config.tpu.set_promote_samples,
-            set_max_dev_slots=config.tpu.set_max_dev_slots)
+            set_max_dev_slots=config.tpu.set_max_dev_slots,
+            llhist_capacity=config.tpu.llhist_capacity,
+            histogram_encoding=config.histogram_encoding)
         self._keys_dropped_reported = 0
         self.aggregates = HistogramAggregates.from_names(config.aggregates)
         self.percentiles = tuple(config.percentiles)
@@ -991,7 +994,9 @@ class Server:
                 set_capacity=cfg.tpu.set_capacity,
                 batch_cap=cfg.tpu.batch_cap,
                 shard_devices=cfg.tpu.shards,
-                pallas_flush=cfg.tpu.pallas_tdigest_flush)
+                pallas_flush=cfg.tpu.pallas_tdigest_flush,
+                llhist_capacity=cfg.tpu.llhist_capacity,
+                histogram_encoding=cfg.histogram_encoding)
             # collect_forward must match the live flush's value: need_export
             # selects between two distinct JIT specializations (fold_staging
             # is a static arg), and warming the wrong one would leave the

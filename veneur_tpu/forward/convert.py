@@ -30,6 +30,7 @@ _TYPE_NAME_TO_PB = {
     m.HISTOGRAM: metric_pb2.Histogram,
     m.SET: metric_pb2.Set,
     m.TIMER: metric_pb2.Timer,
+    m.LLHIST: metric_pb2.LLHist,
 }
 _TYPE_PB_TO_NAME = {v: k for k, v in _TYPE_NAME_TO_PB.items()}
 
@@ -62,6 +63,17 @@ def forwardable_to_protos(fwd: ForwardableState) -> List[metric_pb2.Metric]:
             name=meta.name, tags=list(meta.tags), type=mtype,
             scope=_SCOPE_TO_PB[meta.scope],
             histogram=metric_pb2.HistogramValue(t_digest=digest)))
+    for meta, bins in fwd.llhists:
+        # exact-merge family: registers ride as the llhistwire payload
+        # (sparse delta pairs for the typical few-dozen-bin row) and the
+        # importer ADDS them — the property the bit-exact global
+        # percentile pin rests on
+        from veneur_tpu.forward import llhistwire
+        out.append(metric_pb2.Metric(
+            name=meta.name, tags=list(meta.tags), type=metric_pb2.LLHist,
+            scope=_SCOPE_TO_PB[meta.scope],
+            llhist=metric_pb2.LLHistValue(
+                bins=llhistwire.marshal(bins))))
     for meta, registers in fwd.sets:
         # axiomhq binary form: a Go global veneur can UnmarshalBinary and
         # merge this directly (reference samplers.go:279-311); low-
@@ -201,6 +213,10 @@ def forwardable_to_wire(fwd: ForwardableState) -> List[bytes]:
         out.extend(wired)
     if fwd.sets:
         slim = ForwardableState(sets=fwd.sets)
+        out.extend(p.SerializeToString()
+                   for p in forwardable_to_protos(slim))
+    if fwd.llhists:
+        slim = ForwardableState(llhists=fwd.llhists)
         out.extend(p.SerializeToString()
                    for p in forwardable_to_protos(slim))
     return out

@@ -105,6 +105,31 @@ class ImportServer:
         self.imported_total += count
         return b""
 
+    def _merge_unknown_families(self, body, batch) -> None:
+        """upb sweep behind the native V1 parser for families it does
+        not know (llhist today): the C parser skips an unknown value
+        field and silently drops the metric, so whenever it consumed
+        more metrics than it emitted family entries, re-parse the body
+        with upb and merge just the stragglers. The mismatch also fires
+        on genuinely-empty metrics (no value / empty digest), where the
+        sweep finds nothing — one spare upb parse on a pathological
+        body, zero cost on the common path."""
+        emitted = (len(batch.c_keys) + len(batch.g_keys)
+                   + len(batch.h_keys) + len(batch.s_keys))
+        if emitted >= batch.consumed:
+            return
+        try:
+            req = forward_pb2.MetricList.FromString(body)
+        except Exception:
+            logger.warning("unknown-family sweep could not re-parse "
+                           "import body (%d bytes)", len(body))
+            return
+        buf = _MergeBuffer(self)
+        for pbm in req.metrics:
+            if pbm.WhichOneof("value") == "llhist":
+                buf.add(pbm)
+        buf.flush_all()
+
     # -- native bulk merge ----------------------------------------------
 
     STUB_CACHE_MAX = 1_000_000
@@ -143,6 +168,7 @@ class ImportServer:
                         keep.append(stubs[i])
                 if regs:
                     store.sets.merge_batch(keep, np.stack(regs))
+        self._merge_unknown_families(body, batch)
         return batch.consumed
 
     def _stubs_for(self, keys):
@@ -227,6 +253,7 @@ class _MergeBuffer:
     HISTO_CAP = 16384
     SCALAR_CAP = 65536
     SET_CAP = 4096
+    LLHIST_CAP = 4096  # ~36 KB of decoded int64 bins each
 
     def __init__(self, srv: "ImportServer"):
         self._srv = srv
@@ -236,6 +263,7 @@ class _MergeBuffer:
         self.h_stubs, self.h_means, self.h_weights = [], [], []
         self.h_min, self.h_max, self.h_recip = [], [], []
         self.s_stubs, self.s_regs = [], []
+        self.l_stubs, self.l_bins = [], []
 
     def add(self, pbm: metric_pb2.Metric) -> None:
         which = pbm.WhichOneof("value")
@@ -292,6 +320,18 @@ class _MergeBuffer:
                 self.s_regs.append(regs)
                 if len(self.s_stubs) >= self.SET_CAP:
                     self._flush_sets()
+        elif which == "llhist":
+            from veneur_tpu.forward import llhistwire
+            try:
+                bins = llhistwire.unmarshal(pbm.llhist.bins)
+            except llhistwire.LLHistWireError as e:
+                logger.warning("undecodable llhist payload (%d bytes) "
+                               "dropped: %s", len(pbm.llhist.bins), e)
+                return
+            self.l_stubs.append(stub)
+            self.l_bins.append(bins)
+            if len(self.l_stubs) >= self.LLHIST_CAP:
+                self._flush_llhists()
 
     def _flush_counters(self):
         self._store.counters.merge_batch(self.c_stubs, self.c_vals)
@@ -313,6 +353,11 @@ class _MergeBuffer:
         self._store.sets.merge_batch(self.s_stubs, np.stack(self.s_regs))
         self.s_stubs, self.s_regs = [], []
 
+    def _flush_llhists(self):
+        self._store.llhists.merge_batch(self.l_stubs,
+                                        np.stack(self.l_bins))
+        self.l_stubs, self.l_bins = [], []
+
     def flush_all(self):
         if self.c_stubs:
             self._flush_counters()
@@ -322,6 +367,8 @@ class _MergeBuffer:
             self._flush_histos()
         if self.s_stubs:
             self._flush_sets()
+        if self.l_stubs:
+            self._flush_llhists()
 
 
 def _decode_hll(data: bytes) -> Optional[np.ndarray]:

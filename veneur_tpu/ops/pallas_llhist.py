@@ -1,0 +1,109 @@
+"""Pallas TPU kernel: llhist scatter-add ingest.
+
+The llhist apply is a 2-D integer scatter-add into the (K, BINS_PAD)
+int32 register table. The jnp formulation (`regs.at[rows, bins].add`)
+lowers to XLA scatter, which serializes through HBM; this kernel tiles
+the table's rows into VMEM, walks the (small) sample batch once per row
+tile, and accumulates in-place — the sample columns stay resident in
+VMEM across the whole grid.
+
+Safety model is pallas_hll's: the kernel is attempted only on a real
+TPU backend for aligned shapes, and ANY failure latches the jnp path
+for the process. Off-TPU, interpret mode exists for the parity tests
+only; production scatter-adds take the jnp path there.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+
+import jax
+import jax.numpy as jnp
+
+logger = logging.getLogger("veneur_tpu.ops.pallas_llhist")
+
+TK = 256  # rows per grid step: (256, BINS_PAD) int32 ~= 4.7 MiB VMEM
+
+
+def _kernel(rows_ref, bins_ref, wts_ref, regs_ref, out_ref):
+    import jax.experimental.pallas as pl
+
+    base = pl.program_id(0) * TK
+    out_ref[:] = regs_ref[:]
+    nb = rows_ref.shape[0]
+
+    def body(b, carry):
+        local = rows_ref[b] - base
+
+        @pl.when((local >= 0) & (local < TK))
+        def _():
+            c = bins_ref[b]
+            cur = pl.load(out_ref, (pl.ds(local, 1), pl.ds(c, 1)))
+            pl.store(out_ref, (pl.ds(local, 1), pl.ds(c, 1)),
+                     cur + wts_ref[b])
+
+        return carry
+
+    jax.lax.fori_loop(0, nb, body, 0)
+
+
+# deliberately NOT donated: a runtime kernel fault must leave `regs`
+# intact for the jnp fallback re-apply (the latch path below), so the
+# pallas path pays one defensive table copy per batch
+@functools.partial(jax.jit, static_argnums=4)
+def _apply_pallas(regs, rows, bin_idx, weight, interpret: bool):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    num_keys, width = regs.shape
+    n_tiles = num_keys // TK
+    # out-of-table rows (PAD_ROW padding / dropped samples) fall outside
+    # every tile's [base, base+TK) window, giving mode="drop" semantics
+    return pl.pallas_call(
+        _kernel,
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec((TK, width), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((TK, width), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct(regs.shape, regs.dtype),
+        input_output_aliases={3: 0},
+        interpret=interpret,
+    )(rows, bin_idx, weight, regs)
+
+
+class _State:
+    failed = False
+
+
+def available(num_keys: int, width: int) -> bool:
+    from veneur_tpu.ops import batch_llhist
+    return (not _State.failed and num_keys % TK == 0
+            and width == batch_llhist.BINS_PAD)
+
+
+def apply_batch(regs, rows, bin_idx, weight) -> jnp.ndarray:
+    """Scatter-add through the kernel on TPU; jnp fallback elsewhere or
+    after any kernel failure (latched for the process)."""
+    from veneur_tpu.ops import batch_llhist
+
+    if isinstance(regs, jax.core.Tracer):
+        return batch_llhist._apply_batch_jnp(regs, rows, bin_idx, weight)
+    platform = jax.devices()[0].platform
+    if platform not in ("tpu", "axon") or not available(*regs.shape):
+        return batch_llhist._apply_batch_jnp(regs, rows, bin_idx, weight)
+    try:
+        return _apply_pallas(regs, jnp.asarray(rows, jnp.int32),
+                             jnp.asarray(bin_idx, jnp.int32),
+                             jnp.asarray(weight, jnp.int32), False)
+    except Exception as e:
+        _State.failed = True
+        logger.warning("pallas llhist scatter unavailable (%s); using "
+                       "jnp fallback", e)
+        return batch_llhist._apply_batch_jnp(regs, rows, bin_idx, weight)

@@ -38,6 +38,10 @@ HISTOGRAM = "histogram"
 TIMER = "timer"
 SET = "set"
 STATUS = "status"
+# extension type (no reference equivalent): Circllhist log-linear
+# histogram — exact-merge bins instead of a t-digest. DogStatsD wire
+# type "l"; also the landing family for OTLP exponential histograms.
+LLHIST = "llhist"
 
 
 class Aggregate(enum.IntFlag):

@@ -44,6 +44,9 @@ _TYPE_BY_LEAD = {
     ord("h"): m.HISTOGRAM,
     ord("m"): m.TIMER,  # "ms"
     ord("s"): m.SET,
+    # extension: "l" = log-linear histogram (Circllhist bins; exact
+    # merges through the forward tier). Not in the reference grammar.
+    ord("l"): m.LLHIST,
 }
 
 

@@ -24,6 +24,15 @@ from veneur_tpu.sinks.cortex import sanitize_label, sanitize_name
 logger = logging.getLogger("veneur_tpu.sinks.prometheus")
 
 
+def escape_label_value(v: str) -> str:
+    """Exposition-format label-value escaping: backslash, double-quote,
+    and line-feed (in that order — backslash first, or the escapes
+    would double-escape). Round-trips through
+    sources.openmetrics.parse_exposition."""
+    return (v.replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def render_exposition(metrics: List[InterMetric]) -> str:
     lines = []
     for m in metrics:
@@ -32,8 +41,7 @@ def render_exposition(metrics: List[InterMetric]) -> str:
         labels = []
         for t in m.tags:
             k, _, v = t.partition(":")
-            escaped = v.replace("\\", "\\\\").replace('"', '\\"')
-            labels.append(f'{sanitize_label(k)}="{escaped}"')
+            labels.append(f'{sanitize_label(k)}="{escape_label_value(v)}"')
         label_str = "{" + ",".join(labels) + "}" if labels else ""
         lines.append(f"{sanitize_name(m.name)}{label_str} {m.value}")
     return "\n".join(lines) + ("\n" if lines else "")

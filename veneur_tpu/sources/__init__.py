@@ -8,14 +8,57 @@ SourceTypes (reference server.go:62-91)."""
 from __future__ import annotations
 
 import abc
-from typing import Callable, Dict
+import logging
+import threading
+from typing import Callable, Dict, Optional
 
 from veneur_tpu.samplers.metrics import UDPMetric
+
+logger = logging.getLogger("veneur_tpu.sources")
 
 
 class Ingest(abc.ABC):
     @abc.abstractmethod
     def ingest_metric(self, metric: UDPMetric) -> None: ...
+
+
+class CumulativeDeltaCache:
+    """Cumulative-counter -> per-interval-delta conversion, shared by
+    every pull source that scrapes monotonic series (OpenMetrics
+    counters/buckets, OTLP cumulative Sums).
+
+    Semantics (the counter-reset pin, tests/test_otlp.py):
+    - first observation primes the cache and emits nothing (None);
+    - a growing counter emits `value - prev`;
+    - a RESET (value < prev: scraped process restarted) emits the new
+      cumulative count clamped to >= 0 — the post-reset counts are
+      real traffic, but a broken exporter that goes negative must
+      never produce a negative spike downstream.
+
+    Bounded: past `max_series` the cache is cleared wholesale (logged);
+    it refills from the live series set within one scrape, and the only
+    cost is one primed interval. Thread-safe — the OTLP source's HTTP
+    handler threads share one instance.
+    """
+
+    def __init__(self, max_series: int = 1_000_000):
+        self.max_series = max(1, int(max_series))
+        self._prev: Dict[tuple, float] = {}
+        self._lock = threading.Lock()
+
+    def delta(self, key: tuple, value: float) -> Optional[float]:
+        with self._lock:
+            prev = self._prev.get(key)
+            if prev is None and len(self._prev) >= self.max_series:
+                logger.warning("cumulative-delta cache cleared at %d "
+                               "series", len(self._prev))
+                self._prev.clear()
+            self._prev[key] = value
+        if prev is None:
+            return None  # first scrape primes the cache
+        if value < prev:  # counter reset: emit the new count, 0-clamped
+            return max(0.0, value)
+        return value - prev
 
 
 class Source(abc.ABC):
@@ -42,4 +85,4 @@ def register_source(kind: str):
 
 
 def register_builtin_sources() -> None:
-    from veneur_tpu.sources import openmetrics  # noqa: F401
+    from veneur_tpu.sources import openmetrics, otlp  # noqa: F401

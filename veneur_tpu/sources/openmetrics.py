@@ -23,7 +23,8 @@ from typing import Dict, Iterator, List, Optional, Tuple
 
 from veneur_tpu.samplers.metrics import MetricScope, UDPMetric, update_tags
 from veneur_tpu.samplers import metrics as m
-from veneur_tpu.sources import Ingest, Source, register_source
+from veneur_tpu.sources import (CumulativeDeltaCache, Ingest, Source,
+                                register_source)
 from veneur_tpu.util import http as vhttp
 
 logger = logging.getLogger("veneur_tpu.sources.openmetrics")
@@ -32,6 +33,17 @@ _LINE = re.compile(
     r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
     r"(?:\{(?P<labels>.*)\})?\s+(?P<value>[^ ]+)(?:\s+\d+)?$")
 _LABEL = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+_ESCAPE = re.compile(r"\\(.)")
+_UNESCAPES = {"n": "\n", '"': '"', "\\": "\\"}
+
+
+def _unescape_label_value(v: str) -> str:
+    """Single-pass exposition unescape (\\\\, \\\", \\n). The old
+    sequential str.replace pair mangled a backslash adjacent to a
+    quote; this is the exact inverse of
+    sinks.prometheus.escape_label_value."""
+    return _ESCAPE.sub(
+        lambda mo: _UNESCAPES.get(mo.group(1), mo.group(0)), v)
 
 
 def parse_exposition(text: str) -> Iterator[Tuple[str, str, Dict[str, str],
@@ -51,7 +63,7 @@ def parse_exposition(text: str) -> Iterator[Tuple[str, str, Dict[str, str],
         if not match:
             continue
         name = match.group("name")
-        labels = {k: v.replace('\\"', '"').replace("\\\\", "\\")
+        labels = {k: _unescape_label_value(v)
                   for k, v in _LABEL.findall(match.group("labels") or "")}
         try:
             value = float(match.group("value"))
@@ -97,7 +109,9 @@ class OpenMetricsSource(Source):
         self.ssl_context = ssl_context
         self._stop = threading.Event()
         # cumulative-counter cache: (name, tag-string) -> last value
-        self._counter_cache: Dict[Tuple[str, str], float] = {}
+        # (shared reset semantics with the OTLP source — see
+        # sources.CumulativeDeltaCache)
+        self._counter_cache = CumulativeDeltaCache()
 
     def name(self) -> str:
         return self._name
@@ -125,14 +139,9 @@ class OpenMetricsSource(Source):
 
     def _counter_delta(self, name: str, tags: List[str],
                        value: float) -> Optional[float]:
-        key = (name, ",".join(tags))
-        prev = self._counter_cache.get(key)
-        self._counter_cache[key] = value
-        if prev is None:
-            return None  # first scrape primes the cache
-        if value < prev:
-            return value  # counter reset: emit the new count
-        return value - prev
+        """Cumulative -> delta; a reset emits the 0-clamped new count
+        (never a negative spike), per CumulativeDeltaCache."""
+        return self._counter_cache.delta((name, ",".join(tags)), value)
 
     def scrape_once(self, ingest: Ingest) -> int:
         status, body = vhttp.get(self.url, timeout=self.timeout,

@@ -309,6 +309,18 @@ def merge_forwardable(newer, older):
         else:
             m, regs = newer.sets[i]
             newer.sets[i] = (m, np.maximum(regs, registers))
+
+    idx = index(newer.llhists)
+    for meta, bins in older.llhists:
+        # log-linear histograms are the family the carryover story is
+        # EXACT for: registers add in int64, no recompression loss
+        i = idx.get(_meta_key(meta))
+        if i is None:
+            newer.llhists.append((meta, bins))
+        else:
+            m, cur = newer.llhists[i]
+            newer.llhists[i] = (m, np.asarray(cur, np.int64)
+                                + np.asarray(bins, np.int64))
     return newer
 
 
