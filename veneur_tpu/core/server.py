@@ -71,7 +71,7 @@ class _SpanSinkWorker:
     counts SPANS, not chunks, and a chunk that would overflow is dropped
     whole (accounted per-sink)."""
 
-    def __init__(self, sink, capacity: int):
+    def __init__(self, sink, capacity: int, observatory=None):
         from veneur_tpu.sinks import SpanSink
         self.sink = sink
         # duck-typed sinks (tests, plugins) may predate the batch API;
@@ -81,8 +81,16 @@ class _SpanSinkWorker:
             sink, "ingest_many",
             lambda chunk: SpanSink.ingest_many(sink, chunk))
         self.capacity = max(16, capacity)
-        self._pending: list = []  # list of chunks (lists of spans)
+        self._pending: list = []  # list of (enqueue_t, chunk) pairs
         self._pending_spans = 0
+        # queue-dwell telemetry: per-chunk enqueue->drain latency plus a
+        # scrape-time depth gauge (None when the observatory is off)
+        self._dwell = None
+        if observatory is not None and observatory.enabled:
+            qname = f"span_sink:{sink.name()}"
+            self._dwell = observatory.queue_hist(qname)
+            observatory.register_queue(
+                qname, lambda: self._pending_spans, self.capacity)
         self._lock = threading.Lock()
         self._ready = threading.Condition(self._lock)
         self.dropped = 0
@@ -111,7 +119,7 @@ class _SpanSinkWorker:
             if self._pending and self._pending_spans + n > self.capacity:
                 self.dropped += n
                 return
-            self._pending.append(spans)
+            self._pending.append((time.monotonic(), spans))
             self._pending_spans += n
             self._ready.notify()
 
@@ -124,7 +132,11 @@ class _SpanSinkWorker:
                     self._ready.wait(timeout=0.5)
                 chunks, self._pending = self._pending, []
                 self._pending_spans = 0
-            for chunk in chunks:
+            dwell = self._dwell
+            now = time.monotonic() if dwell is not None else 0.0
+            for enqueued_t, chunk in chunks:
+                if dwell is not None:
+                    dwell.observe(now - enqueued_t)
                 try:
                     self._ingest_many(chunk)
                     self.ingested += len(chunk)
@@ -213,6 +225,14 @@ class Server:
         self._other_samples: List = []
         self._other_lock = threading.Lock()
 
+        # latency observatory (core/latency.py): flush dispatch
+        # attribution, per-plane sample-age watermarks, and queue
+        # dwell/depth telemetry. Created before any bounded hand-off so
+        # the queues below can be instrumented at construction.
+        from veneur_tpu.core.latency import LatencyObservatory
+        self.latency = LatencyObservatory(
+            enabled=config.latency_observatory)
+
         # span pipeline: bounded channel + worker pool (reference
         # server.go:728-736, worker.go:547-686); the metric-extraction
         # sink is always attached (server.go:654-664)
@@ -222,8 +242,8 @@ class Server:
             indicator_timer_name=config.indicator_span_timer_name,
             objective_timer_name=config.objective_span_timer_name)
         self.span_sinks.append(self.metric_extraction)
-        self.span_chan: "queue.Queue" = queue.Queue(
-            maxsize=config.span_channel_capacity)
+        self.span_chan: "queue.Queue" = self.latency.instrument_queue(
+            "span_channel", maxsize=config.span_channel_capacity)
         self._span_workers: List[threading.Thread] = []
         self._span_sink_workers: List[_SpanSinkWorker] = []
         self.spans_dropped = 0
@@ -263,11 +283,15 @@ class Server:
             self.statsd = NullClient(registry=self.telemetry.registry)
 
         # self-tracing: every flush is a span through the internal channel
-        # client into our own span pipeline (reference flusher.go:27-28)
+        # client into our own span pipeline (reference flusher.go:27-28);
+        # its bounded buffer is instrumented like every other hand-off
         from veneur_tpu import trace as trace_mod
         self.trace_client = trace_mod.Client(
             trace_mod.ChannelBackend(self.ingest_span),
-            capacity=config.span_channel_capacity)
+            capacity=config.span_channel_capacity,
+            buffer=self.latency.instrument_queue(
+                "trace_client", maxsize=config.span_channel_capacity))
+        self.telemetry.registry.add_collector(self.latency.telemetry_rows)
 
         self.diagnostics = None
         if config.features.diagnostics_metrics_enabled:
@@ -365,6 +389,8 @@ class Server:
         chaos = self.chaos
         if chaos is not None and chaos.ingest_faults_planned:
             datagrams = chaos.mangle_packets(datagrams)
+        # sample-age stamp at the socket-read boundary, one per batch
+        self.latency.note_arrival("dogstatsd", len(datagrams))
         if self._ingester is None:
             for dgram in datagrams:
                 self.handle_packet_buffer(dgram)
@@ -457,6 +483,10 @@ class Server:
                 for key, value in self.stats.items()]
         rows.append(("ingest.spans_dropped", "counter",
                      float(self.spans_dropped), ()))
+        # the trace CLIENT's silent drops (bounded buffer + buffered
+        # backend), distinct from the span channel's ingest-side drops
+        rows.append(("trace.spans_dropped", "counter",
+                     float(self.trace_client.spans_dropped), ()))
         for worker in self._span_sink_workers:
             tags = [f"sink:{worker.sink.name()}"]
             rows.append(("ingest.span_sink_dropped", "counter",
@@ -487,6 +517,12 @@ class Server:
 
     def handle_ssf_packet(self, packet: bytes) -> None:
         """One unframed SSF datagram (reference server.go:1053-1100)."""
+        self.latency.note_arrival("ssf")
+        self._handle_ssf_packet_stamped(packet)
+
+    def _handle_ssf_packet_stamped(self, packet: bytes) -> None:
+        """handle_ssf_packet minus the arrival stamp — the buffer path
+        below stamps once per batch and must not re-stamp per packet."""
         from veneur_tpu import protocol
         self.stats.inc("packets_received")
         try:
@@ -518,6 +554,7 @@ class Server:
         span objects external sinks need are decoded lazily at worker
         pace (RawSpan), so sink-side decode cost rides the existing
         bounded-queue drop semantics instead of the ingest path."""
+        self.latency.note_arrival("ssf", len(offs))
         ing = getattr(self, "_ingester", None)
         if ing is not None and not os.environ.get(
                 "VENEUR_TPU_DISABLE_PUMP"):
@@ -552,7 +589,8 @@ class Server:
                             preadmitted=True)
             return
         for off, ln in zip(offs, lens):
-            self.handle_ssf_packet(buf[int(off):int(off) + int(ln)])
+            # already stamped above, once for the whole batch
+            self._handle_ssf_packet_stamped(buf[int(off):int(off) + int(ln)])
 
     def ingest_span(self, span, preadmitted: bool = False) -> None:
         """Enqueue a span for the worker pool; drops (and counts) when the
@@ -631,7 +669,8 @@ class Server:
             if sink is self.metric_extraction:
                 continue
             worker = _SpanSinkWorker(
-                sink, self.config.span_sink_queue_capacity)
+                sink, self.config.span_sink_queue_capacity,
+                observatory=self.latency)
             worker.start()
             self._span_sink_workers.append(worker)
         for i in range(max(1, self.config.num_span_workers)):
@@ -673,6 +712,12 @@ class Server:
             self.forwarder = self.forward_client.forward
             self.telemetry.registry.add_collector(
                 self.forward_client.telemetry_rows)
+            # the forward plane's bounded hand-off: failed intervals
+            # queue in the carryover (depth in intervals, not items)
+            self.latency.register_queue(
+                "forward_carryover",
+                lambda: self.forward_client.carryover.depth,
+                cfg.carryover_max_intervals)
         if self.chaos is not None:
             # make the plan visible to the object-less seams (http_post)
             from veneur_tpu.util import chaos as chaos_mod
@@ -807,6 +852,10 @@ class Server:
         self.telemetry.record_event(
             f"columnstore_{kind}", family=family, old_capacity=old_cap,
             new_capacity=new_cap, duration_s=round(seconds, 6))
+        if kind == "recompile":
+            # tag the next flush round's waterfall: recompile cost must
+            # be separable from steady-state execute cost
+            self.latency.note_retrace(family, seconds)
 
     def cardinality_report(self, top: int = 20, name: str = "") -> dict:
         """The /debug/cardinality payload. With `name`, a single-name
@@ -1112,11 +1161,15 @@ class Server:
         # per-phase wall clock for flush-latency attribution; read by
         # the bench's sustained gate (one flush at a time: _flush_lock)
         phases = self.flush_phase_timings = {}
+        # sample-age watermarks roll at the same boundary the column
+        # store snapshots: everything stamped before this flush's
+        # snapshot is aged through to sink ack below
+        watermarks = self.latency.take_watermarks()
         t_store = time.perf_counter()
         batch, fwd = flush_columnstore_batch(
             self.store, self.is_local, self.percentiles, self.aggregates,
             collect_forward=self.forwarder is not None,
-            timings=phases)
+            timings=phases, attribute=self.latency.enabled)
         self.stats.inc("metrics_flushed", len(batch))
         phases["store_flush_s"] = time.perf_counter() - t_store
         phases["preflush_s"] = t_store - flush_start
@@ -1195,17 +1248,33 @@ class Server:
         if self.import_server is not None:
             # per-RPC latency/error aggregates (reference proxy/grpcstats)
             self.import_server.rpc_stats.emit(self.statsd, prefix="import.rpc")
+        # sink joins are the ack point: everything dispatched this round
+        # has been delivered (or timed out, recorded above) — the moment
+        # the interval's samples stop aging
+        self.latency.observe_sample_age(watermarks, time.time())
+        families = phases.get("families")
+        if families:
+            for family, secs in self.latency.drain_retraces().items():
+                rec = families.get(family)
+                if rec is not None:
+                    rec["retrace"] = True
+                    rec["recompile_s"] = round(secs, 6)
+            self._record_family_spans(flush_span, families)
         flush_span.finish()
         duration = time.perf_counter() - flush_start
         self.statsd.gauge("flush.total_duration_ns", int(duration * 1e9))
         self.statsd.timing("flush.total_duration", duration)
         for phase, secs in phases.items():
-            self.statsd.timing("flush.phase_duration", secs,
-                               tags=[f"phase:{phase}"])
+            if isinstance(secs, (int, float)):
+                self.statsd.timing("flush.phase_duration", secs,
+                                   tags=[f"phase:{phase}"])
         self.statsd.count("flush.metrics_total", len(batch))
         round_info["duration_s"] = round(duration, 6)
         round_info["metrics_flushed"] = len(batch)
-        round_info["phases"] = {k: round(v, 6) for k, v in phases.items()}
+        round_info["phases"] = {k: round(v, 6) for k, v in phases.items()
+                                if isinstance(v, (int, float))}
+        if families:
+            round_info["families"] = _round_family_tree(families)
         self.telemetry.flushes.record(round_info)
         self.telemetry.record_event(
             "flush", flush=round_info["flush"],
@@ -1281,6 +1350,33 @@ class Server:
         # decrements land in the interval they happened in; this resets
         # the per-name mint budgets (the shed rung's immediate recovery)
         self.cardinality.roll_interval()
+
+    def _record_family_spans(self, flush_span, families: dict) -> None:
+        """Matching child spans under the flush span, one per family
+        device segment tree: the span's start/end reconstruct the
+        measured dispatch->transfer window (the reference ships its own
+        observability as SSF spans; so does the waterfall)."""
+        base = self.last_flush_unix + self.flush_phase_timings.get(
+            "preflush_s", 0.0)
+        for family, rec in families.items():
+            start_off = rec.get("dispatch_start_s", 0.0)
+            end_off = start_off + rec.get("dispatch_s", 0.0)
+            dev_start = rec.get("device_start_s")
+            if dev_start is not None:
+                end_off = dev_start + rec.get("transfer_s", 0.0) + sum(
+                    d.get("sync_s", 0.0)
+                    for d in rec.get("devices", {}).values())
+            tags = {"family": family,
+                    "dispatch_s": f"{rec.get('dispatch_s', 0.0):.6f}",
+                    "transfer_s": f"{rec.get('transfer_s', 0.0):.6f}"}
+            for dev, seg in rec.get("devices", {}).items():
+                tags[f"sync_s.{dev}"] = f"{seg.get('sync_s', 0.0):.6f}"
+            if rec.get("retrace"):
+                tags["retrace"] = "true"
+                tags["recompile_s"] = f"{rec.get('recompile_s', 0.0):.6f}"
+            child = flush_span.child("flush.family", tags=tags)
+            child.proto.start_timestamp = int((base + start_off) * 1e9)
+            child.finish(end_time=base + end_off)
 
     def _timed_sink_flush(self, key: str, parent_span, round_info: dict,
                           target, *args) -> None:
@@ -1421,6 +1517,20 @@ class Server:
             if current:
                 self._sink_spill[key] = current
             return False
+
+
+def _round_family_tree(families: dict) -> dict:
+    """Round the flusher's per-family segment tree for the flight
+    recorder / waterfall JSON (floats to µs precision, structure kept)."""
+    out = {}
+    for family, rec in families.items():
+        entry = {k: (round(v, 6) if isinstance(v, float) else v)
+                 for k, v in rec.items() if k != "devices"}
+        entry["devices"] = {
+            dev: {k: round(v, 6) for k, v in seg.items()}
+            for dev, seg in rec.get("devices", {}).items()}
+        out[family] = entry
+    return out
 
 
 def _apply_sink_filters(metrics: List[InterMetric], sc: SinkConfig

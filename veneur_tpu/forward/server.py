@@ -94,6 +94,7 @@ class ImportServer:
         (vnt_import_parse: identity keys + pre-bucketed centroid grids
         in one C pass) with a cached-stub intern layer; an unavailable
         native library or unparseable body falls back to upb objects."""
+        self._note_arrival()
         count = self._merge_native(body)
         if count is None:
             req = forward_pb2.MetricList.FromString(body)
@@ -104,6 +105,14 @@ class ImportServer:
             count = len(req.metrics)
         self.imported_total += count
         return b""
+
+    def _note_arrival(self, n: int = 1) -> None:
+        """Sample-age stamp for the forward plane: forwarded intervals
+        age on the GLOBAL server from the moment the import RPC lands
+        until its flush's sinks ack (core/latency.py)."""
+        latency = getattr(self._server, "latency", None)
+        if latency is not None:
+            latency.note_arrival("forward", n)
 
     def _merge_unknown_families(self, body, batch) -> None:
         """upb sweep behind the native V1 parser for families it does
@@ -229,6 +238,7 @@ class ImportServer:
                          scope=scope)
 
     def _send_metrics_v2(self, request_iterator, ctx):
+        self._note_arrival()
         buf = _MergeBuffer(self)
         count = 0
         for pbm in request_iterator:

@@ -37,10 +37,18 @@ class Destination:
                  flush_interval: float = 0.5,
                  max_consecutive_failures: int = 3,
                  tls: Optional[GrpcTLS] = None,
-                 breaker: Optional[CircuitBreaker] = None):
+                 breaker: Optional[CircuitBreaker] = None,
+                 observatory=None):
         self.address = address
         self._on_close = on_close
-        self._queue: "queue.Queue" = queue.Queue(maxsize=send_buffer)
+        # instrumented when the proxy runs a latency observatory: queue
+        # depth + enqueue->send dwell ride the shared queue.* telemetry
+        self._queue: "queue.Queue" = (
+            observatory.instrument_queue(
+                f"proxy_dest:{address}", maxsize=send_buffer)
+            if observatory is not None
+            else queue.Queue(maxsize=send_buffer))
+        self._observatory = observatory
         self._batch = batch
         self._flush_interval = flush_interval
         # shared breaker replaces the old ad-hoc _failures counter: the
@@ -173,6 +181,11 @@ class Destination:
         if self.closed.is_set():
             return
         self.closed.set()
+        if self._observatory is not None:
+            # retire the queue telemetry with the destination, or
+            # discovery churn would grow the observatory unboundedly
+            self._observatory.unregister_queue(
+                f"proxy_dest:{self.address}")
         if notify:
             self._on_close(self)
         try:
@@ -187,7 +200,8 @@ class Destinations:
     def __init__(self, send_buffer: int = 4096, batch: int = 512,
                  flush_interval: float = 0.5,
                  tls: Optional[GrpcTLS] = None,
-                 max_consecutive_failures: int = 3):
+                 max_consecutive_failures: int = 3,
+                 observatory=None):
         self._lock = threading.RLock()
         self._pool: Dict[str, Destination] = {}
         self.ring = ConsistentRing()
@@ -196,6 +210,7 @@ class Destinations:
         self._flush_interval = flush_interval
         self._tls = tls
         self._max_failures = max_consecutive_failures
+        self._observatory = observatory
 
     def set_destinations(self, addresses: List[str]) -> None:
         """Reconcile the pool with a fresh discovery result."""
@@ -210,7 +225,8 @@ class Destinations:
                         address, self._on_destination_closed,
                         send_buffer=self._send_buffer, batch=self._batch,
                         flush_interval=self._flush_interval, tls=self._tls,
-                        max_consecutive_failures=self._max_failures)
+                        max_consecutive_failures=self._max_failures,
+                        observatory=self._observatory)
                     self.ring.add(address)
 
     def addresses(self) -> List[str]:

@@ -42,16 +42,23 @@ class ProxyServer:
                  tls: Optional[GrpcTLS] = None,
                  tls_listen_address: str = "",
                  destination_tls: Optional[GrpcTLS] = None,
-                 max_consecutive_failures: int = 3):
+                 max_consecutive_failures: int = 3,
+                 latency_observatory: bool = True):
         self.discoverer = discoverer
         self.forward_service = forward_service
         self.discovery_interval = discovery_interval
         self.shutdown_grace = 1.0  # stop() grace; the CLI overrides it
         # from shutdown_timeout
         self._ignore = list(ignore_tags or [])
+        # latency observatory (core/latency.py): per-destination queue
+        # dwell/depth — the proxy side of the queue.* telemetry; the
+        # same latency_observatory knob the server honors turns it off
+        from veneur_tpu.core.latency import LatencyObservatory
+        self.latency = LatencyObservatory(enabled=latency_observatory)
         self.destinations = Destinations(
             send_buffer=send_buffer, batch=batch, tls=destination_tls,
-            max_consecutive_failures=max_consecutive_failures)
+            max_consecutive_failures=max_consecutive_failures,
+            observatory=self.latency)
         # per-RPC latency/error aggregates (reference proxy/grpcstats)
         self.rpc_stats = RpcStats()
         self.stats: Dict[str, int] = {
@@ -162,6 +169,7 @@ class ProxyServer:
         rows.append(("proxy.destinations", "gauge",
                      float(self.destinations.size()), ()))
         rows.extend(self.destinations.telemetry_rows())
+        rows.extend(self.latency.telemetry_rows())
         return rows
 
     def cardinality_report(self, top: int = 20, name: str = "") -> dict:

@@ -438,6 +438,11 @@ class OTLPSource(Source):
             return
         length = int(req.headers.get("Content-Length", 0) or 0)
         body = req.rfile.read(length)
+        # sample-age stamp at request receipt (duck-typed: bare Ingest
+        # test harnesses have no observatory)
+        latency = getattr(getattr(self, "_ingest", None), "latency", None)
+        if latency is not None:
+            latency.note_arrival("otlp")
         ctype = (req.headers.get("Content-Type") or "").split(";")[0].strip()
         is_json = ctype == "application/json"
         self._count("otlp.requests_total", 1,

@@ -79,11 +79,15 @@ class Span:
                     trace_id=self.proto.trace_id, parent_id=self.proto.id,
                     tags=tags)
 
-    def finish(self) -> None:
+    def finish(self, end_time: Optional[float] = None) -> None:
+        """Report the span; `end_time` (unix seconds) lets a caller
+        reconstruct a measured segment post-hoc (the flush waterfall's
+        per-family child spans) instead of stamping "now"."""
         if self._finished:
             return
         self._finished = True
-        self.proto.end_timestamp = int(time.time() * 1e9)
+        self.proto.end_timestamp = int(
+            (time.time() if end_time is None else end_time) * 1e9)
         if self.client is not None:
             self.client.record(self.proto)
 
@@ -231,6 +235,10 @@ class BufferedBackend:
                 self.inner.send(s)
             except Exception:
                 self.dropped += 1
+                if self.dropped == 1:
+                    logger.warning(
+                        "buffered trace backend dropped its first span; "
+                        "trace.spans_dropped counts the rest")
 
     def flush(self) -> None:
         with self._lock:
@@ -250,9 +258,13 @@ class Client:
     and counts when the buffer is full), a sender thread drains to the
     backend (reference trace/client.go:56-170)."""
 
-    def __init__(self, backend, capacity: int = 1024):
+    def __init__(self, backend, capacity: int = 1024,
+                 buffer: Optional["queue.Queue"] = None):
         self.backend = backend
-        self._q: "queue.Queue" = queue.Queue(maxsize=capacity)
+        # a caller may supply the buffer (the server passes an
+        # InstrumentedQueue so span dwell shows up in queue.dwell)
+        self._q: "queue.Queue" = (buffer if buffer is not None
+                                  else queue.Queue(maxsize=capacity))
         self.records_dropped = 0
         self.records_sent = 0
         self._closed = threading.Event()
@@ -283,12 +295,28 @@ class Client:
 
     def record(self, span: ssf.SSFSpan) -> None:
         if self._closed.is_set():
-            self.records_dropped += 1
+            self._count_drop()
             return
         try:
             self._q.put_nowait(span)
         except queue.Full:
-            self.records_dropped += 1
+            self._count_drop()
+
+    def _count_drop(self) -> None:
+        self.records_dropped += 1
+        if self.records_dropped == 1:
+            # once, then silently counted: surfaced as trace.spans_dropped
+            # in the telemetry registry and /metrics
+            logger.warning(
+                "trace client dropped its first span (buffer full or "
+                "closed); trace.spans_dropped counts the rest")
+
+    @property
+    def spans_dropped(self) -> int:
+        """Total spans lost anywhere in the client: the bounded buffer's
+        drops plus any the backend swallowed (BufferedBackend counts its
+        failed sends on a bare attribute)."""
+        return self.records_dropped + getattr(self.backend, "dropped", 0)
 
     def start_span(self, name: str, service: str = "",
                    tags: Optional[Dict[str, str]] = None,
