@@ -1,0 +1,220 @@
+#!/usr/bin/env python
+"""Ring-failover soak driver: kill/restore a global destination on a
+timer while streaming metrics through the proxy, and account for every
+metric.
+
+What it exercises (the forward-tier HA machinery, PR "Forward-tier high
+availability"):
+
+- the proxy's active health probes eject the killed destination from
+  the consistent-hash ring (its keys re-shard onto the survivors);
+- routing failover keeps mergeable state flowing while the breaker of
+  the dead node is open;
+- readmission restores the original assignment when the node returns.
+
+The invariant the soak pins is ACCOUNTING EXACTNESS, not zero loss: the
+proxy tier is deliberately memoryless (lossless carryover/spool live on
+the local tier), so metrics enqueued at a dying destination in the
+detection window are dropped — but every one of them must be COUNTED
+(`routed == received`, `sent == received + counted drops`), and once
+ejection lands the stream must flow loss-free through the survivors.
+
+Runnable standalone:
+
+    JAX_PLATFORMS=cpu python scripts/ring_failover_soak.py \
+        --rounds 12 --per-round 200 --kill-round 3 --restore-round 7
+
+and from the `slow`/`ha`-marked soak test (tests/test_ha.py), which
+drives `run_soak()` directly and asserts the report's invariants.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+# standalone invocation from the repo root (the package need not be
+# installed; same pattern as scripts/cardinality_storm.py)
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def wait_until(pred, timeout=10.0, step=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(step)
+    return False
+
+
+def run_soak(rounds: int = 12, per_round: int = 200, n_dest: int = 3,
+             kill_round: int = 3, restore_round: int = 7,
+             probe_interval: float = 0.1, verbose: bool = False) -> dict:
+    """Stream `rounds` batches of counters through a proxy over `n_dest`
+    in-process global stubs, killing destination 0 at `kill_round` and
+    restoring it (same port) at `restore_round`. Returns the accounting
+    report; raises AssertionError when an invariant breaks."""
+    from veneur_tpu.forward.client import ForwardClient
+    from veneur_tpu.forward.protos import metric_pb2
+    from veneur_tpu.proxy.proxy import create_static_proxy
+    from veneur_tpu.testing.forwardtest import ForwardTestServer
+
+    received = [[] for _ in range(n_dest)]
+    servers = []
+    for i in range(n_dest):
+        servers.append(ForwardTestServer(received[i].extend))
+        servers[i].start()
+    addresses = [s.address for s in servers]
+
+    proxy = create_static_proxy(
+        addresses,
+        # fast detection for the soak; production defaults are 2s/3/2
+        health_check_interval=probe_interval,
+        health_check_timeout=0.25,
+        health_unhealthy_after=2,
+        health_healthy_after=2)
+    proxy.start()
+    client = ForwardClient(proxy.address, deadline=5.0)
+
+    def mk(name, value):
+        pbm = metric_pb2.Metric(name=name, type=metric_pb2.Counter,
+                                scope=metric_pb2.Global)
+        pbm.counter.value = value
+        return pbm
+
+    sent = 0
+    events = []
+    post_eject_sent = 0
+    try:
+        for rnd in range(rounds):
+            if rnd == kill_round:
+                servers[0].stop()
+                events.append({"round": rnd, "event": "killed",
+                               "address": addresses[0]})
+                # wait for the prober to eject it so the re-shard window
+                # is deterministic in the report — rounds from here on
+                # must be loss-free (asserted below)
+                ejection_confirmed = wait_until(
+                    lambda: addresses[0]
+                    in proxy.destinations.ejected_addresses(),
+                    timeout=10.0)
+                events.append({"round": rnd, "event": "ejected",
+                               "confirmed": ejection_confirmed})
+            if rnd == restore_round:
+                servers[0] = ForwardTestServer(received[0].extend,
+                                               address=addresses[0])
+                servers[0].start()
+                events.append({"round": rnd, "event": "restored"})
+                wait_until(lambda: addresses[0]
+                           not in proxy.destinations.ejected_addresses(),
+                           timeout=10.0)
+                events.append({"round": rnd, "event": "readmitted"})
+            batch = [mk(f"soak.m.{rnd}.{i}", 1) for i in range(per_round)]
+            client.send_protos(batch)
+            sent += per_round
+            if addresses[0] in proxy.destinations.ejected_addresses() \
+                    or rnd >= restore_round:
+                post_eject_sent += per_round
+            if verbose:
+                print(f"round {rnd}: sent {per_round} "
+                      f"(ejected={proxy.destinations.ejected_addresses()})",
+                      file=sys.stderr)
+        # settle: wait until the books balance — every sent metric is
+        # either received by a global or counted as a drop (live or
+        # retired destination) / no-destination at the proxy. The
+        # retired_* fold matters: a destination that self-closed on an
+        # open breaker was REPLACED by discovery, and its counters
+        # would otherwise vanish from the pool.
+        proxy.destinations.flush_wait(timeout=10.0)
+
+        def drops_total():
+            dests = proxy.destinations
+            with dests._lock:
+                live = sum(d.dropped_total for d in dests._pool.values())
+                return live + dests.retired_dropped_total
+
+        stats_settle = wait_until(
+            lambda: sum(len(r) for r in received) + drops_total()
+            + proxy.stats["no_destination_total"] >= sent,
+            timeout=10.0)
+    finally:
+        client.close()
+        proxy_stats = dict(proxy.stats)
+        dest_rows = {d.address: {"sent": d.sent_total,
+                                 "dropped": d.dropped_total,
+                                 "shed_open": d.shed_open_total}
+                     for d in proxy.destinations._pool.values()}
+        dest_rows["<retired>"] = {
+            "sent": proxy.destinations.retired_sent_total,
+            "dropped": proxy.destinations.retired_dropped_total,
+            "shed_open": proxy.destinations.retired_shed_open_total}
+        health_rows = (proxy.ring_health.member_table()
+                       if proxy.ring_health else [])
+        proxy.stop()
+        for s in servers:
+            s.stop()
+
+    got = sum(len(r) for r in received)
+    dropped = sum(v["dropped"] for v in dest_rows.values())
+    report = {
+        "sent": sent,
+        "received": got,
+        "proxy": proxy_stats,
+        "destinations": dest_rows,
+        "member_table": health_rows,
+        "events": events,
+        "settled": stats_settle,
+        # metrics lost in the kill->ejection detection window (the only
+        # legitimate loss at the memoryless proxy tier)
+        "detection_window_loss": sent - got,
+    }
+    accounted = (got + dropped + proxy_stats["no_destination_total"])
+    report["accounted"] = accounted
+    report["loss_unaccounted"] = sent - accounted
+    assert proxy_stats["received_total"] == sent, report
+    assert report["loss_unaccounted"] == 0, report
+    # the loss-free-after-ejection invariant, asserted per metric: every
+    # round sent at-or-after the CONFIRMED ejection (the dead member is
+    # out of the ring before that round's batch goes in) must land —
+    # drops are confined to the kill->ejection detection window
+    report["post_eject_sent"] = post_eject_sent
+    ejected_ok = any(e["event"] == "ejected" and e.get("confirmed")
+                     for e in events)
+    if ejected_ok and kill_round < rounds:
+        received_names = {p.name for dest in received for p in dest}
+        missing = [f"soak.m.{rnd}.{i}"
+                   for rnd in range(kill_round, rounds)
+                   for i in range(per_round)
+                   if f"soak.m.{rnd}.{i}" not in received_names]
+        report["post_eject_missing"] = len(missing)
+        assert not missing, (missing[:10], report)
+    return report
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--rounds", type=int, default=12)
+    ap.add_argument("--per-round", type=int, default=200)
+    ap.add_argument("--destinations", type=int, default=3)
+    ap.add_argument("--kill-round", type=int, default=3)
+    ap.add_argument("--restore-round", type=int, default=7)
+    ap.add_argument("--probe-interval", type=float, default=0.1)
+    ap.add_argument("--verbose", action="store_true")
+    args = ap.parse_args(argv)
+    report = run_soak(rounds=args.rounds, per_round=args.per_round,
+                      n_dest=args.destinations,
+                      kill_round=args.kill_round,
+                      restore_round=args.restore_round,
+                      probe_interval=args.probe_interval,
+                      verbose=args.verbose)
+    json.dump(report, sys.stdout, indent=2, default=str)
+    print()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,887 @@
+"""Forward-tier HA tests: ring health/ejection/readmission, bounded
+failover, hedged forwards with idempotency-token dedupe, the durable
+carryover spool, and the kill/restore chaos soak the acceptance
+criteria pin (one global down for 5 flush intervals at 30 % fault rate,
+zero counter loss, llhist bit-exactness)."""
+
+import json
+import os
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from veneur_tpu.config import Config
+from veneur_tpu.forward.protos import metric_pb2
+from veneur_tpu.proxy.destinations import Destination, Destinations
+from veneur_tpu.proxy.health import RingHealth
+from veneur_tpu.proxy.proxy import create_static_proxy
+from veneur_tpu.proxy.ring import ConsistentRing
+from veneur_tpu.testing.forwardtest import ForwardTestServer
+from veneur_tpu.util.chaos import Chaos
+from veneur_tpu.util.spool import CarryoverSpool, frame_metrics, \
+    unframe_metrics
+
+pytestmark = pytest.mark.ha
+
+
+def wait_until(fn, timeout=10.0, step=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(step)
+    return False
+
+
+def mkmetric(name, value=1, tags=()):
+    pbm = metric_pb2.Metric(name=name, type=metric_pb2.Counter,
+                            scope=metric_pb2.Global)
+    pbm.tags.extend(tags)
+    pbm.counter.value = value
+    return pbm
+
+
+def make_config(**overrides) -> Config:
+    cfg = Config()
+    cfg.interval = 10.0
+    cfg.hostname = "test"
+    cfg.tpu.counter_capacity = 128
+    cfg.tpu.gauge_capacity = 128
+    cfg.tpu.histo_capacity = 128
+    cfg.tpu.set_capacity = 64
+    cfg.tpu.llhist_capacity = 64
+    cfg.tpu.batch_cap = 512
+    for k, v in overrides.items():
+        setattr(cfg, k, v)
+    return cfg.apply_defaults()
+
+
+# -------------------------------------------------------------------------
+# Satellite: consistent-hash bounded-movement property
+# -------------------------------------------------------------------------
+
+
+class TestRingProperties:
+    def test_eject_bounded_movement_readmit_exact(self):
+        """Ejecting 1 of N members remaps <= (1/N + eps) of a 10k-key
+        corpus; readmission restores the original assignment EXACTLY
+        (identical virtual points recompute from the same address)."""
+        n = 5
+        ring = ConsistentRing(replicas=200)
+        members = [f"host{i}:8128" for i in range(n)]
+        ring.set_members(members)
+        keys = [f"metric.{i}.{i % 97}" for i in range(10_000)]
+        before = {k: ring.get(k) for k in keys}
+
+        victim = members[2]
+        ring.remove(victim)
+        moved = 0
+        for k, owner in before.items():
+            new = ring.get(k)
+            if new != owner:
+                # only the victim's keys may move
+                assert owner == victim, (k, owner, new)
+                moved += 1
+        assert moved / len(keys) <= 1.0 / n + 0.06, moved
+
+        ring.add(victim)
+        after = {k: ring.get(k) for k in keys}
+        assert after == before  # exact restoration
+
+    def test_walk_at_primary_first_and_distinct(self):
+        ring = ConsistentRing(replicas=50)
+        ring.set_members(["a:1", "b:1", "c:1"])
+        for i in range(200):
+            point = ring.point_of(f"k{i}")
+            walk = ring.walk_at(point, 3)
+            assert walk[0] == ring.get_at(point)
+            assert len(walk) == len(set(walk)) == 3
+
+
+# -------------------------------------------------------------------------
+# Ring health: probes, ejection, readmission, membership refresh
+# -------------------------------------------------------------------------
+
+
+class TestRingHealth:
+    def _pool(self, addresses):
+        dests = Destinations(flush_interval=0.1)
+        dests.set_destinations(addresses)
+        return dests
+
+    def test_tcp_probe_ejects_dead_and_readmits(self):
+        ft1 = ForwardTestServer(lambda ms: None)
+        ft1.start()
+        ft2 = ForwardTestServer(lambda ms: None)
+        ft2.start()
+        dests = self._pool([ft1.address, ft2.address])
+        events = []
+        health = RingHealth(
+            dests, interval=0.05, timeout=0.2, unhealthy_after=2,
+            healthy_after=2,
+            on_event=lambda kind, **f: events.append((kind, f)))
+        try:
+            health.run_round()
+            assert dests.ejected_addresses() == []
+
+            port = ft1.port
+            ft1.stop()
+            health.run_round()
+            assert dests.ejected_addresses() == []  # 1 failure < threshold
+            health.run_round()
+            assert dests.ejected_addresses() == [ft1.address]
+            assert ft1.address not in dests.ring.members()
+            assert ft1.address in dests.addresses()  # pool entry survives
+            assert ("ring_ejection",
+                    {"destination": ft1.address,
+                     "consecutive_failures": 2}) in events
+
+            # keys now hash only to the survivor
+            for i in range(20):
+                assert dests.get(f"k{i}").address == ft2.address
+
+            # restore on the SAME port; two passing probes readmit
+            ft1 = ForwardTestServer(lambda ms: None,
+                                    address=f"127.0.0.1:{port}")
+            ft1.start()
+            health.run_round()
+            assert dests.ejected_addresses() == [ft1.address]
+            health.run_round()
+            assert dests.ejected_addresses() == []
+            assert ft1.address in dests.ring.members()
+            assert any(kind == "ring_readmission" for kind, _ in events)
+            rows = dict((r[0], r[2]) for r in health.telemetry_rows())
+            assert rows["proxy.ring.ejections"] == 1.0
+            assert rows["proxy.ring.readmissions"] == 1.0
+            assert rows["proxy.ring.ejected"] == 0.0
+        finally:
+            dests.clear()
+            ft1.stop()
+            ft2.stop()
+
+    def test_chaos_health_probe_seam_is_deterministic(self):
+        """The health_probe chaos seam fails probes without touching a
+        socket — the deterministic way to drive the ejection machinery."""
+        from veneur_tpu.util import chaos as chaos_mod
+        ft = ForwardTestServer(lambda ms: None)
+        ft.start()
+        dests = self._pool([ft.address])
+        health = RingHealth(dests, interval=0.05, unhealthy_after=2,
+                            healthy_after=1)
+        chaos_mod.install(Chaos(error_rate=1.0, seams=("health_probe",)))
+        try:
+            health.run_round()
+            health.run_round()
+            assert dests.ejected_addresses() == [ft.address]
+            chaos_mod.install(None)
+            health.run_round()
+            assert dests.ejected_addresses() == []
+        finally:
+            chaos_mod.install(None)
+            dests.clear()
+            ft.stop()
+
+    def test_membership_refresh_each_round(self):
+        ft = ForwardTestServer(lambda ms: None)
+        ft.start()
+        dests = self._pool([])
+        refreshed = []
+
+        def refresh():
+            refreshed.append(1)
+            dests.set_destinations([ft.address])
+
+        health = RingHealth(dests, interval=0.05, refresh=refresh)
+        try:
+            health.run_round()
+            assert refreshed and dests.addresses() == [ft.address]
+        finally:
+            dests.clear()
+            ft.stop()
+
+    def test_discovery_readd_does_not_bypass_ejection(self):
+        """set_destinations re-adding an ejected address must NOT sneak
+        it back into the ring before the prober readmits it."""
+        ft = ForwardTestServer(lambda ms: None)
+        ft.start()
+        dests = self._pool([ft.address])
+        try:
+            dests.eject(ft.address)
+            assert ft.address not in dests.ring.members()
+            dests.set_destinations([ft.address])
+            assert ft.address not in dests.ring.members()
+            dests.readmit(ft.address)
+            assert ft.address in dests.ring.members()
+        finally:
+            dests.clear()
+            ft.stop()
+
+
+# -------------------------------------------------------------------------
+# Failover routing past a sick primary
+# -------------------------------------------------------------------------
+
+
+class TestFailoverRouting:
+    def test_open_breaker_rehomes_key_to_next_healthy(self):
+        ft1 = ForwardTestServer(lambda ms: None)
+        ft1.start()
+        ft2 = ForwardTestServer(lambda ms: None)
+        ft2.start()
+        dests = Destinations(flush_interval=0.1)
+        dests.set_destinations([ft1.address, ft2.address])
+        try:
+            # find a key owned by ft1
+            key = next(f"k{i}" for i in range(1000)
+                       if dests.ring.get(f"k{i}") == ft1.address)
+            primary = dests._pool[ft1.address]
+            assert dests.get(key) is primary
+            # trip the primary's breaker: the key re-homes to ft2
+            for _ in range(primary.breaker.failure_threshold):
+                primary.breaker.record_failure()
+            assert dests.get(key).address == ft2.address
+            assert dests.failover_routed_total > 0
+            # recovery restores the original owner
+            primary.breaker.record_success()
+            assert dests.get(key) is primary
+        finally:
+            dests.clear()
+            ft1.stop()
+            ft2.stop()
+
+    def test_all_sick_falls_back_to_primary_accounting(self):
+        ft = ForwardTestServer(lambda ms: None)
+        ft.start()
+        dests = Destinations(flush_interval=0.1)
+        dests.set_destinations([ft.address])
+        try:
+            dest = dests._pool[ft.address]
+            for _ in range(dest.breaker.failure_threshold):
+                dest.breaker.record_failure()
+            # sole member sick: the primary still answers (its send()
+            # sheds and counts) instead of raising
+            assert dests.get("anything") is dest
+        finally:
+            dests.clear()
+            ft.stop()
+
+
+# -------------------------------------------------------------------------
+# Hedged forwards + idempotency-token dedupe
+# -------------------------------------------------------------------------
+
+
+class TestHedgedForwards:
+    def test_slow_primary_hedges_to_peer(self):
+        """A primary whose handler stalls past the hedge budget fires
+        the same batch (same token) at the peer; the peer delivers."""
+        slow_received, fast_received = [], []
+
+        def slow_handler(ms):
+            time.sleep(1.0)
+            slow_received.extend(ms)
+
+        slow = ForwardTestServer(slow_handler)
+        slow.start()
+        fast = ForwardTestServer(fast_received.extend)
+        fast.start()
+        peer = Destination(fast.address, on_close=lambda d: None,
+                           flush_interval=0.1)
+        dest = Destination(slow.address, on_close=lambda d: None,
+                           flush_interval=0.1, hedge_after=0.15,
+                           hedge_peer=lambda: peer)
+        try:
+            # pin both senders to V2 first (ForwardTestServer is
+            # V2-only) so the hedged path exercises the stream future
+            dest.send_now([mkmetric("pin.a", 1)], token="")
+            peer.send_now([mkmetric("pin.b", 1)], token="")
+            assert wait_until(lambda: len(fast_received) == 1, timeout=5)
+
+            dest.send(mkmetric("hedged.m", 7))
+            assert wait_until(
+                lambda: any(m.name == "hedged.m" for m in fast_received),
+                timeout=5)
+            assert dest.hedge_fired_total == 1
+            assert dest.hedge_wins_total == 1
+            # delivery is credited to the node that absorbed it, and the
+            # blown budget counts as a failure signal for the primary —
+            # a node that never answers inside the budget must
+            # eventually trip its breaker and fail over
+            assert peer.sent_total >= 1
+            assert dest.breaker.consecutive_failures == 1
+        finally:
+            dest.close()
+            peer.close()
+            slow.stop()
+            fast.stop()
+
+    def test_chaos_latency_fires_hedge_deterministically(self):
+        """chaos_forward_latency_ms >= hedge_after burns the budget
+        inside the timed window, so the hedge fires every batch — no
+        probabilistic rolls, the knob's whole point."""
+        from veneur_tpu.util import chaos as chaos_mod
+
+        fast_received = []
+        primary_srv = ForwardTestServer(lambda ms: None)
+        primary_srv.start()
+        fast = ForwardTestServer(fast_received.extend)
+        fast.start()
+        peer = Destination(fast.address, on_close=lambda d: None,
+                           flush_interval=0.1)
+        dest = Destination(primary_srv.address, on_close=lambda d: None,
+                           flush_interval=0.1, hedge_after=0.1,
+                           hedge_peer=lambda: peer)
+        chaos_mod.install(Chaos(forward_latency_ms=300.0,
+                                seams=("forward_send",)))
+        try:
+            peer.send_now([mkmetric("pin.p", 1)], token="")  # pin V2
+            dest.send_now([mkmetric("pin.d", 1)], token="")  # pin V2
+            dest.send(mkmetric("det.hedge", 3))
+            assert wait_until(
+                lambda: any(m.name == "det.hedge" for m in fast_received),
+                timeout=5)
+            assert dest.hedge_fired_total == 1
+        finally:
+            chaos_mod.install(None)
+            dest.close()
+            peer.close()
+            primary_srv.stop()
+            fast.stop()
+
+    def test_ready_state_before_first_probe_round(self):
+        """A just-started proxy with a healthy pool must be ready even
+        though no probe round has populated the member table yet."""
+        ft = ForwardTestServer(lambda ms: None)
+        ft.start()
+        proxy = create_static_proxy([ft.address],
+                                    health_check_interval=3600.0)
+        proxy.start()  # probe loop won't tick within the test
+        try:
+            ready, body = proxy.ready_state()
+            assert ready is True
+            assert body["destinations"] == 1
+        finally:
+            proxy.stop()
+            ft.stop()
+
+    def test_import_server_token_dedupe(self):
+        """The global import server applies a token once: a duplicate
+        RPC (hedge or at-least-once retry) is acked-and-dropped."""
+        from veneur_tpu.core.server import Server
+        from veneur_tpu.forward.client import ForwardClient
+        from veneur_tpu.forward.wire import token_metadata, _frame_v1
+        from veneur_tpu.sinks.channel import ChannelMetricSink
+
+        cfg = make_config(grpc_address="127.0.0.1:0")
+        server = Server(cfg, extra_metric_sinks=[ChannelMetricSink()])
+        server.start()
+        try:
+            assert wait_until(lambda: server.import_server is not None)
+            imp = server.import_server
+            client = ForwardClient(imp.address, deadline=5.0)
+            body = _frame_v1(
+                mkmetric("dedupe.c", 5).SerializeToString())
+            md = token_metadata("tok:1")
+            client._send_v1(body, timeout=5.0, metadata=md)
+            client._send_v1(body, timeout=5.0, metadata=md)      # dup
+            client._send_v1(body, timeout=5.0,
+                            metadata=token_metadata("tok:2"))    # fresh
+            assert imp.duplicates_dropped_total == 1
+            assert imp.imported_total == 2
+            rows = imp.telemetry_rows()
+            assert rows[0][0] == "forward.hedge.duplicates_dropped"
+            assert rows[0][2] == 1.0
+            client.close()
+        finally:
+            server.shutdown()
+
+    def test_failed_attempt_forgets_token_so_retry_passes(self):
+        from veneur_tpu.forward.wire import TokenDeduper
+
+        class Ctx:
+            def __init__(self, token):
+                self._md = (("x-veneur-idempotency-token", token),)
+
+            def invocation_metadata(self):
+                return self._md
+
+        dd = TokenDeduper(cache_max=8)
+        token, disp = dd.begin(Ctx("t1"))
+        assert (token, disp) == ("t1", "fresh")
+        # a racing second attempt while the first is mid-merge must NOT
+        # be acked (the first may still fail): it fails retryable
+        _, disp = dd.begin(Ctx("t1"))
+        assert disp == "inflight"
+        dd.end(token, ok=False)             # merge failed: forget it
+        token, disp = dd.begin(Ctx("t1"))
+        assert disp == "fresh"              # retry passes
+        dd.end(token, ok=True)
+        _, disp = dd.begin(Ctx("t1"))
+        assert disp == "done"               # now it's a duplicate
+        assert dd.duplicates_dropped_total == 1
+
+    def test_proxy_dedupes_retried_sends(self):
+        """The exactly-once-per-node property holds at the PROXY
+        boundary too: a retried V1 body with the same token routes
+        once."""
+        from veneur_tpu.forward.client import ForwardClient
+        from veneur_tpu.forward.wire import token_metadata, _frame_v1
+
+        got = []
+        ft = ForwardTestServer(got.extend)
+        ft.start()
+        proxy = create_static_proxy([ft.address],
+                                    health_check_interval=0)
+        proxy.start()
+        try:
+            client = ForwardClient(proxy.address, deadline=5.0)
+            body = _frame_v1(mkmetric("pd.c", 4).SerializeToString())
+            md = token_metadata("ptok:1")
+            client._send_v1(body, timeout=5.0, metadata=md)
+            client._send_v1(body, timeout=5.0, metadata=md)  # retry dup
+            proxy.destinations.flush_wait()
+            assert wait_until(
+                lambda: sum(1 for m in got if m.name == "pd.c") == 1)
+            time.sleep(0.2)  # a second routed copy would land by now
+            assert sum(1 for m in got if m.name == "pd.c") == 1
+            assert proxy.stats.get("duplicates_dropped_total") == 1
+            client.close()
+        finally:
+            proxy.stop()
+            ft.stop()
+
+
+# -------------------------------------------------------------------------
+# Durable carryover spool
+# -------------------------------------------------------------------------
+
+
+class TestSpool:
+    def test_framing_roundtrip(self):
+        ms = [b"", b"a", b"x" * 1000]
+        assert unframe_metrics(frame_metrics(ms)) == ms
+        with pytest.raises(ValueError):
+            unframe_metrics(b"\x0b\x01a")  # wrong tag
+        with pytest.raises(ValueError):
+            unframe_metrics(b"\x0a\x05ab")  # truncated body
+
+    def test_append_drain_and_restart_replay(self, tmp_path):
+        spool = CarryoverSpool(str(tmp_path))
+        spool.append([b"m1", b"m2"])
+        spool.append([b"m3"])
+        assert spool.depth == 2 and spool.spilled_metrics_total == 3
+        seg = spool.oldest()
+        assert seg.read_metrics() == [b"m1", b"m2"]  # oldest first
+        spool.pop(seg)
+        assert spool.depth == 1 and spool.drained_metrics_total == 2
+
+        # a new process over the same directory replays what's left
+        spool2 = CarryoverSpool(str(tmp_path))
+        assert spool2.depth == 1 and spool2.replayed_total == 1
+        assert spool2.oldest().read_metrics() == [b"m3"]
+
+    def test_restart_seeds_sequence_past_disk(self, tmp_path):
+        """A restarted spool must not reuse low sequence numbers: the
+        name sort IS the drain/shed order, so interleaving a new
+        spill-00000001 among a predecessor's segments would break
+        oldest-first."""
+        a = CarryoverSpool(str(tmp_path))
+        a.append([b"old1"])
+        a.append([b"old2"])
+        b = CarryoverSpool(str(tmp_path))   # "restart"
+        b.append([b"new1"])
+        assert b.oldest().read_metrics() == [b"old1"]
+        names = sorted(f for f in os.listdir(str(tmp_path))
+                       if f.endswith(".vspool"))
+        # the new segment's name sorts strictly after both replayed ones
+        assert names[-1].startswith("spill-00000003-")
+        c = CarryoverSpool(str(tmp_path))
+        assert [seg.read_metrics() for seg in c._segments] == \
+            [[b"old1"], [b"old2"], [b"new1"]]
+
+    def test_bounds_shed_oldest(self, tmp_path):
+        spool = CarryoverSpool(str(tmp_path), max_segments=2)
+        spool.append([b"a"])
+        spool.append([b"b"])
+        spool.append([b"c"])
+        assert spool.depth == 2
+        assert spool.shed_total == 1 and spool.shed_metrics_total == 1
+        assert spool.oldest().read_metrics() == [b"b"]  # oldest shed
+
+    def test_carryover_spills_instead_of_shedding(self, tmp_path):
+        from veneur_tpu.core.columnstore import RowMeta
+        from veneur_tpu.core.flusher import ForwardableState
+        from veneur_tpu.samplers.metrics import MetricScope
+        from veneur_tpu.util.resilience import Carryover
+
+        spilled = []
+        co = Carryover(max_intervals=1, spill=lambda fwd: spilled.append(fwd))
+
+        def one(name, value):
+            meta = RowMeta(name=name, tags=[], joined_tags="", digest32=1,
+                           scope=MetricScope.GLOBAL_ONLY,
+                           wire_type="counter")
+            return ForwardableState(counters=[(meta, value)])
+
+        co.stash(one("s.c", 1.0))
+        assert not spilled and co.depth == 1
+        co.stash(one("s.c", 2.0))           # past the bound: spills
+        assert co.depth == 0 and co.shed_total == 0
+        assert co.spilled_total == 1
+        (fwd,), = (spilled,)
+        assert fwd.counters[0][1] == 3.0    # merged before the spill
+
+    def test_forward_client_spool_end_to_end(self, tmp_path):
+        """Dead upstream: intervals spill to disk past the carryover
+        bound; once the upstream returns, the spool drains oldest-first
+        and the receiver sees every counter delta exactly once."""
+        from veneur_tpu.core.columnstore import RowMeta
+        from veneur_tpu.core.flusher import ForwardableState
+        from veneur_tpu.forward.client import ForwardClient
+        from veneur_tpu.samplers.metrics import MetricScope
+        from veneur_tpu.util.resilience import (Carryover, CircuitBreaker,
+                                                RetryPolicy)
+
+        received = []
+        ft = ForwardTestServer(received.extend)
+        port = ft.port  # bind later: upstream starts DEAD
+        spool = CarryoverSpool(str(tmp_path))
+        client = ForwardClient(
+            f"127.0.0.1:{port}", deadline=3.0,
+            retry=RetryPolicy(max_attempts=1),
+            breaker=CircuitBreaker(failure_threshold=10_000, name="t"),
+            carryover=Carryover(max_intervals=1),
+            spool=spool)
+
+        def one(value):
+            meta = RowMeta(name="spool.cnt", tags=[], joined_tags="",
+                           digest32=1, scope=MetricScope.GLOBAL_ONLY,
+                           wire_type="counter")
+            return ForwardableState(counters=[(meta, value)])
+
+        try:
+            sent = 0
+            for v in (1.0, 2.0, 4.0, 8.0):
+                client.forward(one(v))
+                sent += v
+            # intervals 3+ overflowed carryover into the spool
+            assert spool.depth >= 1
+            assert client.carryover.spilled_total >= 1
+
+            ft.start()
+            sent += 16.0
+            got = client.forward(one(16.0))
+            # the channel may be inside its (capped, <=2s) reconnect
+            # backoff right after the restart: the failed interval is
+            # stashed, so empty follow-up forwards deliver it
+            from veneur_tpu.core.flusher import ForwardableState
+            deadline = time.time() + 15.0
+            while got == 0 and time.time() < deadline:
+                time.sleep(0.3)
+                got = client.forward(ForwardableState())
+            assert got > 0
+            assert spool.depth == 0         # drained after recovery
+            assert wait_until(lambda: sum(
+                p.counter.value for p in received
+                if p.name == "spool.cnt") == sent)
+            assert not [f for f in os.listdir(str(tmp_path))
+                        if f.endswith(".vspool")]
+        finally:
+            client.close()
+            ft.stop()
+
+    def test_spool_replay_after_restart_drains(self, tmp_path):
+        """A 'restarted' client (fresh objects, same spool dir) delivers
+        segments a previous process left behind."""
+        from veneur_tpu.forward.client import ForwardClient
+        from veneur_tpu.util.resilience import RetryPolicy
+
+        old = CarryoverSpool(str(tmp_path))
+        old.append([mkmetric("replay.c", 9).SerializeToString()])
+
+        received = []
+        ft = ForwardTestServer(received.extend)
+        ft.start()
+        spool = CarryoverSpool(str(tmp_path))
+        assert spool.replayed_total == 1
+        client = ForwardClient(ft.address, deadline=3.0,
+                               retry=RetryPolicy(max_attempts=1),
+                               spool=spool)
+        try:
+            # an empty interval still probes-and-drains the spool
+            from veneur_tpu.core.flusher import ForwardableState
+            assert client.forward(ForwardableState()) == 1
+            assert spool.depth == 0
+            assert wait_until(lambda: sum(
+                p.counter.value for p in received
+                if p.name == "replay.c") == 9)
+        finally:
+            client.close()
+            ft.stop()
+
+
+# -------------------------------------------------------------------------
+# Satellite: Destination.close() drains (and counts) before unregistering
+# -------------------------------------------------------------------------
+
+
+class TestDestinationCloseDrain:
+    def test_close_counts_inflight_and_unregisters_after(self, monkeypatch):
+        from veneur_tpu.core.latency import LatencyObservatory
+
+        # sender thread parked so enqueued metrics stay in the queue
+        monkeypatch.setattr(Destination, "_run", lambda self: None)
+        ft = ForwardTestServer(lambda ms: None)
+        ft.start()
+        obs = LatencyObservatory(enabled=True)
+        dest = Destination(ft.address, on_close=lambda d: None,
+                           observatory=obs)
+        qname = f"proxy_dest:{ft.address}"
+        hist = obs.queue_hist(qname)
+        try:
+            for i in range(3):
+                assert dest.send(mkmetric(f"d{i}", i))
+            assert qname in obs.report()["queues"]
+            dest.close()
+            # queued items were drained: counted dropped, dwell observed
+            # into the still-registered series, THEN unregistered
+            assert dest.dropped_total == 3
+            assert hist.count == 3
+            assert qname not in obs.report()["queues"]
+        finally:
+            ft.stop()
+
+
+# -------------------------------------------------------------------------
+# Satellite: proxy /healthcheck/ready 503 + member table
+# -------------------------------------------------------------------------
+
+
+class TestProxyReadyEndpoint:
+    def test_503_while_majority_ejected(self):
+        from veneur_tpu.core.httpapi import HTTPApi
+
+        ft1 = ForwardTestServer(lambda ms: None)
+        ft1.start()
+        ft2 = ForwardTestServer(lambda ms: None)
+        ft2.start()
+        proxy = create_static_proxy([ft1.address, ft2.address],
+                                    health_check_interval=0)
+        proxy.start()
+        # no probe thread (interval=0): drive rounds by hand for
+        # deterministic ejection state
+        health = RingHealth(proxy.destinations, interval=0.05,
+                            unhealthy_after=1, healthy_after=1)
+        proxy.ring_health = health
+        api = HTTPApi({}, server=None, address="127.0.0.1:0",
+                      ready=proxy.ready_state)
+        api.start()
+        host, port = api.address
+        url = f"http://{host}:{port}/healthcheck/ready"
+        try:
+            health.run_round()
+            with urllib.request.urlopen(url, timeout=5) as resp:
+                assert resp.status == 200
+
+            ft1.stop()
+            ft2.stop()
+            health.run_round()  # both die in one round (threshold 1)
+            try:
+                urllib.request.urlopen(url, timeout=5)
+                assert False, "expected 503"
+            except urllib.error.HTTPError as e:
+                assert e.code == 503
+                body = json.loads(e.read())
+            assert body["ready"] is False
+            assert "ejected" in body["reason"]
+            assert body["destinations"] == 2 and body["ejected"] == 2
+            table = {m["address"]: m for m in body["members"]}
+            assert all(m["ejected"] for m in table.values())
+        finally:
+            api.stop()
+            proxy.stop()
+
+
+# -------------------------------------------------------------------------
+# Chaos: the deterministic slow-destination knob
+# -------------------------------------------------------------------------
+
+
+class TestChaosForwardLatency:
+    def test_forward_latency_ms_is_deterministic(self):
+        slept = []
+        c = Chaos(forward_latency_ms=40.0, sleep=slept.append)
+        for _ in range(5):
+            c.inject("forward_send")
+        assert slept == [0.04] * 5
+        assert c.injected_delays["forward_send"] == 5
+        c.inject("sink_flush")  # other seams unaffected
+        assert slept == [0.04] * 5
+
+    def test_from_config(self):
+        cfg = make_config(chaos_enabled=True,
+                          chaos_forward_latency_ms=25.0)
+        c = Chaos.from_config(cfg)
+        assert c.forward_latency_ms == 25.0
+
+
+# -------------------------------------------------------------------------
+# Acceptance soaks
+# -------------------------------------------------------------------------
+
+
+class TestKillRestoreSoak:
+    def _run(self, kill_rounds, rounds, error_rate, seed=7):
+        """Local server -> global stub. The global dies for
+        `kill_rounds` consecutive flush intervals mid-stream while the
+        forward seam also injects faults; returns (counter total,
+        llhist bin total, sent counter total, sent llhist bins,
+        spool depth, latency report during run)."""
+        from veneur_tpu.core.server import Server
+        from veneur_tpu.forward import llhistwire
+        from veneur_tpu.sinks.channel import ChannelMetricSink
+
+        received = []
+        ft = ForwardTestServer(received.extend)
+        ft.start()
+        port = ft.port
+        import tempfile
+        spool_dir = tempfile.mkdtemp(prefix="veneur-spool-")
+        server = None
+        try:
+            cfg = make_config(
+                forward_address=ft.address,
+                chaos_enabled=error_rate > 0,
+                chaos_error_rate=error_rate,
+                chaos_seams=["forward_send"],
+                chaos_seed=seed,
+                forward_retry_max_attempts=1,
+                # tight carryover bound so the spool engages during the
+                # kill window; the breaker must never refuse (a refusal
+                # is just another stash, but keep the soak simple)
+                carryover_max_intervals=1,
+                carryover_spool_dir=spool_dir,
+                circuit_breaker_failure_threshold=10_000)
+            server = Server(cfg, extra_metric_sinks=[ChannelMetricSink()])
+            server.start()
+            sent_counter = 0
+            sent_bins = np.zeros(0, np.int64)
+            kill_at = 2
+            lat_report_mid = None
+            for rnd in range(rounds):
+                if rnd == kill_at:
+                    ft.stop()
+                if rnd == kill_at + kill_rounds:
+                    ft = ForwardTestServer(received.extend,
+                                           address=f"127.0.0.1:{port}")
+                    ft.start()
+                delta = 3 + rnd
+                server.handle_metric_packet(
+                    b"soak.count:%d|c|#veneurglobalonly" % delta)
+                sent_counter += delta
+                server.handle_metric_packet(b"soak.lat:%d|l" % (rnd + 1))
+                from veneur_tpu.core.latency import bin_index_scalar
+                from veneur_tpu.ops import llhist_ref
+                bins = np.zeros(llhist_ref.BINS, np.int64)
+                bins[bin_index_scalar(float(rnd + 1))] += 1
+                sent_bins = bins if sent_bins.size == 0 else sent_bins + bins
+                server.flush()
+                if rnd == kill_at + 1:
+                    lat_report_mid = server.latency.report()
+            # drain: chaos off, everything owed must deliver. The
+            # restored node needs one (capped, <=2s) reconnect-backoff
+            # window before the channel redials, so pace the flushes.
+            if server.chaos is not None:
+                server.chaos.enabled = False
+            for _ in range(10):
+                server.flush()
+                if (server.forward_client.carryover.depth == 0
+                        and server.forward_client.spool.depth == 0):
+                    break
+                time.sleep(0.5)
+            assert server.forward_client.carryover.depth == 0
+            assert server.forward_client.spool.depth == 0
+            got_counter = [0]
+            got_bins = np.zeros(sent_bins.shape, np.int64)
+
+            def settle():
+                got_counter[0] = sum(p.counter.value for p in received
+                                     if p.name == "soak.count")
+                return got_counter[0] >= sent_counter
+            wait_until(settle, timeout=10.0)
+            for p in received:
+                if p.name == "soak.lat":
+                    got_bins += llhistwire.unmarshal(p.llhist.bins)
+            spool_depth = server.forward_client.spool.depth
+            return (got_counter[0], got_bins, sent_counter, sent_bins,
+                    spool_depth, lat_report_mid, server, spool_dir)
+        finally:
+            if server is not None:
+                server.shutdown()
+            ft.stop()
+
+    def test_kill_restore_fast(self):
+        """Tier-1 pin: global down 2 intervals, no extra faults — zero
+        counter loss via carryover+spool, llhist registers exact."""
+        (got, got_bins, sent, sent_bins, depth, lat_mid, server,
+         spool_dir) = self._run(kill_rounds=2, rounds=6, error_rate=0.0)
+        assert got == sent
+        assert np.array_equal(got_bins, sent_bins)
+        assert depth == 0
+        # the spool queue was registered while the server ran...
+        assert "forward_spool" in (lat_mid or {}).get("queues", {})
+        # ...and unregistered cleanly at shutdown
+        assert "forward_spool" not in server.latency.report()["queues"]
+
+    @pytest.mark.slow
+    @pytest.mark.chaos
+    def test_acceptance_soak_kill_5_intervals_30pct_faults(self):
+        """The acceptance soak: one global instance dead for 5 flush
+        intervals mid-stream with a 30 % injected fault rate on the
+        forward seam; after restore, zero counter loss and llhist
+        bit-exactness versus the unfaulted control run."""
+        (got_c, bins_c, sent_c, sbins_c, depth_c, lat_mid, server,
+         _d) = self._run(kill_rounds=5, rounds=12, error_rate=0.3)
+        (got_0, bins_0, sent_0, sbins_0, depth_0, _l, _s,
+         _d0) = self._run(kill_rounds=0, rounds=12, error_rate=0.0)
+        assert sent_c == sent_0
+        assert got_0 == sent_0                    # control baseline
+        assert got_c == sent_c                    # zero counter loss
+        assert np.array_equal(sbins_c, sbins_0)
+        assert np.array_equal(bins_0, sbins_0)    # control exact
+        assert np.array_equal(bins_c, sbins_c)    # llhist bit-exact
+        assert depth_c == 0 and depth_0 == 0
+        assert "forward_spool" in (lat_mid or {}).get("queues", {})
+
+
+class TestRingFailoverSoak:
+    def _driver(self):
+        import importlib.util
+        path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "scripts", "ring_failover_soak.py")
+        spec = importlib.util.spec_from_file_location(
+            "ring_failover_soak", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_driver_quick(self):
+        """The standalone driver's invariants hold on a short run."""
+        report = self._driver().run_soak(
+            rounds=6, per_round=40, kill_round=2, restore_round=4,
+            probe_interval=0.05)
+        assert report["loss_unaccounted"] == 0
+        assert report["proxy"]["received_total"] == report["sent"]
+        assert any(e["event"] == "ejected" for e in report["events"])
+        assert any(e["event"] == "readmitted" for e in report["events"])
+
+    @pytest.mark.slow
+    def test_driver_soak(self):
+        report = self._driver().run_soak(
+            rounds=16, per_round=250, kill_round=4, restore_round=10,
+            probe_interval=0.05)
+        assert report["loss_unaccounted"] == 0
+        # loss is confined to the kill->ejection detection window
+        assert report["detection_window_loss"] <= 2 * 250, report

@@ -154,6 +154,11 @@ def build_from_config(raw: dict, args, log):
     # validated before any port binds so a bad value fails at startup,
     # not mid-shutdown after SIGTERM
     shutdown_grace = parse_duration(raw.get("shutdown_timeout", "1s"))
+    # forward-tier HA knobs: active ring health checks (ejection /
+    # readmission, DNS re-resolution each probe round) and optional
+    # hedged sends against a slow primary
+    health_interval = parse_duration(raw.get("health_check_interval", "2s"))
+    hedge_after = parse_duration(raw.get("hedge_after", 0))
     proxy = ProxyServer(
         discoverer,
         forward_service=forward_service,
@@ -166,7 +171,16 @@ def build_from_config(raw: dict, args, log):
         destination_tls=dest_tls or None,
         max_consecutive_failures=int(
             raw.get("circuit_breaker_failure_threshold") or 3),
-        latency_observatory=bool(raw.get("latency_observatory", True)))
+        latency_observatory=bool(raw.get("latency_observatory", True)),
+        health_check_interval=health_interval,
+        health_check_timeout=parse_duration(
+            raw.get("health_check_timeout", "1s")),
+        health_unhealthy_after=int(raw.get("health_unhealthy_after") or 3),
+        health_healthy_after=int(raw.get("health_healthy_after") or 2),
+        health_probe=raw.get("health_probe", "tcp"),
+        health_http_url_template=raw.get("health_http_url_template", ""),
+        hedge_after=hedge_after,
+        failover_walk=int(raw.get("failover_walk", 2)))
     proxy.shutdown_grace = shutdown_grace
     proxy.start()
     log.info("veneur-proxy listening on %s -> %s", proxy.address,
@@ -174,9 +188,10 @@ def build_from_config(raw: dict, args, log):
 
     # self-telemetry, reference cmd/veneur-proxy/main.go:64-90: RPC
     # aggregates + runtime gauges to the configured statsd address, teed
-    # into a pull-side registry the proxy's /metrics serves
-    from veneur_tpu.core.telemetry import Telemetry, device_memory_rows
-    telemetry = Telemetry()
+    # into a pull-side registry the proxy's /metrics serves. The proxy's
+    # own Telemetry carries the flight recorder (ring ejection events).
+    from veneur_tpu.core.telemetry import device_memory_rows
+    telemetry = proxy.telemetry
     telemetry.registry.add_collector(device_memory_rows)
     # routing + per-destination breaker/queue rows (proxy.*, proxy.dest.*,
     # resilience.breaker_state) rendered fresh at scrape time
@@ -203,7 +218,8 @@ def build_from_config(raw: dict, args, log):
         http_api = HTTPApi(raw, server=None, address=http_addr,
                            telemetry=telemetry,
                            cardinality=proxy.cardinality_report,
-                           latency=proxy.latency.report)
+                           latency=proxy.latency.report,
+                           ready=proxy.ready_state)
         http_api.start()
 
     return proxy, stats_loop, http_api

@@ -168,6 +168,17 @@ class Config:
     # this many consecutive intervals; beyond it the state is shed loudly.
     # 0 disables carryover (fail-and-forget, the pre-resilience behavior).
     carryover_max_intervals: int = 3
+    # -- durable carryover spill (util/spool.py) ------------------------
+    # when set, carryover past the bound is serialized (metricpb wire,
+    # the same bytes a forward send carries) into this directory instead
+    # of shed, drained oldest-first when the destination recovers, and
+    # replayed on process restart. Empty = shed at the bound (above).
+    carryover_spool_dir: str = ""
+    carryover_spool_max_bytes: int = 256 * 1024 * 1024
+    carryover_spool_max_segments: int = 1024
+    # (hedged forwards are a proxy-tier knob — `hedge_after` in the
+    # proxy yaml; the local forward client has one upstream and gets
+    # duplicate-safety from its per-interval idempotency token alone)
     # -- latency observatory (core/latency.py) --------------------------
     # per-family×device flush dispatch attribution, per-plane end-to-end
     # sample-age llhists, and queue dwell/depth telemetry. On by default
@@ -233,6 +244,11 @@ class Config:
     # ingest-side chaos: per-packet drop/truncate/duplicate rolls applied
     # by the server's packet intake, and simulated memory pressure added
     # to real RSS by the overload watermark monitor
+    # deterministic slow-destination injection: every forward_send seam
+    # crossing (local forward client AND proxy destination senders)
+    # sleeps this long — makes hedging budgets and health-probe timeouts
+    # testable without probabilistic rolls
+    chaos_forward_latency_ms: float = 0.0
     chaos_ingest_drop_rate: float = 0.0
     chaos_ingest_truncate_rate: float = 0.0
     chaos_ingest_duplicate_rate: float = 0.0

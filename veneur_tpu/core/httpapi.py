@@ -79,9 +79,14 @@ class _Handler(BaseHTTPRequestHandler):
             # plus the server's own degradation verdict — shedding
             # overload state or a tripped flush watchdog answer 503 with
             # a JSON reason, so orchestrators stop routing to an
-            # instance that is wedged or actively dropping data
+            # instance that is wedged or actively dropping data. A
+            # standalone API (the proxy) passes its own `ready` source;
+            # its body may be a full dict (the proxy includes the ring
+            # member table alongside the reason).
             ready, reason = True, ""
-            if api.server is not None:
+            if api.ready_source is not None:
+                ready, reason = api.ready_source()
+            elif api.server is not None:
                 if api.require_flush_for_ready and not api.server.flush_count:
                     ready, reason = False, "no flush completed yet"
                 else:
@@ -91,9 +96,11 @@ class _Handler(BaseHTTPRequestHandler):
             if ready:
                 self._send(200, b"ready\n")
             else:
-                self._send(503, json.dumps(
-                    {"ready": False, "reason": reason}).encode() + b"\n",
-                    "application/json")
+                body = (dict(reason, ready=False)
+                        if isinstance(reason, dict)
+                        else {"ready": False, "reason": reason})
+                self._send(503, json.dumps(body).encode() + b"\n",
+                           "application/json")
         elif path == "/version":
             self._send(200, veneur_tpu.__version__.encode())
         elif path == "/builddate":
@@ -340,7 +347,7 @@ class HTTPApi:
     def __init__(self, config, server=None, address: str = "127.0.0.1:0",
                  http_quit: bool = False, on_quit=None,
                  require_flush_for_ready: bool = False, telemetry=None,
-                 cardinality=None, latency=None):
+                 cardinality=None, latency=None, ready=None):
         self.config = config
         self.server = server
         self.http_quit = http_quit
@@ -354,6 +361,10 @@ class HTTPApi:
         # server's latency.report is used by default, the proxy passes
         # its own observatory's
         self.latency_source = latency
+        # /healthcheck/ready source for a standalone API (the proxy):
+        # a callable -> (ready, reason_str_or_body_dict); None defers to
+        # the owning server's readiness ladder
+        self.ready_source = ready
         # /metrics & the flight recorder serve the owning server's
         # telemetry; a standalone API (proxy passes its own, tests pass
         # none) gets a private registry so the routes always answer —

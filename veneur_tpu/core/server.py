@@ -696,6 +696,25 @@ class Server:
                      if self.config.forward_tls_key else ""),
                 authority=self.config.forward_tls_authority_certificate)
             cfg = self.config
+            # durable carryover spill: with a spool dir configured,
+            # carryover past its bound serializes to disk instead of
+            # shedding; segments left by a previous process (crash or
+            # SIGUSR2 handoff mid-outage) are re-scanned here and drain
+            # after the first successful forward
+            spool = None
+            if cfg.carryover_spool_dir:
+                from veneur_tpu.util.spool import CarryoverSpool
+                spool = CarryoverSpool(
+                    cfg.carryover_spool_dir,
+                    max_bytes=cfg.carryover_spool_max_bytes,
+                    max_segments=cfg.carryover_spool_max_segments,
+                    dwell_hist=self.latency.queue_hist("forward_spool"))
+                self.latency.register_queue(
+                    "forward_spool", lambda: spool.depth,
+                    cfg.carryover_spool_max_segments)
+                self.telemetry.record_event(
+                    "spool_attached", directory=cfg.carryover_spool_dir,
+                    replayed_segments=spool.replayed_total)
             self.forward_client = ForwardClient(
                 cfg.forward_address, deadline=self.interval,
                 tls=fwd_tls or None,
@@ -708,7 +727,7 @@ class Server:
                     recovery_time=cfg.circuit_breaker_recovery,
                     name="forward", on_transition=self._breaker_transition),
                 carryover=Carryover(cfg.carryover_max_intervals),
-                chaos=self.chaos)
+                chaos=self.chaos, spool=spool)
             self.forwarder = self.forward_client.forward
             self.telemetry.registry.add_collector(
                 self.forward_client.telemetry_rows)
@@ -746,6 +765,9 @@ class Server:
             self.import_server = ImportServer(
                 self, self.config.grpc_address, ignored_tags=ignored,
                 tls=grpc_tls or None)
+            # hedge/retry duplicate drops surface in /metrics
+            self.telemetry.registry.add_collector(
+                self.import_server.telemetry_rows)
             self.import_server.start()
         for source in self.sources:
             t = threading.Thread(target=source.start, args=(self,),
@@ -981,6 +1003,11 @@ class Server:
             self.profiler.stop()
         if self.forward_client is not None:
             self.forward_client.close()
+            # retire the forward plane's observatory queues with their
+            # owner so /debug/latency reflects only live hand-offs
+            self.latency.unregister_queue("forward_carryover")
+            if self.forward_client.spool is not None:
+                self.latency.unregister_queue("forward_spool")
         if self.diagnostics is not None:
             self.diagnostics.stop()
         self.trace_client.close()
@@ -1175,10 +1202,14 @@ class Server:
         phases["preflush_s"] = t_store - flush_start
 
         # dispatch even with an empty snapshot when a previous interval's
-        # failed state is pending — otherwise a quiet interval would
-        # strand the carryover until new traffic arrives
+        # failed state is pending (in carryover OR the durable spool) —
+        # otherwise a quiet interval would strand it until new traffic
+        # arrives
         pending_carryover = (self.forward_client is not None
-                             and self.forward_client.carryover.depth > 0)
+                             and (self.forward_client.carryover.depth > 0
+                                  or (self.forward_client.spool is not None
+                                      and self.forward_client.spool.depth
+                                      > 0)))
         if self.is_local and self.forwarder is not None and (
                 len(fwd) or pending_carryover):
             if not _start_sink_thread("forward", self._forward_safe, fwd) \

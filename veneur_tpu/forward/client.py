@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import logging
 import time
+import uuid
 from typing import Dict, Optional
 
 import grpc
@@ -26,11 +27,12 @@ import grpc
 from veneur_tpu.core.flusher import ForwardableState
 from veneur_tpu.forward.convert import forwardable_to_wire
 from veneur_tpu.forward.wire import (_frame_v1, _serialize_metric,
-                                     send_batch)
+                                     send_batch, token_metadata)
 from veneur_tpu.util import chaos as chaos_mod
 from veneur_tpu.util.chaos import ChaosError
 from veneur_tpu.util.grpctls import GrpcTLS, secure_or_insecure_channel
 from veneur_tpu.util.resilience import Carryover, CircuitBreaker, RetryPolicy
+from veneur_tpu.util.spool import CarryoverSpool
 
 logger = logging.getLogger("veneur_tpu.forward.client")
 
@@ -47,13 +49,18 @@ class ForwardClient:
     """gRPC client for /forwardrpc.Forward, built on the generic channel
     API (no generated stubs needed)."""
 
+    # drain attempts (while the destination is demonstrably up) before a
+    # spool segment is declared undeliverable and quarantined
+    SEGMENT_ATTEMPTS_MAX = 10
+
     def __init__(self, address: str, deadline: float = 10.0,
                  channel: Optional[grpc.Channel] = None,
                  tls: Optional[GrpcTLS] = None,
                  retry: Optional[RetryPolicy] = None,
                  breaker: Optional[CircuitBreaker] = None,
                  carryover: Optional[Carryover] = None,
-                 chaos: Optional[chaos_mod.Chaos] = None):
+                 chaos: Optional[chaos_mod.Chaos] = None,
+                 spool: Optional[CarryoverSpool] = None):
         self.address = address
         self.deadline = deadline
         # resilience: callers that want fail-and-forget (veneur-emit's
@@ -64,11 +71,36 @@ class ForwardClient:
         self.retry = retry or RetryPolicy()
         self.breaker = breaker or CircuitBreaker(name=f"forward:{address}")
         self.carryover = carryover or Carryover()
+        # durable spill: carryover past its age bound serializes into
+        # the spool (instead of shedding) and drains oldest-first after
+        # the next successful send; segments left by a dead process were
+        # already re-scanned by the spool's constructor
+        self.spool = spool
+        if spool is not None and self.carryover.spill is None:
+            self.carryover.spill = self._spill
         self.chaos = chaos
+        # interval+shard idempotency token: every forward() call mints
+        # one token that rides ALL its attempts (V1 body, V2 fallback,
+        # every retry) as gRPC metadata — the import server merges the
+        # payload once no matter how many attempts land. The uuid is the
+        # shard identity (one per client/process), the sequence the
+        # interval identity.
+        self._token_id = uuid.uuid4().hex[:12]
+        self._token_seq = 0
+        # per-segment drain attempts: a segment whose send fails
+        # DETERMINISTICALLY (server-side merge error, not an outage)
+        # would otherwise wedge the whole drain at the head of the
+        # queue forever; past the cap it is quarantined (*.corrupt)
+        self._segment_attempts: Dict[str, int] = {}
+        from veneur_tpu.util.grpctls import RECONNECT_BACKOFF_OPTIONS
         self._channel = channel or secure_or_insecure_channel(
             address, tls,
-            # the V1 bulk body scales with key count (~36 MB at 50k keys)
-            options=[("grpc.max_send_message_length", 256 << 20)])
+            # the V1 bulk body scales with key count (~36 MB at 50k
+            # keys); the shared backoff cap keeps a freshly-restored
+            # global dialable within a flush interval so the carryover/
+            # spool drain isn't stalled by grpc's post-outage backoff
+            options=[("grpc.max_send_message_length", 256 << 20),
+                     *RECONNECT_BACKOFF_OPTIONS])
         self._send_v2 = self._channel.stream_unary(
             "/forwardrpc.Forward/SendMetricsV2",
             request_serializer=_serialize_metric,
@@ -112,54 +144,83 @@ class ForwardClient:
         overhead at 50k keys costs seconds — falling back to the V2
         stream for importers that reject V1."""
         fwd = self.carryover.drain_into(fwd)
-        if not len(fwd):
+        spool_pending = self.spool is not None and self.spool.depth > 0
+        if not len(fwd) and not spool_pending:
             return 0
         if not self.breaker.allow():
             self.stats["breaker_refused_total"] += 1
-            self.carryover.stash(fwd)
-            logger.warning(
-                "forward breaker %s to %s: carrying %d metrics over",
-                self.breaker.state, self.address, len(fwd))
+            if len(fwd):
+                self.carryover.stash(fwd)
+                logger.warning(
+                    "forward breaker %s to %s: carrying %d metrics over",
+                    self.breaker.state, self.address, len(fwd))
             return 0
-        protos = forwardable_to_wire(fwd)
-        if not protos:
+        protos = forwardable_to_wire(fwd) if len(fwd) else []
+        if not protos and not spool_pending:
             return 0
         deadline_ts = time.monotonic() + self.deadline
-        delays = self.retry.delays(self.deadline)
-        while True:
-            try:
-                self._inject_chaos()
-                # per-attempt timeout is the REMAINING budget: a slow
-                # first attempt leaves correspondingly less for retries
-                timeout = max(0.05, deadline_ts - time.monotonic())
-                # a single flush body scales with key count (~36 MB at
-                # 50k keys), so RESOURCE_EXHAUSTED here is structural,
-                # not transient — both codes pin the client to V2
-                self._v1_ok = send_batch(
-                    self._send_v1, self._send_v2, protos, timeout,
-                    self._v1_ok,
-                    pin_codes=(grpc.StatusCode.UNIMPLEMENTED,
-                               grpc.StatusCode.RESOURCE_EXHAUSTED))
-                break
-            except (grpc.RpcError, ChaosError) as e:
-                code = e.code() if hasattr(e, "code") else None
-                retryable = (isinstance(e, ChaosError)
-                             or code in _RETRYABLE_CODES)
-                delay = next(delays, None) if retryable else None
-                if delay is None:
-                    self._record_failure(code, fwd, len(protos))
-                    return 0
-                self.stats["retries_total"] += 1
-                logger.info(
-                    "forward to %s failed (%s); retrying in %.2fs",
-                    self.address, code or e, delay)
-                if delay > 0:
-                    time.sleep(delay)
+        if protos:
+            # one token per interval payload, stable across every retry
+            # and the V1->V2 fallback of THIS call — an attempt that
+            # landed but errored client-side can't merge twice
+            self._token_seq += 1
+            token = f"fwd:{self._token_id}:{self._token_seq}"
+            delays = self.retry.delays(self.deadline)
+            while True:
+                try:
+                    self._inject_chaos()
+                    # per-attempt timeout is the REMAINING budget: a slow
+                    # first attempt leaves correspondingly less for retries
+                    timeout = max(0.05, deadline_ts - time.monotonic())
+                    # a single flush body scales with key count (~36 MB at
+                    # 50k keys), so RESOURCE_EXHAUSTED here is structural,
+                    # not transient — both codes pin the client to V2
+                    self._v1_ok = send_batch(
+                        self._send_v1, self._send_v2, protos, timeout,
+                        self._v1_ok,
+                        pin_codes=(grpc.StatusCode.UNIMPLEMENTED,
+                                   grpc.StatusCode.RESOURCE_EXHAUSTED),
+                        metadata=token_metadata(token))
+                    break
+                except (grpc.RpcError, ChaosError) as e:
+                    code = e.code() if hasattr(e, "code") else None
+                    retryable = (isinstance(e, ChaosError)
+                                 or code in _RETRYABLE_CODES)
+                    delay = next(delays, None) if retryable else None
+                    if delay is None:
+                        self._record_failure(code, fwd, len(protos))
+                        return 0
+                    self.stats["retries_total"] += 1
+                    logger.info(
+                        "forward to %s failed (%s); retrying in %.2fs",
+                        self.address, code or e, delay)
+                    if delay > 0:
+                        time.sleep(delay)
+        else:
+            # nothing fresh to send, but the spool holds spilled state:
+            # probe the destination with the drain itself below
+            pass
+        drained, drain_err, attempted = self._drain_spool(
+            deadline_ts, destination_up=bool(protos))
+        if not protos and drained == 0:
+            if drain_err is not None:
+                # the spool-only probe failed: destination still down
+                self._record_failure(
+                    drain_err.code() if hasattr(drain_err, "code")
+                    else None, fwd, 0)
+                return 0
+            if not attempted:
+                # nothing sendable was found (every segment quarantined
+                # on read): there is NO network evidence the peer is up,
+                # so don't close a half-open breaker on it — release the
+                # probe pessimistically instead
+                self.breaker.record_failure()
+                return 0
         self.breaker.record_success()
         self.carryover.clear_age()
         self.stats["forwarded_total"] += len(protos)
         logger.debug("forwarded %d metrics to %s", len(protos), self.address)
-        return len(protos)
+        return len(protos) + drained
 
     def _record_failure(self, code, fwd: ForwardableState,
                         n_protos: int) -> None:
@@ -170,10 +231,116 @@ class ForwardClient:
         else:
             self.stats["errors_send"] += 1
         self.breaker.record_failure()
-        self.carryover.stash(fwd)
+        if len(fwd):
+            self.carryover.stash(fwd)
         logger.warning(
             "could not forward %d metrics to %s: %s (carryover depth %d)",
             n_protos, self.address, code, self.carryover.depth)
+
+    # -- durable spool ---------------------------------------------------
+
+    def _spill(self, fwd: ForwardableState) -> int:
+        """Carryover's overflow hook: serialize the shed-bound state to
+        the on-disk spool (same wire bytes a send would carry)."""
+        return self.spool.append(forwardable_to_wire(fwd))
+
+    def _drain_spool(self, deadline_ts: float, destination_up: bool):
+        """After a successful send (the destination is demonstrably up),
+        deliver spilled segments oldest-first until the spool is empty,
+        the flush budget runs out, or a send fails (the segment stays
+        for the next interval). Returns (metrics_drained, last_error,
+        attempted) — `attempted` is False when no RPC was even made
+        (empty spool, budget gone, or every segment quarantined on
+        read), so the caller can't mistake a no-op for a live peer.
+
+        Each segment send carries its own idempotency token, stable for
+        the segment's lifetime (derived from its path), so a segment
+        whose send landed but errored client-side is dropped by the
+        import server when re-sent next interval.
+
+        `destination_up` gates the quarantine counter: a head-segment
+        failure right after a SUCCESSFUL main send points at the
+        segment, but a failure on the spool-only probe path is
+        indistinguishable from the outage continuing — counting those
+        would quarantine a perfectly good segment after a long quiet
+        outage."""
+        if self.spool is None:
+            return 0, None, False
+        drained = 0
+        err = None
+        attempted = False
+        while True:
+            seg = self.spool.oldest()
+            if seg is None:
+                break
+            remaining = deadline_ts - time.monotonic()
+            if remaining <= 0.05:
+                break
+            try:
+                metrics = seg.read_metrics()
+            except (OSError, ValueError) as e:
+                logger.error("undeliverable spool segment %s: %s",
+                             seg.path, e)
+                self.spool.discard(seg)
+                self._segment_attempts.pop(seg.path, None)
+                continue
+            token = "spool:" + seg.path.rsplit("/", 1)[-1]
+            try:
+                attempted = True
+                self._inject_chaos()
+                self._v1_ok = send_batch(
+                    self._send_v1, self._send_v2, metrics, remaining,
+                    self._v1_ok,
+                    pin_codes=(grpc.StatusCode.UNIMPLEMENTED,
+                               grpc.StatusCode.RESOURCE_EXHAUSTED),
+                    metadata=token_metadata(token))
+            except (grpc.RpcError, ChaosError) as e:
+                err = e
+                code = e.code() if hasattr(e, "code") else None
+                attempts = self._segment_attempts.get(seg.path, 0)
+                # count toward quarantine only failures that indict the
+                # SEGMENT: the peer answered (destination_up) with a
+                # non-transient error. DEADLINE_EXCEEDED is usually a
+                # near-exhausted flush budget after a slow main send,
+                # UNAVAILABLE the node dying mid-drain, chaos an
+                # injected transport fault — quarantining a deliverable
+                # interval on those would BE the loss the spool
+                # prevents.
+                if destination_up and not isinstance(e, ChaosError)                         and code not in (
+                            grpc.StatusCode.DEADLINE_EXCEEDED,
+                            grpc.StatusCode.UNAVAILABLE):
+                    attempts += 1
+                    self._segment_attempts[seg.path] = attempts
+                if attempts >= self.SEGMENT_ATTEMPTS_MAX:
+                    # not an outage (the main send just succeeded, or
+                    # this has now failed across many recovered
+                    # intervals): the segment itself is undeliverable —
+                    # quarantine it so it can't wedge everything behind
+                    logger.error(
+                        "spool segment %s failed %d drain attempts; "
+                        "quarantining (.corrupt)", seg.path, attempts)
+                    self.spool.discard(seg)
+                    self._segment_attempts.pop(seg.path, None)
+                    continue
+                logger.warning(
+                    "spool drain to %s stopped at %s: %s (%d segments "
+                    "remain)", self.address, seg.path, e, self.spool.depth)
+                break
+            self.spool.pop(seg)
+            self._segment_attempts.pop(seg.path, None)
+            drained += len(metrics)
+        if drained:
+            logger.info("drained %d spilled metrics to %s (%d segments "
+                        "remain)", drained, self.address, self.spool.depth)
+        if len(self._segment_attempts) > 64:
+            # segments can also leave via the spool's own bound shed,
+            # which this client never sees — prune to live paths so the
+            # attempt map can't grow without bound
+            live = self.spool.live_paths()
+            self._segment_attempts = {p: n for p, n
+                                      in self._segment_attempts.items()
+                                      if p in live}
+        return drained, err, attempted
 
     def telemetry_rows(self):
         """(name, kind, value, tags) rows for the /metrics registry: the
@@ -191,6 +358,10 @@ class ForwardClient:
                      float(self.carryover.merged_total), ()))
         rows.append(("resilience.carryover_shed", "counter",
                      float(self.carryover.shed_total), ()))
+        rows.append(("resilience.carryover_spilled", "counter",
+                     float(self.carryover.spilled_total), ()))
+        if self.spool is not None:
+            rows.extend(self.spool.telemetry_rows())
         return rows
 
     def send_protos(self, protos) -> int:

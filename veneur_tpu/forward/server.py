@@ -67,6 +67,33 @@ class ImportServer:
         # same keys every interval, so the native import path pays
         # update_tags/fnv once per key lifetime instead of per flush
         self._stub_cache: dict = {}
+        # idempotency dedupe (hedged forwards / at-least-once retries):
+        # makes duplicate-on-ambiguity (a landed request whose response
+        # was lost, then re-sent) exactly-once per receiving node. The
+        # shared implementation also runs in the proxy's handlers.
+        from veneur_tpu.forward.wire import TokenDeduper
+        self._deduper = TokenDeduper()
+
+    @property
+    def duplicates_dropped_total(self) -> int:
+        return self._deduper.duplicates_dropped_total
+
+    def _token_begin(self, ctx):
+        token, disposition = self._deduper.begin(ctx)
+        if disposition == "done":
+            logger.info("dropping duplicate import (token %s)", token)
+        elif disposition == "inflight":
+            logger.info("duplicate import racing its first attempt "
+                        "(token %s): refusing retryably", token)
+        return token, disposition
+
+    def _token_end(self, token: str, ok: bool) -> None:
+        self._deduper.end(token, ok)
+
+    def telemetry_rows(self) -> List[tuple]:
+        """Scrape-time rows for the owning server's /metrics registry."""
+        return [("forward.hedge.duplicates_dropped", "counter",
+                 float(self.duplicates_dropped_total), ())]
 
     @property
     def address(self) -> str:
@@ -94,16 +121,28 @@ class ImportServer:
         (vnt_import_parse: identity keys + pre-bucketed centroid grids
         in one C pass) with a cached-stub intern layer; an unavailable
         native library or unparseable body falls back to upb objects."""
-        self._note_arrival()
-        count = self._merge_native(body)
-        if count is None:
-            req = forward_pb2.MetricList.FromString(body)
-            buf = _MergeBuffer(self)
-            for pbm in req.metrics:
-                buf.add(pbm)
-            buf.flush_all()
-            count = len(req.metrics)
-        self.imported_total += count
+        token, disposition = self._token_begin(ctx)
+        if disposition == "done":
+            return b""
+        if disposition == "inflight":
+            # the first attempt may yet fail; make the sender try again
+            ctx.abort(grpc.StatusCode.UNAVAILABLE,
+                      "duplicate import racing its first attempt")
+        ok = False
+        try:
+            self._note_arrival()
+            count = self._merge_native(body)
+            if count is None:
+                req = forward_pb2.MetricList.FromString(body)
+                buf = _MergeBuffer(self)
+                for pbm in req.metrics:
+                    buf.add(pbm)
+                buf.flush_all()
+                count = len(req.metrics)
+            self.imported_total += count
+            ok = True
+        finally:
+            self._token_end(token, ok)
         return b""
 
     def _note_arrival(self, n: int = 1) -> None:
@@ -238,14 +277,30 @@ class ImportServer:
                          scope=scope)
 
     def _send_metrics_v2(self, request_iterator, ctx):
-        self._note_arrival()
-        buf = _MergeBuffer(self)
-        count = 0
-        for pbm in request_iterator:
-            buf.add(pbm)
-            count += 1
-        buf.flush_all()
-        self.imported_total += count
+        token, disposition = self._token_begin(ctx)
+        if disposition == "done":
+            # drain without merging so the sender's stream completes
+            # normally (duplicates are rare; the deserialize cost is
+            # acceptable on this path)
+            for _ in request_iterator:
+                pass
+            return b""
+        if disposition == "inflight":
+            ctx.abort(grpc.StatusCode.UNAVAILABLE,
+                      "duplicate import racing its first attempt")
+        ok = False
+        try:
+            self._note_arrival()
+            buf = _MergeBuffer(self)
+            count = 0
+            for pbm in request_iterator:
+                buf.add(pbm)
+                count += 1
+            buf.flush_all()
+            self.imported_total += count
+            ok = True
+        finally:
+            self._token_end(token, ok)
         return b""
 
 

@@ -33,8 +33,74 @@ def _frame_v1(m) -> bytes:
     return b"".join(out)
 
 
+# gRPC metadata key carrying the sender's idempotency token: the import
+# server (and the proxy) remember recent tokens and ack-and-drop a
+# repeat, so an at-least-once retry or a hedged duplicate merges once
+# per receiving node. Lowercase per the gRPC metadata contract.
+IDEMPOTENCY_KEY = "x-veneur-idempotency-token"
+
+
+def token_metadata(token: str):
+    """Metadata tuple for one send attempt; None disables the header."""
+    return ((IDEMPOTENCY_KEY, token),) if token else None
+
+
+class TokenDeduper:
+    """Receiver-side idempotency-token bookkeeping, shared by the global
+    ImportServer AND the proxy handlers (a retry whose first attempt
+    landed at the proxy would otherwise be routed — and counted —
+    twice, with fresh per-destination tokens the global can't catch).
+
+    `begin` returns (token, disposition): "fresh" (process it), "done"
+    (a COMPLETED attempt already applied this token — ack and drop), or
+    "inflight" (the first attempt is still processing — the caller must
+    fail retryable, NOT ack: acking would let the sender record
+    delivery while the racing first attempt can still fail). `end`
+    records the outcome; failed attempts forget the token so the retry
+    passes."""
+
+    def __init__(self, cache_max: int = 8192):
+        import threading
+        from collections import OrderedDict
+        self.cache_max = cache_max
+        self._lock = threading.Lock()
+        self._done: "OrderedDict[str, None]" = OrderedDict()
+        self._inflight: set = set()
+        self.duplicates_dropped_total = 0
+
+    def begin(self, ctx):
+        token = ""
+        try:
+            for key, value in (ctx.invocation_metadata() or ()):
+                if key == IDEMPOTENCY_KEY:
+                    token = value
+                    break
+        except Exception:
+            return "", "fresh"
+        if not token:
+            return "", "fresh"
+        with self._lock:
+            if token in self._done:
+                self.duplicates_dropped_total += 1
+                return token, "done"
+            if token in self._inflight:
+                return token, "inflight"
+            self._inflight.add(token)
+        return token, "fresh"
+
+    def end(self, token: str, ok: bool) -> None:
+        if not token:
+            return
+        with self._lock:
+            self._inflight.discard(token)
+            if ok:
+                self._done[token] = None
+                while len(self._done) > self.cache_max:
+                    self._done.popitem(last=False)
+
+
 def send_batch(send_v1, send_v2, batch, timeout, v1_ok: bool,
-               pin_codes, retry_codes=()) -> bool:
+               pin_codes, retry_codes=(), metadata=None) -> bool:
     """One batch over the V1 bulk body when the peer takes it, else the
     V2 stream — the single transport policy both the forward client and
     the proxy destinations use, so the fallback semantics cannot drift.
@@ -43,20 +109,25 @@ def send_batch(send_v1, send_v2, batch, timeout, v1_ok: bool,
     return False so the caller stays on V2); `retry_codes` are
     transient V1 failures (retry via V2 but keep preferring V1). Any
     other error propagates for the caller's failure accounting.
-    Returns the updated v1-preference flag."""
+    Returns the updated v1-preference flag.
+
+    `metadata` (e.g. token_metadata) rides on every attempt, INCLUDING
+    the V2 retry of a failed V1 body: a V1 attempt the receiver applied
+    before erroring client-side must not merge twice via the fallback.
+    """
     if v1_ok:
         try:
             body = b"".join(_frame_v1(m) for m in batch)
-            send_v1(body, timeout=timeout)
+            send_v1(body, timeout=timeout, metadata=metadata)
             return True
         except grpc.RpcError as e:
             code = e.code() if hasattr(e, "code") else None
             if code in pin_codes:
-                send_v2(iter(batch), timeout=timeout)
+                send_v2(iter(batch), timeout=timeout, metadata=metadata)
                 return False
             if code in retry_codes:
-                send_v2(iter(batch), timeout=timeout)
+                send_v2(iter(batch), timeout=timeout, metadata=metadata)
                 return True
             raise
-    send_v2(iter(batch), timeout=timeout)
+    send_v2(iter(batch), timeout=timeout, metadata=metadata)
     return v1_ok

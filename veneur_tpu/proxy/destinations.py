@@ -14,14 +14,19 @@ from __future__ import annotations
 import logging
 import queue
 import threading
+import time
+import uuid
 from typing import Callable, Dict, List, Optional
 
 import grpc
 
 from veneur_tpu.forward.protos import metric_pb2
-from veneur_tpu.forward.wire import _serialize_metric, send_batch
+from veneur_tpu.forward.wire import (_frame_v1, _serialize_metric,
+                                     send_batch, token_metadata)
 from veneur_tpu.ops import hll_ref
 from veneur_tpu.proxy.ring import ConsistentRing, EmptyRingError
+from veneur_tpu.util import chaos as chaos_mod
+from veneur_tpu.util.chaos import ChaosError
 from veneur_tpu.util.grpctls import GrpcTLS, secure_or_insecure_channel
 from veneur_tpu.util.resilience import CircuitBreaker
 
@@ -38,9 +43,24 @@ class Destination:
                  max_consecutive_failures: int = 3,
                  tls: Optional[GrpcTLS] = None,
                  breaker: Optional[CircuitBreaker] = None,
-                 observatory=None):
+                 observatory=None,
+                 hedge_after: float = 0.0,
+                 hedge_peer: Optional[Callable[[], Optional["Destination"]]]
+                 = None):
         self.address = address
         self._on_close = on_close
+        # hedged sends: when a batch's primary send exceeds
+        # `hedge_after` seconds, the SAME batch (same idempotency token)
+        # fires at the next healthy ring member via `hedge_peer`; the
+        # import server's token dedupe keeps a late-landing primary from
+        # double-merging on ITS node. 0 disables hedging.
+        self._hedge_after = max(0.0, float(hedge_after))
+        self._hedge_peer = hedge_peer
+        self.hedge_fired_total = 0
+        self.hedge_wins_total = 0
+        # idempotency token namespace for this sender's batches
+        self._token_id = uuid.uuid4().hex[:12]
+        self._token_seq = 0
         # instrumented when the proxy runs a latency observatory: queue
         # depth + enqueue->send dwell ride the shared queue.* telemetry
         self._queue: "queue.Queue" = (
@@ -59,6 +79,10 @@ class Destination:
             failure_threshold=max_consecutive_failures,
             name=f"proxy-dest:{address}")
         self.closed = threading.Event()
+        # sent_total is written by this sender's thread AND (on a hedge
+        # win) by a hedging peer's thread; += is not atomic, and the
+        # soaks pin exact accounting
+        self._counter_lock = threading.Lock()
         self.sent_total = 0
         self.dropped_total = 0
         self.shed_open_total = 0  # immediate sheds while the breaker is open
@@ -67,7 +91,12 @@ class Destination:
         # destination is absorbing a key explosion). Fed by note_key on
         # the routing path; cumulative for the destination's lifetime.
         self.key_hll = hll_ref.HLL()
-        self._channel = secure_or_insecure_channel(address, tls)
+        # shared backoff cap: a readmitted member must be dialable the
+        # moment its probes pass, not whenever grpc's post-outage
+        # backoff (20s+) next fires
+        from veneur_tpu.util.grpctls import RECONNECT_BACKOFF_OPTIONS
+        self._channel = secure_or_insecure_channel(
+            address, tls, options=list(RECONNECT_BACKOFF_OPTIONS))
         # batches hold Metric objects (the V2 ingest path) or raw wire
         # bytes (the native V1 re-scatter): the serializer passes both
         self._send_v2 = self._channel.stream_unary(
@@ -112,11 +141,13 @@ class Destination:
         metric that hashed here, for the whole window between the first
         failure and the breaker tripping."""
         if self.closed.is_set():
-            self.dropped_total += 1
+            with self._counter_lock:
+                self.dropped_total += 1
             return False
         if not self.breaker.is_dispatchable:
-            self.dropped_total += 1
-            self.shed_open_total += 1
+            with self._counter_lock:
+                self.dropped_total += 1
+                self.shed_open_total += 1
             return False
         try:
             self._queue.put_nowait(metric)
@@ -127,14 +158,16 @@ class Destination:
             # failing-but-not-yet-open: the queue is full because the
             # sender can't drain it — blocking would stall the handler
             # without ever creating room
-            self.dropped_total += 1
-            self.shed_open_total += 1
+            with self._counter_lock:
+                self.dropped_total += 1
+                self.shed_open_total += 1
             return False
         try:
             self._queue.put(metric, timeout=self._flush_interval)
             return True
         except queue.Full:
-            self.dropped_total += 1
+            with self._counter_lock:
+                self.dropped_total += 1
             return False
 
     def _drain_batch(self) -> List[metric_pb2.Metric]:
@@ -155,21 +188,38 @@ class Destination:
             batch = self._drain_batch()
             if not batch:
                 continue
+            self._token_seq += 1
+            token = f"dest:{self._token_id}:{self._token_seq}"
             try:
-                # proxy batches are <= self._batch small metrics, so
-                # RESOURCE_EXHAUSTED is far likelier transient receiver
-                # overload than an oversized body: retry via V2 but keep
-                # preferring V1; only UNIMPLEMENTED pins
-                self._v1_ok = send_batch(
-                    self._send_v1, self._send_v2, batch, 10.0,
-                    self._v1_ok,
-                    pin_codes=(grpc.StatusCode.UNIMPLEMENTED,),
-                    retry_codes=(grpc.StatusCode.RESOURCE_EXHAUSTED,))
-                self.sent_total += len(batch)
-                self.breaker.record_success()
-            except grpc.RpcError as e:
+                hedge_won = False
+                if self._hedge_after > 0 and self._hedge_peer is not None:
+                    # the chaos seam runs INSIDE the hedge-timed window
+                    # (chaos_forward_latency_ms makes THIS the slow
+                    # primary the budget fires against)
+                    hedge_won = self._send_hedged(batch, token)
+                else:
+                    # the forward_send chaos seam covers proxy senders
+                    # too: injected errors exercise the breaker and
+                    # ejection paths deterministically
+                    chaos_mod.inject("forward_send")
+                    self.send_now(batch, token)
+                if hedge_won:
+                    # the PEER delivered (and was credited inside
+                    # _send_hedged); the blown budget is a failure
+                    # signal for THIS node — a destination that never
+                    # completes inside the budget must eventually trip
+                    # its breaker so routing fails over instead of
+                    # paying hedge_after + a doubled RPC forever. No
+                    # close() here: probes/half-open own recovery.
+                    self.breaker.record_failure()
+                else:
+                    with self._counter_lock:
+                        self.sent_total += len(batch)
+                    self.breaker.record_success()
+            except (grpc.RpcError, ChaosError) as e:
                 self.breaker.record_failure()
-                self.dropped_total += len(batch)
+                with self._counter_lock:
+                    self.dropped_total += len(batch)
                 code = e.code() if hasattr(e, "code") else None
                 logger.warning("send to %s failed (%s), breaker %s",
                                self.address, code, self.breaker.state)
@@ -177,10 +227,109 @@ class Destination:
                     self.close(notify=True)
                     return
 
+    def send_now(self, batch, token: str, timeout: float = 10.0) -> None:
+        """One blocking batch send with the idempotency token attached —
+        also the entry point a PEER uses to deliver a hedged batch
+        through this destination's channel. Raises grpc.RpcError on
+        failure (the caller owns breaker/drop accounting).
+
+        Proxy batches are <= self._batch small metrics, so
+        RESOURCE_EXHAUSTED is far likelier transient receiver overload
+        than an oversized body: retry via V2 but keep preferring V1;
+        only UNIMPLEMENTED pins."""
+        self._v1_ok = send_batch(
+            self._send_v1, self._send_v2, batch, timeout,
+            self._v1_ok,
+            pin_codes=(grpc.StatusCode.UNIMPLEMENTED,),
+            retry_codes=(grpc.StatusCode.RESOURCE_EXHAUSTED,),
+            metadata=token_metadata(token))
+
+    def _send_hedged(self, batch, token: str,
+                     timeout: float = 10.0) -> bool:
+        """Primary send with a latency budget: past `hedge_after`
+        seconds the same batch (same token) fires at the next healthy
+        ring member. First success wins; the loser is cancelled. The
+        token makes a retry/hedge landing twice on ONE node merge once;
+        see the README's hedging caveats for the cross-node window.
+        Returns True when the PEER delivered the batch (the caller
+        treats that as a failure signal for this node's breaker).
+
+        The forward_send chaos seam runs inside the budget window, so
+        chaos_forward_latency_ms >= the budget deterministically fires
+        the hedge (the knob's reason to exist)."""
+        budget_start = time.monotonic()
+        chaos_mod.inject("forward_send")
+        md = token_metadata(token)
+        was_v1 = self._v1_ok
+        if was_v1:
+            body = b"".join(_frame_v1(m) for m in batch)
+            fut = self._send_v1.future(body, timeout=timeout, metadata=md)
+        else:
+            fut = self._send_v2.future(iter(batch), timeout=timeout,
+                                       metadata=md)
+        remaining = max(0.0, self._hedge_after
+                        - (time.monotonic() - budget_start))
+        try:
+            fut.result(timeout=remaining)
+            return False
+        except grpc.FutureTimeoutError:
+            pass  # primary slow: hedge below
+        except grpc.RpcError as e:
+            code = e.code() if hasattr(e, "code") else None
+            if was_v1 and code in (grpc.StatusCode.UNIMPLEMENTED,
+                                   grpc.StatusCode.RESOURCE_EXHAUSTED):
+                # V1 refusal: re-send through the SHARED transport
+                # helper (send_now -> wire.send_batch) so the pin/retry
+                # fallback policy lives in exactly one place; the token
+                # makes the repeat attempt duplicate-safe
+                self.send_now(batch, token, timeout=timeout)
+                return False
+            raise
+        peer = None
+        try:
+            peer = self._hedge_peer()
+        except Exception:
+            logger.exception("hedge peer selection failed")
+        if peer is None or peer is self or peer.closed.is_set():
+            fut.result()  # nobody to hedge to: wait out the primary
+            return False
+        self.hedge_fired_total += 1
+        logger.info("hedging slow send to %s via %s (budget %.3fs)",
+                    self.address, peer.address, self._hedge_after)
+        try:
+            peer.send_now(batch, token, timeout=timeout)
+        except (grpc.RpcError, ChaosError):
+            # hedge lost too: the primary is the last hope (may raise)
+            fut.result()
+            return False
+        self.hedge_wins_total += 1
+        # delivery is credited to the node that actually absorbed it
+        with peer._counter_lock:
+            peer.sent_total += len(batch)
+        fut.cancel()
+        return True
+
     def close(self, notify: bool = False) -> None:
         if self.closed.is_set():
             return
         self.closed.set()
+        # final drain BEFORE retiring the queue telemetry: items still
+        # queued at shutdown will never send — get()-ing them records
+        # their dwell into the (still-registered) observatory series and
+        # counts them as drops, instead of silently discarding both the
+        # samples and the accounting with the unregister
+        drained = 0
+        while True:
+            try:
+                self._queue.get_nowait()
+                drained += 1
+            except queue.Empty:
+                break
+        if drained:
+            with self._counter_lock:
+                self.dropped_total += drained
+            logger.info("destination %s closed with %d undelivered "
+                        "metrics (counted dropped)", self.address, drained)
         if self._observatory is not None:
             # retire the queue telemetry with the destination, or
             # discovery churn would grow the observatory unboundedly
@@ -201,7 +350,9 @@ class Destinations:
                  flush_interval: float = 0.5,
                  tls: Optional[GrpcTLS] = None,
                  max_consecutive_failures: int = 3,
-                 observatory=None):
+                 observatory=None,
+                 hedge_after: float = 0.0,
+                 failover_walk: int = 2):
         self._lock = threading.RLock()
         self._pool: Dict[str, Destination] = {}
         self.ring = ConsistentRing()
@@ -211,6 +362,30 @@ class Destinations:
         self._tls = tls
         self._max_failures = max_consecutive_failures
         self._observatory = observatory
+        self._hedge_after = max(0.0, float(hedge_after))
+        # bounded failover: how many ADDITIONAL ring members past the
+        # primary a sick key's lookup may walk; deterministic, so every
+        # proxy re-homes the key to the same survivor
+        self._failover_walk = max(0, int(failover_walk))
+        # health-ejected members: kept in the POOL (their sender drains
+        # and probes keep targeting them) but out of the RING, so no new
+        # keys hash there — and discovery re-adding the address must not
+        # sneak it back into the ring before the prober readmits it
+        self._ejected: set = set()
+        self.failover_routed_total = 0
+        # point -> (survivor address, stamp): while a primary is sick
+        # but not yet health-ejected, every routed metric would re-walk
+        # the ring for the same answer — memoized for a short TTL (the
+        # window itself ends at ejection, which removes the node from
+        # the ring and makes normal hashing correct again)
+        self._failover_cache: Dict[int, tuple] = {}
+        # counters of destinations that left the pool (self-closed on
+        # breaker open, or dropped by discovery): without this fold the
+        # pool's lifetime sent/dropped accounting silently resets on
+        # churn — exactly when an operator is trying to balance a loss
+        self.retired_sent_total = 0
+        self.retired_dropped_total = 0
+        self.retired_shed_open_total = 0
 
     def set_destinations(self, addresses: List[str]) -> None:
         """Reconcile the pool with a fresh discovery result."""
@@ -226,19 +401,34 @@ class Destinations:
                         send_buffer=self._send_buffer, batch=self._batch,
                         flush_interval=self._flush_interval, tls=self._tls,
                         max_consecutive_failures=self._max_failures,
-                        observatory=self._observatory)
-                    self.ring.add(address)
+                        observatory=self._observatory,
+                        hedge_after=self._hedge_after,
+                        hedge_peer=(lambda a=address:
+                                    self.hedge_peer_for(a)))
+                    if address not in self._ejected:
+                        self.ring.add(address)
 
     def addresses(self) -> List[str]:
         """Current pool membership (discovery/elasticity observability)."""
         with self._lock:
             return sorted(self._pool)
 
+    def _retire_locked(self, dest: Destination) -> None:
+        self.retired_sent_total += dest.sent_total
+        self.retired_dropped_total += dest.dropped_total
+        self.retired_shed_open_total += dest.shed_open_total
+
     def _remove_locked(self, address: str) -> None:
         dest = self._pool.pop(address, None)
         self.ring.remove(address)
+        # discovery dropped the member outright: clear its ejection so a
+        # future re-add starts fresh in the ring
+        self._ejected.discard(address)
         if dest is not None:
             dest.close()
+            # close() drained the queue into dropped_total, so the fold
+            # runs after it — nothing in flight escapes the accounting
+            self._retire_locked(dest)
 
     def _on_destination_closed(self, dest: Destination) -> None:
         """Self-removal on connection failure (destinations.go:99-110);
@@ -247,6 +437,48 @@ class Destinations:
             if self._pool.get(dest.address) is dest:
                 self._pool.pop(dest.address)
                 self.ring.remove(dest.address)
+                self._retire_locked(dest)
+
+    # -- health ejection (proxy/health.py drives these) ------------------
+
+    def eject(self, address: str) -> None:
+        """Take a member out of the RING (keys re-shard onto survivors)
+        while keeping its pool entry alive for probes and queue drain."""
+        with self._lock:
+            self._ejected.add(address)
+            self.ring.remove(address)
+
+    def readmit(self, address: str) -> None:
+        """Restore an ejected member's ring points — identical virtual
+        points recompute from the same address, so every key it owned
+        returns to it exactly."""
+        with self._lock:
+            self._ejected.discard(address)
+            if address in self._pool:
+                self.ring.add(address)
+
+    def ejected_addresses(self) -> List[str]:
+        with self._lock:
+            return sorted(self._ejected)
+
+    def hedge_peer_for(self, address: str) -> Optional[Destination]:
+        """The next healthy DISTINCT ring member clockwise from
+        `address`'s own first virtual point — the deterministic hedge
+        target for a slow primary."""
+        with self._lock:
+            try:
+                candidates = self.ring.walk_at(
+                    self.ring.point_of(address), len(self._pool) or 1)
+            except EmptyRingError:
+                return None
+            for candidate in candidates:
+                if candidate == address:
+                    continue
+                dest = self._pool.get(candidate)
+                if (dest is not None and not dest.closed.is_set()
+                        and dest.breaker.likely_dispatchable):
+                    return dest
+            return None
 
     def get(self, key: str) -> Destination:
         return self.get_at(self.ring.point_of(key))
@@ -254,10 +486,43 @@ class Destinations:
     def get_at(self, point: int) -> Destination:
         """Lookup by pre-computed ring point (ring.point_of): the proxy
         route cache stores points so the per-metric hot path skips the
-        Python fnv hash entirely."""
+        Python fnv hash entirely.
+
+        Failover: a healthy primary answers directly (ejected members
+        are already out of the ring, so this is the common path). A
+        primary whose breaker is open or whose sender closed re-homes
+        the key with a bounded deterministic walk to the next healthy
+        member — mergeable state keeps flowing through a partial outage
+        instead of shedding at the sick node's door."""
         with self._lock:
             address = self.ring.get_at(point)
             dest = self._pool.get(address)
+            # likely_dispatchable: lock-free in the common healthy case
+            # — this runs per routed metric, and send() re-checks the
+            # breaker authoritatively anyway
+            if (dest is not None and not dest.closed.is_set()
+                    and dest.breaker.likely_dispatchable):
+                return dest
+            now = time.monotonic()
+            cached = self._failover_cache.get(point)
+            if cached is not None and now - cached[1] < 1.0:
+                alt = self._pool.get(cached[0])
+                if (alt is not None and not alt.closed.is_set()
+                        and alt.breaker.likely_dispatchable):
+                    self.failover_routed_total += 1
+                    return alt
+            for candidate in self.ring.walk_at(
+                    point, self._failover_walk + 1)[1:]:
+                alt = self._pool.get(candidate)
+                if (alt is not None and not alt.closed.is_set()
+                        and alt.breaker.likely_dispatchable):
+                    self.failover_routed_total += 1
+                    if len(self._failover_cache) > 100_000:
+                        self._failover_cache.clear()
+                    self._failover_cache[point] = (candidate, now)
+                    return alt
+            # every walked member is sick: keep the primary's accounting
+            # (its send() sheds and counts) rather than inventing a drop
             if dest is None:
                 raise EmptyRingError(f"no destination for {address}")
             return dest
@@ -272,9 +537,24 @@ class Destinations:
         and breaker state."""
         with self._lock:
             pool = list(self._pool.values())
-        rows: List[tuple] = []
+            failover = self.failover_routed_total
+            retired = (self.retired_sent_total, self.retired_dropped_total,
+                       self.retired_shed_open_total)
+        rows: List[tuple] = [
+            ("proxy.ring.failover_routed", "counter", float(failover), ()),
+            # churn-proof totals: per-destination rows below reset when a
+            # destination is replaced; these fold in the retired ones
+            ("proxy.dest.retired_sent", "counter", float(retired[0]), ()),
+            ("proxy.dest.retired_dropped", "counter", float(retired[1]), ()),
+            ("proxy.dest.retired_shed_open", "counter",
+             float(retired[2]), ()),
+        ]
         for dest in pool:
             tags = [f"destination:{dest.address}"]
+            rows.append(("forward.hedge.fired", "counter",
+                         float(dest.hedge_fired_total), tags))
+            rows.append(("forward.hedge.wins", "counter",
+                         float(dest.hedge_wins_total), tags))
             rows.append(("proxy.dest.sent", "counter",
                          float(dest.sent_total), tags))
             rows.append(("proxy.dest.dropped", "counter",

@@ -43,13 +43,29 @@ class ProxyServer:
                  tls_listen_address: str = "",
                  destination_tls: Optional[GrpcTLS] = None,
                  max_consecutive_failures: int = 3,
-                 latency_observatory: bool = True):
+                 latency_observatory: bool = True,
+                 health_check_interval: float = 2.0,
+                 health_check_timeout: float = 1.0,
+                 health_unhealthy_after: int = 3,
+                 health_healthy_after: int = 2,
+                 health_probe: str = "tcp",
+                 health_http_url_template: str = "",
+                 hedge_after: float = 0.0,
+                 failover_walk: int = 2,
+                 telemetry=None):
         self.discoverer = discoverer
         self.forward_service = forward_service
         self.discovery_interval = discovery_interval
         self.shutdown_grace = 1.0  # stop() grace; the CLI overrides it
         # from shutdown_timeout
         self._ignore = list(ignore_tags or [])
+        # flight recorder: ejection/readmission (and any future proxy
+        # events) land here; the CLI shares this instance with its
+        # /metrics registry so the events surface at /debug/events
+        if telemetry is None:
+            from veneur_tpu.core.telemetry import Telemetry
+            telemetry = Telemetry()
+        self.telemetry = telemetry
         # latency observatory (core/latency.py): per-destination queue
         # dwell/depth — the proxy side of the queue.* telemetry; the
         # same latency_observatory knob the server honors turns it off
@@ -58,13 +74,40 @@ class ProxyServer:
         self.destinations = Destinations(
             send_buffer=send_buffer, batch=batch, tls=destination_tls,
             max_consecutive_failures=max_consecutive_failures,
-            observatory=self.latency)
+            observatory=self.latency,
+            hedge_after=hedge_after, failover_walk=failover_walk)
+        # active ring health: probes every pool member each round,
+        # ejecting/readmitting through the destination pool; membership
+        # (DNS/SRV et al) re-resolves on the same cadence via the
+        # discovery refresh hook. 0 disables the loop (tests drive
+        # run_round() by hand).
+        self.ring_health = None
+        if health_check_interval > 0:
+            from veneur_tpu.proxy.health import RingHealth
+            self.ring_health = RingHealth(
+                self.destinations,
+                interval=health_check_interval,
+                timeout=health_check_timeout,
+                unhealthy_after=health_unhealthy_after,
+                healthy_after=health_healthy_after,
+                probe=health_probe,
+                http_url_template=health_http_url_template,
+                refresh=self._refresh_destinations,
+                on_event=self.telemetry.record_event)
         # per-RPC latency/error aggregates (reference proxy/grpcstats)
         self.rpc_stats = RpcStats()
         self.stats: Dict[str, int] = {
             "received_total": 0, "routed_total": 0,
             "no_destination_total": 0, "dropped_total": 0,
+            "duplicates_dropped_total": 0,
         }
+        # idempotency-token dedupe at the PROXY boundary: a local's
+        # retry whose first attempt already routed here would otherwise
+        # be re-routed with fresh per-destination tokens the global
+        # tier can't catch — the exactly-once-per-node property must
+        # hold at whichever tier terminates the sender's RPC
+        from veneur_tpu.forward.wire import TokenDeduper
+        self._deduper = TokenDeduper()
         # identity-key bytes -> (ring POINT, 64-bit key hash): forward
         # streams repeat the same keys every interval, so ring-key
         # derivation (tag filtering, type naming, joining), its ring
@@ -146,11 +189,15 @@ class ProxyServer:
         self._discovery_thread = threading.Thread(
             target=self._discovery_loop, name="proxy-discovery", daemon=True)
         self._discovery_thread.start()
+        if self.ring_health is not None:
+            self.ring_health.start()
         logger.info("proxy listening on %s (%d destinations)",
                     self.address, self.destinations.size())
 
     def stop(self, grace: float = 1.0) -> None:
         self._shutdown.set()
+        if self.ring_health is not None:
+            self.ring_health.stop()
         self._grpc.stop(grace)
         self.destinations.flush_wait(timeout=grace)
         self.destinations.clear()
@@ -158,6 +205,34 @@ class ProxyServer:
     def healthy(self) -> bool:
         """False while no destinations are connected (handlers.go:30-38)."""
         return self.destinations.size() > 0
+
+    def ready_state(self):
+        """(ready, body) for the proxy's /healthcheck/ready: 503 while
+        the ring is empty OR more than half its members are ejected —
+        the mirror of the server's shedding semantics (an instance that
+        would blackhole most of the keyspace should stop receiving
+        traffic). The body always carries the member table so the
+        operator sees WHICH globals are sick from the probe itself."""
+        members = (self.ring_health.member_table()
+                   if self.ring_health is not None else [])
+        if not members:
+            # no probe round has run yet (or probing is disabled): fall
+            # back to pool membership so a healthy just-started proxy
+            # doesn't answer 503 for a whole probe interval
+            members = [{"address": a, "ejected": False}
+                       for a in self.destinations.addresses()]
+        total = len(members)
+        ejected = sum(1 for m in members if m.get("ejected"))
+        body = {"destinations": total, "ejected": ejected,
+                "members": members}
+        if total == 0:
+            body["reason"] = "no destinations connected"
+            return False, body
+        if ejected * 2 > total:
+            body["reason"] = (f"{ejected}/{total} ring members ejected "
+                              "(>50%)")
+            return False, body
+        return True, body
 
     def telemetry_rows(self) -> List[tuple]:
         """Scrape-time rows for /metrics: routing counters plus the
@@ -169,6 +244,8 @@ class ProxyServer:
         rows.append(("proxy.destinations", "gauge",
                      float(self.destinations.size()), ()))
         rows.extend(self.destinations.telemetry_rows())
+        if self.ring_health is not None:
+            rows.extend(self.ring_health.telemetry_rows())
         rows.extend(self.latency.telemetry_rows())
         return rows
 
@@ -232,10 +309,23 @@ class ProxyServer:
     # -- handlers --------------------------------------------------------
 
     def _send_metrics_v1(self, body, ctx):
-        if self._route_native(body) is None:
-            metric_list = forward_pb2.MetricList.FromString(body)
-            for pbm in metric_list.metrics:
-                self.handle_metric(pbm)
+        token, disposition = self._deduper.begin(ctx)
+        if disposition == "done":
+            with self._stats_lock:
+                self.stats["duplicates_dropped_total"] += 1
+            return b""
+        if disposition == "inflight":
+            ctx.abort(grpc.StatusCode.UNAVAILABLE,
+                      "duplicate send racing its first attempt")
+        ok = False
+        try:
+            if self._route_native(body) is None:
+                metric_list = forward_pb2.MetricList.FromString(body)
+                for pbm in metric_list.metrics:
+                    self.handle_metric(pbm)
+            ok = True
+        finally:
+            self._deduper.end(token, ok)
         return b""
 
     def _route_native(self, body) -> Optional[int]:
@@ -309,8 +399,23 @@ class ProxyServer:
         return len(keys)
 
     def _send_metrics_v2(self, request_iterator, ctx):
-        for pbm in request_iterator:
-            self.handle_metric(pbm)
+        token, disposition = self._deduper.begin(ctx)
+        if disposition == "done":
+            with self._stats_lock:
+                self.stats["duplicates_dropped_total"] += 1
+            for _ in request_iterator:  # complete the sender's stream
+                pass
+            return b""
+        if disposition == "inflight":
+            ctx.abort(grpc.StatusCode.UNAVAILABLE,
+                      "duplicate send racing its first attempt")
+        ok = False
+        try:
+            for pbm in request_iterator:
+                self.handle_metric(pbm)
+            ok = True
+        finally:
+            self._deduper.end(token, ok)
         return b""
 
     def handle_metric(self, pbm: metric_pb2.Metric) -> None:

@@ -94,6 +94,27 @@ class ConsistentRing:
                 idx = 0
             return self._owner[self._points[idx]]
 
+    def walk_at(self, point: int, max_members: int) -> List[str]:
+        """Up to `max_members` DISTINCT members clockwise from `point`,
+        primary first — the deterministic failover order: every proxy
+        with the same membership walks the same sequence, so a key whose
+        primary is sick lands on the same healthy node cluster-wide."""
+        with self._lock:
+            if not self._points:
+                raise EmptyRingError("empty consistent-hash ring")
+            out: List[str] = []
+            seen = set()
+            idx = bisect.bisect_right(self._points, point)
+            n = len(self._points)
+            for step in range(n):
+                member = self._owner[self._points[(idx + step) % n]]
+                if member not in seen:
+                    seen.add(member)
+                    out.append(member)
+                    if len(out) >= max_members:
+                        break
+            return out
+
     def get_two(self, key: str) -> tuple:
         """The owner and the next distinct member clockwise (for
         replicated sends; reference ring offers Get/GetTwo/GetN)."""

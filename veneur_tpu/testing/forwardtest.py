@@ -12,7 +12,11 @@ from veneur_tpu.forward.protos import metric_pb2
 
 
 class ForwardTestServer:
-    def __init__(self, handler: Callable[[List[metric_pb2.Metric]], None]):
+    def __init__(self, handler: Callable[[List[metric_pb2.Metric]], None],
+                 address: str = "127.0.0.1:0"):
+        # a fixed `address` lets kill/restore tests re-bind the SAME
+        # port a stopped instance held (grpc listeners use SO_REUSEADDR),
+        # so a reconnecting client/destination finds the "restarted node"
         self._handler = handler
         self._grpc = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
         h = grpc.method_handlers_generic_handler("forwardrpc.Forward", {
@@ -22,7 +26,9 @@ class ForwardTestServer:
                 response_serializer=lambda _: b""),
         })
         self._grpc.add_generic_rpc_handlers((h,))
-        self.port = self._grpc.add_insecure_port("127.0.0.1:0")
+        self.port = self._grpc.add_insecure_port(address)
+        if self.port == 0:
+            raise RuntimeError(f"could not bind test server to {address}")
 
     @property
     def address(self) -> str:

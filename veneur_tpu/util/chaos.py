@@ -42,7 +42,7 @@ from typing import Dict, Optional, Sequence
 
 logger = logging.getLogger("veneur_tpu.util.chaos")
 
-SEAMS = ("forward_send", "sink_flush", "http_post")
+SEAMS = ("forward_send", "sink_flush", "http_post", "health_probe")
 
 
 class ChaosError(RuntimeError):
@@ -62,6 +62,7 @@ class Chaos:
     def __init__(self, enabled: bool = True, error_rate: float = 0.0,
                  delay_rate: float = 0.0, delay: float = 0.0,
                  seams: Sequence[str] = SEAMS, seed: int = 0,
+                 forward_latency_ms: float = 0.0,
                  ingest_drop_rate: float = 0.0,
                  ingest_truncate_rate: float = 0.0,
                  ingest_duplicate_rate: float = 0.0,
@@ -71,6 +72,12 @@ class Chaos:
         self.error_rate = min(1.0, max(0.0, float(error_rate)))
         self.delay_rate = min(1.0, max(0.0, float(delay_rate)))
         self.delay = max(0.0, float(delay))
+        # deterministic slow-destination seam: EVERY forward_send (and
+        # every proxy destination send, which shares the seam) sleeps
+        # this long before the real I/O — no RNG roll, so hedging
+        # latency budgets and health-probe timeouts are testable without
+        # a probabilistic soak. Independent of delay/delay_rate.
+        self.forward_latency_ms = max(0.0, float(forward_latency_ms))
         self.seams = frozenset(seams or SEAMS)
         self.ingest_drop_rate = min(1.0, max(0.0, float(ingest_drop_rate)))
         self.ingest_truncate_rate = min(
@@ -97,6 +104,8 @@ class Chaos:
                    delay=config.chaos_delay,
                    seams=config.chaos_seams or SEAMS,
                    seed=config.chaos_seed,
+                   forward_latency_ms=getattr(
+                       config, "chaos_forward_latency_ms", 0.0),
                    ingest_drop_rate=getattr(
                        config, "chaos_ingest_drop_rate", 0.0),
                    ingest_truncate_rate=getattr(
@@ -111,6 +120,13 @@ class Chaos:
         the egress thread right before the real I/O."""
         if not self.enabled or seam not in self.seams:
             return
+        if self.forward_latency_ms > 0 and seam == "forward_send":
+            # deterministic (not rolled) slow-destination delay; counted
+            # with the probabilistic delays so a soak's accounting sums
+            with self._lock:
+                self.injected_delays[seam] = \
+                    self.injected_delays.get(seam, 0) + 1
+            self._sleep(self.forward_latency_ms / 1000.0)
         with self._lock:
             delay = (self.delay_rate > 0 and self.delay > 0
                      and self._rng.random() < self.delay_rate)

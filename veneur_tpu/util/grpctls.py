@@ -70,6 +70,19 @@ class GrpcTLS:
             root_certificates=ca, private_key=key, certificate_chain=cert)
 
 
+# Reconnect backoff cap shared by every forward-plane dialer (local
+# client AND proxy destinations). Load-bearing for the HA design: grpc's
+# default backoff climbs past 20s after an outage, which would keep a
+# freshly-restored global looking dead for whole flush intervals /
+# probe rounds — recovery must land at probe speed, and both tiers must
+# agree on it.
+RECONNECT_BACKOFF_OPTIONS = (
+    ("grpc.initial_reconnect_backoff_ms", 250),
+    ("grpc.min_reconnect_backoff_ms", 250),
+    ("grpc.max_reconnect_backoff_ms", 2000),
+)
+
+
 def secure_or_insecure_channel(address: str, tls: Optional[GrpcTLS],
                                **kwargs):
     """Dial helper shared by the forward client and proxy destinations."""

@@ -165,6 +165,19 @@ class CircuitBreaker:
             return self._state != OPEN
 
     @property
+    def likely_dispatchable(self) -> bool:
+        """Lock-free fast path for per-metric ROUTING decisions: the
+        common healthy case (CLOSED) answers with a single racy state
+        read and zero lock round-trips; only an OPEN breaker pays the
+        lock (to tick into half-open when recovery has elapsed). Racy
+        by design — the send path re-checks `is_dispatchable`
+        authoritatively, so a stale answer costs at worst one metric
+        routed to a node that sheds it (counted)."""
+        if self._state != OPEN:
+            return True
+        return self.is_dispatchable
+
+    @property
     def consecutive_failures(self) -> int:
         """Current failure streak (0 while healthy) — producers use it
         to stop extending courtesies (blocking waits) to a peer that is
@@ -335,14 +348,22 @@ class Carryover:
     telemetry scraper reads `depth` concurrently.
     """
 
-    def __init__(self, max_intervals: int = 3):
+    def __init__(self, max_intervals: int = 3, spill=None):
         self.max_intervals = max(0, int(max_intervals))
+        # optional durable overflow (util/spool.py, wired by the forward
+        # client): state that would be SHED at the age bound is handed
+        # to `spill(state)` instead — serialized to the on-disk spool
+        # and re-delivered when the destination recovers. A spill that
+        # raises falls back to the loud shed, never silent loss of the
+        # loss-accounting.
+        self.spill = spill
         self._lock = threading.Lock()
         self._pending = None          # merged ForwardableState of failures
         self._age = 0                 # consecutive failed intervals held
         self.stashed_total = 0        # intervals stashed
         self.merged_total = 0         # metrics re-merged into a snapshot
         self.shed_total = 0           # metrics dropped at the age bound
+        self.spilled_total = 0        # metrics handed to the spill hook
 
     @property
     def depth(self) -> int:
@@ -356,6 +377,7 @@ class Carryover:
         drain-merge-send-stash cycle, the flush loop stashes intervals
         it could not even dispatch (previous forward still hung), and
         those writers race."""
+        overflow = None
         with self._lock:
             if self.max_intervals <= 0:
                 self.shed_total += len(fwd)
@@ -369,13 +391,32 @@ class Carryover:
             self._age += 1
             self.stashed_total += 1
             if self._age > self.max_intervals:
-                shed, self._pending = self._pending, None
+                overflow, self._pending = self._pending, None
                 self._age = 0
-                self.shed_total += len(shed)
-                logger.error(
-                    "carryover exceeded %d intervals: shedding %d "
-                    "forwardable metrics (counter deltas in them are "
-                    "permanently lost)", self.max_intervals, len(shed))
+        if overflow is None:
+            return
+        # past the age bound: spill to the durable spool when one is
+        # wired, shed loudly otherwise. The spill (serialization + disk
+        # write) runs OUTSIDE the lock — telemetry scrapers reading
+        # `depth` must never wait on an fsync.
+        if self.spill is not None:
+            try:
+                self.spill(overflow)
+                with self._lock:
+                    self.spilled_total += len(overflow)
+                logger.warning(
+                    "carryover exceeded %d intervals: spilled %d "
+                    "forwardable metrics to the durable spool",
+                    self.max_intervals, len(overflow))
+                return
+            except Exception:
+                logger.exception("carryover spill failed; shedding")
+        with self._lock:
+            self.shed_total += len(overflow)
+        logger.error(
+            "carryover exceeded %d intervals: shedding %d "
+            "forwardable metrics (counter deltas in them are "
+            "permanently lost)", self.max_intervals, len(overflow))
 
     def drain_into(self, fwd):
         """Fold any pending carryover into this interval's snapshot and

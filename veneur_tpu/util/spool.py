@@ -1,0 +1,343 @@
+"""Durable carryover spill: a bounded on-disk spool of forward intervals.
+
+In-memory carryover (util/resilience.py) is bounded to
+`carryover_max_intervals` because an unbounded merge would grow without
+limit under a long global-tier outage — but past the bound it SHEDS, and
+shed counter deltas are permanently lost. Because every forwarded family
+merges associatively and commutatively (counters sum, t-digests
+recompress, HLL/llhist registers max/add — the bit-exactness the forward
+interop tests pin), a failed interval's state is just as valid delivered
+minutes later from disk as seconds later from memory. This module is
+that escape hatch: when carryover hits its bound, the merged
+ForwardableState is serialized to metricpb wire bytes (the SAME encoding
+a forward send uses, `forward.convert.forwardable_to_wire`) and appended
+to a bounded directory spool instead of shed.
+
+Segments are drained oldest-first by the forward client once the
+destination recovers (each segment body is already a valid
+SendMetrics V1 MetricList framing), and a process restart (including
+PR 3's SIGUSR2 handoff) simply re-scans the directory — a crash mid-
+outage loses nothing that reached disk.
+
+Bounded loudly, like everything else in the resilience layer: past
+`max_segments` or `max_bytes` the OLDEST segments are dropped (counted,
+logged) so the newest state — the most likely to still matter — wins.
+
+stdlib-only; no jax, no grpc (the caller hands in pre-serialized wire
+bytes and gets them back).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+import uuid
+from typing import List, Optional, Tuple
+
+logger = logging.getLogger("veneur_tpu.util.spool")
+
+_SEGMENT_SUFFIX = ".vspool"
+_HEADER_MAX = 4096  # sanity bound on the JSON header line
+
+
+def frame_metrics(metrics: List[bytes]) -> bytes:
+    """Concatenated MetricList `metrics` entries (field 1,
+    length-delimited): the V1 forward body framing, inlined here so the
+    spool stays grpc-free."""
+    out = []
+    for b in metrics:
+        n = len(b)
+        out.append(b"\x0a")
+        while n >= 0x80:
+            out.append(bytes((n & 0x7F | 0x80,)))
+            n >>= 7
+        out.append(bytes((n,)))
+        out.append(b)
+    return b"".join(out)
+
+
+def unframe_metrics(body: bytes) -> List[bytes]:
+    """Inverse of frame_metrics: split a MetricList body back into
+    per-Metric wire bytes. Raises ValueError on malformed framing (a
+    truncated segment from a crash mid-write never reaches the sender —
+    append() is write-tmp-then-rename, so this only fires on external
+    corruption)."""
+    out: List[bytes] = []
+    i, n = 0, len(body)
+    while i < n:
+        if body[i] != 0x0A:
+            raise ValueError(f"bad MetricList frame tag at {i}")
+        i += 1
+        size = shift = 0
+        while True:
+            if i >= n:
+                raise ValueError("truncated frame length")
+            byte = body[i]
+            i += 1
+            size |= (byte & 0x7F) << shift
+            if not byte & 0x80:
+                break
+            shift += 7
+            if shift > 35:
+                raise ValueError("frame length varint overflow")
+        if i + size > n:
+            raise ValueError("truncated frame body")
+        out.append(body[i:i + size])
+        i += size
+    return out
+
+
+class SpoolSegment:
+    """One on-disk spill: a JSON header line + a MetricList body."""
+
+    __slots__ = ("path", "created_unix", "count", "nbytes")
+
+    def __init__(self, path: str, created_unix: float, count: int,
+                 nbytes: int):
+        self.path = path
+        self.created_unix = created_unix
+        self.count = count
+        self.nbytes = nbytes
+
+    def read_metrics(self) -> List[bytes]:
+        with open(self.path, "rb") as f:
+            f.readline()  # header
+            return unframe_metrics(f.read())
+
+
+class CarryoverSpool:
+    """Bounded directory spool of spilled forward intervals.
+
+    Thread-safe. `append` is called from whatever thread trips the
+    carryover bound (the forward thread or the flush loop); `oldest`/
+    `pop` from the forward thread's drain; counters from the telemetry
+    scraper."""
+
+    def __init__(self, directory: str,
+                 max_bytes: int = 256 * 1024 * 1024,
+                 max_segments: int = 1024,
+                 dwell_hist=None):
+        self.directory = directory
+        self.max_bytes = max(0, int(max_bytes))
+        self.max_segments = max(1, int(max_segments))
+        # optional latency-observatory llhist: spill->drain dwell rides
+        # the shared queue.dwell telemetry under the caller's queue name
+        self._dwell_hist = dwell_hist
+        self._lock = threading.Lock()
+        # serializes whole append() bodies: seq assignment, the disk
+        # write, and the publish must be one atomic unit or concurrent
+        # spills (forward thread + flush loop both stash) could order
+        # _segments out of seq order — and the bound shed would then
+        # evict a NEWER segment while believing it took the oldest
+        self._append_lock = threading.Lock()
+        self._segments: List[SpoolSegment] = []
+        self._seq = 0
+        self.spilled_total = 0          # segments written
+        self.spilled_metrics_total = 0  # metrics across them
+        self.drained_total = 0          # segments delivered and removed
+        self.drained_metrics_total = 0
+        self.shed_total = 0             # segments dropped at the bound
+        self.shed_metrics_total = 0
+        self.replayed_total = 0         # segments recovered at startup
+        os.makedirs(directory, exist_ok=True)
+        self._scan()
+
+    # -- startup replay --------------------------------------------------
+
+    def _scan(self) -> None:
+        """Recover segments left by a previous process (crash or SIGUSR2
+        handoff mid-outage). Unreadable files are quarantined aside, not
+        deleted — loud beats silent for data that exists because of a
+        failure."""
+        found: List[Tuple[str, SpoolSegment]] = []
+        for name in os.listdir(self.directory):
+            if not name.endswith(_SEGMENT_SUFFIX):
+                continue
+            path = os.path.join(self.directory, name)
+            seg = self._read_header(path)
+            if seg is None:
+                bad = path + ".corrupt"
+                logger.error("spool segment %s unreadable; set aside as %s",
+                             path, bad)
+                try:
+                    os.replace(path, bad)
+                except OSError:
+                    pass
+                continue
+            found.append((name, seg))
+        found.sort(key=lambda pair: pair[0])  # seq-prefixed names: oldest first
+        # seed the sequence PAST everything on disk: a fresh process
+        # restarting at seq 1 would interleave its segment names with a
+        # predecessor's, breaking the oldest-first drain/shed ordering
+        # the zero-padded prefix exists to give
+        max_seq = 0
+        for name, _seg in found:
+            try:
+                max_seq = max(max_seq, int(name.split("-")[1]))
+            except (IndexError, ValueError):
+                pass
+        with self._lock:
+            self._segments = [seg for _, seg in found]
+            self._seq = max(self._seq, max_seq)
+            self.replayed_total = len(found)
+        if found:
+            logger.warning(
+                "carryover spool: replaying %d segment(s) (%d metrics) "
+                "left by a previous process", len(found),
+                sum(seg.count for _, seg in found))
+
+    @staticmethod
+    def _read_header(path: str) -> Optional[SpoolSegment]:
+        try:
+            with open(path, "rb") as f:
+                header = f.readline(_HEADER_MAX)
+                meta = json.loads(header)
+                nbytes = os.fstat(f.fileno()).st_size
+            return SpoolSegment(path, float(meta["created_unix"]),
+                                int(meta["count"]), nbytes)
+        except (OSError, ValueError, KeyError):
+            return None
+
+    # -- state -----------------------------------------------------------
+
+    @property
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._segments)
+
+    @property
+    def nbytes(self) -> int:
+        with self._lock:
+            return sum(seg.nbytes for seg in self._segments)
+
+    # -- spill -----------------------------------------------------------
+
+    def append(self, metrics: List[bytes]) -> int:
+        """Spill one interval's serialized metrics as a new segment;
+        returns the count written. Atomic (tmp + rename) so a crash
+        mid-spill leaves either a whole segment or none."""
+        if not metrics:
+            return 0
+        with self._append_lock:
+            return self._append_locked(metrics)
+
+    def _append_locked(self, metrics: List[bytes]) -> int:
+        body = frame_metrics(metrics)
+        created = time.time()
+        header = json.dumps({"created_unix": round(created, 3),
+                             "count": len(metrics)}).encode() + b"\n"
+        with self._lock:
+            self._seq += 1
+            name = f"spill-{self._seq:08d}-{uuid.uuid4().hex[:8]}"
+        path = os.path.join(self.directory, name + _SEGMENT_SUFFIX)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(header)
+            f.write(body)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        # the rename itself must reach disk too, or a power loss leaves
+        # a segment that was counted "spilled" (not shed) yet vanishes
+        # from the restart scan — the durability the spool exists for
+        try:
+            dirfd = os.open(self.directory, os.O_RDONLY)
+            try:
+                os.fsync(dirfd)
+            finally:
+                os.close(dirfd)
+        except OSError:
+            pass  # non-POSIX dir-fsync (or odd fs): best effort
+        seg = SpoolSegment(path, created, len(metrics),
+                           len(header) + len(body))
+        shed: List[SpoolSegment] = []
+        with self._lock:
+            self._segments.append(seg)
+            self.spilled_total += 1
+            self.spilled_metrics_total += len(metrics)
+            total = sum(s.nbytes for s in self._segments)
+            while (len(self._segments) > self.max_segments
+                   or (self.max_bytes and total > self.max_bytes)) \
+                    and len(self._segments) > 1:
+                victim = self._segments.pop(0)
+                total -= victim.nbytes
+                shed.append(victim)
+                self.shed_total += 1
+                self.shed_metrics_total += victim.count
+        for victim in shed:
+            logger.error(
+                "carryover spool over bound: shedding oldest segment %s "
+                "(%d metrics — counter deltas in it are permanently lost)",
+                victim.path, victim.count)
+            try:
+                os.unlink(victim.path)
+            except OSError:
+                pass
+        return len(metrics)
+
+    # -- drain -----------------------------------------------------------
+
+    def live_paths(self) -> set:
+        with self._lock:
+            return {seg.path for seg in self._segments}
+
+    def oldest(self) -> Optional[SpoolSegment]:
+        with self._lock:
+            return self._segments[0] if self._segments else None
+
+    def pop(self, seg: SpoolSegment) -> None:
+        """Remove a successfully-delivered segment and observe its
+        spill->drain dwell."""
+        with self._lock:
+            try:
+                self._segments.remove(seg)
+            except ValueError:
+                return
+            self.drained_total += 1
+            self.drained_metrics_total += seg.count
+        if self._dwell_hist is not None:
+            self._dwell_hist.observe(max(0.0, time.time() - seg.created_unix))
+        try:
+            os.unlink(seg.path)
+        except OSError:
+            logger.warning("could not unlink drained spool segment %s",
+                           seg.path)
+
+    def discard(self, seg: SpoolSegment) -> None:
+        """Drop an undeliverable (corrupt) segment without counting it
+        drained."""
+        with self._lock:
+            try:
+                self._segments.remove(seg)
+            except ValueError:
+                return
+            self.shed_total += 1
+            self.shed_metrics_total += seg.count
+        bad = seg.path + ".corrupt"
+        try:
+            os.replace(seg.path, bad)
+        except OSError:
+            pass
+
+    # -- telemetry -------------------------------------------------------
+
+    def telemetry_rows(self) -> List[tuple]:
+        with self._lock:
+            depth = len(self._segments)
+            nbytes = sum(s.nbytes for s in self._segments)
+            rows = [
+                ("carryover.spool.depth", "gauge", float(depth), ()),
+                ("carryover.spool.bytes", "gauge", float(nbytes), ()),
+                ("carryover.spool.spilled", "counter",
+                 float(self.spilled_metrics_total), ()),
+                ("carryover.spool.drained", "counter",
+                 float(self.drained_metrics_total), ()),
+                ("carryover.spool.shed", "counter",
+                 float(self.shed_metrics_total), ()),
+                ("carryover.spool.replayed", "counter",
+                 float(self.replayed_total), ()),
+            ]
+        return rows
