@@ -26,6 +26,11 @@ histogram inventory that way) expands each base name to the
 ``.p50``/``.p99``/``.max``/``.count`` rows the observatory renders into
 /metrics, and every expanded name must be documented.
 
+Flow-ledger rows ride the same contract: core/ledger.py declares its
+dynamically-rendered series (imbalance gauges, stage totals) in a
+module-level ``LEDGER_ROWS = ("name", ...)`` tuple; each name is linted
+verbatim against the inventory.
+
 Usage: python scripts/check_metric_names.py [--repo DIR]
 Exit codes: 0 ok, 1 undocumented metrics found, 2 could not parse docs.
 """
@@ -73,16 +78,21 @@ def emitted_names(root: pathlib.Path):
             print(f"warning: could not parse {path}: {e}", file=sys.stderr)
             continue
         for node in ast.walk(tree):
-            # observatory llhist inventory: HIST_ROWS = ("base", ...)
-            # expands to the .p50/.p99/.max/.count rows it renders
+            # declared inventories: HIST_ROWS = ("base", ...) expands
+            # to the .p50/.p99/.max/.count rows the observatory
+            # renders; LEDGER_ROWS = ("name", ...) names the flow
+            # ledger's dynamically-rendered rows verbatim
             if isinstance(node, ast.Assign):
-                if any(isinstance(t, ast.Name) and t.id == "HIST_ROWS"
-                       for t in node.targets) \
+                names = {t.id for t in node.targets
+                         if isinstance(t, ast.Name)}
+                if names & {"HIST_ROWS", "LEDGER_ROWS"} \
                         and isinstance(node.value, (ast.Tuple, ast.List)):
+                    suffixes = (HIST_ROW_SUFFIXES if "HIST_ROWS" in names
+                                else ("",))
                     for el in node.value.elts:
                         if isinstance(el, ast.Constant) \
                                 and isinstance(el.value, str):
-                            for suffix in HIST_ROW_SUFFIXES:
+                            for suffix in suffixes:
                                 yield (path, node.lineno,
                                        el.value + suffix, False)
                 continue

@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""Pretty-print a flow-ledger conservation report.
+
+Reads ``GET /debug/ledger`` from a live veneur-tpu server or proxy —
+or a saved JSON file — and renders the conservation books as text: one
+identity table (inputs / outputs / stocks / net unexplained imbalance),
+the lifetime stage totals, and a per-interval waterfall of the last N
+closed intervals with their imbalances flagged.
+
+Usage:
+    python scripts/flow_report.py http://127.0.0.1:8127/debug/ledger
+    python scripts/flow_report.py http://host:8127 --intervals 8
+    python scripts/flow_report.py saved-ledger.json
+
+Exit codes: 0 = every identity balanced (net unexplained == 0),
+1 = nonzero unexplained imbalance somewhere, 2 = could not read input.
+
+stdlib-only (urllib) so it runs anywhere the operator has Python.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List
+
+BAL = 1e-6
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return "-"
+    f = float(v)
+    return str(int(f)) if f.is_integer() else f"{f:g}"
+
+
+def load_report(source: str, intervals: int = 0) -> dict:
+    """Fetch the report from a URL (``/debug/ledger`` appended when the
+    path is missing) or read it from a JSON file."""
+    if source.startswith(("http://", "https://")):
+        from urllib.request import urlopen
+        url = source
+        if "/debug/ledger" not in url:
+            url = url.rstrip("/") + "/debug/ledger"
+        if intervals:
+            sep = "&" if "?" in url else "?"
+            url = f"{url}{sep}intervals={intervals}"
+        with urlopen(url, timeout=10) as resp:
+            return json.loads(resp.read())
+    with open(source) as f:
+        return json.loads(f.read())
+
+
+def format_report(report: dict, intervals: int = 0) -> str:
+    """The full text rendering (separated from main for the smoke
+    test: feed it a server's ledger.report() and eyeball the table)."""
+    lines: List[str] = []
+    add = lines.append
+    add("flow ledger — conservation report")
+    add(f"  intervals closed: {report.get('intervals_closed', 0)}"
+        f"   strict: {report.get('strict', False)}"
+        f"   enabled: {report.get('enabled', True)}")
+    add("")
+    idents = report.get("identities", {})
+    add("identities (inflow + opening == outflow + closing):")
+    for name in sorted(idents):
+        spec = idents[name]
+        net = float(spec.get("imbalance_net", 0.0))
+        total = float(spec.get("unexplained_total", 0.0))
+        flag = "  OK" if total <= BAL else "  ** UNEXPLAINED **"
+        add(f"  {name}: net {_fmt(net)}  "
+            f"unexplained {_fmt(total)}{flag}")
+        add(f"    in:     {' + '.join(spec.get('inputs', [])) or '-'}")
+        add(f"    out:    {' + '.join(spec.get('outputs', [])) or '-'}")
+        if spec.get("stocks"):
+            add(f"    stocks: {', '.join(spec['stocks'])}")
+    add("")
+    stocks = report.get("stocks", {})
+    if stocks:
+        add("live stocks:")
+        for name in sorted(stocks):
+            add(f"  {name}: {_fmt(stocks[name])}")
+        add("")
+    totals = report.get("stage_totals", {})
+    if totals:
+        add("lifetime stage totals:")
+        for stage in sorted(totals):
+            per_key = totals[stage]
+            detail = ", ".join(
+                f"{k or 'total'}={_fmt(v)}"
+                for k, v in sorted(per_key.items()))
+            add(f"  {stage}: {detail}")
+        add("")
+    history = report.get("intervals", [])
+    if intervals:
+        history = history[-intervals:]
+    if history:
+        add(f"last {len(history)} interval(s), oldest first:")
+        for rec in history:
+            imb = rec.get("imbalance", {})
+            bad = {k: v for k, v in imb.items() if abs(float(v)) > BAL}
+            mark = f"  ** {bad} **" if bad else "  ok"
+            add(f"  #{rec.get('interval')}  "
+                f"closed={_fmt(rec.get('closed_unix'))}{mark}")
+            for stage in sorted(rec.get("stages", {})):
+                per_key = rec["stages"][stage]
+                total = sum(float(v) for v in per_key.values())
+                add(f"      {stage}: {_fmt(total)}")
+    return "\n".join(lines)
+
+
+def net_unexplained(report: dict) -> float:
+    """Cumulative unexplained imbalance across identities — the
+    lifetime |imbalance| sum, NOT the net (two opposite-sign leaks must
+    not self-cancel into a clean exit code)."""
+    return sum(float(spec.get("unexplained_total", 0.0))
+               for spec in report.get("identities", {}).values())
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("source",
+                        help="ledger URL (http://host:port[/debug/ledger])"
+                             " or a saved JSON file")
+    parser.add_argument("--intervals", type=int, default=0,
+                        help="show only the last N intervals")
+    args = parser.parse_args(argv)
+    try:
+        report = load_report(args.source, args.intervals)
+    except Exception as e:
+        print(f"error: could not read {args.source}: {e}", file=sys.stderr)
+        return 2
+    print(format_report(report, args.intervals))
+    return 0 if net_unexplained(report) <= BAL else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

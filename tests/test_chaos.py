@@ -177,7 +177,13 @@ class TestForwardChaos:
                 # the soak must never shed or trip the breaker — losses
                 # would be legitimate then, and we are pinning zero loss
                 carryover_max_intervals=1000,
-                circuit_breaker_failure_threshold=10_000)
+                circuit_breaker_failure_threshold=10_000,
+                # the flow ledger replaces bespoke per-seam counting:
+                # strict mode makes ANY unexplained imbalance raise out
+                # of flush(), so every interval of the soak is a
+                # conservation check
+                ledger_strict=True,
+                ledger_history=64)
             server = Server(cfg, extra_metric_sinks=[ChannelMetricSink()])
             server.start()
             sent = 0
@@ -197,6 +203,13 @@ class TestForwardChaos:
             wait_until(
                 lambda: self._counter_sum(received, "soak.count") >= sent,
                 timeout=5.0)
+            # zero unexplained imbalance, end to end: every closed
+            # interval of the soak (strict mode already raised on any
+            # live breach; this pins the recorded history too)
+            for interval in server.ledger.history_imbalances():
+                assert all(v == 0.0 for v in interval.values()), interval
+            assert all(v == 0.0 for v in
+                       server.ledger.imbalance_net.values())
             return self._counter_sum(received, "soak.count"), sent
         finally:
             if server is not None:

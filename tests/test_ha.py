@@ -763,7 +763,13 @@ class TestKillRestoreSoak:
                 # is just another stash, but keep the soak simple)
                 carryover_max_intervals=1,
                 carryover_spool_dir=spool_dir,
-                circuit_breaker_failure_threshold=10_000)
+                circuit_breaker_failure_threshold=10_000,
+                # conservation accounting instead of bespoke per-seam
+                # counting: strict mode raises out of flush() on ANY
+                # unexplained imbalance, so the kill window, the spool
+                # spill/drain, and the restore all balance per interval
+                ledger_strict=True,
+                ledger_history=64)
             server = Server(cfg, extra_metric_sinks=[ChannelMetricSink()])
             server.start()
             sent_counter = 0
@@ -814,6 +820,14 @@ class TestKillRestoreSoak:
             for p in received:
                 if p.name == "soak.lat":
                     got_bins += llhistwire.unmarshal(p.llhist.bins)
+            # zero unexplained ledger imbalance at every stage, every
+            # interval — one dead global, forward faults, spool drain
+            # to empty all explained (strict already raised on a live
+            # breach; this pins the recorded history and the net)
+            for interval in server.ledger.history_imbalances():
+                assert all(v == 0.0 for v in interval.values()), interval
+            assert all(v == 0.0 for v in
+                       server.ledger.imbalance_net.values())
             spool_depth = server.forward_client.spool.depth
             return (got_counter[0], got_bins, sent_counter, sent_bins,
                     spool_depth, lat_report_mid, server, spool_dir)

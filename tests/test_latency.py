@@ -117,6 +117,43 @@ class TestSampleAgeWatermarks:
         assert 10.0 <= snap["p50"] <= 11.0 * 1.1
         assert 50.0 <= snap["max"] <= 51.0 * 1.1
 
+    def test_idle_plane_age_series_rolls_and_returns_fresh(self):
+        """A plane that idles across AGE_IDLE_SUPPRESS consecutive
+        flushes must stop rendering its (stale, otherwise-forever)
+        sample-age quantiles; traffic returning recreates the series
+        fresh."""
+        obs = LatencyObservatory()
+        t0 = time.time()
+        obs.note_arrival("otlp", t=t0 - 2.0)
+        obs.observe_sample_age(obs.take_watermarks(), t0)
+        assert any("plane:otlp" in row[3]
+                   for row in obs.telemetry_rows() if row[3])
+        assert "otlp" in obs.report()["sample_age"]
+        # idle flushes: the series survives up to the suppress bound...
+        for i in range(LatencyObservatory.AGE_IDLE_SUPPRESS - 1):
+            obs.observe_sample_age(obs.take_watermarks(), t0)
+            assert "otlp" in obs.report()["sample_age"], i
+        # ...then rolls
+        obs.observe_sample_age(obs.take_watermarks(), t0)
+        assert "otlp" not in obs.report()["sample_age"]
+        assert not any("plane:otlp" in row[3]
+                       for row in obs.telemetry_rows() if row[3])
+        # traffic returns: fresh series, count restarts at the new
+        # interval's two observations
+        obs.note_arrival("otlp", t=t0 - 1.0)
+        obs.observe_sample_age(obs.take_watermarks(), t0)
+        snap = obs.report()["sample_age"]["otlp"]
+        assert snap["count"] == 2
+
+    def test_active_plane_is_never_rolled(self):
+        obs = LatencyObservatory()
+        t0 = time.time()
+        for _ in range(3 * LatencyObservatory.AGE_IDLE_SUPPRESS):
+            obs.note_arrival("dogstatsd", t=t0)
+            obs.observe_sample_age(obs.take_watermarks(), t0)
+        snap = obs.report()["sample_age"]["dogstatsd"]
+        assert snap["count"] == 6 * LatencyObservatory.AGE_IDLE_SUPPRESS
+
 
 class TestFlushWaterfall:
     """The acceptance pin: per-family×device segments sum to within 10%

@@ -1,0 +1,509 @@
+"""Flow-ledger tests (core/ledger.py): double-entry unit semantics,
+the server's ingest/forward/forward_tier conservation identities under
+real flushes, the chaos_ledger_leak silent-drop drill (the acceptance
+pin: caught within one flush interval), the /debug/ledger HTTP surface
+on server and proxy, the proxy's churn-proof egress books, the
+flow_report pretty-printer, and the slow-marked <2% overhead soak."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from veneur_tpu.config import Config
+from veneur_tpu.core.ledger import FlowLedger, LedgerImbalance
+from veneur_tpu.core.server import Server
+from veneur_tpu.sinks.channel import ChannelMetricSink
+from veneur_tpu.testing.forwardtest import ForwardTestServer
+
+
+def make_config(**overrides) -> Config:
+    cfg = Config()
+    cfg.hostname = "test"
+    cfg.tpu.counter_capacity = 128
+    cfg.tpu.gauge_capacity = 128
+    cfg.tpu.histo_capacity = 128
+    cfg.tpu.set_capacity = 64
+    cfg.tpu.batch_cap = 512
+    for k, v in overrides.items():
+        setattr(cfg, k, v)
+    return cfg.apply_defaults()
+
+
+def wait_until(fn, timeout=10.0, step=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(step)
+    return False
+
+
+def imbalances(server):
+    rep = server.ledger.report()
+    return {k: v["imbalance_net"] for k, v in rep["identities"].items()}
+
+
+# -------------------------------------------------------------------------
+# FlowLedger unit semantics
+# -------------------------------------------------------------------------
+
+
+class TestFlowLedgerUnit:
+    def test_balanced_identity_closes_clean(self):
+        led = FlowLedger(strict=True)
+        led.declare("x", inputs=("in",), outputs=("out_a", "out_b"))
+        led.note("in", 10)
+        led.note("out_a", 7)
+        led.note("out_b", 3)
+        rec = led.close_interval()
+        assert rec["imbalance"]["x"] == 0.0
+        assert led.intervals_closed == 1
+
+    def test_imbalance_detected_and_event_fired(self):
+        events = []
+        led = FlowLedger(on_event=lambda kind, **f: events.append((kind, f)))
+        led.declare("x", inputs=("in",), outputs=("out",))
+        led.note("in", 10)
+        led.note("out", 6)
+        rec = led.close_interval()
+        assert rec["imbalance"]["x"] == 4.0
+        assert led.imbalance_last["x"] == 4.0
+        assert led.unexplained_total["x"] == 4.0
+        assert events and events[0][0] == "ledger_imbalance"
+        assert events[0][1]["imbalance"]["x"] == 4.0
+
+    def test_strict_raises(self):
+        led = FlowLedger(strict=True)
+        led.declare("x", inputs=("in",), outputs=("out",))
+        led.note("in", 1)
+        with pytest.raises(LedgerImbalance) as ei:
+            led.close_interval()
+        assert ei.value.imbalances["x"] == 1.0
+
+    def test_disabled_is_inert(self):
+        led = FlowLedger(enabled=False, strict=True)
+        led.declare("x", inputs=("in",), outputs=("out",))
+        led.note("in", 5)
+        assert led.close_interval() == {}
+        assert led.telemetry_rows() == []
+
+    def test_probe_folds_deltas_not_baseline(self):
+        led = FlowLedger()
+        led.declare("x", inputs=("in",), outputs=("out",))
+        counter = {"v": 100.0}  # pre-existing count: not interval 1's
+        led.probe("in", lambda: counter["v"])
+        led.note("out", 0)
+        rec = led.close_interval()
+        assert rec["imbalance"]["x"] == 0.0  # baseline absorbed
+        counter["v"] += 3
+        led.note("out", 3)
+        rec = led.close_interval()
+        assert rec["imbalance"]["x"] == 0.0
+        assert rec["stages"]["in"][""] == 3.0
+
+    def test_probe_map_per_key_deltas(self):
+        led = FlowLedger()
+        table = {"a|x": 2}
+        led.probe_map("shed", lambda: table)
+        table["a|x"] = 5
+        table["b"] = 1
+        rec = led.close_interval()
+        assert rec["stages"]["shed"] == {"a|x": 3.0, "b": 1.0}
+
+    def test_stock_inventory_balances_across_intervals(self):
+        led = FlowLedger(strict=True)
+        led.declare("x", inputs=("in",), outputs=("out",), stocks=("q",))
+        level = {"v": 0.0}
+        led.stock("q", lambda: level["v"])
+        # interval 1: 10 in, 4 out, 6 still queued
+        led.note("in", 10)
+        led.note("out", 4)
+        level["v"] = 6.0
+        assert led.close_interval()["imbalance"]["x"] == 0.0
+        # interval 2: nothing new, the queue drains
+        led.note("out", 6)
+        level["v"] = 0.0
+        assert led.close_interval()["imbalance"]["x"] == 0.0
+
+    def test_preexisting_stock_is_opening_not_inflow(self):
+        led = FlowLedger(strict=True)
+        led.declare("x", inputs=("in",), outputs=("out",), stocks=("q",))
+        level = {"v": 5.0}  # e.g. spool segments replayed at startup
+        led.stock("q", lambda: level["v"])
+        level["v"] = 0.0
+        led.note("out", 5)  # drained without any inflow this interval
+        assert led.close_interval()["imbalance"]["x"] == 0.0
+
+    def test_history_bounded_and_report_shape(self):
+        led = FlowLedger(history=3)
+        led.declare("x", inputs=("in",), outputs=("out",))
+        for i in range(5):
+            led.note("in", i)
+            led.note("out", i)
+            led.close_interval()
+        rep = led.report()
+        assert len(rep["intervals"]) == 3
+        assert rep["intervals_closed"] == 5
+        assert rep["identities"]["x"]["imbalance_net"] == 0.0
+        assert led.report(intervals=1)["intervals"][-1]["interval"] == 5
+
+    def test_telemetry_rows_names_match_declared(self):
+        from veneur_tpu.core.ledger import LEDGER_ROWS
+        led = FlowLedger()
+        led.declare("x", inputs=("in",), outputs=("out",))
+        led.note("in", 1)
+        led.note("out", 1)
+        led.stock("q", lambda: 2.0)
+        led.close_interval()
+        names = {row[0] for row in led.telemetry_rows()}
+        assert names <= set(LEDGER_ROWS)
+        assert "ledger.imbalance" in names
+        assert "ledger.stage_total" in names
+        assert "ledger.stock" in names
+
+
+# -------------------------------------------------------------------------
+# Server integration: the conservation identities under real flushes
+# -------------------------------------------------------------------------
+
+
+class TestServerIngestIdentity:
+    def test_mixed_families_balance_strict(self):
+        server = Server(make_config(ledger_strict=True))
+        server.start()
+        try:
+            for i in range(7):
+                server.handle_metric_packet(b"led.c:2|c")
+                server.handle_metric_packet(b"led.g:%d|g" % i)
+                server.handle_metric_packet(b"led.h:1.5|h")
+                server.handle_metric_packet(b"led.s:m%d|s" % i)
+                server.handle_metric_packet(b"led.l:%d|l" % (i + 1))
+                server.handle_metric_packet(b"_sc|led.sc|0")
+            server.flush()  # strict: raises on any imbalance
+            rep = server.ledger.report()
+            applied = rep["stage_totals"]["agg.applied"]
+            assert applied["counter"] == 7
+            assert applied["status"] == 7
+            assert rep["stage_totals"]["ingest.admitted"]["python"] == 42
+        finally:
+            server.shutdown()
+
+    def test_mint_rejection_is_explained(self):
+        cfg = make_config(ledger_strict=True)
+        cfg.tpu.max_rows_per_family = 2
+        server = Server(cfg)
+        server.start()
+        try:
+            for i in range(6):
+                server.handle_metric_packet(b"cap.k%d:1|c" % i)
+            server.flush()  # strict: the capped mints must be explained
+            rep = server.ledger.report()
+            assert rep["stage_totals"]["agg.rejected"]["counter"] == 4.0
+            assert rep["stage_totals"]["agg.applied"]["counter"] == 2.0
+        finally:
+            server.shutdown()
+
+    def test_parse_errors_ride_along_informationally(self):
+        server = Server(make_config(ledger_strict=True))
+        server.start()
+        try:
+            server.handle_metric_packet(b"garbage")
+            server.handle_metric_packet(b"ok.c:1|c")
+            server.flush()
+            rep = server.ledger.report()
+            assert rep["stage_totals"]["ingress.parse_errors"][""] == 1.0
+        finally:
+            server.shutdown()
+
+
+class TestLeakDrill:
+    """The acceptance pin: a deliberately injected SILENT drop (the
+    chaos_ledger_leak seam — no shed accounting at all) is caught as a
+    nonzero ledger.imbalance within one flush interval."""
+
+    def test_leak_caught_within_one_interval(self):
+        server = Server(make_config(
+            chaos_enabled=True, chaos_ledger_leak=3))
+        server.start()
+        try:
+            for _ in range(9):
+                server.handle_metric_packet(b"leak.c:1|c")
+            server.flush()
+            rep = server.ledger.report()
+            leaked = server.chaos.leaked_samples
+            assert leaked == 3
+            assert rep["identities"]["ingest"]["imbalance_last"] == leaked
+            # the flight recorder saw it
+            events = server.telemetry.events.snapshot(
+                kind="ledger_imbalance")
+            assert events
+            assert events[-1]["imbalance"]["ingest"] == leaked
+            # and the gauges export it
+            rows = {(r[0], tuple(r[3])): r[2]
+                    for r in server.ledger.telemetry_rows()}
+            assert rows[("ledger.imbalance",
+                         ("identity:ingest",))] == leaked
+        finally:
+            server.shutdown()
+
+    def test_leak_raises_in_strict_mode(self):
+        server = Server(make_config(
+            ledger_strict=True, chaos_enabled=True, chaos_ledger_leak=2))
+        server.start()
+        try:
+            for _ in range(4):
+                server.handle_metric_packet(b"leak.c:1|c")
+            with pytest.raises(LedgerImbalance):
+                server.flush()
+        finally:
+            server.shutdown()
+
+
+class TestForwardIdentity:
+    def test_fault_then_drain_balances_every_interval(self):
+        received = []
+        ft = ForwardTestServer(received.extend)
+        ft.start()
+        server = None
+        try:
+            server = Server(make_config(
+                forward_address=ft.address, ledger_strict=True,
+                chaos_enabled=True, chaos_error_rate=1.0,
+                chaos_seams=["forward_send"], chaos_seed=3,
+                forward_retry_max_attempts=1,
+                carryover_max_intervals=1000,
+                circuit_breaker_failure_threshold=10_000))
+            server.start()
+            for i in range(3):
+                server.handle_metric_packet(
+                    b"fwd.c:%d|c|#veneurglobalonly" % (i + 1))
+                server.flush()  # strict: every faulted interval balances
+            # stocks hold the undelivered state
+            assert server.ledger.report()["stocks"][
+                "forward_carryover"] == 1  # same key merged down to 1 row
+            server.chaos.enabled = False
+            server.flush()
+            assert wait_until(
+                lambda: server.forward_client.carryover.depth == 0)
+            rep = server.ledger.report()
+            assert all(v == 0.0 for v in imbalances(server).values())
+            assert rep["stage_totals"]["forward.acked"][""] >= 1
+            assert rep["stage_totals"]["forward.merged_away"]["drain"] >= 1
+        finally:
+            if server is not None:
+                server.shutdown()
+            ft.stop()
+
+    def test_tier_reconciliation_against_real_global(self):
+        global_server = Server(make_config(
+            grpc_address="127.0.0.1:0", ledger_strict=True))
+        global_server.start()
+        local = None
+        try:
+            local = Server(make_config(
+                forward_address=global_server.import_server.address,
+                ledger_strict=True))
+            local.start()
+            local.handle_metric_packet(b"tier.c:5|c|#veneurglobalonly")
+            local.handle_metric_packet(b"tier.l:2|l")
+            local.flush()
+            rep = local.ledger.report()
+            totals = rep["stage_totals"]
+            # the global's FlowCounts response reconciled sent == merged
+            assert totals["forward.acked_reported"][""] == 2.0
+            assert totals["forward.remote_merged"][""] == 2.0
+            assert "forward.remote_rejected" not in totals
+            # the global's own ingest identity balances on its flush
+            global_server.flush()
+            g = global_server.ledger.report()
+            assert g["stage_totals"]["ingest.admitted"]["forward"] == 2.0
+            assert g["stage_totals"]["import.received"]["forward"] == 2.0
+        finally:
+            if local is not None:
+                local.shutdown()
+            global_server.shutdown()
+
+
+# -------------------------------------------------------------------------
+# HTTP surface + proxy books
+# -------------------------------------------------------------------------
+
+
+class TestLedgerHTTP:
+    def test_debug_ledger_endpoint(self):
+        server = Server(make_config(http_address="127.0.0.1:0"))
+        server.start()
+        try:
+            server.handle_metric_packet(b"http.c:1|c")
+            server.flush()
+            host, port = server.http_api.address
+            with urllib.request.urlopen(
+                    f"http://{host}:{port}/debug/ledger?intervals=1") as r:
+                body = json.loads(r.read())
+            assert body["intervals_closed"] >= 1
+            assert "ingest" in body["identities"]
+            assert len(body["intervals"]) == 1
+            assert body["intervals"][-1]["imbalance"]["ingest"] == 0.0
+        finally:
+            server.shutdown()
+
+
+class TestProxyLedger:
+    def _proxy(self, addresses, **kwargs):
+        from veneur_tpu.proxy.proxy import create_static_proxy
+        proxy = create_static_proxy(
+            addresses, health_check_interval=0, **kwargs)
+        proxy.start()
+        return proxy
+
+    def test_egress_books_survive_destination_churn(self):
+        from veneur_tpu.forward.protos import metric_pb2
+        received = []
+        ft = ForwardTestServer(received.extend)
+        ft.start()
+        proxy = self._proxy([ft.address], ledger_strict=True)
+        try:
+            for i in range(10):
+                proxy.handle_metric(metric_pb2.Metric(
+                    name=f"p.{i}", tags=["a:b"],
+                    type=metric_pb2.Counter, scope=metric_pb2.Global,
+                    counter=metric_pb2.CounterValue(value=i)))
+            proxy.destinations.flush_wait(timeout=5.0)
+            assert wait_until(lambda:
+                              proxy.destinations.flow_totals()["sent"] == 10)
+            proxy.ledger.close_interval()  # strict: must balance
+            before = proxy.destinations.flow_totals()
+            assert before["enqueued"] == 10
+            # churn: drop the destination; its counters must FOLD into
+            # the retired totals, not vanish (satellite: retired_* fold)
+            proxy.destinations.set_destinations(["127.0.0.1:1"])
+            after = proxy.destinations.flow_totals()
+            assert after["enqueued"] >= before["enqueued"]
+            assert after["sent"] >= before["sent"]
+            proxy.ledger.close_interval()  # still balanced after churn
+            rep = proxy.ledger.report()
+            assert rep["identities"]["proxy_egress"]["imbalance_net"] == 0.0
+            # tier reconciliation columns exist only for upgraded
+            # receivers; the stub answers empty — unreported, no rows
+            assert "dest.acked_reported" not in rep["stage_totals"]
+        finally:
+            proxy.stop()
+            ft.stop()
+
+    def test_proxy_route_identity_balances(self):
+        from veneur_tpu.forward.protos import metric_pb2
+        received = []
+        ft = ForwardTestServer(received.extend)
+        ft.start()
+        proxy = self._proxy([ft.address], ledger_strict=True)
+        try:
+            for i in range(6):
+                proxy.handle_metric(metric_pb2.Metric(
+                    name="route.x", tags=[],
+                    type=metric_pb2.Counter, scope=metric_pb2.Global,
+                    counter=metric_pb2.CounterValue(value=1)))
+            proxy.ledger.close_interval()
+            rep = proxy.ledger.report()
+            assert rep["identities"]["proxy_route"]["imbalance_net"] == 0.0
+            assert rep["stage_totals"]["proxy.received"][""] == 6.0
+        finally:
+            proxy.stop()
+            ft.stop()
+
+
+# -------------------------------------------------------------------------
+# flow_report script
+# -------------------------------------------------------------------------
+
+
+class TestFlowReportScript:
+    def _mod(self):
+        import importlib.util
+        import os
+        path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "scripts", "flow_report.py")
+        spec = importlib.util.spec_from_file_location("flow_report", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_format_and_exit_codes(self, tmp_path, capsys):
+        mod = self._mod()
+        server = Server(make_config())
+        server.start()
+        try:
+            server.handle_metric_packet(b"rep.c:1|c")
+            server.flush()
+            report = server.ledger.report()
+        finally:
+            server.shutdown()
+        text = mod.format_report(report)
+        assert "flow ledger" in text
+        assert "ingest" in text and "forward_tier" in text
+        assert "** UNEXPLAINED **" not in text
+        # saved-JSON mode drives the same path the live-URL mode uses
+        path = tmp_path / "ledger.json"
+        path.write_text(json.dumps(report))
+        assert mod.main([str(path)]) == 0
+        capsys.readouterr()
+        # a doctored leak flips the exit code — keyed off the lifetime
+        # |imbalance| sum, so opposite-sign leaks can't self-cancel
+        # into a clean exit (imbalance_net stays 0 here on purpose)
+        report["identities"]["ingest"]["unexplained_total"] = 6.0
+        path.write_text(json.dumps(report))
+        assert mod.main([str(path)]) == 1
+
+
+# -------------------------------------------------------------------------
+# Overhead soak (acceptance: <2% of flush wall time, strict off)
+# -------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+class TestLedgerOverheadSoak:
+    N_KEYS = 1500
+    ROUNDS = 30
+
+    def _median_flush_s(self, ledger_on: bool) -> float:
+        cfg = make_config(ledger_enabled=ledger_on)
+        cfg.tpu.counter_capacity = 4096
+        cfg.tpu.gauge_capacity = 4096
+        cfg.tpu.histo_capacity = 4096
+        cfg.tpu.set_capacity = 1024
+        server = Server(cfg)
+        server.start()
+        pkts = []
+        for i in range(self.N_KEYS):
+            kind = i % 4
+            if kind == 0:
+                pkts.append(b"soak.c%d:1|c" % i)
+            elif kind == 1:
+                pkts.append(b"soak.g%d:2.5|g" % i)
+            elif kind == 2:
+                pkts.append(b"soak.t%d:3:4:5|ms" % i)
+            else:
+                pkts.append(b"soak.s%d:u%d|s" % (i, i))
+        try:
+            server.handle_packet_batch(pkts)
+            server.store.apply_all_pending()
+            server.flush()  # compile outside the measured window
+            times = []
+            for _ in range(self.ROUNDS):
+                server.handle_packet_batch(pkts)
+                server.store.apply_all_pending()
+                t0 = time.perf_counter()
+                server.flush()
+                times.append(time.perf_counter() - t0)
+            times.sort()
+            return times[len(times) // 2]
+        finally:
+            server.shutdown()
+
+    def test_ledger_overhead_under_2pct(self):
+        off = self._median_flush_s(ledger_on=False)
+        on = self._median_flush_s(ledger_on=True)
+        # 2% of flush wall time, plus a 200µs absolute epsilon so OS
+        # scheduling noise on a tiny flush can't flake the pin
+        assert on <= off * 1.02 + 2e-4, (on, off)

@@ -180,7 +180,9 @@ def build_from_config(raw: dict, args, log):
         health_probe=raw.get("health_probe", "tcp"),
         health_http_url_template=raw.get("health_http_url_template", ""),
         hedge_after=hedge_after,
-        failover_walk=int(raw.get("failover_walk", 2)))
+        failover_walk=int(raw.get("failover_walk", 2)),
+        ledger_enabled=bool(raw.get("ledger_enabled", True)),
+        ledger_strict=bool(raw.get("ledger_strict", False)))
     proxy.shutdown_grace = shutdown_grace
     proxy.start()
     log.info("veneur-proxy listening on %s -> %s", proxy.address,
@@ -219,6 +221,7 @@ def build_from_config(raw: dict, args, log):
                            telemetry=telemetry,
                            cardinality=proxy.cardinality_report,
                            latency=proxy.latency.report,
+                           ledger=proxy.ledger.report,
                            ready=proxy.ready_state)
         http_api.start()
 

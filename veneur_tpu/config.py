@@ -179,6 +179,19 @@ class Config:
     # (hedged forwards are a proxy-tier knob — `hedge_after` in the
     # proxy yaml; the local forward client has one upstream and gets
     # duplicate-safety from its per-interval idempotency token alone)
+    # -- flow ledger (core/ledger.py) -----------------------------------
+    # per-interval conservation accounting from socket to sink ack:
+    # stage counters stamped at every pipeline crossing, reconciled at
+    # flush close (ingested = aggregated + rejected; snapshotted =
+    # acked + merged-away + shed, with carryover/spool/in-flight as
+    # inventory). Nonzero unexplained imbalance exports
+    # ledger.imbalance{identity:} and records a flight-recorder event;
+    # ledger_strict additionally makes it RAISE at interval close (for
+    # tests/soaks — never on in production, where a transient mid-send
+    # close can show a one-interval blip that nets out).
+    ledger_enabled: bool = True
+    ledger_strict: bool = False
+    ledger_history: int = 32
     # -- latency observatory (core/latency.py) --------------------------
     # per-family×device flush dispatch attribution, per-plane end-to-end
     # sample-age llhists, and queue dwell/depth telemetry. On by default
@@ -249,6 +262,12 @@ class Config:
     # sleeps this long — makes hedging budgets and health-probe timeouts
     # testable without probabilistic rolls
     chaos_forward_latency_ms: float = 0.0
+    # deterministic SILENT drop seam for the flow ledger's acceptance
+    # drill: every Nth sample admitted past admission control vanishes
+    # WITHOUT any accounting (0 = off). The ledger must catch it as a
+    # nonzero ingest imbalance within one flush interval — this knob
+    # exists so that detection is testable.
+    chaos_ledger_leak: int = 0
     chaos_ingest_drop_rate: float = 0.0
     chaos_ingest_truncate_rate: float = 0.0
     chaos_ingest_duplicate_rate: float = 0.0

@@ -114,6 +114,11 @@ class _BaseTable:
         # accountant consulted on every mint (admit_mint/note_mint) and
         # fed evictions; None = unlimited, account nothing
         self.cardinality = None
+        # flow ledger (core/ledger.py): every sample this table accepts
+        # stamps agg.applied, every mint-gate rejection agg.rejected —
+        # the out-side of the ingest conservation identity. The ledger
+        # lock is a leaf, so stamping under this table's locks is safe.
+        self.ledger = None
         # capacity/churn accounting, exported by ColumnStore.telemetry_rows
         # and /debug/cardinality: every counter below is monotonic and
         # mutated only under `lock` (resize/recompile under apply rules
@@ -245,6 +250,8 @@ class _BaseTable:
             card = self.cardinality
             if card is not None and not card.admit_mint(
                     self.family, metric.key.name, metric.tags):
+                if self.ledger is not None:
+                    self.ledger.note("agg.rejected", 1, key=self.family)
                 return -1
             meta = RowMeta(
                 name=metric.key.name, tags=list(metric.tags),
@@ -266,6 +273,8 @@ class _BaseTable:
                 # within-interval key flood; the sample is dropped and
                 # counted (keys_dropped self-metric)
                 self.keys_dropped += 1
+                if self.ledger is not None:
+                    self.ledger.note("agg.rejected", 1, key=self.family)
                 return -1
             else:
                 row = len(self.meta)
@@ -284,6 +293,12 @@ class _BaseTable:
             if card is not None:
                 card.note_mint(self.family, metric.key.name)
         return row
+
+    def _note_applied(self, n: int) -> None:
+        """Stamp n samples accepted into this family (flow ledger)."""
+        led = self.ledger
+        if led is not None and n:
+            led.note("agg.applied", n, key=self.family)
 
     def _note_generation_locked(self) -> None:
         """Advance the flush generation and stamp rows touched this
@@ -494,6 +509,7 @@ class CounterTable(_BaseTable):
             if row < 0:
                 return
             self.touched[row] = True
+            self._note_applied(1)
             n = self._n
             self._prow[n] = row
             self._pval[n] = metric.value
@@ -515,6 +531,7 @@ class CounterTable(_BaseTable):
     def add_batch(self, rows, vals, rates) -> None:
         """Native-parser fast path: pre-interned rows, parallel columns."""
         with self.lock:
+            self._note_applied(len(rows))
             self._append_batch((rows, vals, rates))
 
     def merge_batch(self, stubs: List[UDPMetric], values) -> None:
@@ -532,6 +549,7 @@ class CounterTable(_BaseTable):
                 self.touched[row] = True
                 rows.append(row)
                 vals.append(value)
+            self._note_applied(len(rows))
             if self._import_acc.shape[0] < self.capacity:
                 grown = np.zeros(self.capacity, np.float64)
                 grown[: self._import_acc.shape[0]] = self._import_acc
@@ -596,6 +614,7 @@ class GaugeTable(_BaseTable):
             if row < 0:
                 return
             self.touched[row] = True
+            self._note_applied(1)
             n = self._n
             self._prow[n] = row
             self._pval[n] = metric.value
@@ -614,6 +633,7 @@ class GaugeTable(_BaseTable):
     def add_batch(self, rows, vals) -> None:
         """Native-parser fast path; buffer order preserves last-write-wins."""
         with self.lock:
+            self._note_applied(len(rows))
             self._append_batch((rows, vals))
 
     def merge_batch(self, stubs: List[UDPMetric], values) -> None:
@@ -626,6 +646,7 @@ class GaugeTable(_BaseTable):
             ok = rows >= 0  # cardinality-capped stubs drop out
             rows = rows[ok]
             self.touched[rows] = True
+            self._note_applied(int(rows.size))
             self.apply_lock.acquire()
         try:
             self.state = scalars.merge_gauges(
@@ -750,6 +771,7 @@ class HistoTable(_BaseTable):
             if row < 0:
                 return
             self.touched[row] = True
+            self._note_applied(1)
             n = self._n
             self._prow[n] = row
             self._pval[n] = metric.value
@@ -778,6 +800,7 @@ class HistoTable(_BaseTable):
     def add_batch(self, rows, vals, weights) -> None:
         """Native-parser fast path: weights are 1/sample_rate."""
         with self.lock:
+            self._note_applied(len(rows))
             self._append_batch((rows, vals, weights))
 
     def merge_batch(self, stubs: List[UDPMetric], in_means, in_weights,
@@ -790,6 +813,7 @@ class HistoTable(_BaseTable):
             ok = rows >= 0  # cardinality-capped stubs drop out
             rows = rows[ok]
             self.touched[rows] = True
+            self._note_applied(int(rows.size))
             self.apply_lock.acquire()
         try:
             self.state = batch_tdigest.merge_centroid_rows(
@@ -1042,6 +1066,7 @@ class SetTable(_BaseTable):
             if row < 0:
                 return
             self.touched[row] = True
+            self._note_applied(1)
             if self._sparse:
                 self._counts[row] += 1
                 slot = self._slot_of[row]
@@ -1076,6 +1101,7 @@ class SetTable(_BaseTable):
         """Native-parser fast path: members already hashed to (idx, rho).
         Routes each sample to its key's tier (device slot or host COO)."""
         with self.lock:
+            self._note_applied(len(rows))
             if not self._sparse:
                 self._append_batch((rows, reg_idx, rho), touch_rows=rows)
                 return
@@ -1134,6 +1160,7 @@ class SetTable(_BaseTable):
             ok = rows >= 0  # cardinality-capped stubs drop out
             rows = rows[ok]
             self.touched[rows] = True
+            self._note_applied(int(rows.size))
             regs_sel = np.asarray(in_regs, np.int8)[ok]
             if self._sparse:
                 for r in rows:
@@ -1312,6 +1339,7 @@ class LLHistTable(_BaseTable):
             if row < 0:
                 return
             self.touched[row] = True
+            self._note_applied(1)
             self.samples_total += weight
             if llhist_ref.clamped_mask(value):
                 self.clamped_total += weight
@@ -1336,6 +1364,7 @@ class LLHistTable(_BaseTable):
         weights are 1/sample_rate floats."""
         bins, wts = batch_llhist.bin_batch_host(vals, weights)
         with self.lock:
+            self._note_applied(len(rows))
             self.samples_total += int(wts.sum())
             self.clamped_total += int(
                 wts[llhist_ref.clamped_mask(vals)].sum())
@@ -1351,6 +1380,7 @@ class LLHistTable(_BaseTable):
             ok = rows >= 0  # cardinality-capped stubs drop out
             rows = rows[ok]
             self.touched[rows] = True
+            self._note_applied(int(rows.size))
             padded = batch_llhist.pad_rows_to_device(
                 np.asarray(in_bins)[ok])
             self.samples_total += int(padded.sum())
@@ -1452,6 +1482,7 @@ class StatusTable(_BaseTable):
             while len(self.values) <= row:
                 self.values.append(StatusEntry())
             self.touched[row] = True
+            self._note_applied(1)
             self.values[row] = StatusEntry(
                 value=float(metric.value), message=metric.message,
                 hostname=metric.hostname)
@@ -1530,6 +1561,7 @@ class ColumnStore:
         for family, table in self.tables():
             table.family = family
         self.processed = 0
+        self.ledger = None  # set by attach_ledger
         self._processed_lock = threading.Lock()
 
     def tables(self):
@@ -1543,6 +1575,14 @@ class ColumnStore:
         every table's interning path."""
         for _family, table in self.tables():
             table.cardinality = accountant
+
+    def attach_ledger(self, ledger) -> None:
+        """Wire the flow ledger (core/ledger.py) into every table's
+        apply/reject paths — the out-side of the ingest conservation
+        identity (admitted == applied + rejected)."""
+        self.ledger = ledger
+        for _family, table in self.tables():
+            table.ledger = ledger
 
     def attach_resize_hook(self, hook) -> None:
         """hook(family, old_cap, new_cap, seconds, kind=...) fires on
@@ -1679,6 +1719,12 @@ class ColumnStore:
         elif t == m.STATUS:
             self.statuses.add(metric)
         else:
+            # unknown wire type: the sample was counted admitted by the
+            # caller, so its drop must be explained or the ledger's
+            # ingest identity (rightly) flags it
+            led = getattr(self, "ledger", None)
+            if led is not None:
+                led.note("agg.rejected", 1, key="unknown")
             return
         self.count_processed(1)
 

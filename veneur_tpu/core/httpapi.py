@@ -147,6 +147,24 @@ class _Handler(BaseHTTPRequestHandler):
                 return
             body = json.dumps(source(), indent=2, default=str).encode()
             self._send(200, body, "application/json")
+        elif path == "/debug/ledger":
+            # the flow ledger's conservation report: per-identity
+            # imbalances, lifetime stage totals, live inventory stocks,
+            # and the last N closed intervals as a waterfall
+            # (?intervals=N). Served by the proxy too (routing +
+            # destination-pool identities).
+            source = api.ledger_source
+            if source is None:
+                ledger = getattr(api.server, "ledger", None)
+                source = getattr(ledger, "report", None)
+            if source is None:
+                self._send(404, b"no ledger source\n")
+                return
+            n = int(_query_float(self.path, "intervals", 0.0,
+                                 max_value=1e4))
+            body = json.dumps(source(intervals=n), indent=2,
+                              default=str).encode()
+            self._send(200, body, "application/json")
         elif path == "/debug/cardinality":
             # series-cardinality observatory: top-N names by live rows
             # with mint rates and per-tag-key HLL estimates for the top
@@ -264,6 +282,7 @@ class _Handler(BaseHTTPRequestHandler):
                 b"  /debug/flush?n=N                recent flush rounds\n"
                 b"  /debug/flush?waterfall=1        per-family segment trees\n"
                 b"  /debug/latency                  latency observatory\n"
+                b"  /debug/ledger?intervals=N       flow-ledger conservation\n"
                 b"  /debug/cardinality?top=N&name=  series cardinality\n"
                 b"  /metrics                        Prometheus exposition\n"))
         elif path == "/debug/profile/device":
@@ -347,7 +366,7 @@ class HTTPApi:
     def __init__(self, config, server=None, address: str = "127.0.0.1:0",
                  http_quit: bool = False, on_quit=None,
                  require_flush_for_ready: bool = False, telemetry=None,
-                 cardinality=None, latency=None, ready=None):
+                 cardinality=None, latency=None, ready=None, ledger=None):
         self.config = config
         self.server = server
         self.http_quit = http_quit
@@ -361,6 +380,10 @@ class HTTPApi:
         # server's latency.report is used by default, the proxy passes
         # its own observatory's
         self.latency_source = latency
+        # /debug/ledger source: a callable(intervals=N) -> dict; the
+        # owning server's ledger.report by default, the proxy passes
+        # its own ledger's
+        self.ledger_source = ledger
         # /healthcheck/ready source for a standalone API (the proxy):
         # a callable -> (ready, reason_str_or_body_dict); None defers to
         # the owning server's readiness ladder

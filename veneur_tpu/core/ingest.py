@@ -117,6 +117,14 @@ class BatchIngester:
         # replay loop below
         server.stats.inc("packets_received", res.lines - len(res.unknown))
         store.count_processed(res.samples)
+        # flow ledger: the native counter/gauge columns are admitted
+        # here (histogram/set columns stamp in _add_histo_set, where
+        # the shed ladder decides what actually reaches the store)
+        ledger = getattr(server, "ledger", None)
+        if ledger is not None:
+            n = len(res.c_rows) + len(res.g_rows)
+            if n:
+                ledger.note("ingest.admitted", n, key="native")
         unknown = res.unknown
 
         # Counters/histograms/sets merge commutatively, so replay order
@@ -136,6 +144,11 @@ class BatchIngester:
 
             def capture(metric):
                 if metric.key.type == m.GAUGE:
+                    # admitted BEFORE the intern: a mint rejection
+                    # stamps agg.rejected inside row_for, so the
+                    # ledger's ingest identity stays balanced
+                    if ledger is not None:
+                        ledger.note("ingest.admitted", 1, key="python")
                     row = store.gauges.intern(metric)
                     if row < 0:  # cardinality cap: drop, already counted
                         return
@@ -190,14 +203,22 @@ class BatchIngester:
         counted."""
         store = self.store
         overload = getattr(self.server, "overload", None)
+        ledger = getattr(self.server, "ledger", None)
+
+        def admit(n):
+            if ledger is not None and n:
+                ledger.note("ingest.admitted", n, key="native")
+
         if shed_nonessential and overload is not None:
             keep = 0.0
         else:
             keep = overload.histo_set_keep() if overload is not None else 1.0
         if keep >= 1.0:
             if len(res.h_rows):
+                admit(len(res.h_rows))
                 store.histos.add_batch(res.h_rows, res.h_vals, res.h_wts)
             if len(res.s_rows):
+                admit(len(res.s_rows))
                 store.sets.add_batch(res.s_rows, res.s_idx, res.s_rho)
             return
         from veneur_tpu.core import overload as overload_mod
@@ -218,6 +239,7 @@ class BatchIngester:
             overload.shed(cls, n - len(kept), reason="degraded")
             table = (store.histos if cls == overload_mod.CLASS_HISTOGRAM
                      else store.sets)
+            admit(len(kept))
             table.add_batch(kept, cols[0][::stride], cols[1][::stride])
 
     def _register_line(self, line: bytes) -> None:
@@ -282,6 +304,7 @@ class BatchIngester:
         server = self.server
         store = self.store
         cfg = server.config
+        ledger = getattr(server, "ledger", None)
         ext = server.metric_extraction
         parser_nat = self._parser()
         n = len(offs)
@@ -328,6 +351,9 @@ class BatchIngester:
             if metric.key.type == m.GAUGE:
                 # captured, not applied: merged with the native gauge
                 # columns by line index so last-write-wins holds
+                # (admitted stamp precedes the intern, like _ingest's)
+                if ledger is not None:
+                    ledger.note("ingest.admitted", 1, key="python")
                 row = store.gauges.intern(metric)
                 if row >= 0:
                     gauge_rows.append(row)
@@ -339,6 +365,10 @@ class BatchIngester:
             replayed += 1
             self._register_ssf_sample(sample, metric)
 
+        if ledger is not None:
+            n = len(res.c_rows) + len(res.g_rows)
+            if n:
+                ledger.note("ingest.admitted", n, key="native")
         if len(res.c_rows):
             store.counters.add_batch(res.c_rows, res.c_vals, res.c_rates)
         if gauge_rows:

@@ -168,10 +168,23 @@ class LatencyObservatory:
     per-family flush attribution, and every note_* call is a cheap
     early return — the <2% overhead guard's off switch."""
 
+    # consecutive flushes a plane may idle (no arrivals) before its
+    # sample-age series is ROLLED: the cumulative llhist would otherwise
+    # render its last p50/p99/max forever — a gone-quiet forward plane
+    # reading hours-stale ages is exactly the dashboard lie the
+    # observatory exists to prevent. Traffic returning re-creates the
+    # series fresh (count restarts from 0).
+    AGE_IDLE_SUPPRESS = 3
+
     def __init__(self, enabled: bool = True):
         self.enabled = enabled
         self._lock = threading.Lock()
         self._age_hists: Dict[str, LatencyHist] = {}
+        # plane -> consecutive takes with no arrivals (idle-roll state)
+        self._age_idle: Dict[str, int] = {}
+        # optional flow ledger (core/ledger.py): arrivals stamp the
+        # informational ingress.observed stage per plane
+        self.ledger = None
         self._queue_hists: Dict[str, LatencyHist] = {}
         # name -> (depth_fn, capacity)
         self._queues: Dict[str, tuple] = {}
@@ -230,6 +243,9 @@ class LatencyObservatory:
             return
         if t is None:
             t = time.time()
+        led = self.ledger
+        if led is not None:
+            led.note("ingress.observed", n, key=plane)
         with self._lock:
             mark = self._marks.get(plane)
             if mark is None:
@@ -251,6 +267,22 @@ class LatencyObservatory:
             out = {plane: (mark.oldest, mark.newest)
                    for plane, mark in self._marks.items() if mark.batches}
             self._marks.clear()
+            # idle-plane roll: a plane with no arrivals for
+            # AGE_IDLE_SUPPRESS consecutive flushes loses its age
+            # series — stale quantiles (the last interval's age,
+            # growing meaningless as the plane stays quiet) must not
+            # keep rendering in /metrics and /debug/latency. The series
+            # is recreated fresh when traffic returns.
+            for plane in list(self._age_hists):
+                if plane in out:
+                    self._age_idle[plane] = 0
+                    continue
+                idle = self._age_idle.get(plane, 0) + 1
+                if idle >= self.AGE_IDLE_SUPPRESS:
+                    del self._age_hists[plane]
+                    self._age_idle.pop(plane, None)
+                else:
+                    self._age_idle[plane] = idle
         return out
 
     def observe_sample_age(self, watermarks: Dict[str, tuple],

@@ -507,6 +507,12 @@ class OverloadManager:
         with self._shed_lock:
             self.shed_total[key] = self.shed_total.get(key, 0) + n
 
+    def shed_snapshot(self) -> Dict[str, int]:
+        """Copy of the shed table (class|reason -> n) — the flow
+        ledger's ingress.shed probe source."""
+        with self._shed_lock:
+            return dict(self.shed_total)
+
     def admit_span(self) -> bool:
         """Spans shed first: any degradation state pauses span ingest,
         and the span-plane token bucket bounds the happy path."""

@@ -262,6 +262,38 @@ class Server:
         self.telemetry.registry.add_collector(
             telemetry_mod.device_memory_rows)
 
+        # flow ledger (core/ledger.py): per-interval conservation
+        # accounting from socket to sink ack. Declared here so every
+        # crossing below (ingest, store, forward, spool) can stamp it;
+        # the interval closes at the end of each flush.
+        from veneur_tpu.core.ledger import FlowLedger
+        self.ledger = FlowLedger(
+            enabled=config.ledger_enabled, strict=config.ledger_strict,
+            history=config.ledger_history,
+            on_event=self.telemetry.record_event)
+        # ingested = aggregated + rejected: a sample admitted past
+        # admission control must land in a family table or be rejected
+        # at the mint gate — anything else is a silent drop
+        self.ledger.declare(
+            "ingest", inputs=("ingest.admitted",),
+            outputs=("agg.applied", "agg.rejected"))
+        # snapshotted = acked + merged-away + shed, with the carryover,
+        # the durable spool, and the in-flight send as inventory stocks
+        self.ledger.declare(
+            "forward", inputs=("forward.snapshot",),
+            outputs=("forward.acked", "forward.merged_away",
+                     "forward.shed"),
+            stocks=("forward_carryover", "forward_spool",
+                    "forward_inflight"))
+        # cross-tier reconciliation: what this local acked against what
+        # the receiver reports it received/merged (FlowCounts responses)
+        self.ledger.declare(
+            "forward_tier", inputs=("forward.acked_reported",),
+            outputs=("forward.remote_merged", "forward.remote_rejected",
+                     "forward.remote_deduped"))
+        self.latency.ledger = self.ledger if self.ledger.enabled else None
+        self.telemetry.registry.add_collector(self.ledger.telemetry_rows)
+
         # self-metrics: UDP to stats_address, or internal loopback so they
         # re-enter this server's own pipeline (reference scopedstatsd +
         # NewChannelClient server.go:518-524)
@@ -369,6 +401,14 @@ class Server:
         self.stats = StatCounters(
             "packets_received", "parse_errors", "metrics_flushed",
             "tcp_overlong_dropped", "ssf_undecodable_dropped")
+        # ledger feeds from counters that already exist: parse errors
+        # and the overload shed table surface as informational ingress
+        # stages in /debug/ledger (per-interval deltas, folded at close)
+        self.store.attach_ledger(self.ledger if self.ledger.enabled
+                                 else None)
+        self.ledger.probe("ingress.parse_errors",
+                          lambda: self.stats["parse_errors"])
+        self.ledger.probe_map("ingress.shed", self.overload.shed_snapshot)
 
     # -- identity --------------------------------------------------------
 
@@ -452,10 +492,21 @@ class Server:
     def ingest_metric(self, metric: UDPMetric) -> None:
         """The single Python-path chokepoint into the column store: the
         overload shed ladder applies here (histogram/set samples are
-        shed under memory pressure; counter/gauge deltas never are)."""
+        shed under memory pressure; counter/gauge deltas never are).
+        Samples that pass admission stamp the flow ledger's
+        ingest.admitted — the in-side of the conservation identity the
+        column store's applied/rejected stamps must balance. The
+        chaos_ledger_leak seam sits between the stamp and the store:
+        the deliberate silent drop the ledger must catch."""
         cls = _SHED_CLASS.get(metric.key.type)
         if cls is not None and not self.overload.admit_sample(cls):
             return
+        led = self.ledger
+        if led.enabled:
+            led.note("ingest.admitted", 1, key="python")
+            chaos = self.chaos
+            if chaos is not None and chaos.leak_sample():
+                return  # the drill: vanish with no accounting at all
         self.store.process(metric)
 
     def _ingest_metric_essential(self, metric: UDPMetric) -> None:
@@ -465,6 +516,9 @@ class Server:
         if cls is not None and not self.overload.admit_sample(
                 cls, over_limit=True):
             return
+        led = self.ledger
+        if led.enabled:
+            led.note("ingest.admitted", 1, key="python")
         self.store.process(metric)
 
     def _self_packet(self, packet: bytes) -> None:
@@ -702,13 +756,15 @@ class Server:
             # SIGUSR2 handoff mid-outage) are re-scanned here and drain
             # after the first successful forward
             spool = None
+            ledger = self.ledger if self.ledger.enabled else None
             if cfg.carryover_spool_dir:
                 from veneur_tpu.util.spool import CarryoverSpool
                 spool = CarryoverSpool(
                     cfg.carryover_spool_dir,
                     max_bytes=cfg.carryover_spool_max_bytes,
                     max_segments=cfg.carryover_spool_max_segments,
-                    dwell_hist=self.latency.queue_hist("forward_spool"))
+                    dwell_hist=self.latency.queue_hist("forward_spool"),
+                    ledger=ledger)
                 self.latency.register_queue(
                     "forward_spool", lambda: spool.depth,
                     cfg.carryover_spool_max_segments)
@@ -726,8 +782,9 @@ class Server:
                     failure_threshold=cfg.circuit_breaker_failure_threshold,
                     recovery_time=cfg.circuit_breaker_recovery,
                     name="forward", on_transition=self._breaker_transition),
-                carryover=Carryover(cfg.carryover_max_intervals),
-                chaos=self.chaos, spool=spool)
+                carryover=Carryover(cfg.carryover_max_intervals,
+                                    ledger=ledger),
+                chaos=self.chaos, spool=spool, ledger=ledger)
             self.forwarder = self.forward_client.forward
             self.telemetry.registry.add_collector(
                 self.forward_client.telemetry_rows)
@@ -737,6 +794,19 @@ class Server:
                 "forward_carryover",
                 lambda: self.forward_client.carryover.depth,
                 cfg.carryover_max_intervals)
+            # ledger inventory stocks: metrics held in the carryover,
+            # on disk in the spool (incl. segments replayed from a dead
+            # process — opening stock, not unexplained inflow), and
+            # in flight inside a send — so a close landing mid-outage
+            # (or mid-send) still balances
+            fc = self.forward_client
+            self.ledger.stock("forward_carryover",
+                              lambda: fc.carryover.pending_metrics)
+            self.ledger.stock("forward_inflight",
+                              lambda: fc.inflight_metrics)
+            if spool is not None:
+                self.ledger.stock("forward_spool",
+                                  lambda: spool.pending_metrics)
         if self.chaos is not None:
             # make the plan visible to the object-less seams (http_post)
             from veneur_tpu.util import chaos as chaos_mod
@@ -768,6 +838,10 @@ class Server:
             # hedge/retry duplicate drops surface in /metrics
             self.telemetry.registry.add_collector(
                 self.import_server.telemetry_rows)
+            imp = self.import_server
+            self.ledger.probe("import.deduped",
+                              lambda: imp.duplicates_dropped_total,
+                              key="forward")
             self.import_server.start()
         for source in self.sources:
             t = threading.Thread(target=source.start, args=(self,),
@@ -1006,8 +1080,11 @@ class Server:
             # retire the forward plane's observatory queues with their
             # owner so /debug/latency reflects only live hand-offs
             self.latency.unregister_queue("forward_carryover")
+            self.ledger.unstock("forward_carryover")
+            self.ledger.unstock("forward_inflight")
             if self.forward_client.spool is not None:
                 self.latency.unregister_queue("forward_spool")
+                self.ledger.unstock("forward_spool")
         if self.diagnostics is not None:
             self.diagnostics.stop()
         self.trace_client.close()
@@ -1200,6 +1277,10 @@ class Server:
         self.stats.inc("metrics_flushed", len(batch))
         phases["store_flush_s"] = time.perf_counter() - t_store
         phases["preflush_s"] = t_store - flush_start
+        # flush-stage ledger rows (informational): what this interval's
+        # snapshot produced
+        self.ledger.note("flush.emitted", len(batch))
+        self.ledger.note("flush.forward_rows", len(fwd))
 
         # dispatch even with an empty snapshot when a previous interval's
         # failed state is pending (in carryover OR the durable spool) —
@@ -1212,6 +1293,9 @@ class Server:
                                       > 0)))
         if self.is_local and self.forwarder is not None and (
                 len(fwd) or pending_carryover):
+            # flow ledger: everything snapshotted for the forward plane
+            # is owed an outcome (ack / merge-away / shed / inventory)
+            self.ledger.note("forward.snapshot", len(fwd))
             if not _start_sink_thread("forward", self._forward_safe, fwd) \
                     and self.forward_client is not None and len(fwd):
                 # undispatched interval (previous forward still hung):
@@ -1332,6 +1416,13 @@ class Server:
             self.statsd.gauge("worker.ssf.spans_dropped_total",
                               self.spans_dropped + span_sink_drops)
         self._reclaim_idle_rows()
+        # interval close for the flow ledger: fold the probe deltas,
+        # read the inventory stocks, run every conservation check. In
+        # strict mode (tests) an imbalance raises out of flush(); in
+        # production it exports ledger.imbalance and records an event.
+        if self.ledger.enabled:
+            record = self.ledger.close_interval()
+            round_info["ledger"] = record.get("imbalance", {})
 
     def _reclaim_idle_rows(self) -> None:
         """Idle-key reclamation + intern-table self-metrics, once per
@@ -1511,6 +1602,7 @@ class Server:
                     fb(batch)
                 else:
                     sink.flush(batch.materialize())
+                self.ledger.note("egress.acked", len(batch), key=name)
                 return ok
             selected = [mm for mm in batch.materialize()
                         if mm.sinks is None or name in mm.sinks]
@@ -1518,6 +1610,8 @@ class Server:
                 selected = _apply_sink_filters(selected, sc)
             current = selected
             sink.flush(spill + selected if spill else selected)
+            self.ledger.note("egress.acked",
+                             len(selected) + len(spill or ()), key=name)
             return ok
         except Exception:
             logger.exception("sink %s flush failed", sink.name())
@@ -1527,6 +1621,7 @@ class Server:
             if spill:
                 self.statsd.count("flush.spill_shed_total", len(spill),
                                   tags=[f"sink:{key}"])
+                self.ledger.note("egress.shed", len(spill), key=key)
                 logger.error(
                     "sink %s: shedding %d spilled metrics after a failed "
                     "retry (one-interval spill bound)", key, len(spill))
@@ -1547,6 +1642,7 @@ class Server:
                     current = []
             if current:
                 self._sink_spill[key] = current
+                self.ledger.note("egress.spilled", len(current), key=key)
             return False
 
 

@@ -177,6 +177,7 @@ class ShardedHistoTable(HistoTable):
             ok = rows >= 0
             rows = rows[ok]
             self.touched[rows] = True
+            self._note_applied(int(rows.size))
             self.apply_lock.acquire()
         try:
             i = self._next
@@ -284,6 +285,7 @@ class ShardedSetTable(SetTable):
             ok = rows >= 0
             rows = rows[ok]
             self.touched[rows] = True
+            self._note_applied(int(rows.size))
             self.apply_lock.acquire()
         try:
             i = self._next

@@ -27,7 +27,8 @@ import grpc
 from veneur_tpu.core.flusher import ForwardableState
 from veneur_tpu.forward.convert import forwardable_to_wire
 from veneur_tpu.forward.wire import (_frame_v1, _serialize_metric,
-                                     send_batch, token_metadata)
+                                     decode_flow_counts, send_batch,
+                                     token_metadata)
 from veneur_tpu.util import chaos as chaos_mod
 from veneur_tpu.util.chaos import ChaosError
 from veneur_tpu.util.grpctls import GrpcTLS, secure_or_insecure_channel
@@ -60,7 +61,8 @@ class ForwardClient:
                  breaker: Optional[CircuitBreaker] = None,
                  carryover: Optional[Carryover] = None,
                  chaos: Optional[chaos_mod.Chaos] = None,
-                 spool: Optional[CarryoverSpool] = None):
+                 spool: Optional[CarryoverSpool] = None,
+                 ledger=None):
         self.address = address
         self.deadline = deadline
         # resilience: callers that want fail-and-forget (veneur-emit's
@@ -79,6 +81,14 @@ class ForwardClient:
         if spool is not None and self.carryover.spill is None:
             self.carryover.spill = self._spill
         self.chaos = chaos
+        # flow ledger (core/ledger.py): acked/shed stamps plus the
+        # in-flight inventory stock, so a close landing mid-send still
+        # balances; the receiver's FlowCounts response feeds the
+        # forward_tier reconciliation (sent vs merged across the tier)
+        self.ledger = ledger
+        if ledger is not None and self.carryover.ledger is None:
+            self.carryover.ledger = ledger
+        self.inflight_metrics = 0
         # interval+shard idempotency token: every forward() call mints
         # one token that rides ALL its attempts (V1 body, V2 fallback,
         # every retry) as gRPC metadata — the import server merges the
@@ -143,7 +153,45 @@ class ForwardClient:
         prefers one unary SendMetrics (MetricList) — per-message stream
         overhead at 50k keys costs seconds — falling back to the V2
         stream for importers that reject V1."""
+        self.inflight_metrics = len(fwd)
+        try:
+            return self._forward_inner(fwd)
+        finally:
+            # an unexpected exception past this point loses the state
+            # with no outcome stamped — clearing the in-flight stock
+            # here makes that loss VISIBLE as ledger imbalance instead
+            # of hiding it behind a stuck inventory level
+            self.inflight_metrics = 0
+
+    def _note(self, stage: str, n: int, key: str = "") -> None:
+        led = self.ledger
+        if led is not None and n:
+            led.note(stage, n, key=key)
+
+    def _note_tier(self, sent: int, resp) -> None:
+        """Reconcile one acked send against the receiver's FlowCounts
+        response (None/empty = an un-upgraded peer; skipped)."""
+        counts = decode_flow_counts(resp)
+        if counts is None or not sent:
+            return
+        self._note("forward.acked_reported", sent)
+        if counts["duplicate"]:
+            # whole payload dropped by the receiver's token dedupe: a
+            # previous attempt already merged it
+            self._note("forward.remote_deduped", sent)
+            return
+        merged = int(counts["merged"])
+        received = int(counts["received"])
+        self._note("forward.remote_merged", merged)
+        # receiver-side accounted drops (unknown families, undecodable
+        # payloads): explained by the receiver, distinct from the
+        # unexplained residual (sent != received = wire-level loss)
+        if received > merged:
+            self._note("forward.remote_rejected", received - merged)
+
+    def _forward_inner(self, fwd: ForwardableState) -> int:
         fwd = self.carryover.drain_into(fwd)
+        self.inflight_metrics = len(fwd)
         spool_pending = self.spool is not None and self.spool.depth > 0
         if not len(fwd) and not spool_pending:
             return 0
@@ -157,8 +205,12 @@ class ForwardClient:
             return 0
         protos = forwardable_to_wire(fwd) if len(fwd) else []
         if not protos and not spool_pending:
+            # nonempty state that serialized to nothing leaves the
+            # pipeline here — explained as a convert shed
+            self._note("forward.shed", len(fwd), key="convert")
             return 0
         deadline_ts = time.monotonic() + self.deadline
+        resp = None
         if protos:
             # one token per interval payload, stable across every retry
             # and the V1->V2 fallback of THIS call — an attempt that
@@ -175,7 +227,7 @@ class ForwardClient:
                     # a single flush body scales with key count (~36 MB at
                     # 50k keys), so RESOURCE_EXHAUSTED here is structural,
                     # not transient — both codes pin the client to V2
-                    self._v1_ok = send_batch(
+                    self._v1_ok, resp = send_batch(
                         self._send_v1, self._send_v2, protos, timeout,
                         self._v1_ok,
                         pin_codes=(grpc.StatusCode.UNIMPLEMENTED,
@@ -215,10 +267,25 @@ class ForwardClient:
                 # so don't close a half-open breaker on it — release the
                 # probe pessimistically instead
                 self.breaker.record_failure()
+                # fwd can only be nonempty here when it serialized to
+                # zero protos — unconvertible state that leaves the
+                # pipeline now, explained as a convert shed
+                self._note("forward.shed", len(fwd), key="convert")
                 return 0
         self.breaker.record_success()
         self.carryover.clear_age()
         self.stats["forwarded_total"] += len(protos)
+        if protos:
+            self._note("forward.acked", len(protos))
+            self._note_tier(len(protos), resp)
+        if len(fwd) > len(protos):
+            # rows the wire conversion dropped, accounted only on
+            # success (a failed send stashes the FULL state back);
+            # outside the `if protos` guard so a spool-drain-only
+            # success with a fully-unconvertible snapshot still
+            # explains where that snapshot went
+            self._note("forward.shed", len(fwd) - len(protos),
+                       key="convert")
         logger.debug("forwarded %d metrics to %s", len(protos), self.address)
         return len(protos) + drained
 
@@ -288,7 +355,7 @@ class ForwardClient:
             try:
                 attempted = True
                 self._inject_chaos()
-                self._v1_ok = send_batch(
+                self._v1_ok, resp = send_batch(
                     self._send_v1, self._send_v2, metrics, remaining,
                     self._v1_ok,
                     pin_codes=(grpc.StatusCode.UNIMPLEMENTED,
@@ -328,6 +395,11 @@ class ForwardClient:
                 break
             self.spool.pop(seg)
             self._segment_attempts.pop(seg.path, None)
+            # the popped segment's stock delta is seg.count; ack the
+            # same figure so a header/body count drift surfaces as
+            # imbalance instead of silently canceling
+            self._note("forward.acked", seg.count, key="spool")
+            self._note_tier(len(metrics), resp)
             drained += len(metrics)
         if drained:
             logger.info("drained %d spilled metrics to %s (%d segments "

@@ -42,17 +42,22 @@ class ImportServer:
         self._grpc = grpc.server(
             futures.ThreadPoolExecutor(max_workers=max_workers),
             options=[("grpc.max_receive_message_length", 256 << 20)])
+        # responses carry FlowCounts (received/merged/duplicate) for the
+        # sender's flow-ledger tier reconciliation; a reference peer
+        # parses them as Empty-with-unknown-fields (forward/wire.py)
+        serialize_resp = (lambda b: b if isinstance(b, (bytes, bytearray))
+                          else b"")
         handler = grpc.method_handlers_generic_handler("forwardrpc.Forward", {
             "SendMetricsV2": grpc.stream_unary_rpc_method_handler(
                 self.rpc_stats.timed("SendMetricsV2", self._send_metrics_v2),
                 request_deserializer=metric_pb2.Metric.FromString,
-                response_serializer=lambda _: b""),
+                response_serializer=serialize_resp),
             "SendMetrics": grpc.unary_unary_rpc_method_handler(
                 self.rpc_stats.timed("SendMetrics", self._send_metrics_v1),
                 # raw bytes: the native MetricList decoder wants the wire
                 # body; the upb fallback parses it itself
                 request_deserializer=lambda b: b,
-                response_serializer=lambda _: b""),
+                response_serializer=serialize_resp),
         })
         self._grpc.add_generic_rpc_handlers((handler,))
         if tls:
@@ -121,9 +126,10 @@ class ImportServer:
         (vnt_import_parse: identity keys + pre-bucketed centroid grids
         in one C pass) with a cached-stub intern layer; an unavailable
         native library or unparseable body falls back to upb objects."""
+        from veneur_tpu.forward.wire import encode_flow_counts
         token, disposition = self._token_begin(ctx)
         if disposition == "done":
-            return b""
+            return encode_flow_counts(0, 0, duplicate=True)
         if disposition == "inflight":
             # the first attempt may yet fail; make the sender try again
             ctx.abort(grpc.StatusCode.UNAVAILABLE,
@@ -131,19 +137,22 @@ class ImportServer:
         ok = False
         try:
             self._note_arrival()
-            count = self._merge_native(body)
-            if count is None:
+            res = self._merge_native(body)
+            if res is None:
                 req = forward_pb2.MetricList.FromString(body)
                 buf = _MergeBuffer(self)
                 for pbm in req.metrics:
                     buf.add(pbm)
                 buf.flush_all()
-                count = len(req.metrics)
-            self.imported_total += count
+                received, merged = len(req.metrics), buf.admitted
+            else:
+                received, merged = res
+            self.imported_total += received
+            self._note_flow(received, merged)
             ok = True
         finally:
             self._token_end(token, ok)
-        return b""
+        return encode_flow_counts(received, merged)
 
     def _note_arrival(self, n: int = 1) -> None:
         """Sample-age stamp for the forward plane: forwarded intervals
@@ -153,7 +162,18 @@ class ImportServer:
         if latency is not None:
             latency.note_arrival("forward", n)
 
-    def _merge_unknown_families(self, body, batch) -> None:
+    def _note_flow(self, received: int, merged: int) -> None:
+        """Flow-ledger stamps for one import: `merged` metrics entered
+        the store (ingest.admitted, key forward — the table stamps the
+        matching applied/rejected side), `received` is informational
+        (and what the FlowCounts response reports back to the sender)."""
+        ledger = getattr(self._server, "ledger", None)
+        if ledger is None:
+            return
+        ledger.note("import.received", received, key="forward")
+        ledger.note("ingest.admitted", merged, key="forward")
+
+    def _merge_unknown_families(self, body, batch) -> int:
         """upb sweep behind the native V1 parser for families it does
         not know (llhist today): the C parser skips an unknown value
         field and silently drops the metric, so whenever it consumed
@@ -161,28 +181,34 @@ class ImportServer:
         with upb and merge just the stragglers. The mismatch also fires
         on genuinely-empty metrics (no value / empty digest), where the
         sweep finds nothing — one spare upb parse on a pathological
-        body, zero cost on the common path."""
+        body, zero cost on the common path. Returns the number of
+        straggler metrics the sweep merged (for the FlowCounts tally)."""
         emitted = (len(batch.c_keys) + len(batch.g_keys)
                    + len(batch.h_keys) + len(batch.s_keys))
         if emitted >= batch.consumed:
-            return
+            return 0
         try:
             req = forward_pb2.MetricList.FromString(body)
         except Exception:
             logger.warning("unknown-family sweep could not re-parse "
                            "import body (%d bytes)", len(body))
-            return
+            return 0
         buf = _MergeBuffer(self)
         for pbm in req.metrics:
             if pbm.WhichOneof("value") == "llhist":
                 buf.add(pbm)
         buf.flush_all()
+        return buf.admitted
 
     # -- native bulk merge ----------------------------------------------
 
     STUB_CACHE_MAX = 1_000_000
 
-    def _merge_native(self, body) -> Optional[int]:
+    def _merge_native(self, body) -> Optional[tuple]:
+        """Returns (received, merged) or None when the native parser is
+        unavailable — `merged` counts the metrics actually offered to
+        the store (the figure the FlowCounts response reports, and the
+        ingest.admitted ledger stamp)."""
         from veneur_tpu import native
 
         batch = native.parse_metric_list(
@@ -190,20 +216,24 @@ class ImportServer:
         if batch is None:
             return None
         store = self._server.store
+        merged = 0
         if batch.c_keys:
             stubs, ok = self._stubs_for(batch.c_keys)
             if stubs:
                 store.counters.merge_batch(stubs, batch.c_vals[ok])
+                merged += len(stubs)
         if batch.g_keys:
             stubs, ok = self._stubs_for(batch.g_keys)
             if stubs:
                 store.gauges.merge_batch(stubs, batch.g_vals[ok])
+                merged += len(stubs)
         if batch.h_keys:
             stubs, ok = self._stubs_for(batch.h_keys)
             if stubs:
                 store.histos.merge_batch(
                     stubs, batch.h_means[ok], batch.h_weights[ok],
                     batch.h_min[ok], batch.h_max[ok], batch.h_recip[ok])
+                merged += len(stubs)
         if batch.s_keys:
             stubs, ok = self._stubs_for(batch.s_keys)
             if stubs:
@@ -216,8 +246,9 @@ class ImportServer:
                         keep.append(stubs[i])
                 if regs:
                     store.sets.merge_batch(keep, np.stack(regs))
-        self._merge_unknown_families(body, batch)
-        return batch.consumed
+                    merged += len(regs)
+        merged += self._merge_unknown_families(body, batch)
+        return batch.consumed, merged
 
     def _stubs_for(self, keys):
         """Identity keys -> UDPMetric stubs through the intern cache.
@@ -277,6 +308,7 @@ class ImportServer:
                          scope=scope)
 
     def _send_metrics_v2(self, request_iterator, ctx):
+        from veneur_tpu.forward.wire import encode_flow_counts
         token, disposition = self._token_begin(ctx)
         if disposition == "done":
             # drain without merging so the sender's stream completes
@@ -284,7 +316,7 @@ class ImportServer:
             # acceptable on this path)
             for _ in request_iterator:
                 pass
-            return b""
+            return encode_flow_counts(0, 0, duplicate=True)
         if disposition == "inflight":
             ctx.abort(grpc.StatusCode.UNAVAILABLE,
                       "duplicate import racing its first attempt")
@@ -298,10 +330,11 @@ class ImportServer:
                 count += 1
             buf.flush_all()
             self.imported_total += count
+            self._note_flow(count, buf.admitted)
             ok = True
         finally:
             self._token_end(token, ok)
-        return b""
+        return encode_flow_counts(count, buf.admitted)
 
 
 class _MergeBuffer:
@@ -329,6 +362,10 @@ class _MergeBuffer:
         self.h_min, self.h_max, self.h_recip = [], [], []
         self.s_stubs, self.s_regs = [], []
         self.l_stubs, self.l_bins = [], []
+        # metrics accepted into a family buffer (vs skipped: no value,
+        # local scope, unknown type, undecodable payload) — the
+        # "merged" figure the FlowCounts response reports
+        self.admitted = 0
 
     def add(self, pbm: metric_pb2.Metric) -> None:
         which = pbm.WhichOneof("value")
@@ -352,11 +389,13 @@ class _MergeBuffer:
         stub = UDPMetric(key=key, digest=h32, digest64=h64,
                          tags=list(tags), scope=scope)
         if which == "counter":
+            self.admitted += 1
             self.c_stubs.append(stub)
             self.c_vals.append(float(pbm.counter.value))
             if len(self.c_stubs) >= self.SCALAR_CAP:
                 self._flush_counters()
         elif which == "gauge":
+            self.admitted += 1
             self.g_stubs.append(stub)
             self.g_vals.append(pbm.gauge.value)
             if len(self.g_stubs) >= self.SCALAR_CAP:
@@ -368,6 +407,7 @@ class _MergeBuffer:
                 # still clobber the row's min/max with default zeros
                 return
             n = len(d.main_centroids)
+            self.admitted += 1
             self.h_stubs.append(stub)
             self.h_means.append(np.fromiter(
                 (c.mean for c in d.main_centroids), np.float64, n))
@@ -381,6 +421,7 @@ class _MergeBuffer:
         elif which == "set":
             regs = _decode_hll(pbm.set.hyper_log_log)
             if regs is not None:
+                self.admitted += 1
                 self.s_stubs.append(stub)
                 self.s_regs.append(regs)
                 if len(self.s_stubs) >= self.SET_CAP:
@@ -393,6 +434,7 @@ class _MergeBuffer:
                 logger.warning("undecodable llhist payload (%d bytes) "
                                "dropped: %s", len(pbm.llhist.bins), e)
                 return
+            self.admitted += 1
             self.l_stubs.append(stub)
             self.l_bins.append(bins)
             if len(self.l_stubs) >= self.LLHIST_CAP:

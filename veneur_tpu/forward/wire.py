@@ -33,6 +33,83 @@ def _frame_v1(m) -> bytes:
     return b"".join(out)
 
 
+# -- flow-count responses ---------------------------------------------
+#
+# The reference Forward service answers with google.protobuf.Empty; the
+# flow ledger needs the receiver's side of the books, so this
+# framework's ImportServer (and the proxy handlers) answer with a tiny
+# proto-compatible message instead:
+#
+#   message FlowCounts {
+#     uint64 received  = 1;  // metrics parsed out of the request
+#     uint64 merged    = 2;  // metrics accepted into the pipeline
+#     bool   duplicate = 3;  // whole payload dropped by token dedupe
+#   }
+#
+# A reference peer parsing this as Empty ignores the unknown fields
+# (proto3 contract); a reference SERVER answering a genuine Empty gives
+# this framework's clients zero bytes, which decode_flow_counts maps to
+# None ("counts unreported") — the tier reconciliation simply skips
+# those sends. Hand-rolled varints keep this module protobuf-free.
+
+def encode_flow_counts(received: int, merged: int,
+                       duplicate: bool = False) -> bytes:
+    out = bytearray()
+
+    def field(tag: int, value: int) -> None:
+        out.append(tag << 3)  # wire type 0 (varint)
+        while value >= 0x80:
+            out.append(value & 0x7F | 0x80)
+            value >>= 7
+        out.append(value)
+
+    # field 1 is always present (even at 0) so any response bytes at
+    # all mean "counts reported"
+    field(1, max(0, int(received)))
+    if merged:
+        field(2, int(merged))
+    if duplicate:
+        field(3, 1)
+    return bytes(out)
+
+
+def decode_flow_counts(body) -> "dict | None":
+    """FlowCounts wire bytes -> {received, merged, duplicate}; None for
+    an empty/absent/undecodable response (an un-upgraded peer)."""
+    if not body or not isinstance(body, (bytes, bytearray)):
+        return None
+    out = {"received": 0, "merged": 0, "duplicate": False}
+    i, n = 0, len(body)
+    seen_received = False
+    while i < n:
+        tag = body[i]
+        i += 1
+        if tag & 0x07 != 0:  # only varint fields are ours; bail on rest
+            return None
+        value = shift = 0
+        while True:
+            if i >= n:
+                return None
+            byte = body[i]
+            i += 1
+            value |= (byte & 0x7F) << shift
+            if not byte & 0x80:
+                break
+            shift += 7
+            if shift > 63:
+                return None
+        fnum = tag >> 3
+        if fnum == 1:
+            out["received"] = value
+            seen_received = True
+        elif fnum == 2:
+            out["merged"] = value
+        elif fnum == 3:
+            out["duplicate"] = bool(value)
+        # unknown varint fields: ignored (forward compatibility)
+    return out if seen_received else None
+
+
 # gRPC metadata key carrying the sender's idempotency token: the import
 # server (and the proxy) remember recent tokens and ack-and-drop a
 # repeat, so an at-least-once retry or a hedged duplicate merges once
@@ -100,7 +177,7 @@ class TokenDeduper:
 
 
 def send_batch(send_v1, send_v2, batch, timeout, v1_ok: bool,
-               pin_codes, retry_codes=(), metadata=None) -> bool:
+               pin_codes, retry_codes=(), metadata=None):
     """One batch over the V1 bulk body when the peer takes it, else the
     V2 stream — the single transport policy both the forward client and
     the proxy destinations use, so the fallback semantics cannot drift.
@@ -109,7 +186,9 @@ def send_batch(send_v1, send_v2, batch, timeout, v1_ok: bool,
     return False so the caller stays on V2); `retry_codes` are
     transient V1 failures (retry via V2 but keep preferring V1). Any
     other error propagates for the caller's failure accounting.
-    Returns the updated v1-preference flag.
+    Returns (updated v1-preference flag, raw response bytes) — the
+    response carries the receiver's FlowCounts when it is this
+    framework's importer/proxy (decode_flow_counts), empty otherwise.
 
     `metadata` (e.g. token_metadata) rides on every attempt, INCLUDING
     the V2 retry of a failed V1 body: a V1 attempt the receiver applied
@@ -118,16 +197,18 @@ def send_batch(send_v1, send_v2, batch, timeout, v1_ok: bool,
     if v1_ok:
         try:
             body = b"".join(_frame_v1(m) for m in batch)
-            send_v1(body, timeout=timeout, metadata=metadata)
-            return True
+            resp = send_v1(body, timeout=timeout, metadata=metadata)
+            return True, resp
         except grpc.RpcError as e:
             code = e.code() if hasattr(e, "code") else None
             if code in pin_codes:
-                send_v2(iter(batch), timeout=timeout, metadata=metadata)
-                return False
+                resp = send_v2(iter(batch), timeout=timeout,
+                               metadata=metadata)
+                return False, resp
             if code in retry_codes:
-                send_v2(iter(batch), timeout=timeout, metadata=metadata)
-                return True
+                resp = send_v2(iter(batch), timeout=timeout,
+                               metadata=metadata)
+                return True, resp
             raise
-    send_v2(iter(batch), timeout=timeout, metadata=metadata)
-    return v1_ok
+    resp = send_v2(iter(batch), timeout=timeout, metadata=metadata)
+    return v1_ok, resp

@@ -22,7 +22,8 @@ import grpc
 
 from veneur_tpu.forward.protos import metric_pb2
 from veneur_tpu.forward.wire import (_frame_v1, _serialize_metric,
-                                     send_batch, token_metadata)
+                                     decode_flow_counts, send_batch,
+                                     token_metadata)
 from veneur_tpu.ops import hll_ref
 from veneur_tpu.proxy.ring import ConsistentRing, EmptyRingError
 from veneur_tpu.util import chaos as chaos_mod
@@ -46,9 +47,14 @@ class Destination:
                  observatory=None,
                  hedge_after: float = 0.0,
                  hedge_peer: Optional[Callable[[], Optional["Destination"]]]
-                 = None):
+                 = None, ledger=None):
         self.address = address
         self._on_close = on_close
+        # proxy flow ledger: successful sends reconcile against the
+        # receiver's FlowCounts response (proxy_tier identity); the
+        # enqueue/sent/drop counters below feed the proxy_egress
+        # identity via Destinations.flow_totals()
+        self.ledger = ledger
         # hedged sends: when a batch's primary send exceeds
         # `hedge_after` seconds, the SAME batch (same idempotency token)
         # fires at the next healthy ring member via `hedge_peer`; the
@@ -86,6 +92,19 @@ class Destination:
         self.sent_total = 0
         self.dropped_total = 0
         self.shed_open_total = 0  # immediate sheds while the breaker is open
+        # flow-ledger stage counters: metrics that made it INTO the
+        # queue, and the subset of dropped_total lost AFTER enqueue
+        # (batch send failures, close-time drains) — together with the
+        # live queue depth they satisfy enqueued == sent +
+        # dropped_enqueued + queued, pool-wide (hedge wins credit the
+        # delivering peer's sent_total, so the identity holds only in
+        # aggregate — and in aggregate is how the ledger checks it)
+        self.enqueued_total = 0
+        self.dropped_enqueued_total = 0
+        # metrics drained from the queue but not yet accounted sent/
+        # dropped — an inventory stock, so a ledger close landing
+        # mid-send still balances
+        self.inflight_batch = 0
         # distinct forwarded metric keys, as a p=14 HLL over the ring-key
         # hash (the proxy's side of the cardinality observatory: which
         # destination is absorbing a key explosion). Fed by note_key on
@@ -151,6 +170,8 @@ class Destination:
             return False
         try:
             self._queue.put_nowait(metric)
+            with self._counter_lock:
+                self.enqueued_total += 1
             return True
         except queue.Full:
             pass
@@ -164,6 +185,8 @@ class Destination:
             return False
         try:
             self._queue.put(metric, timeout=self._flush_interval)
+            with self._counter_lock:
+                self.enqueued_total += 1
             return True
         except queue.Full:
             with self._counter_lock:
@@ -188,6 +211,7 @@ class Destination:
             batch = self._drain_batch()
             if not batch:
                 continue
+            self.inflight_batch = len(batch)
             self._token_seq += 1
             token = f"dest:{self._token_id}:{self._token_seq}"
             try:
@@ -213,36 +237,68 @@ class Destination:
                     # close() here: probes/half-open own recovery.
                     self.breaker.record_failure()
                 else:
+                    # credit + in-flight clear under ONE lock hold so a
+                    # concurrent flow_totals() never sees the batch as
+                    # both sent and in flight
                     with self._counter_lock:
                         self.sent_total += len(batch)
+                        self.inflight_batch = 0
                     self.breaker.record_success()
             except (grpc.RpcError, ChaosError) as e:
                 self.breaker.record_failure()
                 with self._counter_lock:
                     self.dropped_total += len(batch)
+                    self.dropped_enqueued_total += len(batch)
+                    self.inflight_batch = 0
                 code = e.code() if hasattr(e, "code") else None
                 logger.warning("send to %s failed (%s), breaker %s",
                                self.address, code, self.breaker.state)
                 if not self.breaker.is_dispatchable:
+                    self.inflight_batch = 0
                     self.close(notify=True)
                     return
+            finally:
+                self.inflight_batch = 0
 
-    def send_now(self, batch, token: str, timeout: float = 10.0) -> None:
+    def send_now(self, batch, token: str, timeout: float = 10.0):
         """One blocking batch send with the idempotency token attached —
         also the entry point a PEER uses to deliver a hedged batch
         through this destination's channel. Raises grpc.RpcError on
-        failure (the caller owns breaker/drop accounting).
+        failure (the caller owns breaker/drop accounting). Returns the
+        raw response bytes (the receiver's FlowCounts, when upgraded),
+        already reconciled into the proxy's flow ledger.
 
         Proxy batches are <= self._batch small metrics, so
         RESOURCE_EXHAUSTED is far likelier transient receiver overload
         than an oversized body: retry via V2 but keep preferring V1;
         only UNIMPLEMENTED pins."""
-        self._v1_ok = send_batch(
+        self._v1_ok, resp = send_batch(
             self._send_v1, self._send_v2, batch, timeout,
             self._v1_ok,
             pin_codes=(grpc.StatusCode.UNIMPLEMENTED,),
             retry_codes=(grpc.StatusCode.RESOURCE_EXHAUSTED,),
             metadata=token_metadata(token))
+        self._note_tier(len(batch), resp)
+        return resp
+
+    def _note_tier(self, sent: int, resp) -> None:
+        """Reconcile one acked batch against the receiver's FlowCounts
+        (the proxy_tier identity); empty response = un-upgraded peer."""
+        led = self.ledger
+        if led is None or not sent:
+            return
+        counts = decode_flow_counts(resp)
+        if counts is None:
+            return
+        led.note("dest.acked_reported", sent)
+        if counts["duplicate"]:
+            led.note("dest.remote_deduped", sent)
+            return
+        merged = int(counts["merged"])
+        received = int(counts["received"])
+        led.note("dest.remote_merged", merged)
+        if received > merged:
+            led.note("dest.remote_rejected", received - merged)
 
     def _send_hedged(self, batch, token: str,
                      timeout: float = 10.0) -> bool:
@@ -270,7 +326,7 @@ class Destination:
         remaining = max(0.0, self._hedge_after
                         - (time.monotonic() - budget_start))
         try:
-            fut.result(timeout=remaining)
+            self._note_tier(len(batch), fut.result(timeout=remaining))
             return False
         except grpc.FutureTimeoutError:
             pass  # primary slow: hedge below
@@ -291,7 +347,8 @@ class Destination:
         except Exception:
             logger.exception("hedge peer selection failed")
         if peer is None or peer is self or peer.closed.is_set():
-            fut.result()  # nobody to hedge to: wait out the primary
+            # nobody to hedge to: wait out the primary
+            self._note_tier(len(batch), fut.result())
             return False
         self.hedge_fired_total += 1
         logger.info("hedging slow send to %s via %s (budget %.3fs)",
@@ -300,7 +357,7 @@ class Destination:
             peer.send_now(batch, token, timeout=timeout)
         except (grpc.RpcError, ChaosError):
             # hedge lost too: the primary is the last hope (may raise)
-            fut.result()
+            self._note_tier(len(batch), fut.result())
             return False
         self.hedge_wins_total += 1
         # delivery is credited to the node that actually absorbed it
@@ -328,6 +385,7 @@ class Destination:
         if drained:
             with self._counter_lock:
                 self.dropped_total += drained
+                self.dropped_enqueued_total += drained
             logger.info("destination %s closed with %d undelivered "
                         "metrics (counted dropped)", self.address, drained)
         if self._observatory is not None:
@@ -352,7 +410,9 @@ class Destinations:
                  max_consecutive_failures: int = 3,
                  observatory=None,
                  hedge_after: float = 0.0,
-                 failover_walk: int = 2):
+                 failover_walk: int = 2,
+                 ledger=None):
+        self._ledger = ledger
         self._lock = threading.RLock()
         self._pool: Dict[str, Destination] = {}
         self.ring = ConsistentRing()
@@ -382,10 +442,18 @@ class Destinations:
         # counters of destinations that left the pool (self-closed on
         # breaker open, or dropped by discovery): without this fold the
         # pool's lifetime sent/dropped accounting silently resets on
-        # churn — exactly when an operator is trying to balance a loss
+        # churn — exactly when an operator is trying to balance a loss.
+        # The flow-ledger stage counters (enqueued, dropped-after-
+        # enqueue, hedge outcomes) fold too, so /debug/ledger totals
+        # survive ring membership changes instead of going negative at
+        # the next probe delta.
         self.retired_sent_total = 0
         self.retired_dropped_total = 0
         self.retired_shed_open_total = 0
+        self.retired_enqueued_total = 0
+        self.retired_dropped_enqueued_total = 0
+        self.retired_hedge_fired_total = 0
+        self.retired_hedge_wins_total = 0
 
     def set_destinations(self, addresses: List[str]) -> None:
         """Reconcile the pool with a fresh discovery result."""
@@ -404,7 +472,8 @@ class Destinations:
                         observatory=self._observatory,
                         hedge_after=self._hedge_after,
                         hedge_peer=(lambda a=address:
-                                    self.hedge_peer_for(a)))
+                                    self.hedge_peer_for(a)),
+                        ledger=self._ledger)
                     if address not in self._ejected:
                         self.ring.add(address)
 
@@ -417,6 +486,10 @@ class Destinations:
         self.retired_sent_total += dest.sent_total
         self.retired_dropped_total += dest.dropped_total
         self.retired_shed_open_total += dest.shed_open_total
+        self.retired_enqueued_total += dest.enqueued_total
+        self.retired_dropped_enqueued_total += dest.dropped_enqueued_total
+        self.retired_hedge_fired_total += dest.hedge_fired_total
+        self.retired_hedge_wins_total += dest.hedge_wins_total
 
     def _remove_locked(self, address: str) -> None:
         dest = self._pool.pop(address, None)
@@ -531,6 +604,31 @@ class Destinations:
         with self._lock:
             return len(self._pool)
 
+    def flow_totals(self) -> Dict[str, float]:
+        """Pool-wide cumulative flow counters (live + retired) plus the
+        live queue depth — the proxy ledger's probe/stock source. The
+        retired folds make every figure monotonic across ring churn,
+        which is what lets the ledger treat them as counters."""
+        with self._lock:
+            pool = list(self._pool.values())
+            out = {
+                "enqueued": float(self.retired_enqueued_total),
+                "sent": float(self.retired_sent_total),
+                "dropped_enqueued":
+                    float(self.retired_dropped_enqueued_total),
+                "queued": 0.0,
+            }
+        for dest in pool:
+            # one lock hold per destination: the sender clears its
+            # in-flight stock under the same lock it credits sent/
+            # dropped, so this read can't see a batch on both sides
+            with dest._counter_lock:
+                out["enqueued"] += dest.enqueued_total
+                out["sent"] += dest.sent_total
+                out["dropped_enqueued"] += dest.dropped_enqueued_total
+                out["queued"] += dest._queue.qsize() + dest.inflight_batch
+        return out
+
     def telemetry_rows(self) -> List[tuple]:
         """(name, kind, value, tags) rows for the proxy's /metrics
         registry: per-destination send/drop/shed totals, queue depth,
@@ -539,7 +637,11 @@ class Destinations:
             pool = list(self._pool.values())
             failover = self.failover_routed_total
             retired = (self.retired_sent_total, self.retired_dropped_total,
-                       self.retired_shed_open_total)
+                       self.retired_shed_open_total,
+                       self.retired_enqueued_total,
+                       self.retired_dropped_enqueued_total,
+                       self.retired_hedge_fired_total,
+                       self.retired_hedge_wins_total)
         rows: List[tuple] = [
             ("proxy.ring.failover_routed", "counter", float(failover), ()),
             # churn-proof totals: per-destination rows below reset when a
@@ -548,6 +650,14 @@ class Destinations:
             ("proxy.dest.retired_dropped", "counter", float(retired[1]), ()),
             ("proxy.dest.retired_shed_open", "counter",
              float(retired[2]), ()),
+            ("proxy.dest.retired_enqueued", "counter",
+             float(retired[3]), ()),
+            ("proxy.dest.retired_dropped_enqueued", "counter",
+             float(retired[4]), ()),
+            ("proxy.dest.retired_hedge_fired", "counter",
+             float(retired[5]), ()),
+            ("proxy.dest.retired_hedge_wins", "counter",
+             float(retired[6]), ()),
         ]
         for dest in pool:
             tags = [f"destination:{dest.address}"]
@@ -557,8 +667,12 @@ class Destinations:
                          float(dest.hedge_wins_total), tags))
             rows.append(("proxy.dest.sent", "counter",
                          float(dest.sent_total), tags))
+            rows.append(("proxy.dest.enqueued", "counter",
+                         float(dest.enqueued_total), tags))
             rows.append(("proxy.dest.dropped", "counter",
                          float(dest.dropped_total), tags))
+            rows.append(("proxy.dest.dropped_enqueued", "counter",
+                         float(dest.dropped_enqueued_total), tags))
             rows.append(("proxy.dest.shed_open", "counter",
                          float(dest.shed_open_total), tags))
             rows.append(("proxy.dest.queue_depth", "gauge",

@@ -52,7 +52,9 @@ class ProxyServer:
                  health_http_url_template: str = "",
                  hedge_after: float = 0.0,
                  failover_walk: int = 2,
-                 telemetry=None):
+                 telemetry=None,
+                 ledger_enabled: bool = True,
+                 ledger_strict: bool = False):
         self.discoverer = discoverer
         self.forward_service = forward_service
         self.discovery_interval = discovery_interval
@@ -71,11 +73,62 @@ class ProxyServer:
         # same latency_observatory knob the server honors turns it off
         from veneur_tpu.core.latency import LatencyObservatory
         self.latency = LatencyObservatory(enabled=latency_observatory)
+        # flow ledger (core/ledger.py), the proxy's side of the
+        # conservation books: routing (received == routed + dropped +
+        # no-destination), the destination pool (enqueued == sent +
+        # dropped-after-enqueue + queued, retired folds included), and
+        # the tier reconciliation against receivers' FlowCounts.
+        # Intervals close on the discovery cadence (the proxy has no
+        # flush loop).
+        from veneur_tpu.core.ledger import FlowLedger
+        self.ledger = FlowLedger(
+            enabled=ledger_enabled, strict=ledger_strict,
+            on_event=self.telemetry.record_event)
+        self.ledger.declare(
+            "proxy_route", inputs=("proxy.received",),
+            outputs=("proxy.routed", "proxy.dropped",
+                     "proxy.no_destination"))
+        self.ledger.declare(
+            "proxy_egress", inputs=("dest.enqueued",),
+            outputs=("dest.sent", "dest.dropped_enqueued"),
+            stocks=("dest_queues",))
+        self.ledger.declare(
+            "proxy_tier", inputs=("dest.acked_reported",),
+            outputs=("dest.remote_merged", "dest.remote_rejected",
+                     "dest.remote_deduped"))
         self.destinations = Destinations(
             send_buffer=send_buffer, batch=batch, tls=destination_tls,
             max_consecutive_failures=max_consecutive_failures,
             observatory=self.latency,
-            hedge_after=hedge_after, failover_walk=failover_walk)
+            hedge_after=hedge_after, failover_walk=failover_walk,
+            ledger=self.ledger if self.ledger.enabled else None)
+        # probe the pool's monotonic flow totals (retired folds make
+        # them churn-proof) and its live queue depth as a stock. ONE
+        # flow_totals() snapshot per close, shared by all four readers:
+        # close_interval evaluates probes in registration order and
+        # stocks after them, so refreshing on the first (enqueued) read
+        # keeps the identity's sides from tearing against each other
+        dests = self.destinations
+        snap_box = {"snap": None, "t": 0.0}
+
+        def _flow(field: str, refresh: bool = False) -> float:
+            import time as _time
+            now = _time.monotonic()
+            # 1s freshness bound: a /metrics scrape between closes
+            # still reads near-live stock levels, while the close's
+            # back-to-back reads stay on one consistent snapshot
+            if (refresh or snap_box["snap"] is None
+                    or now - snap_box["t"] > 1.0):
+                snap_box["snap"] = dests.flow_totals()
+                snap_box["t"] = now
+            return snap_box["snap"][field]
+
+        self.ledger.probe("dest.enqueued",
+                          lambda: _flow("enqueued", refresh=True))
+        self.ledger.probe("dest.sent", lambda: _flow("sent"))
+        self.ledger.probe("dest.dropped_enqueued",
+                          lambda: _flow("dropped_enqueued"))
+        self.ledger.stock("dest_queues", lambda: _flow("queued"))
         # active ring health: probes every pool member each round,
         # ejecting/readmitting through the destination pool; membership
         # (DNS/SRV et al) re-resolves on the same cadence via the
@@ -122,6 +175,14 @@ class ProxyServer:
         # handle_metric runs on up to max_workers gRPC threads; python
         # dict += is not atomic, so counter accuracy needs a lock
         self._stats_lock = threading.Lock()
+        # routing counters feed the proxy_route identity as probes
+        # (per-interval deltas of the already-exact stats table)
+        for stage, key in (("proxy.received", "received_total"),
+                           ("proxy.routed", "routed_total"),
+                           ("proxy.dropped", "dropped_total"),
+                           ("proxy.no_destination", "no_destination_total"),
+                           ("proxy.deduped", "duplicates_dropped_total")):
+            self.ledger.probe(stage, lambda k=key: self._read_stat(k))
         self._shutdown = threading.Event()
         self._discovery_thread: Optional[threading.Thread] = None
 
@@ -131,17 +192,21 @@ class ProxyServer:
         self._grpc = grpc.server(
             futures.ThreadPoolExecutor(max_workers=max_workers),
             options=[("grpc.max_receive_message_length", 256 << 20)])
+        # responses carry FlowCounts (received/routed/duplicate) for
+        # the sender's flow-ledger tier reconciliation (forward/wire.py)
+        serialize_resp = (lambda b: b if isinstance(b, (bytes, bytearray))
+                          else b"")
         handler = grpc.method_handlers_generic_handler("forwardrpc.Forward", {
             "SendMetricsV2": grpc.stream_unary_rpc_method_handler(
                 self.rpc_stats.timed("SendMetricsV2", self._send_metrics_v2),
                 request_deserializer=metric_pb2.Metric.FromString,
-                response_serializer=lambda _: b""),
+                response_serializer=serialize_resp),
             "SendMetrics": grpc.unary_unary_rpc_method_handler(
                 self.rpc_stats.timed("SendMetrics", self._send_metrics_v1),
                 # raw bytes: the native route parser re-scatters the
                 # body without deserializing; upb is the fallback
                 request_deserializer=lambda b: b,
-                response_serializer=lambda _: b""),
+                response_serializer=serialize_resp),
         })
         self._grpc.add_generic_rpc_handlers((handler,))
         # listener layout mirrors the reference v2 proxy (proxy/proxy.go
@@ -247,6 +312,7 @@ class ProxyServer:
         if self.ring_health is not None:
             rows.extend(self.ring_health.telemetry_rows())
         rows.extend(self.latency.telemetry_rows())
+        rows.extend(self.ledger.telemetry_rows())
         return rows
 
     def cardinality_report(self, top: int = 20, name: str = "") -> dict:
@@ -282,11 +348,29 @@ class ProxyServer:
             "destinations": dests[:max(0, top)],
         }
 
+    def _read_stat(self, key: str) -> float:
+        with self._stats_lock:
+            return float(self.stats.get(key, 0))
+
     # -- discovery -------------------------------------------------------
 
     def _discovery_loop(self) -> None:
         while not self._shutdown.wait(self.discovery_interval):
             self._refresh_destinations()
+            # ledger intervals ride the discovery cadence — the proxy
+            # has no flush loop, and ~10s matches the server's interval
+            from veneur_tpu.core.ledger import LedgerImbalance
+            try:
+                self.ledger.close_interval()
+            except LedgerImbalance:
+                # strict mode on a live proxy: the imbalance is loud
+                # (ERROR + traceback + the ledger_imbalance event the
+                # close already recorded) but must not kill the
+                # discovery/health-refresh thread it shares
+                logger.exception("proxy flow-ledger conservation breach "
+                                 "(ledger_strict)")
+            except Exception:
+                logger.exception("proxy ledger close failed")
 
     def _refresh_destinations(self) -> None:
         try:
@@ -309,31 +393,43 @@ class ProxyServer:
     # -- handlers --------------------------------------------------------
 
     def _send_metrics_v1(self, body, ctx):
+        from veneur_tpu.forward.wire import encode_flow_counts
         token, disposition = self._deduper.begin(ctx)
         if disposition == "done":
             with self._stats_lock:
                 self.stats["duplicates_dropped_total"] += 1
-            return b""
+            return encode_flow_counts(0, 0, duplicate=True)
         if disposition == "inflight":
             ctx.abort(grpc.StatusCode.UNAVAILABLE,
                       "duplicate send racing its first attempt")
         ok = False
+        received = routed = 0
         try:
-            if self._route_native(body) is None:
+            res = self._route_native(body)
+            if res is None:
                 metric_list = forward_pb2.MetricList.FromString(body)
                 for pbm in metric_list.metrics:
-                    self.handle_metric(pbm)
+                    received += 1
+                    if self.handle_metric(pbm):
+                        routed += 1
+            else:
+                received, routed = res
             ok = True
         finally:
             self._deduper.end(token, ok)
-        return b""
+        # FlowCounts back to the local: received metrics this handler
+        # parsed, "merged" = routed onto a destination queue (drops and
+        # no-destination are this proxy's accounted loss)
+        return encode_flow_counts(received, routed)
 
-    def _route_native(self, body) -> Optional[int]:
+    def _route_native(self, body) -> Optional[tuple]:
         """Re-scatter a V1 body without deserializing: the native walk
         (vnt_route_parse) yields each metric's identity key + raw bytes;
         the ring key derives from the identity key once per key lifetime
         (the route cache) and destinations forward the raw bytes — both
-        V1 framing and the V2 stream serializer pass bytes through."""
+        V1 framing and the V2 stream serializer pass bytes through.
+        Returns (received, routed) for the FlowCounts response, or None
+        when the native walker is unavailable."""
         from veneur_tpu import native
 
         parsed = native.route_parse(body)
@@ -342,13 +438,17 @@ class ProxyServer:
         keys, raws = parsed
         cache = self._route_cache
         fast = routed = dropped = no_dest = 0
+        slow = slow_routed = 0
         try:
             for key, raw in zip(keys, raws):
                 if not key:
                     # wide open enum: the upb path decides (and raises
                     # the same way the stream path would); it also does
                     # its own received/routed accounting
-                    self.handle_metric(metric_pb2.Metric.FromString(raw))
+                    slow += 1
+                    if self.handle_metric(
+                            metric_pb2.Metric.FromString(raw)):
+                        slow_routed += 1
                     continue
                 fast += 1
                 cached = cache.get(key)
@@ -363,7 +463,10 @@ class ProxyServer:
                         type_name = metric_pb2.Type.Name(mtype).lower()
                     except (ValueError, IndexError):
                         fast -= 1  # slow path does its own accounting
-                        self.handle_metric(metric_pb2.Metric.FromString(raw))
+                        slow += 1
+                        if self.handle_metric(
+                                metric_pb2.Metric.FromString(raw)):
+                            slow_routed += 1
                         continue
                     tags = [t for t in tags
                             if not any(mm.match(t) for mm in self._ignore)]
@@ -396,31 +499,37 @@ class ProxyServer:
                 self.stats["routed_total"] += routed
                 self.stats["dropped_total"] += dropped
                 self.stats["no_destination_total"] += no_dest
-        return len(keys)
+        return fast + slow, routed + slow_routed
 
     def _send_metrics_v2(self, request_iterator, ctx):
+        from veneur_tpu.forward.wire import encode_flow_counts
         token, disposition = self._deduper.begin(ctx)
         if disposition == "done":
             with self._stats_lock:
                 self.stats["duplicates_dropped_total"] += 1
             for _ in request_iterator:  # complete the sender's stream
                 pass
-            return b""
+            return encode_flow_counts(0, 0, duplicate=True)
         if disposition == "inflight":
             ctx.abort(grpc.StatusCode.UNAVAILABLE,
                       "duplicate send racing its first attempt")
         ok = False
+        received = routed = 0
         try:
             for pbm in request_iterator:
-                self.handle_metric(pbm)
+                received += 1
+                if self.handle_metric(pbm):
+                    routed += 1
             ok = True
         finally:
             self._deduper.end(token, ok)
-        return b""
+        return encode_flow_counts(received, routed)
 
-    def handle_metric(self, pbm: metric_pb2.Metric) -> None:
+    def handle_metric(self, pbm: metric_pb2.Metric) -> bool:
         """Route one metric (handlers.go:100-164): hash key is
-        name + lowercase type + joined tags minus ignored tags."""
+        name + lowercase type + joined tags minus ignored tags.
+        Returns True when the metric landed on a destination queue
+        (the FlowCounts "merged" figure for this tier)."""
         with self._stats_lock:
             self.stats["received_total"] += 1
         tags = [t for t in pbm.tags
@@ -444,11 +553,12 @@ class ProxyServer:
         except EmptyRingError:
             with self._stats_lock:
                 self.stats["no_destination_total"] += 1
-            return
+            return False
         dest.note_key(key_hash)
         routed = dest.send(pbm)
         with self._stats_lock:
             self.stats["routed_total" if routed else "dropped_total"] += 1
+        return routed
 
 
 def create_static_proxy(destination_addresses: List[str],

@@ -67,6 +67,7 @@ class Chaos:
                  ingest_truncate_rate: float = 0.0,
                  ingest_duplicate_rate: float = 0.0,
                  ingest_rss_bytes: int = 0,
+                 ledger_leak: int = 0,
                  sleep=time.sleep):
         self.enabled = bool(enabled)
         self.error_rate = min(1.0, max(0.0, float(error_rate)))
@@ -85,6 +86,13 @@ class Chaos:
         self.ingest_duplicate_rate = min(
             1.0, max(0.0, float(ingest_duplicate_rate)))
         self._ingest_rss_bytes = max(0, int(ingest_rss_bytes))
+        # ledger drill: every Nth admitted sample is SILENTLY dropped
+        # (no shed accounting) so the flow ledger's conservation check
+        # has a deterministic bug to catch. The leak count is kept so
+        # the drill itself can assert the ledger found exactly it.
+        self.ledger_leak = max(0, int(ledger_leak))
+        self._leak_roll = 0
+        self.leaked_samples = 0
         self._rng = random.Random(seed)
         self._sleep = sleep
         self._lock = threading.Lock()
@@ -113,7 +121,8 @@ class Chaos:
                    ingest_duplicate_rate=getattr(
                        config, "chaos_ingest_duplicate_rate", 0.0),
                    ingest_rss_bytes=getattr(
-                       config, "chaos_ingest_rss_bytes", 0))
+                       config, "chaos_ingest_rss_bytes", 0),
+                   ledger_leak=getattr(config, "chaos_ledger_leak", 0))
 
     def inject(self, seam: str) -> None:
         """Run the seam: maybe sleep, maybe raise ChaosError. Called on
@@ -192,6 +201,21 @@ class Chaos:
             # drop: the packet simply vanishes (counted above)
         return out
 
+    def leak_sample(self) -> bool:
+        """The deliberate silent-drop seam: True for every
+        `ledger_leak`-th call (deterministic, no RNG), meaning the
+        caller must drop the sample WITHOUT any shed accounting — the
+        exact bug class the flow ledger exists to catch."""
+        if not self.enabled or self.ledger_leak <= 0:
+            return False
+        with self._lock:
+            self._leak_roll += 1
+            if self._leak_roll >= self.ledger_leak:
+                self._leak_roll = 0
+                self.leaked_samples += 1
+                return True
+        return False
+
     def simulated_rss_bytes(self) -> int:
         """Extra bytes the watermark monitor adds to real RSS."""
         if not self.enabled:
@@ -216,6 +240,9 @@ class Chaos:
             rows.extend(("chaos.packet_faults", "counter", float(n),
                          [f"action:{action}"])
                         for action, n in self.packet_faults.items())
+            if self.leaked_samples:
+                rows.append(("chaos.ledger_leaked", "counter",
+                             float(self.leaked_samples), ()))
         return rows
 
 

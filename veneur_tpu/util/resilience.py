@@ -348,8 +348,16 @@ class Carryover:
     telemetry scraper reads `depth` concurrently.
     """
 
-    def __init__(self, max_intervals: int = 3, spill=None):
+    def __init__(self, max_intervals: int = 3, spill=None, ledger=None):
         self.max_intervals = max(0, int(max_intervals))
+        # flow ledger (core/ledger.py): the carryover is an inventory
+        # stock of the forward conservation identity; the EXPLAINED
+        # shrinkage when two intervals' rows merge associatively (same
+        # key -> one row) is stamped as forward.merged_away, sheds as
+        # forward.shed. Notes always fire OUTSIDE self._lock (the
+        # ledger lock is a leaf; the ledger's stock probe takes
+        # self._lock at interval close).
+        self.ledger = ledger
         # optional durable overflow (util/spool.py, wired by the forward
         # client): state that would be SHED at the age bound is handed
         # to `spill(state)` instead — serialized to the on-disk spool
@@ -371,6 +379,17 @@ class Carryover:
         with self._lock:
             return self._age
 
+    @property
+    def pending_metrics(self) -> int:
+        """Metric rows currently held — the ledger's stock level."""
+        with self._lock:
+            return len(self._pending) if self._pending is not None else 0
+
+    def _note(self, stage: str, n: int, key: str = "") -> None:
+        led = self.ledger
+        if led is not None and n:
+            led.note(stage, n, key=key)
+
     def stash(self, fwd) -> None:
         """Remember a failed interval's state. Merges into any pending
         state rather than replacing it: besides the forward thread's
@@ -378,21 +397,27 @@ class Carryover:
         it could not even dispatch (previous forward still hung), and
         those writers race."""
         overflow = None
+        merged_away = 0
         with self._lock:
             if self.max_intervals <= 0:
                 self.shed_total += len(fwd)
                 logger.error(
                     "carryover disabled: dropping %d forwardable metrics",
                     len(fwd))
+                self._note("forward.shed", len(fwd),
+                           key="carryover_disabled")
                 return
             if self._pending is not None:
+                before = len(fwd) + len(self._pending)
                 fwd = merge_forwardable(fwd, self._pending)
+                merged_away = before - len(fwd)
             self._pending = fwd
             self._age += 1
             self.stashed_total += 1
             if self._age > self.max_intervals:
                 overflow, self._pending = self._pending, None
                 self._age = 0
+        self._note("forward.merged_away", merged_away, key="stash")
         if overflow is None:
             return
         # past the age bound: spill to the durable spool when one is
@@ -401,9 +426,14 @@ class Carryover:
         # `depth` must never wait on an fsync.
         if self.spill is not None:
             try:
-                self.spill(overflow)
+                spilled = self.spill(overflow)
                 with self._lock:
                     self.spilled_total += len(overflow)
+                if spilled is not None and spilled < len(overflow):
+                    # serialization dropped rows (empty digests and the
+                    # like): they left the pipeline here, account them
+                    self._note("forward.shed", len(overflow) - spilled,
+                               key="convert")
                 logger.warning(
                     "carryover exceeded %d intervals: spilled %d "
                     "forwardable metrics to the durable spool",
@@ -413,6 +443,7 @@ class Carryover:
                 logger.exception("carryover spill failed; shedding")
         with self._lock:
             self.shed_total += len(overflow)
+        self._note("forward.shed", len(overflow), key="carryover_bound")
         logger.error(
             "carryover exceeded %d intervals: shedding %d "
             "forwardable metrics (counter deltas in them are "
@@ -430,7 +461,10 @@ class Carryover:
         self.merged_total += len(pending)
         logger.info("carryover: merging %d metrics from %d failed "
                     "interval(s) into this flush", len(pending), age)
-        return merge_forwardable(fwd, pending)
+        before = len(fwd) + len(pending)
+        fwd = merge_forwardable(fwd, pending)
+        self._note("forward.merged_away", before - len(fwd), key="drain")
+        return fwd
 
     def clear_age(self) -> None:
         """A successful send ends the failure streak."""

@@ -119,10 +119,16 @@ class CarryoverSpool:
     def __init__(self, directory: str,
                  max_bytes: int = 256 * 1024 * 1024,
                  max_segments: int = 1024,
-                 dwell_hist=None):
+                 dwell_hist=None, ledger=None):
         self.directory = directory
         self.max_bytes = max(0, int(max_bytes))
         self.max_segments = max(1, int(max_segments))
+        # flow ledger (core/ledger.py): the spool is an inventory stock
+        # of the forward conservation identity; bound sheds and
+        # quarantines stamp forward.shed so a dropped segment is
+        # explained loss, never unexplained imbalance. Notes fire
+        # outside self._lock.
+        self.ledger = ledger
         # optional latency-observatory llhist: spill->drain dwell rides
         # the shared queue.dwell telemetry under the caller's queue name
         self._dwell_hist = dwell_hist
@@ -213,6 +219,17 @@ class CarryoverSpool:
         with self._lock:
             return sum(seg.nbytes for seg in self._segments)
 
+    @property
+    def pending_metrics(self) -> int:
+        """Metric rows across all live segments — the ledger's stock."""
+        with self._lock:
+            return sum(seg.count for seg in self._segments)
+
+    def _note_shed(self, n: int, key: str) -> None:
+        led = self.ledger
+        if led is not None and n:
+            led.note("forward.shed", n, key=key)
+
     # -- spill -----------------------------------------------------------
 
     def append(self, metrics: List[bytes]) -> int:
@@ -272,6 +289,7 @@ class CarryoverSpool:
                 "carryover spool over bound: shedding oldest segment %s "
                 "(%d metrics — counter deltas in it are permanently lost)",
                 victim.path, victim.count)
+            self._note_shed(victim.count, "spool_bound")
             try:
                 os.unlink(victim.path)
             except OSError:
@@ -316,6 +334,7 @@ class CarryoverSpool:
                 return
             self.shed_total += 1
             self.shed_metrics_total += seg.count
+        self._note_shed(seg.count, "spool_quarantine")
         bad = seg.path + ".corrupt"
         try:
             os.replace(seg.path, bad)
