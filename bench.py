@@ -577,13 +577,27 @@ def run_scenario_sustained(num_keys: int = 100_000, interval_s: float = 10.0,
     server = rig.server
     flush_times = []
     flush_phases = []  # per-flush attribution (server.flush_phase_timings)
+    # per-flush self-tracing cost counters (trace/store.py): spans
+    # recorded + exemplars captured per flush, so the next BENCH round
+    # measures what the cross-tier trace plane costs under load
+    trace_marks = []
     orig_flush_locked = server._flush_locked
+
+    def _trace_mark():
+        plane = getattr(server, "trace_plane", None)
+        if plane is None:
+            return (0, 0)
+        return (plane.store.spans_recorded,
+                plane.exemplars.captured_total)
 
     def timed_flush():
         t0 = time.perf_counter()
+        mark = _trace_mark()
         orig_flush_locked()
         flush_times.append(time.perf_counter() - t0)
         flush_phases.append(dict(getattr(server, "flush_phase_timings", {})))
+        after = _trace_mark()
+        trace_marks.append((after[0] - mark[0], after[1] - mark[1]))
 
     server._flush_locked = timed_flush
     try:
@@ -631,6 +645,13 @@ def run_scenario_sustained(num_keys: int = 100_000, interval_s: float = 10.0,
         "offered_samples_per_sec": round(off_rate, 1),
         "sustained_keys": num_keys,
     }
+    if trace_marks:
+        extra["trace_spans_per_flush"] = {
+            "max": max(s for s, _e in trace_marks),
+            "total": sum(s for s, _e in trace_marks)}
+        extra["exemplars_per_flush"] = {
+            "max": max(e for _s, e in trace_marks),
+            "total": sum(e for _s, e in trace_marks)}
     if flush_phases:
         scalar = [{k: v for k, v in p.items()
                    if isinstance(v, (int, float))} for p in flush_phases]

@@ -101,8 +101,12 @@ def format_report(report: dict, intervals: int = 0) -> str:
             imb = rec.get("imbalance", {})
             bad = {k: v for k, v in imb.items() if abs(float(v)) > BAL}
             mark = f"  ** {bad} **" if bad else "  ok"
+            # the interval's self-trace id cross-links a finding to
+            # GET /debug/traces?trace_id=<id> on every tier it crossed
+            trace = (f"  trace={rec['trace_id']}"
+                     if rec.get("trace_id") else "")
             add(f"  #{rec.get('interval')}  "
-                f"closed={_fmt(rec.get('closed_unix'))}{mark}")
+                f"closed={_fmt(rec.get('closed_unix'))}{trace}{mark}")
             for stage in sorted(rec.get("stages", {})):
                 per_key = rec["stages"][stage]
                 total = sum(float(v) for v in per_key.values())

@@ -1,0 +1,742 @@
+"""Cross-tier self-tracing & exemplars (trace/store.py, the gRPC
+metadata carrier in forward/wire.py, and the propagation seams in the
+forward client, proxy, and import server): carrier round-trips incl.
+the V1->V2 fallback, hedged-duplicate dedupe yielding ONE span tree,
+the local->proxy->global acceptance topology, exemplar latest-wins
+merges + OpenMetrics rendering, and the slow-marked overhead soak."""
+
+import json
+import time
+import urllib.request
+
+import grpc
+import pytest
+
+from veneur_tpu import trace as trace_mod
+from veneur_tpu.config import Config
+from veneur_tpu.core.server import Server
+from veneur_tpu.forward import wire
+from veneur_tpu.forward.protos import metric_pb2
+from veneur_tpu.sinks.channel import ChannelMetricSink
+from veneur_tpu.testing.forwardtest import ForwardTestServer
+from veneur_tpu.trace import context as trace_ctx
+from veneur_tpu.trace import opentracing as ot
+from veneur_tpu.trace.store import (
+    ExemplarStore, SelfTracePlane, TraceStore, decode_exemplars,
+    encode_exemplars, parse_trace_id, trace_id_hex)
+
+pytestmark = pytest.mark.tracing
+
+
+def make_config(**overrides) -> Config:
+    cfg = Config()
+    cfg.interval = 10.0
+    cfg.hostname = "test"
+    cfg.tpu.counter_capacity = 128
+    cfg.tpu.gauge_capacity = 128
+    cfg.tpu.histo_capacity = 128
+    cfg.tpu.set_capacity = 64
+    cfg.tpu.batch_cap = 512
+    for k, v in overrides.items():
+        setattr(cfg, k, v)
+    return cfg.apply_defaults()
+
+
+def wait_until(fn, timeout=10.0, step=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(step)
+    return False
+
+
+class _FakeCtx:
+    """Duck-typed grpc.ServicerContext carrying invocation metadata."""
+
+    def __init__(self, md):
+        self._md = tuple(md or ())
+
+    def invocation_metadata(self):
+        return self._md
+
+
+# -- carriers --------------------------------------------------------------
+
+class TestGrpcMetadataCarrier:
+    def test_inject_extract_list_carrier(self):
+        tracer = ot.Tracer(service="svc")
+        span = tracer.start_span("op")
+        carrier = []
+        tracer.inject(span.context(), ot.FORMAT_GRPC_METADATA, carrier)
+        assert carrier and carrier[0][0] == wire.TRACE_KEY
+        got = tracer.extract(ot.FORMAT_GRPC_METADATA, carrier)
+        assert got.trace_id == span.context().trace_id
+        assert got.span_id == span.context().span_id
+
+    def test_inject_extract_dict_carrier(self):
+        tracer = ot.Tracer(service="svc")
+        ctx = ot.SpanContext(trace_id=0x1234, span_id=0x99)
+        carrier = {}
+        tracer.inject(ctx, ot.FORMAT_GRPC_METADATA, carrier)
+        got = tracer.extract(ot.FORMAT_GRPC_METADATA, carrier)
+        assert (got.trace_id, got.span_id) == (0x1234, 0x99)
+
+    def test_extract_from_servicer_context(self):
+        md = wire.trace_metadata(77, 88)
+        got = ot.Tracer().extract(ot.FORMAT_GRPC_METADATA, _FakeCtx(md))
+        assert (got.trace_id, got.span_id) == (77, 88)
+
+    def test_extract_empty_carrier_raises(self):
+        with pytest.raises(ot.SpanContextCorruptedException):
+            ot.Tracer().extract(ot.FORMAT_GRPC_METADATA, [])
+
+    def test_http_header_parity(self):
+        """The same context injected via the HTTP-header carrier and the
+        gRPC-metadata carrier extracts to identical lineage."""
+        tracer = ot.Tracer(service="svc")
+        ctx = ot.SpanContext(trace_id=314159, span_id=271828)
+        headers, metadata = {}, []
+        tracer.inject(ctx, ot.FORMAT_HTTP_HEADERS, headers)
+        tracer.inject(ctx, ot.FORMAT_GRPC_METADATA, metadata)
+        via_http = tracer.extract(ot.FORMAT_HTTP_HEADERS, headers)
+        via_grpc = tracer.extract(ot.FORMAT_GRPC_METADATA, metadata)
+        assert (via_http.trace_id, via_http.span_id) == \
+               (via_grpc.trace_id, via_grpc.span_id) == (314159, 271828)
+
+
+class TestWireHelpers:
+    def test_trace_metadata_roundtrip(self):
+        md = wire.trace_metadata(123, 456)
+        assert wire.extract_trace(_FakeCtx(md)) == (123, 456)
+
+    def test_untraced_is_none(self):
+        assert wire.trace_metadata(0, 5) is None
+        assert wire.extract_trace(_FakeCtx(())) == (0, 0)
+
+    def test_junk_value_degrades(self):
+        assert wire.parse_trace_value("nonsense") == (0, 0)
+        assert wire.parse_trace_value("a:b") == (0, 0)
+
+    def test_combine_metadata(self):
+        a = wire.token_metadata("t1")
+        b = wire.trace_metadata(1, 2)
+        combined = wire.combine_metadata(a, None, b)
+        assert len(combined) == 2
+        assert wire.combine_metadata(None, None) is None
+
+    def test_trace_id_hex_roundtrip(self):
+        assert parse_trace_id(trace_id_hex(0xdeadbeef)) == 0xdeadbeef
+        assert parse_trace_id("") == 0
+        assert parse_trace_id("zz") == 0
+
+
+# -- trace store -----------------------------------------------------------
+
+class TestTraceStore:
+    def test_record_and_report(self):
+        store = TraceStore()
+        store.record(7, 1, 0, "flush", "svc", 10, 20,
+                     tags={"interval": "3"})
+        store.record(7, 2, 1, "flush.sink", "svc", 11, 19)
+        rep = store.report()
+        assert len(rep["traces"]) == 1
+        trace = rep["traces"][0]
+        assert trace["trace_id"] == trace_id_hex(7)
+        assert trace["interval"] == 3
+        assert trace["span_count"] == 2
+        assert trace["roots"] == [1]
+
+    def test_filters(self):
+        store = TraceStore()
+        store.record(1, 1, 0, "a", "s", 0, 1, tags={"interval": "1"})
+        store.record(2, 2, 0, "b", "s", 0, 1, tags={"interval": "2"})
+        assert len(store.report(trace_id=trace_id_hex(2))["traces"]) == 1
+        assert store.report(interval=1)["traces"][0]["spans"][0]["name"] \
+            == "a"
+        assert len(store.report(limit=1)["traces"]) == 1
+
+    def test_bounds(self):
+        store = TraceStore(max_traces=2, max_spans=2)
+        for tid in (1, 2, 3):
+            store.record(tid, tid * 10, 0, "x", "s", 0, 1)
+        assert len(store) == 2
+        assert store.traces_evicted == 1
+        store.record(3, 31, 30, "y", "s", 0, 1)
+        store.record(3, 32, 30, "z", "s", 0, 1)  # over the span cap
+        assert store.spans_dropped == 1
+
+
+class TestExemplarStore:
+    def test_latest_wins_merge(self):
+        ex = ExemplarStore()
+        ex.merge("m", 1, 5.0, ts=100.0)
+        ex.merge("m", 2, 6.0, ts=50.0)   # older: ignored
+        assert ex.get("m")[0] == 1
+        ex.merge("m", 3, 7.0, ts=200.0)  # newer: wins
+        assert ex.get("m") == (3, 7.0, 200.0)
+
+    def test_for_series_suffix_and_bucket_bounds(self):
+        ex = ExemplarStore()
+        ex.capture("lat", 3.0, trace_id=9, ts=1.0)
+        assert ex.for_series("lat") == (9, 3.0, 1.0)
+        assert ex.for_series("lat.sum") == (9, 3.0, 1.0)
+        # a bucket line only carries the exemplar when its bound
+        # contains the value
+        assert ex.for_series("lat.bucket", ["le:2.9"]) is None
+        assert ex.for_series("lat.bucket", ["le:3.1"]) == (9, 3.0, 1.0)
+        assert ex.for_series("lat.bucket", ["le:+Inf"]) == (9, 3.0, 1.0)
+        assert ex.for_series("other") is None
+
+    def test_wire_roundtrip_and_junk(self):
+        entries = [("a.b", 0xabc, 1.5, 100.25), ("c", 7, 2.0, 99.0)]
+        data = encode_exemplars(entries)
+        assert decode_exemplars(data) == entries
+        assert decode_exemplars(b"not json") == []
+        assert decode_exemplars(b"[[1,2]]") == []
+        # hostile deep nesting (RecursionError inside json) must
+        # degrade to "no exemplars", never escape into the import
+        # handler's token bookkeeping
+        assert decode_exemplars(b"[" * 10000 + b"]" * 10000) == []
+        assert encode_exemplars([]) is None
+
+    def test_bounded_names(self):
+        ex = ExemplarStore(max_names=2)
+        for i in range(4):
+            ex.capture(f"n{i}", float(i), trace_id=1)
+        assert len(ex) == 2
+
+
+class TestPlane:
+    def test_sampling_gate(self):
+        plane = SelfTracePlane(sample_rate=0.0)
+        assert not plane.interval_sampled
+        assert plane.active_trace_hex() == ""
+        plane.maybe_capture("x", 1.0, always=True)
+        assert len(plane.exemplars) == 0
+
+    def test_follow_gates_recording_only(self):
+        plane = SelfTracePlane(sample_rate=0.0)
+        assert plane.follow(12345) is False
+        assert plane.span("s", 12345) is None
+        on = SelfTracePlane(sample_rate=1.0)
+        assert on.follow(12345) is True
+        span = on.span("s", 12345, parent_id=7)
+        span.finish()
+        rep = on.store.report(trace_id=trace_id_hex(12345))
+        assert rep["traces"][0]["spans"][0]["parent_id"] == 7
+
+    def test_watch_and_budget(self):
+        plane = SelfTracePlane()
+        plane.set_watch(["hot"])
+        plane.maybe_capture("cold", 1.0)
+        plane.maybe_capture("hot", 2.0)
+        plane.maybe_capture("hot", 3.0)  # first-per-interval wins
+        entry = plane.exemplars.get("hot")
+        assert entry is not None and entry[1] == 2.0
+        assert plane.exemplars.get("cold") is None
+        plane.roll()
+        plane.maybe_capture("hot", 4.0)
+        assert plane.exemplars.get("hot")[1] == 4.0
+
+
+# -- transport paths -------------------------------------------------------
+
+def _mk_meta(name):
+    from veneur_tpu.core.columnstore import RowMeta
+    from veneur_tpu.samplers.metrics import MetricScope
+    return RowMeta(name=name, tags=[], joined_tags="", digest32=1,
+                   scope=MetricScope.GLOBAL_ONLY, wire_type="counter")
+
+
+def _ambient(span):
+    return trace_ctx._current_span.set(span)
+
+
+class TestForwardClientCarries:
+    def test_v1_fallback_keeps_trace_metadata(self):
+        """A V2-only importer refuses the bulk body; the V2 retry of the
+        SAME flush still carries the trace + exemplar sidecars."""
+        from veneur_tpu.core.flusher import ForwardableState
+        from veneur_tpu.forward.client import ForwardClient
+
+        received = []
+        ft = ForwardTestServer(received.extend)  # V2-only
+        ft.start()
+        plane = SelfTracePlane()
+        plane.exemplars.capture("hh", 4.5, trace_id=555, ts=12.0)
+        try:
+            client = ForwardClient(ft.address, deadline=10.0,
+                                   trace_plane=plane)
+            fwd = ForwardableState()
+            fwd.counters.append((_mk_meta("fb.count"), 4.0))
+            parent = trace_mod.Span(None, "flush", "t", trace_id=555)
+            token = _ambient(parent)
+            try:
+                assert client.forward(fwd) == 1
+            finally:
+                trace_ctx._current_span.reset(token)
+            assert client._v1_ok is False  # pinned: the V2 path ran
+            assert wait_until(lambda: len(ft.call_metadata) >= 1)
+            md = ft.call_metadata[-1]
+            assert md[wire.TRACE_KEY] == f"555:{parent.id}"
+            blob = md["x-veneur-exemplars-bin"]
+            assert decode_exemplars(blob) == [("hh", 555, 4.5, 12.0)]
+            client.close()
+        finally:
+            ft.stop()
+
+    def test_unsampled_interval_sends_no_trace_metadata(self):
+        from veneur_tpu.core.flusher import ForwardableState
+        from veneur_tpu.forward.client import ForwardClient
+
+        received = []
+        ft = ForwardTestServer(received.extend)
+        ft.start()
+        try:
+            client = ForwardClient(ft.address, deadline=10.0,
+                                   trace_plane=SelfTracePlane())
+            fwd = ForwardableState()
+            fwd.counters.append((_mk_meta("plain.count"), 1.0))
+            assert client.forward(fwd) == 1  # no ambient span set
+            assert wait_until(lambda: len(ft.call_metadata) >= 1)
+            assert wire.TRACE_KEY not in ft.call_metadata[-1]
+            client.close()
+        finally:
+            ft.stop()
+
+
+class TestSinkExemplarRules:
+    def test_counter_only_one_line_per_family(self):
+        from veneur_tpu.samplers.metrics import InterMetric, MetricType
+        from veneur_tpu.sinks.prometheus import render_exposition
+        ex = ExemplarStore()
+        ex.capture("lat", 3.0, trace_id=5, ts=1.0)
+        ex.capture("hits", 7.0, trace_id=5, ts=1.0)
+
+        def source(name, tags):
+            from veneur_tpu.trace.store import (
+                render_openmetrics_exemplar)
+            entry = ex.for_series(name, tags)
+            return (render_openmetrics_exemplar(entry)
+                    if entry else None)
+
+        metrics = [
+            InterMetric(name="lat.50percentile", timestamp=1, value=2.0,
+                        tags=[], type=MetricType.GAUGE),
+            InterMetric(name="lat.sum", timestamp=1, value=9.0,
+                        tags=[], type=MetricType.GAUGE),
+            InterMetric(name="lat.count", timestamp=1, value=3.0,
+                        tags=[], type=MetricType.COUNTER),
+            InterMetric(name="lat.bucket", timestamp=1, value=1.0,
+                        tags=["le:2.0"], type=MetricType.COUNTER),
+            InterMetric(name="lat.bucket", timestamp=1, value=3.0,
+                        tags=["le:3.1"], type=MetricType.COUNTER),
+            InterMetric(name="lat.bucket", timestamp=1, value=3.0,
+                        tags=["le:+Inf"], type=MetricType.COUNTER),
+            InterMetric(name="hits", timestamp=1, value=7.0,
+                        tags=[], type=MetricType.COUNTER),
+        ]
+        text = render_exposition(metrics, exemplars=source)
+        ex_lines = [ln for ln in text.splitlines() if "trace_id=" in ln]
+        # the llhist family: ONLY the tightest containing bucket —
+        # never the gauges (.sum, percentiles) and not .count; the
+        # heavy-hitter counter takes its own exact-name exemplar
+        assert sorted(ln.split("{")[0].split(" ")[0]
+                      for ln in ex_lines) == ["hits", "lat_bucket"]
+        assert 'le="3.1"' in next(ln for ln in ex_lines
+                                  if ln.startswith("lat_bucket"))
+
+    def test_parse_tolerates_clause_and_keeps_hash_labels(self):
+        from veneur_tpu.sources.openmetrics import parse_exposition
+        text = ('foo{msg="err # {code} 5"} 1\n'
+                'bar_bucket{le="2.0"} 3 # {trace_id="abc"} 1.5 99.0\n')
+        got = {name: (labels, value)
+               for _t, name, labels, value in parse_exposition(text)}
+        # a quoted label value containing " # {...}" still parses
+        assert got["foo"] == ({"msg": "err # {code} 5"}, 1.0)
+        # and the exemplified line isn't silently dropped
+        assert got["bar_bucket"] == ({"le": "2.0"}, 3.0)
+
+
+class TestHostileExemplarBlobNoTokenWedge:
+    def test_import_retry_passes_after_hostile_blob(self):
+        """A hostile exemplar sidecar must not wedge the idempotency
+        token in-flight: the send still merges, and a RETRY with the
+        same token is answered as a duplicate (not refused forever)."""
+        from veneur_tpu.forward.server import ImportServer
+        from veneur_tpu.trace.store import EXEMPLAR_KEY
+
+        gserver = Server(make_config(),
+                         extra_metric_sinks=[ChannelMetricSink()])
+        imp = ImportServer(gserver, "127.0.0.1:0")
+        imp.start()
+        try:
+            pbm = metric_pb2.Metric(name="hostile.c",
+                                    type=metric_pb2.Counter,
+                                    scope=metric_pb2.Global)
+            pbm.counter.value = 1
+            body = wire._frame_v1(pbm)
+            md = wire.combine_metadata(
+                wire.token_metadata("hostile-tok"),
+                wire.trace_metadata(111, 222),
+                ((EXEMPLAR_KEY, b"[" * 2000 + b"]" * 2000),))
+            ch = grpc.insecure_channel(imp.address)
+            send_v1 = ch.unary_unary(
+                "/forwardrpc.Forward/SendMetrics",
+                request_serializer=lambda b: b,
+                response_deserializer=lambda b: b)
+            r1 = send_v1(body, metadata=md)
+            assert wire.decode_flow_counts(r1)["merged"] == 1
+            r2 = send_v1(body, metadata=md)
+            assert wire.decode_flow_counts(r2)["duplicate"] is True
+            ch.close()
+        finally:
+            imp.stop()
+            gserver.shutdown()
+
+
+class TestHedgedDuplicateOneTree:
+    def test_token_dedupe_discards_loser_span(self):
+        """Two attempts with the SAME idempotency token + trace lineage
+        (a hedge pair, or a retry of a landed send) must yield exactly
+        one import.merge span — the loser is dropped whole before any
+        tracing work happens."""
+        from veneur_tpu.forward.server import ImportServer
+
+        gserver = Server(make_config(),
+                         extra_metric_sinks=[ChannelMetricSink()])
+        imp = ImportServer(gserver, "127.0.0.1:0")
+        imp.start()
+        try:
+            pbm = metric_pb2.Metric(name="hedge.c",
+                                    type=metric_pb2.Counter,
+                                    scope=metric_pb2.Global)
+            pbm.counter.value = 3
+            body = wire._frame_v1(pbm)
+            md = wire.combine_metadata(
+                wire.token_metadata("hedge-tok-1"),
+                wire.trace_metadata(909, 808))
+            ch = grpc.insecure_channel(imp.address)
+            send_v1 = ch.unary_unary(
+                "/forwardrpc.Forward/SendMetrics",
+                request_serializer=lambda b: b,
+                response_deserializer=lambda b: b)
+            r1 = send_v1(body, metadata=md)
+            r2 = send_v1(body, metadata=md)  # the hedged duplicate
+            assert wire.decode_flow_counts(r1)["merged"] == 1
+            assert wire.decode_flow_counts(r2)["duplicate"] is True
+            assert imp.duplicates_dropped_total == 1
+            rep = gserver.trace_plane.store.report(
+                trace_id=trace_id_hex(909))
+            spans = rep["traces"][0]["spans"]
+            merges = [s for s in spans if s["name"] == "import.merge"]
+            assert len(merges) == 1  # ONE connected tree, loser gone
+            assert merges[0]["parent_id"] == 808
+            ch.close()
+        finally:
+            imp.stop()
+            gserver.shutdown()
+
+
+# -- the acceptance topology ----------------------------------------------
+
+def _http_json(api, path):
+    host, port = api.address
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+class TestForwardtestTopology:
+    def test_one_connected_trace_and_exemplar(self):
+        """ISSUE 10 acceptance: a local->proxy->global run yields, for
+        one flush interval, a single connected trace (shared trace_id,
+        resolvable parent links) spanning the local flush root,
+        proxy.route, global import.merge, and global sink-ack spans —
+        retrievable from /debug/traces on all three tiers — and an
+        OpenMetrics exposition line for a llhist series carrying an
+        exemplar whose trace_id matches after the forward merge."""
+        from veneur_tpu.core.httpapi import HTTPApi
+        from veneur_tpu.proxy.proxy import create_static_proxy
+        from veneur_tpu.sinks.prometheus import PrometheusMetricSink
+
+        prom = PrometheusMetricSink("prometheus")
+        gobs = ChannelMetricSink()
+        gserver = Server(
+            make_config(grpc_address="127.0.0.1:0",
+                        http_address="127.0.0.1:0"),
+            extra_metric_sinks=[gobs, prom])
+        gserver.start()
+        proxy = create_static_proxy([gserver.import_server.address])
+        proxy.start()
+        proxy_api = HTTPApi({}, server=None, address="127.0.0.1:0",
+                            telemetry=proxy.telemetry,
+                            traces=proxy.trace_plane.report)
+        proxy_api.start()
+        local = Server(
+            make_config(forward_address=proxy.address,
+                        http_address="127.0.0.1:0"),
+            extra_metric_sinks=[ChannelMetricSink()])
+        local.start()
+        try:
+            local.handle_metric_packet(b"topo.gc:5|c|#veneurglobalonly")
+            local.handle_metric_packet(b"topo.lat:3|l")
+            local.flush()
+            local.trace_client.flush()
+            assert wait_until(
+                lambda: gserver.import_server.imported_total >= 1)
+            gserver.flush()
+            gserver.trace_client.flush()
+            gobs.wait_flush(timeout=10)
+
+            lrep = _http_json(local.http_api, "/debug/traces")
+            tid = lrep["traces"][-1]["trace_id"]
+            assert tid
+            prep = _http_json(proxy_api, f"/debug/traces?trace_id={tid}")
+            grep_ = _http_json(gserver.http_api,
+                               f"/debug/traces?trace_id={tid}")
+            assert prep["traces"] and grep_["traces"]
+
+            spans = []
+            for rep in (lrep, prep, grep_):
+                for trace in rep["traces"]:
+                    if trace["trace_id"] == tid:
+                        spans.extend(trace["spans"])
+            names = {s["name"] for s in spans}
+            assert {"flush", "flush.sink", "proxy.route",
+                    "proxy.dest.send", "import.merge"} <= names
+            # exactly one root across ALL tiers: the local flush span;
+            # every other span's parent link resolves
+            by_id = {s["span_id"]: s for s in spans}
+            roots = [s for s in spans
+                     if not s["parent_id"] or s["parent_id"] not in by_id]
+            assert len(roots) == 1 and roots[0]["name"] == "flush"
+            # two flush spans total (local root + global child), two
+            # tiers' worth of sink-ack spans in the same tree
+            assert sum(1 for s in spans if s["name"] == "flush") == 2
+
+            # exemplar: the llhist series' OpenMetrics exposition on
+            # the GLOBAL carries the interval's trace id after the
+            # forward merge; the plain 0.0.4 rendering stays clean
+            # (mid-line `#` would break 0.0.4 parsers)
+            exposition = prom.exposition_openmetrics()
+            assert f'# {{trace_id="{tid}"}} 3' in exposition
+            assert exposition.endswith("# EOF\n")
+            assert "trace_id=" not in prom.exposition_plain()
+            ex_sink_lines = [ln for ln in exposition.splitlines()
+                             if "trace_id=" in ln]
+            # exactly the bucket line — never gauges (percentiles,
+            # .sum) and at most one line per exemplar family
+            assert ex_sink_lines == [ln for ln in ex_sink_lines
+                                     if ln.startswith("topo_lat_bucket")]
+            assert len(ex_sink_lines) == 1  # tightest containing bucket
+            # the repo's own exposition parser survives the clause
+            from veneur_tpu.sources.openmetrics import parse_exposition
+            parsed_names = {n for _t, n, _l, _v
+                            in parse_exposition(exposition)}
+            # the exemplified bucket line parses instead of being
+            # silently dropped
+            assert "topo_lat_bucket" in parsed_names
+            # /metrics on the global renders plane counters; exemplars
+            # only under OpenMetrics content negotiation
+            host, port = gserver.http_api.address
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics",
+                    timeout=10) as resp:
+                metrics_text = resp.read().decode()
+            assert "veneur_trace_store_spans_recorded_total" in \
+                metrics_text
+            assert "veneur_exemplar_merged_total" in metrics_text
+            assert "trace_id=" not in metrics_text  # plain scrape
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/metrics",
+                headers={"Accept": "application/openmetrics-text"})
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                om_type = resp.headers.get("Content-Type", "")
+                om_text = resp.read().decode()
+            assert "openmetrics-text" in om_type
+            assert om_text.endswith("# EOF\n")
+            ex_lines = [ln for ln in om_text.splitlines()
+                        if "# {trace_id=" in ln]
+            # counters only (exemplars on gauges are invalid
+            # OpenMetrics), at most once per metric name
+            assert ex_lines
+            assert all("_total" in ln.split(" ")[0] or "_count" in
+                       ln.split(" ")[0] for ln in ex_lines)
+        finally:
+            local.shutdown()
+            proxy_api.stop()
+            proxy.stop()
+            gserver.shutdown()
+
+
+class TestEventAndLedgerCrossLinks:
+    def test_events_and_ledger_carry_interval_trace(self):
+        from veneur_tpu.core.httpapi import HTTPApi
+
+        server = Server(make_config(http_address="127.0.0.1:0"),
+                        extra_metric_sinks=[ChannelMetricSink()])
+        server.start()
+        try:
+            server.handle_metric_packet(b"ev.c:1|c")
+            server.flush()
+            rounds = server.telemetry.flushes.snapshot()
+            tid = rounds[-1]["trace_id"]
+            assert tid
+            # flush events stamped with the interval's trace id, and
+            # ?trace_id= filters to them
+            payload = _http_json(server.http_api,
+                                 f"/debug/events?trace_id={tid}")
+            kinds = {e["kind"] for e in payload["events"]}
+            assert "flush" in kinds
+            assert all(e["trace_id"] == tid for e in payload["events"])
+            other = _http_json(server.http_api,
+                               "/debug/events?trace_id=ffffffff")
+            assert other["events"] == []
+            # the ledger's closed interval cross-links the same trace
+            record = server.ledger.report()["intervals"][-1]
+            assert record["trace_id"] == tid
+            # the waterfall view carries it too
+            waterfall = _http_json(server.http_api,
+                                   "/debug/flush?waterfall=1")
+            assert waterfall["rounds"][-1]["trace_id"] == tid
+        finally:
+            server.shutdown()
+
+    def test_flow_report_prints_trace_id(self):
+        import importlib.util
+        import os
+        path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "scripts", "flow_report.py")
+        spec = importlib.util.spec_from_file_location("flow_report", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        format_report = mod.format_report
+        report = {
+            "identities": {}, "stage_totals": {}, "stocks": {},
+            "intervals": [{"interval": 1, "closed_unix": 1.0,
+                           "trace_id": "abc123", "imbalance": {},
+                           "stages": {}}],
+        }
+        text = format_report(report)
+        assert "trace=abc123" in text
+
+
+class TestSamplingKnob:
+    def test_rate_zero_disables_recording_and_propagation(self):
+        received = []
+        ft = ForwardTestServer(received.extend)
+        ft.start()
+        try:
+            server = Server(
+                make_config(forward_address=ft.address,
+                            trace_self_sample_rate=0.0),
+                extra_metric_sinks=[ChannelMetricSink()])
+            server.start()
+            server.handle_metric_packet(b"off.c:2|c|#veneurglobalonly")
+            server.flush()
+            server.trace_client.flush()
+            assert wait_until(lambda: len(ft.call_metadata) >= 1)
+            assert wire.TRACE_KEY not in ft.call_metadata[-1]
+            assert len(server.trace_plane.store) == 0
+            rounds = server.telemetry.flushes.snapshot()
+            assert "trace_id" not in rounds[-1]
+            server.shutdown()
+        finally:
+            ft.stop()
+
+    def test_deterministic_one_in_n(self):
+        plane = SelfTracePlane(sample_rate=0.5)
+        states = []
+        for _ in range(6):
+            states.append(plane.interval_sampled)
+            plane.roll()
+        assert states == [True, False, True, False, True, False]
+
+    def test_follow_rate_survives_odd_trace_ids(self):
+        """Regression: _gen_id() makes every trace id odd, so a naive
+        `trace_id % period` gate would adopt NOTHING at rate 0.5."""
+        from veneur_tpu.trace.store import _gen_id
+        plane = SelfTracePlane(sample_rate=0.5)
+        adopted = sum(1 for _ in range(400) if plane.follow(_gen_id()))
+        assert 120 <= adopted <= 280  # ~half, not zero
+
+
+class TestRegistryExemplars:
+    def test_counters_only_once_and_negotiated(self):
+        from veneur_tpu.core.telemetry import Registry
+        reg = Registry()
+        ex = ExemplarStore()
+        ex.capture("pipeline.sample_age", 1.5, trace_id=42, ts=7.0)
+
+        def source(name, tags):
+            entry = ex.for_series(name, tags)
+            if entry is None:
+                return None
+            from veneur_tpu.trace.store import (
+                render_openmetrics_exemplar)
+            return render_openmetrics_exemplar(entry)
+
+        reg.exemplar_source = source
+        reg.count("pipeline.sample_age.count", 3.0, ["plane:a"])
+        reg.count("pipeline.sample_age.count", 2.0, ["plane:b"])
+        reg.gauge("pipeline.sample_age.p99", 1.2, ["plane:a"])
+        plain = reg.render_prometheus()
+        assert "trace_id=" not in plain  # default: no exemplars
+        om = reg.render_prometheus(exemplars=True)
+        ex_lines = [ln for ln in om.splitlines() if "trace_id=" in ln]
+        assert len(ex_lines) == 1  # once per name, counters only
+        assert "_total" in ex_lines[0].split(" ")[0]
+        assert "p99" not in ex_lines[0]  # never on a gauge row
+
+
+@pytest.mark.slow
+class TestTracingOverheadSoak:
+    """Self-tracing + exemplar capture pinned under 2% of flush wall
+    time vs trace_self_sample_rate: 0 (the acceptance guard)."""
+
+    N_KEYS = 1500
+    ROUNDS = 30
+
+    def _median_flush_s(self, rate: float) -> float:
+        cfg = make_config(trace_self_sample_rate=rate)
+        cfg.tpu.counter_capacity = 4096
+        cfg.tpu.gauge_capacity = 4096
+        cfg.tpu.histo_capacity = 4096
+        cfg.tpu.set_capacity = 1024
+        server = Server(cfg, extra_metric_sinks=[ChannelMetricSink()])
+        pkts = []
+        for i in range(self.N_KEYS):
+            kind = i % 4
+            if kind == 0:
+                pkts.append(b"soak.c%d:1|c" % i)
+            elif kind == 1:
+                pkts.append(b"soak.g%d:2.5|g" % i)
+            elif kind == 2:
+                pkts.append(b"soak.t%d:3:4:5|ms" % i)
+            else:
+                pkts.append(b"soak.l%d:6|l" % i)
+        try:
+            server.handle_packet_batch(pkts)
+            server.store.apply_all_pending()
+            server.flush()  # compile outside the measured window
+            times = []
+            for _ in range(self.ROUNDS):
+                server.handle_packet_batch(pkts)
+                server.store.apply_all_pending()
+                t0 = time.perf_counter()
+                server.flush()
+                times.append(time.perf_counter() - t0)
+            times.sort()
+            return times[len(times) // 2]
+        finally:
+            server.shutdown()
+
+    def test_tracing_overhead_under_2pct(self):
+        off = self._median_flush_s(rate=0.0)
+        on = self._median_flush_s(rate=1.0)
+        # 2% of flush wall time, plus a 200µs absolute epsilon so OS
+        # scheduling noise on a fast flush can't fail a passing build
+        assert on <= off * 1.02 + 0.0002, \
+            f"self-tracing overhead {on - off:.6f}s vs base {off:.6f}s"

@@ -182,7 +182,11 @@ def build_from_config(raw: dict, args, log):
         hedge_after=hedge_after,
         failover_walk=int(raw.get("failover_walk", 2)),
         ledger_enabled=bool(raw.get("ledger_enabled", True)),
-        ledger_strict=bool(raw.get("ledger_strict", False)))
+        ledger_strict=bool(raw.get("ledger_strict", False)),
+        trace_self_sample_rate=float(
+            raw.get("trace_self_sample_rate", 1.0)),
+        trace_store_traces=int(raw.get("trace_store_traces", 128)),
+        trace_store_spans=int(raw.get("trace_store_spans", 256)))
     proxy.shutdown_grace = shutdown_grace
     proxy.start()
     log.info("veneur-proxy listening on %s -> %s", proxy.address,
@@ -222,6 +226,7 @@ def build_from_config(raw: dict, args, log):
                            cardinality=proxy.cardinality_report,
                            latency=proxy.latency.report,
                            ledger=proxy.ledger.report,
+                           traces=proxy.trace_plane.report,
                            ready=proxy.ready_state)
         http_api.start()
 

@@ -192,6 +192,22 @@ class Config:
     ledger_enabled: bool = True
     ledger_strict: bool = False
     ledger_history: int = 32
+    # -- cross-tier self-tracing (trace/store.py) -----------------------
+    # fraction of flush intervals whose self-trace is recorded AND
+    # propagated across the forward tier (1.0 = every interval; a
+    # deterministic 1-in-N below that). Unsampled intervals still get a
+    # flush span through the SSF pipeline, but nothing lands in the
+    # bounded trace store and no trace metadata rides the forward RPCs,
+    # so downstream tiers do zero tracing work for them.
+    trace_self_sample_rate: float = 1.0
+    # bounded /debug/traces store: traces kept (LRU) and spans per trace
+    trace_store_traces: int = 128
+    trace_store_spans: int = 256
+    # exemplars: per-series (trace_id, value, timestamp) captured at
+    # ingest for heavy-hitter + llhist series, merged latest-wins across
+    # the forward tier, rendered in OpenMetrics exemplar syntax by
+    # /metrics and the Prometheus/Cortex sinks. Bounds the name set.
+    trace_exemplar_names: int = 64
     # -- latency observatory (core/latency.py) --------------------------
     # per-family×device flush dispatch attribution, per-plane end-to-end
     # sample-age llhists, and queue dwell/depth telemetry. On by default

@@ -112,14 +112,32 @@ class _Handler(BaseHTTPRequestHandler):
             body = yaml.safe_dump(config_to_dict(api.config)).encode()
             self._send(200, body, "application/x-yaml")
         elif path == "/metrics":
-            body = api.telemetry.registry.render_prometheus().encode()
-            self._send(200, body,
-                       "text/plain; version=0.0.4; charset=utf-8")
+            # content negotiation: exemplars are OpenMetrics-only
+            # syntax (a mid-line `#` breaks text/plain 0.0.4 parsers),
+            # so they render only when the scraper asks for
+            # application/openmetrics-text (or forces ?exemplars=1),
+            # and the response is stamped with that content type + EOF
+            accept = self.headers.get("Accept") or ""
+            want_om = ("openmetrics" in accept
+                       or _query_str(self.path, "exemplars").lower()
+                       in ("1", "true", "yes"))
+            if want_om:
+                body = (api.telemetry.registry.render_prometheus(
+                    exemplars=True) + "# EOF\n").encode()
+                self._send(200, body,
+                           "application/openmetrics-text; version=1.0.0; "
+                           "charset=utf-8")
+            else:
+                body = api.telemetry.registry.render_prometheus().encode()
+                self._send(200, body,
+                           "text/plain; version=0.0.4; charset=utf-8")
         elif path == "/debug/events":
             limit = int(_query_float(self.path, "n", 0.0, max_value=1e6))
             kind = _query_str(self.path, "kind")
-            self._send(200, api.telemetry.events_json(limit, kind=kind),
-                       "application/json")
+            trace_id = _query_str(self.path, "trace_id")
+            self._send(200, api.telemetry.events_json(
+                limit, kind=kind, trace_id=trace_id),
+                "application/json")
         elif path == "/debug/flush":
             limit = int(_query_float(self.path, "n", 0.0, max_value=1e6))
             if _query_str(self.path, "waterfall").lower() not in (
@@ -164,6 +182,28 @@ class _Handler(BaseHTTPRequestHandler):
                                  max_value=1e4))
             body = json.dumps(source(intervals=n), indent=2,
                               default=str).encode()
+            self._send(200, body, "application/json")
+        elif path == "/debug/traces":
+            # the cross-tier self-trace store (trace/store.py): this
+            # tier's recorded spans grouped by interval trace.
+            # ?trace_id= (hex) drills into one trace, ?interval= into
+            # one flush interval, ?n= bounds the listing. Served by
+            # server, proxy, AND global — one flush interval's trace is
+            # retrievable on every tier it crossed.
+            source = api.trace_source
+            if source is None:
+                plane = getattr(api.server, "trace_plane", None)
+                source = getattr(plane, "report", None)
+            if source is None:
+                self._send(404, b"no trace source\n")
+                return
+            body = json.dumps(source(
+                trace_id=_query_str(self.path, "trace_id"),
+                interval=int(_query_float(self.path, "interval", 0.0,
+                                          max_value=1e12)),
+                limit=int(_query_float(self.path, "n", 0.0,
+                                       max_value=1e4))),
+                indent=2, default=str).encode()
             self._send(200, body, "application/json")
         elif path == "/debug/cardinality":
             # series-cardinality observatory: top-N names by live rows
@@ -281,6 +321,7 @@ class _Handler(BaseHTTPRequestHandler):
                 b"  /debug/events?n=N               event flight recorder\n"
                 b"  /debug/flush?n=N                recent flush rounds\n"
                 b"  /debug/flush?waterfall=1        per-family segment trees\n"
+                b"  /debug/traces?trace_id=&interval=  cross-tier traces\n"
                 b"  /debug/latency                  latency observatory\n"
                 b"  /debug/ledger?intervals=N       flow-ledger conservation\n"
                 b"  /debug/cardinality?top=N&name=  series cardinality\n"
@@ -366,7 +407,8 @@ class HTTPApi:
     def __init__(self, config, server=None, address: str = "127.0.0.1:0",
                  http_quit: bool = False, on_quit=None,
                  require_flush_for_ready: bool = False, telemetry=None,
-                 cardinality=None, latency=None, ready=None, ledger=None):
+                 cardinality=None, latency=None, ready=None, ledger=None,
+                 traces=None):
         self.config = config
         self.server = server
         self.http_quit = http_quit
@@ -384,6 +426,10 @@ class HTTPApi:
         # owning server's ledger.report by default, the proxy passes
         # its own ledger's
         self.ledger_source = ledger
+        # /debug/traces source: a callable(trace_id=, interval=, limit=)
+        # -> dict; the owning server's trace_plane.report by default,
+        # the proxy passes its own plane's
+        self.trace_source = traces
         # /healthcheck/ready source for a standalone API (the proxy):
         # a callable -> (ready, reason_str_or_body_dict); None defers to
         # the owning server's readiness ladder

@@ -418,6 +418,9 @@ def waterfall_rounds(rounds: List[dict]) -> List[dict]:
         families = r.get("families") or {}
         tree = {
             "flush": r.get("flush"),
+            # the interval's self-trace id (hex): the waterfall row
+            # cross-links to /debug/traces?trace_id= directly
+            **({"trace_id": r["trace_id"]} if r.get("trace_id") else {}),
             "start_unix": r.get("start_unix"),
             "duration_s": r.get("duration_s"),
             "phases": {k: v for k, v in phases.items()

@@ -112,6 +112,11 @@ class FlowLedger:
         self.enabled = bool(enabled)
         self.strict = bool(strict)
         self.on_event = on_event
+        # active interval trace stamp (trace/store.py plane): when set,
+        # each closed interval's record carries the trace id (hex) of
+        # the flush that closed it, so a ledger finding cross-links to
+        # the exact /debug/traces entry
+        self.trace_source = None
         self._clock = clock
         self._lock = threading.Lock()
         # stage -> key -> count, current interval / lifetime totals
@@ -281,9 +286,16 @@ class FlowLedger:
                     self.unexplained_total[name] = \
                         self.unexplained_total.get(name, 0.0) + abs(imb)
             self.intervals_closed += 1
+            trace_id = ""
+            if self.trace_source is not None:
+                try:
+                    trace_id = self.trace_source() or ""
+                except Exception:
+                    trace_id = ""
             record = {
                 "interval": self.intervals_closed,
                 "closed_unix": round(self._clock(), 3),
+                **({"trace_id": trace_id} if trace_id else {}),
                 "stages": {s: dict(per_key)
                            for s, per_key in counts.items()},
                 "stocks": {"opening": opening, "closing": dict(closing)},

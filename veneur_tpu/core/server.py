@@ -262,6 +262,29 @@ class Server:
         self.telemetry.registry.add_collector(
             telemetry_mod.device_memory_rows)
 
+        # cross-tier self-trace plane (trace/store.py): the bounded
+        # trace store behind /debug/traces, the pre-minted per-interval
+        # trace id that exemplar capture and the flush span share, and
+        # the sampling decision bounding all of it. Every flight-
+        # recorder event and ledger interval is stamped with the active
+        # interval's trace id, and /metrics exposition lines pick up
+        # OpenMetrics exemplars from the plane.
+        from veneur_tpu.trace.store import SelfTracePlane
+        self.trace_plane = SelfTracePlane(
+            service="veneur-tpu",
+            sample_rate=config.trace_self_sample_rate,
+            max_traces=config.trace_store_traces,
+            max_spans=config.trace_store_spans,
+            exemplar_names=config.trace_exemplar_names)
+        self.telemetry.registry.add_collector(
+            self.trace_plane.telemetry_rows)
+        self.telemetry.trace_source = self.trace_plane.active_trace_hex
+        self.telemetry.registry.exemplar_source = \
+            self.trace_plane.exemplar_for
+        # a GLOBAL's next flush adopts the originating local's interval
+        # trace (latest fresh import wins); see adopt_flush_trace
+        self._adopted_trace = None
+
         # flow ledger (core/ledger.py): per-interval conservation
         # accounting from socket to sink ack. Declared here so every
         # crossing below (ingest, store, forward, spool) can stamp it;
@@ -292,6 +315,7 @@ class Server:
             outputs=("forward.remote_merged", "forward.remote_rejected",
                      "forward.remote_deduped"))
         self.latency.ledger = self.ledger if self.ledger.enabled else None
+        self.ledger.trace_source = self.trace_plane.active_trace_hex
         self.telemetry.registry.add_collector(self.ledger.telemetry_rows)
 
         # self-metrics: UDP to stats_address, or internal loopback so they
@@ -322,7 +346,10 @@ class Server:
             trace_mod.ChannelBackend(self.ingest_span),
             capacity=config.span_channel_capacity,
             buffer=self.latency.instrument_queue(
-                "trace_client", maxsize=config.span_channel_capacity))
+                "trace_client", maxsize=config.span_channel_capacity),
+            # every self-span also lands (synchronously, when its trace
+            # is sampled) in the bounded trace store behind /debug/traces
+            tee=self.trace_plane.record_proto)
         self.telemetry.registry.add_collector(self.latency.telemetry_rows)
 
         self.diagnostics = None
@@ -507,6 +534,13 @@ class Server:
             chaos = self.chaos
             if chaos is not None and chaos.leak_sample():
                 return  # the drill: vanish with no accounting at all
+        # exemplar capture: first sample per heavy-hitter/llhist name
+        # per interval, stamped with the pre-minted interval trace id
+        # (two set lookups when the name isn't interesting)
+        if metric.value is not None:
+            self.trace_plane.maybe_capture(
+                metric.key.name, metric.value,
+                always=metric.key.type == m.LLHIST)
         self.store.process(metric)
 
     def _ingest_metric_essential(self, metric: UDPMetric) -> None:
@@ -784,7 +818,8 @@ class Server:
                     name="forward", on_transition=self._breaker_transition),
                 carryover=Carryover(cfg.carryover_max_intervals,
                                     ledger=ledger),
-                chaos=self.chaos, spool=spool, ledger=ledger)
+                chaos=self.chaos, spool=spool, ledger=ledger,
+                trace_plane=self.trace_plane)
             self.forwarder = self.forward_client.forward
             self.telemetry.registry.add_collector(
                 self.forward_client.telemetry_rows)
@@ -952,6 +987,18 @@ class Server:
             # tag the next flush round's waterfall: recompile cost must
             # be separable from steady-state execute cost
             self.latency.note_retrace(family, seconds)
+
+    def adopt_flush_trace(self, trace_id: int, parent_span_id: int) -> None:
+        """Called by the import server when a fresh (non-duplicate)
+        forwarded payload carries trace metadata: this GLOBAL's next
+        flush span parents under the originating local's interval trace
+        (latest import wins — hedged duplicates were already deduped by
+        token before reaching here, so exactly one import per payload
+        adopts). Only the latch is written here: the flush itself calls
+        set_active() when it consumes the adoption, so an import landing
+        DURING a flush can't retarget the trace id that flush's ledger
+        close and event stamps are about to read."""
+        self._adopted_trace = (int(trace_id), int(parent_span_id))
 
     def cardinality_report(self, top: int = 20, name: str = "") -> dict:
         """The /debug/cardinality payload. With `name`, a single-name
@@ -1166,12 +1213,31 @@ class Server:
             self._flush_locked()
 
     def _flush_locked(self) -> None:
+        from veneur_tpu import trace as trace_mod
+        from veneur_tpu.trace.store import trace_id_hex
         flush_start = time.perf_counter()
         self.last_flush_unix = time.time()
         self.flush_count += 1
-        flush_span = self.trace_client.start_span(
-            "flush", service="veneur-tpu",
-            tags={"mode": "local" if self.is_local else "global"})
+        # the flush span IS the interval trace root: a local roots it on
+        # the plane's pre-minted interval trace id (the same id ingest-
+        # time exemplars stamped all interval), a global parents it
+        # under the originating local's trace when a fresh import
+        # adopted one this interval — that is what makes local flush ->
+        # proxy.route -> import.merge -> global sink ack ONE trace
+        plane = self.trace_plane
+        adopted, self._adopted_trace = self._adopted_trace, None
+        tags = {"mode": "local" if self.is_local else "global",
+                "interval": str(self.flush_count)}
+        if adopted and not self.is_local:
+            flush_span = trace_mod.Span(
+                self.trace_client, "flush", "veneur-tpu",
+                trace_id=adopted[0], parent_id=adopted[1], tags=tags)
+        else:
+            flush_span = trace_mod.Span(
+                self.trace_client, "flush", "veneur-tpu",
+                trace_id=plane.interval_trace_id, tags=tags)
+        traced = plane.is_sampled(flush_span.trace_id)
+        plane.set_active(flush_span.trace_id if traced else 0)
 
         if self.config.count_unique_timeseries:
             # exact count of timeseries touched this interval (reference
@@ -1202,6 +1268,10 @@ class Server:
             "mode": "local" if self.is_local else "global",
             "sinks": {},
         }
+        if traced:
+            # cross-link: /debug/flush (and its waterfall view) point at
+            # the interval's /debug/traces entry
+            round_info["trace_id"] = trace_id_hex(flush_span.trace_id)
 
         def _start_sink_thread(key: str, target, *args) -> bool:
             """Dispatch one sink flush thread; returns False when the
@@ -1366,7 +1436,16 @@ class Server:
         # sink joins are the ack point: everything dispatched this round
         # has been delivered (or timed out, recorded above) — the moment
         # the interval's samples stop aging
-        self.latency.observe_sample_age(watermarks, time.time())
+        ack_unix = time.time()
+        self.latency.observe_sample_age(watermarks, ack_unix)
+        if traced and watermarks:
+            # anchor this interval's worst-case staleness to its trace:
+            # the pipeline.sample_age rows in /metrics carry an
+            # OpenMetrics exemplar pointing at exactly this flush
+            oldest = min(mark[0] for mark in watermarks.values())
+            self.trace_plane.exemplars.capture(
+                "pipeline.sample_age", max(0.0, ack_unix - oldest),
+                flush_span.trace_id, ts=ack_unix)
         families = phases.get("families")
         if families:
             for family, secs in self.latency.drain_retraces().items():
@@ -1423,6 +1502,12 @@ class Server:
         if self.ledger.enabled:
             record = self.ledger.close_interval()
             round_info["ledger"] = record.get("imbalance", {})
+        # interval-trace rollover LAST (the ledger close above stamps
+        # this interval's trace id): mint the next interval's id, reset
+        # the exemplar capture budget, and refresh the watched
+        # heavy-hitter names from the cardinality observatory
+        self.trace_plane.roll(
+            [rec["name"] for rec in self.cardinality.top(16)])
 
     def _reclaim_idle_rows(self) -> None:
         """Idle-key reclamation + intern-table self-metrics, once per
@@ -1507,8 +1592,23 @@ class Server:
         the flight recorder, and the per-sink duration self-metric."""
         outcome = round_info["sinks"].setdefault(key, {})
         child = parent_span.child("flush.sink", tags={"sink": key})
+        # make this sink's span the ambient parent for the duration of
+        # the flush call (each sink thread has its own context): the
+        # forward client reads it to inject (trace_id, span_id) gRPC
+        # metadata, which is how the interval trace crosses the tier.
+        # Gated on the round being traced so unsampled intervals add no
+        # metadata downstream.
+        ctx_token = None
+        if round_info.get("trace_id"):
+            from veneur_tpu.trace import context as trace_ctx
+            ctx_token = trace_ctx._current_span.set(child)
         start = time.perf_counter()
-        ok = target(*args)
+        try:
+            ok = target(*args)
+        finally:
+            if ctx_token is not None:
+                from veneur_tpu.trace import context as trace_ctx
+                trace_ctx._current_span.reset(ctx_token)
         duration = time.perf_counter() - start
         was_timed_out = outcome.get("status") == "timed_out"
         breaker = self._sink_breakers.get(key)

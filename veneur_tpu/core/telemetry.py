@@ -83,6 +83,12 @@ class Registry:
 
     def __init__(self, max_series: int = DEFAULT_MAX_SERIES):
         self.max_series = max_series
+        # OpenMetrics exemplars: callable(name, tags) -> rendered
+        # exemplar clause (or None), consulted per sample line at
+        # exposition time. The server wires the self-trace plane's
+        # exemplar_for here so /metrics rows (pipeline.sample_age and
+        # friends) carry the interval trace that produced them.
+        self.exemplar_source = None
         self._lock = threading.Lock()
         self._counters: Dict[Tuple[str, Tuple[str, ...]], float] = {}
         self._gauges: Dict[Tuple[str, Tuple[str, ...]], float] = {}
@@ -192,9 +198,14 @@ class Registry:
         name, tags = key
         return f"{name}|{','.join(tags)}" if tags else name
 
-    def render_prometheus(self) -> str:
+    def render_prometheus(self, exemplars: bool = False) -> str:
         """The whole registry (plus collectors) as Prometheus text
-        exposition format 0.0.4."""
+        exposition format 0.0.4. With `exemplars=True` (the operator
+        asked for OpenMetrics — content negotiation happens in the
+        HTTP layer, which also switches the content type and appends
+        `# EOF`), counter lines matching the exemplar source gain the
+        OpenMetrics exemplar clause — counters only (exemplars on
+        gauges are invalid OpenMetrics) and once per metric name."""
         with self._lock:
             counters = dict(self._counters)
             gauges = dict(self._gauges)
@@ -218,6 +229,21 @@ class Registry:
             counters[("telemetry.series_dropped_by_name",
                       (f"name:{name}",))] = float(n)
 
+        exemplar_source = self.exemplar_source if exemplars else None
+        exemplified: set = set()
+
+        def exemplar_clause(name: str, tags, ptype: str) -> str:
+            if (exemplar_source is None or ptype != "counter"
+                    or name in exemplified):
+                return ""
+            try:
+                clause = exemplar_source(name, tags) or ""
+            except Exception:
+                return ""
+            if clause:
+                exemplified.add(name)
+            return clause
+
         out: List[str] = []
         for table, ptype in ((counters, "counter"), (gauges, "gauge")):
             grouped: Dict[str, list] = {}
@@ -227,7 +253,8 @@ class Registry:
                 pname = prom_name(metric, ptype)
                 out.append(f"# TYPE {pname} {ptype}")
                 for tags, value in sorted(grouped[metric]):
-                    out.append(f"{pname}{prom_labels(tags)} {fnum(value)}")
+                    out.append(f"{pname}{prom_labels(tags)} {fnum(value)}"
+                               f"{exemplar_clause(metric, tags, ptype)}")
         hgrouped: Dict[str, list] = {}
         for (name, tags), series in histograms.items():
             hgrouped.setdefault(name, []).append((tags, series))
@@ -317,16 +344,22 @@ class EventRecorder:
             self._events.append(event)
         return event
 
-    def snapshot(self, limit: int = 0, kind: str = "") -> List[dict]:
+    def snapshot(self, limit: int = 0, kind: str = "",
+                 trace_id: str = "") -> List[dict]:
         """Newest-last; `limit` > 0 keeps only the most recent events;
         `kind` filters to one event kind (e.g. overload_state,
         pipeline_stall) BEFORE the limit applies, so an operator can
         pull the last N ladder transitions even when chatty events
-        (watchdog ticks, flush rounds) dominate the ring."""
+        (watchdog ticks, flush rounds) dominate the ring. `trace_id`
+        (hex) keeps only events stamped with that interval trace, so a
+        /debug/ledger or /debug/traces finding cross-links to exactly
+        the events of its interval."""
         with self._lock:
             events = list(self._events)
         if kind:
             events = [e for e in events if e.get("kind") == kind]
+        if trace_id:
+            events = [e for e in events if e.get("trace_id") == trace_id]
         return events[-limit:] if limit > 0 else events
 
     @property
@@ -381,15 +414,29 @@ class Telemetry:
         self.registry = Registry(max_series=max_series)
         self.events = EventRecorder(capacity=event_capacity)
         self.flushes = FlushRecorder(capacity=flush_capacity)
+        # active interval trace stamp: zero-arg callable returning the
+        # running interval's trace id (hex, '' when unsampled). When
+        # set, every recorded event carries it, so the flight recorder
+        # cross-links to /debug/traces (?trace_id= filters on it).
+        self.trace_source = None
 
     def record_event(self, kind: str, **fields) -> dict:
+        if self.trace_source is not None and "trace_id" not in fields:
+            try:
+                tid = self.trace_source()
+            except Exception:
+                tid = ""
+            if tid:
+                fields["trace_id"] = tid
         return self.events.record(kind, **fields)
 
-    def events_json(self, limit: int = 0, kind: str = "") -> bytes:
+    def events_json(self, limit: int = 0, kind: str = "",
+                    trace_id: str = "") -> bytes:
         return json.dumps({
             "capacity": self.events.capacity,
             "total_recorded": self.events.total_recorded,
-            "events": self.events.snapshot(limit, kind=kind),
+            "events": self.events.snapshot(limit, kind=kind,
+                                           trace_id=trace_id),
         }, indent=2, default=str).encode()
 
     def flushes_json(self, limit: int = 0) -> bytes:

@@ -27,8 +27,9 @@ import grpc
 from veneur_tpu.core.flusher import ForwardableState
 from veneur_tpu.forward.convert import forwardable_to_wire
 from veneur_tpu.forward.wire import (_frame_v1, _serialize_metric,
-                                     decode_flow_counts, send_batch,
-                                     token_metadata)
+                                     combine_metadata, decode_flow_counts,
+                                     send_batch, token_metadata,
+                                     trace_metadata)
 from veneur_tpu.util import chaos as chaos_mod
 from veneur_tpu.util.chaos import ChaosError
 from veneur_tpu.util.grpctls import GrpcTLS, secure_or_insecure_channel
@@ -62,7 +63,7 @@ class ForwardClient:
                  carryover: Optional[Carryover] = None,
                  chaos: Optional[chaos_mod.Chaos] = None,
                  spool: Optional[CarryoverSpool] = None,
-                 ledger=None):
+                 ledger=None, trace_plane=None):
         self.address = address
         self.deadline = deadline
         # resilience: callers that want fail-and-forget (veneur-emit's
@@ -88,6 +89,13 @@ class ForwardClient:
         self.ledger = ledger
         if ledger is not None and self.carryover.ledger is None:
             self.carryover.ledger = ledger
+        # self-trace plane (trace/store.py): when the owning server's
+        # flush runs under a sampled interval trace, the forward sink
+        # thread's ambient span is injected as gRPC metadata on EVERY
+        # attempt (V1 body, V2 fallback, retries, spool drains), and the
+        # interval's exemplars ride alongside so the global's merge
+        # keeps them latest-wins
+        self.trace_plane = trace_plane
         self.inflight_metrics = 0
         # interval+shard idempotency token: every forward() call mints
         # one token that rides ALL its attempts (V1 body, V2 fallback,
@@ -137,6 +145,24 @@ class ForwardClient:
         c = self.chaos or chaos_mod.active()
         if c is not None:
             c.inject("forward_send")
+
+    def _trace_sidecar(self):
+        """Trace + exemplar metadata for this send: the ambient span
+        (the flush's `flush.sink` child, set by the owning server's
+        sink thread — None on unsampled intervals and for standalone
+        clients) and the interval's exemplar blob."""
+        from veneur_tpu.trace import context as trace_ctx
+        parts = []
+        parent = trace_ctx.current_span()
+        if parent is not None:
+            parts.append(trace_metadata(parent.trace_id, parent.id))
+        plane = self.trace_plane
+        if plane is not None and parent is not None:
+            from veneur_tpu.trace.store import EXEMPLAR_KEY
+            blob = plane.exemplar_wire()
+            if blob:
+                parts.append(((EXEMPLAR_KEY, blob),))
+        return combine_metadata(*parts)
 
     def forward(self, fwd: ForwardableState) -> int:
         """Serialize and send one flush's state; returns count sent.
@@ -211,6 +237,7 @@ class ForwardClient:
             return 0
         deadline_ts = time.monotonic() + self.deadline
         resp = None
+        sidecar = self._trace_sidecar()
         if protos:
             # one token per interval payload, stable across every retry
             # and the V1->V2 fallback of THIS call — an attempt that
@@ -232,7 +259,8 @@ class ForwardClient:
                         self._v1_ok,
                         pin_codes=(grpc.StatusCode.UNIMPLEMENTED,
                                    grpc.StatusCode.RESOURCE_EXHAUSTED),
-                        metadata=token_metadata(token))
+                        metadata=combine_metadata(
+                            token_metadata(token), sidecar))
                     break
                 except (grpc.RpcError, ChaosError) as e:
                     code = e.code() if hasattr(e, "code") else None
@@ -253,7 +281,7 @@ class ForwardClient:
             # probe the destination with the drain itself below
             pass
         drained, drain_err, attempted = self._drain_spool(
-            deadline_ts, destination_up=bool(protos))
+            deadline_ts, destination_up=bool(protos), sidecar=sidecar)
         if not protos and drained == 0:
             if drain_err is not None:
                 # the spool-only probe failed: destination still down
@@ -311,7 +339,8 @@ class ForwardClient:
         the on-disk spool (same wire bytes a send would carry)."""
         return self.spool.append(forwardable_to_wire(fwd))
 
-    def _drain_spool(self, deadline_ts: float, destination_up: bool):
+    def _drain_spool(self, deadline_ts: float, destination_up: bool,
+                     sidecar=None):
         """After a successful send (the destination is demonstrably up),
         deliver spilled segments oldest-first until the spool is empty,
         the flush budget runs out, or a send fails (the segment stays
@@ -360,7 +389,10 @@ class ForwardClient:
                     self._v1_ok,
                     pin_codes=(grpc.StatusCode.UNIMPLEMENTED,
                                grpc.StatusCode.RESOURCE_EXHAUSTED),
-                    metadata=token_metadata(token))
+                    # spilled segments drain inside the CURRENT flush's
+                    # trace (the spans show replay work where it costs)
+                    metadata=combine_metadata(
+                        token_metadata(token), sidecar))
             except (grpc.RpcError, ChaosError) as e:
                 err = e
                 code = e.code() if hasattr(e, "code") else None

@@ -38,10 +38,15 @@ class ImportServer:
         self._ignored = list(ignored_tags or [])
         self.rpc_stats = RpcStats()
         # a V1 MetricList at 50k digest keys is ~36 MB; the 4 MB gRPC
-        # default would reject the bulk path outright
+        # default would reject the bulk path outright. Metadata cap
+        # raised past the 8 KiB default: the trace + exemplar sidecars
+        # (x-veneur-trace / x-veneur-exemplars-bin) ride the header
+        # block alongside the idempotency token, and -bin values
+        # base64-expand ~4/3 on the wire.
         self._grpc = grpc.server(
             futures.ThreadPoolExecutor(max_workers=max_workers),
-            options=[("grpc.max_receive_message_length", 256 << 20)])
+            options=[("grpc.max_receive_message_length", 256 << 20),
+                     ("grpc.max_metadata_size", 64 << 10)])
         # responses carry FlowCounts (received/merged/duplicate) for the
         # sender's flow-ledger tier reconciliation; a reference peer
         # parses them as Empty-with-unknown-fields (forward/wire.py)
@@ -95,6 +100,49 @@ class ImportServer:
     def _token_end(self, token: str, ok: bool) -> None:
         self._deduper.end(token, ok)
 
+    # -- cross-tier self-tracing -----------------------------------------
+
+    def _trace_begin(self, ctx):
+        """Continue the sender's interval trace: adopt the incoming
+        trace id, merge the exemplar sidecar (latest-wins), and open the
+        import.merge span parented on the sender's span. None when the
+        RPC carries no trace metadata (un-upgraded peer or unsampled
+        interval) — the handler then does zero tracing work. Runs only
+        AFTER token dedupe passed, so a hedged duplicate or retry never
+        opens a second span tree (the loser is dropped before here)."""
+        plane = getattr(self._server, "trace_plane", None)
+        if plane is None:
+            return None
+        from veneur_tpu.forward.wire import extract_trace, metadata_value
+        from veneur_tpu.trace.store import EXEMPLAR_KEY
+        trace_id, span_id = extract_trace(ctx)
+        if not trace_id:
+            return None
+        blob = metadata_value(ctx, EXEMPLAR_KEY)
+        if blob:
+            # exemplar merges are never sample-gated: latest-wins per
+            # series must hold even for intervals this tier declines
+            # to record
+            plane.merge_exemplar_wire(blob)
+        if not plane.follow(trace_id):
+            return None
+        return plane.span("import.merge", trace_id, parent_id=span_id)
+
+    def _trace_end(self, span, received: int, merged: int,
+                   ok: bool) -> None:
+        """Close the import.merge span; a SUCCESSFUL merge makes this
+        global's next flush (and its sink-ack spans) parent under the
+        originating local's interval trace."""
+        if span is None:
+            return
+        span.set_tag("received", received)
+        span.set_tag("merged", merged)
+        if not ok:
+            span.error()
+        span.finish()
+        if ok:
+            self._server.adopt_flush_trace(span.trace_id, span.id)
+
     def telemetry_rows(self) -> List[tuple]:
         """Scrape-time rows for the owning server's /metrics registry."""
         return [("forward.hedge.duplicates_dropped", "counter",
@@ -135,7 +183,14 @@ class ImportServer:
             ctx.abort(grpc.StatusCode.UNAVAILABLE,
                       "duplicate import racing its first attempt")
         ok = False
+        tspan = None
+        received = merged = 0
         try:
+            # inside the try: an exception anywhere past _token_begin
+            # must still reach _token_end, or the token wedges in the
+            # in-flight state and every retry of this payload is
+            # refused forever
+            tspan = self._trace_begin(ctx)
             self._note_arrival()
             res = self._merge_native(body)
             if res is None:
@@ -152,6 +207,7 @@ class ImportServer:
             ok = True
         finally:
             self._token_end(token, ok)
+            self._trace_end(tspan, received, merged, ok)
         return encode_flow_counts(received, merged)
 
     def _note_arrival(self, n: int = 1) -> None:
@@ -321,20 +377,26 @@ class ImportServer:
             ctx.abort(grpc.StatusCode.UNAVAILABLE,
                       "duplicate import racing its first attempt")
         ok = False
+        tspan = None
+        count = merged = 0
         try:
+            # see _send_metrics_v1: nothing may run between the token
+            # begin and this try, or a failure wedges the token
+            tspan = self._trace_begin(ctx)
             self._note_arrival()
             buf = _MergeBuffer(self)
-            count = 0
             for pbm in request_iterator:
                 buf.add(pbm)
                 count += 1
             buf.flush_all()
+            merged = buf.admitted
             self.imported_total += count
-            self._note_flow(count, buf.admitted)
+            self._note_flow(count, merged)
             ok = True
         finally:
             self._token_end(token, ok)
-        return encode_flow_counts(count, buf.admitted)
+            self._trace_end(tspan, count, merged, ok)
+        return encode_flow_counts(count, merged)
 
 
 class _MergeBuffer:

@@ -122,6 +122,62 @@ def token_metadata(token: str):
     return ((IDEMPOTENCY_KEY, token),) if token else None
 
 
+# gRPC metadata key carrying the sender's trace lineage: every forward
+# RPC (client sends, proxy re-sends, hedges, spool drains, and the
+# V1->V2 fallback of any of them) rides `<trace_id>:<span_id>` in
+# decimal, so the receiving tier can continue the sender's interval
+# trace (proxy.route / import.merge spans) instead of starting an
+# island. Absent on unsampled intervals and from un-upgraded peers —
+# extraction degrades to (0, 0) and the receiver traces nothing.
+TRACE_KEY = "x-veneur-trace"
+
+
+def trace_metadata(trace_id: int, span_id: int):
+    """Metadata tuple carrying one span's lineage; None when untraced."""
+    if not trace_id or not span_id:
+        return None
+    return ((TRACE_KEY, f"{int(trace_id)}:{int(span_id)}"),)
+
+
+def parse_trace_value(value: str):
+    """`<trace_id>:<span_id>` -> (trace_id, span_id); (0, 0) on junk."""
+    tid, sep, sid = str(value).partition(":")
+    if not sep:
+        return 0, 0
+    try:
+        return int(tid), int(sid)
+    except ValueError:
+        return 0, 0
+
+
+def extract_trace(ctx):
+    """(trace_id, span_id) from a gRPC ServicerContext's invocation
+    metadata; (0, 0) when absent or undecodable."""
+    return parse_trace_value(metadata_value(ctx, TRACE_KEY) or "")
+
+
+def metadata_value(ctx, key: str):
+    """One metadata entry's value (None when absent) — the exemplar
+    blob and any future sidecar headers read through this."""
+    try:
+        for k, value in (ctx.invocation_metadata() or ()):
+            if k == key:
+                return value
+    except Exception:
+        pass
+    return None
+
+
+def combine_metadata(*parts):
+    """Concatenate metadata tuples, skipping Nones; None when empty (the
+    gRPC call layer treats None as 'no metadata')."""
+    out = []
+    for part in parts:
+        if part:
+            out.extend(part)
+    return tuple(out) if out else None
+
+
 class TokenDeduper:
     """Receiver-side idempotency-token bookkeeping, shared by the global
     ImportServer AND the proxy handlers (a retry whose first attempt

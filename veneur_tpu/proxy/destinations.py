@@ -22,8 +22,9 @@ import grpc
 
 from veneur_tpu.forward.protos import metric_pb2
 from veneur_tpu.forward.wire import (_frame_v1, _serialize_metric,
-                                     decode_flow_counts, send_batch,
-                                     token_metadata)
+                                     combine_metadata, decode_flow_counts,
+                                     send_batch, token_metadata,
+                                     trace_metadata)
 from veneur_tpu.ops import hll_ref
 from veneur_tpu.proxy.ring import ConsistentRing, EmptyRingError
 from veneur_tpu.util import chaos as chaos_mod
@@ -47,9 +48,19 @@ class Destination:
                  observatory=None,
                  hedge_after: float = 0.0,
                  hedge_peer: Optional[Callable[[], Optional["Destination"]]]
-                 = None, ledger=None):
+                 = None, ledger=None, trace_source=None, trace_plane=None):
         self.address = address
         self._on_close = on_close
+        # cross-tier self-tracing: trace_source() -> (trace_id,
+        # parent_span_id, exemplar_blob) — the routing tier's latest
+        # active lineage (latest-wins per pool; batches and RPCs don't
+        # align 1:1, and one local's interval batch dominates a flush).
+        # Each outgoing batch opens a proxy.dest.send span under it and
+        # re-injects (trace_id, send_span_id) + the exemplar sidecar as
+        # gRPC metadata, hedged duplicates carrying the SAME span id so
+        # the global's token dedupe keeps exactly one tree.
+        self._trace_source = trace_source
+        self._trace_plane = trace_plane
         # proxy flow ledger: successful sends reconcile against the
         # receiver's FlowCounts response (proxy_tier identity); the
         # enqueue/sent/drop counters below feed the proxy_egress
@@ -206,6 +217,29 @@ class Destination:
                 break
         return out
 
+    def _trace_open(self, batch_len: int):
+        """(extra metadata, proxy.dest.send span) for one batch — both
+        None when no lineage is active (untraced traffic costs two
+        attribute reads per BATCH, never per metric)."""
+        src = self._trace_source
+        if src is None:
+            return None, None
+        trace_id, parent_sid, blob = src()
+        if not trace_id:
+            return None, None
+        span = None
+        if self._trace_plane is not None:
+            span = self._trace_plane.span(
+                "proxy.dest.send", trace_id, parent_id=parent_sid,
+                tags={"destination": self.address,
+                      "metrics": str(batch_len)})
+        parts = [trace_metadata(
+            trace_id, span.id if span is not None else parent_sid)]
+        if blob:
+            from veneur_tpu.trace.store import EXEMPLAR_KEY
+            parts.append(((EXEMPLAR_KEY, blob),))
+        return combine_metadata(*parts), span
+
     def _run(self) -> None:
         while not self.closed.is_set():
             batch = self._drain_batch()
@@ -214,19 +248,23 @@ class Destination:
             self.inflight_batch = len(batch)
             self._token_seq += 1
             token = f"dest:{self._token_id}:{self._token_seq}"
+            extra_md, send_span = self._trace_open(len(batch))
             try:
                 hedge_won = False
                 if self._hedge_after > 0 and self._hedge_peer is not None:
                     # the chaos seam runs INSIDE the hedge-timed window
                     # (chaos_forward_latency_ms makes THIS the slow
                     # primary the budget fires against)
-                    hedge_won = self._send_hedged(batch, token)
+                    hedge_won = self._send_hedged(batch, token,
+                                                  extra_md=extra_md)
                 else:
                     # the forward_send chaos seam covers proxy senders
                     # too: injected errors exercise the breaker and
                     # ejection paths deterministically
                     chaos_mod.inject("forward_send")
-                    self.send_now(batch, token)
+                    self.send_now(batch, token, extra_md=extra_md)
+                if send_span is not None and hedge_won:
+                    send_span.set_tag("hedged", "true")
                 if hedge_won:
                     # the PEER delivered (and was credited inside
                     # _send_hedged); the blown budget is a failure
@@ -245,6 +283,8 @@ class Destination:
                         self.inflight_batch = 0
                     self.breaker.record_success()
             except (grpc.RpcError, ChaosError) as e:
+                if send_span is not None:
+                    send_span.error()
                 self.breaker.record_failure()
                 with self._counter_lock:
                     self.dropped_total += len(batch)
@@ -255,18 +295,25 @@ class Destination:
                                self.address, code, self.breaker.state)
                 if not self.breaker.is_dispatchable:
                     self.inflight_batch = 0
+                    if send_span is not None:
+                        send_span.finish()
                     self.close(notify=True)
                     return
             finally:
                 self.inflight_batch = 0
+                if send_span is not None:
+                    send_span.finish()
 
-    def send_now(self, batch, token: str, timeout: float = 10.0):
+    def send_now(self, batch, token: str, timeout: float = 10.0,
+                 extra_md=None):
         """One blocking batch send with the idempotency token attached —
         also the entry point a PEER uses to deliver a hedged batch
         through this destination's channel. Raises grpc.RpcError on
         failure (the caller owns breaker/drop accounting). Returns the
         raw response bytes (the receiver's FlowCounts, when upgraded),
-        already reconciled into the proxy's flow ledger.
+        already reconciled into the proxy's flow ledger. `extra_md`
+        carries the trace lineage + exemplar sidecar, identical across
+        a hedge pair.
 
         Proxy batches are <= self._batch small metrics, so
         RESOURCE_EXHAUSTED is far likelier transient receiver overload
@@ -277,7 +324,7 @@ class Destination:
             self._v1_ok,
             pin_codes=(grpc.StatusCode.UNIMPLEMENTED,),
             retry_codes=(grpc.StatusCode.RESOURCE_EXHAUSTED,),
-            metadata=token_metadata(token))
+            metadata=combine_metadata(token_metadata(token), extra_md))
         self._note_tier(len(batch), resp)
         return resp
 
@@ -301,7 +348,7 @@ class Destination:
             led.note("dest.remote_rejected", received - merged)
 
     def _send_hedged(self, batch, token: str,
-                     timeout: float = 10.0) -> bool:
+                     timeout: float = 10.0, extra_md=None) -> bool:
         """Primary send with a latency budget: past `hedge_after`
         seconds the same batch (same token) fires at the next healthy
         ring member. First success wins; the loser is cancelled. The
@@ -315,7 +362,7 @@ class Destination:
         the hedge (the knob's reason to exist)."""
         budget_start = time.monotonic()
         chaos_mod.inject("forward_send")
-        md = token_metadata(token)
+        md = combine_metadata(token_metadata(token), extra_md)
         was_v1 = self._v1_ok
         if was_v1:
             body = b"".join(_frame_v1(m) for m in batch)
@@ -338,7 +385,8 @@ class Destination:
                 # helper (send_now -> wire.send_batch) so the pin/retry
                 # fallback policy lives in exactly one place; the token
                 # makes the repeat attempt duplicate-safe
-                self.send_now(batch, token, timeout=timeout)
+                self.send_now(batch, token, timeout=timeout,
+                              extra_md=extra_md)
                 return False
             raise
         peer = None
@@ -354,7 +402,11 @@ class Destination:
         logger.info("hedging slow send to %s via %s (budget %.3fs)",
                     self.address, peer.address, self._hedge_after)
         try:
-            peer.send_now(batch, token, timeout=timeout)
+            # the SAME lineage (and span id) rides the hedge: whichever
+            # attempt the global accepts continues one connected tree,
+            # the loser is dropped whole by its token
+            peer.send_now(batch, token, timeout=timeout,
+                          extra_md=extra_md)
         except (grpc.RpcError, ChaosError):
             # hedge lost too: the primary is the last hope (may raise)
             self._note_tier(len(batch), fut.result())
@@ -411,8 +463,14 @@ class Destinations:
                  observatory=None,
                  hedge_after: float = 0.0,
                  failover_walk: int = 2,
-                 ledger=None):
+                 ledger=None, trace_plane=None):
         self._ledger = ledger
+        # latest active trace lineage: (trace_id, parent_span_id,
+        # exemplar_blob), set by the routing handlers per RPC (plain
+        # tuple assignment — GIL-atomic) and read by every sender at
+        # batch-send time; (0, 0, None) = untraced traffic
+        self._trace_plane = trace_plane
+        self._active_trace = (0, 0, None)
         self._lock = threading.RLock()
         self._pool: Dict[str, Destination] = {}
         self.ring = ConsistentRing()
@@ -473,7 +531,9 @@ class Destinations:
                         hedge_after=self._hedge_after,
                         hedge_peer=(lambda a=address:
                                     self.hedge_peer_for(a)),
-                        ledger=self._ledger)
+                        ledger=self._ledger,
+                        trace_source=self._trace_context,
+                        trace_plane=self._trace_plane)
                     if address not in self._ejected:
                         self.ring.add(address)
 
@@ -481,6 +541,16 @@ class Destinations:
         """Current pool membership (discovery/elasticity observability)."""
         with self._lock:
             return sorted(self._pool)
+
+    def note_trace(self, trace_id: int, parent_span_id: int,
+                   exemplar_blob) -> None:
+        """Latch the routing tier's active lineage (latest-wins); the
+        senders re-inject it on their next batch. (0, 0, None) clears."""
+        self._active_trace = (int(trace_id), int(parent_span_id),
+                              exemplar_blob)
+
+    def _trace_context(self):
+        return self._active_trace
 
     def _retire_locked(self, dest: Destination) -> None:
         self.retired_sent_total += dest.sent_total

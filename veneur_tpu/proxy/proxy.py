@@ -54,7 +54,10 @@ class ProxyServer:
                  failover_walk: int = 2,
                  telemetry=None,
                  ledger_enabled: bool = True,
-                 ledger_strict: bool = False):
+                 ledger_strict: bool = False,
+                 trace_self_sample_rate: float = 1.0,
+                 trace_store_traces: int = 128,
+                 trace_store_spans: int = 256):
         self.discoverer = discoverer
         self.forward_service = forward_service
         self.discovery_interval = discovery_interval
@@ -73,6 +76,20 @@ class ProxyServer:
         # same latency_observatory knob the server honors turns it off
         from veneur_tpu.core.latency import LatencyObservatory
         self.latency = LatencyObservatory(enabled=latency_observatory)
+        # cross-tier self-tracing (trace/store.py): the proxy follows
+        # whatever interval traces its locals sampled — incoming trace
+        # metadata is adopted, continued with proxy.route /
+        # proxy.dest.send spans into the bounded store behind this
+        # tier's /debug/traces, and re-injected on every destination
+        # send (hedges included) so the global can keep the thread.
+        # sample_rate here gates only the RECORDING of adopted traces
+        # (an overload escape hatch); it never gates pass-through.
+        from veneur_tpu.trace.store import SelfTracePlane
+        self.trace_plane = SelfTracePlane(
+            service="veneur-proxy",
+            sample_rate=trace_self_sample_rate,
+            max_traces=trace_store_traces,
+            max_spans=trace_store_spans)
         # flow ledger (core/ledger.py), the proxy's side of the
         # conservation books: routing (received == routed + dropped +
         # no-destination), the destination pool (enqueued == sent +
@@ -101,7 +118,8 @@ class ProxyServer:
             max_consecutive_failures=max_consecutive_failures,
             observatory=self.latency,
             hedge_after=hedge_after, failover_walk=failover_walk,
-            ledger=self.ledger if self.ledger.enabled else None)
+            ledger=self.ledger if self.ledger.enabled else None,
+            trace_plane=self.trace_plane)
         # probe the pool's monotonic flow totals (retired folds make
         # them churn-proof) and its live queue depth as a stock. ONE
         # flow_totals() snapshot per close, shared by all four readers:
@@ -191,7 +209,10 @@ class ProxyServer:
         # bounce it at exactly the scale the bulk path exists for
         self._grpc = grpc.server(
             futures.ThreadPoolExecutor(max_workers=max_workers),
-            options=[("grpc.max_receive_message_length", 256 << 20)])
+            # metadata cap raised past the 8 KiB default for the trace
+            # + exemplar sidecars (see forward/server.py)
+            options=[("grpc.max_receive_message_length", 256 << 20),
+                     ("grpc.max_metadata_size", 64 << 10)])
         # responses carry FlowCounts (received/routed/duplicate) for
         # the sender's flow-ledger tier reconciliation (forward/wire.py)
         serialize_resp = (lambda b: b if isinstance(b, (bytes, bytearray))
@@ -313,6 +334,7 @@ class ProxyServer:
             rows.extend(self.ring_health.telemetry_rows())
         rows.extend(self.latency.telemetry_rows())
         rows.extend(self.ledger.telemetry_rows())
+        rows.extend(self.trace_plane.telemetry_rows())
         return rows
 
     def cardinality_report(self, top: int = 20, name: str = "") -> dict:
@@ -390,6 +412,44 @@ class ProxyServer:
 
     ROUTE_CACHE_MAX = 1_000_000
 
+    # -- cross-tier self-tracing -----------------------------------------
+
+    def _trace_begin(self, ctx):
+        """Continue a local's interval trace through the routing tier:
+        adopt the incoming id (sample-gated for RECORDING only), open
+        the proxy.route span, and hand the lineage + exemplar sidecar
+        to the destination pool so the next batch each sender ships
+        re-injects them toward the global. An untraced RPC clears the
+        pool's pending lineage so stale ids never ride later batches.
+        Runs only after token dedupe passed — a retry whose first
+        attempt landed here never opens a second proxy.route span."""
+        from veneur_tpu.forward.wire import extract_trace, metadata_value
+        from veneur_tpu.trace.store import EXEMPLAR_KEY
+        trace_id, span_id = extract_trace(ctx)
+        if not trace_id:
+            self.destinations.note_trace(0, 0, None)
+            return None
+        blob = metadata_value(ctx, EXEMPLAR_KEY)
+        span = (self.trace_plane.span("proxy.route", trace_id,
+                                      parent_id=span_id)
+                if self.trace_plane.follow(trace_id) else None)
+        # downstream parent: the route span when recorded here, else
+        # the sender's span (pass-through keeps the chain connected
+        # even when this tier declines to record)
+        self.destinations.note_trace(
+            trace_id, span.id if span is not None else span_id, blob)
+        return span
+
+    @staticmethod
+    def _trace_end(span, received: int, routed: int, ok: bool) -> None:
+        if span is None:
+            return
+        span.set_tag("received", received)
+        span.set_tag("routed", routed)
+        if not ok:
+            span.error()
+        span.finish()
+
     # -- handlers --------------------------------------------------------
 
     def _send_metrics_v1(self, body, ctx):
@@ -403,8 +463,13 @@ class ProxyServer:
             ctx.abort(grpc.StatusCode.UNAVAILABLE,
                       "duplicate send racing its first attempt")
         ok = False
+        tspan = None
         received = routed = 0
         try:
+            # inside the try: a _trace_begin failure past _deduper.begin
+            # must still reach _deduper.end, or the token wedges
+            # in-flight and every retry is refused
+            tspan = self._trace_begin(ctx)
             res = self._route_native(body)
             if res is None:
                 metric_list = forward_pb2.MetricList.FromString(body)
@@ -417,6 +482,7 @@ class ProxyServer:
             ok = True
         finally:
             self._deduper.end(token, ok)
+            self._trace_end(tspan, received, routed, ok)
         # FlowCounts back to the local: received metrics this handler
         # parsed, "merged" = routed onto a destination queue (drops and
         # no-destination are this proxy's accounted loss)
@@ -514,8 +580,10 @@ class ProxyServer:
             ctx.abort(grpc.StatusCode.UNAVAILABLE,
                       "duplicate send racing its first attempt")
         ok = False
+        tspan = None
         received = routed = 0
         try:
+            tspan = self._trace_begin(ctx)  # see _send_metrics_v1
             for pbm in request_iterator:
                 received += 1
                 if self.handle_metric(pbm):
@@ -523,6 +591,7 @@ class ProxyServer:
             ok = True
         finally:
             self._deduper.end(token, ok)
+            self._trace_end(tspan, received, routed, ok)
         return encode_flow_counts(received, routed)
 
     def handle_metric(self, pbm: metric_pb2.Metric) -> bool:

@@ -7,11 +7,19 @@ basic/bearer auth. Metric and label names sanitize to the Prometheus
 charset ([a-zA-Z_:][a-zA-Z0-9_:]*), duplicate labels keep the last value.
 
 The WriteRequest message is hand-encoded protobuf wire format (the schema
-is 4 tiny messages; no codegen needed):
+is 5 tiny messages; no codegen needed):
   WriteRequest{ repeated TimeSeries timeseries = 1 }
-  TimeSeries{ repeated Label labels = 1; repeated Sample samples = 2 }
+  TimeSeries{ repeated Label labels = 1; repeated Sample samples = 2;
+              repeated Exemplar exemplars = 3 }
   Label{ string name = 1; string value = 2 }
   Sample{ double value = 1; int64 timestamp = 2 }  # ms
+  Exemplar{ repeated Label labels = 1; double value = 2;
+            int64 timestamp = 3 }  # ms
+
+Exemplars carry the cross-tier self-trace plane's per-series
+`(trace_id, raw value, timestamp)` (trace/store.py) as a
+`trace_id` exemplar label — the native remote-write form of the
+OpenMetrics `# {trace_id="..."}` clause the text sinks render.
 """
 
 from __future__ import annotations
@@ -73,15 +81,28 @@ def _encode_sample(value: float, timestamp_ms: int) -> bytes:
     return body
 
 
-def encode_write_request(
-        series: Sequence[Tuple[List[Tuple[str, str]], float, int]]) -> bytes:
-    """series: [(labels, value, timestamp_ms)] -> WriteRequest bytes."""
+def _encode_exemplar(trace_id_hex: str, value: float,
+                     ts_ms: int) -> bytes:
+    body = _field_bytes(1, _encode_label("trace_id", trace_id_hex))
+    body += bytes([(2 << 3) | 1]) + struct.pack("<d", value)
+    body += bytes([3 << 3]) + _varint(ts_ms & ((1 << 64) - 1))
+    return body
+
+
+def encode_write_request(series: Sequence[tuple]) -> bytes:
+    """series: [(labels, value, timestamp_ms)] or
+    [(labels, value, timestamp_ms, (trace_id_hex, exemplar_value,
+    exemplar_ts_ms))] -> WriteRequest bytes."""
     out = bytearray()
-    for labels, value, ts_ms in series:
+    for entry in series:
+        labels, value, ts_ms = entry[0], entry[1], entry[2]
+        exemplar = entry[3] if len(entry) > 3 else None
         ts_body = bytearray()
         for name, value_str in labels:
             ts_body += _field_bytes(1, _encode_label(name, value_str))
         ts_body += _field_bytes(2, _encode_sample(value, ts_ms))
+        if exemplar is not None:
+            ts_body += _field_bytes(3, _encode_exemplar(*exemplar))
         out += _field_bytes(1, bytes(ts_body))
     return bytes(out)
 
@@ -171,6 +192,7 @@ class CortexMetricSink(MetricSink):
         # process lifetime — high-churn tag sets grow the map)
         self.convert_counters_to_monotonic = convert_counters_to_monotonic
         self._monotonic: Dict[Tuple[str, Tuple[str, ...], str], float] = {}
+        self._exemplars = None  # ExemplarStore, bound in start()
         self.headers = {
             "Content-Encoding": "snappy",
             "X-Prometheus-Remote-Write-Version": "0.1.0",
@@ -189,6 +211,32 @@ class CortexMetricSink(MetricSink):
     def kind(self) -> str:
         return "cortex"
 
+    def start(self, server) -> None:
+        # self-trace exemplars (trace/store.py): per-series
+        # (trace_id, value, ts) riding the remote-write TimeSeries
+        plane = getattr(server, "trace_plane", None)
+        self._exemplars = getattr(plane, "exemplars", None)
+
+    def _exemplar_entry(self, m: InterMetric, exemplified: set):
+        """Same attachment contract as the Prometheus sink
+        (sinks/prometheus.py exemplar_clause_for): COUNTER series only,
+        one per exemplar base name per write, suffix-resolved entries
+        only on their `.bucket` family (tightest containing bucket:
+        buckets emit smallest-le first and for_series checks the
+        bound), exact-name entries on their own line."""
+        if self._exemplars is None or m.type != MetricType.COUNTER:
+            return None
+        from veneur_tpu.trace.store import exemplar_base
+        base = exemplar_base(m.name)
+        if base in exemplified:
+            return None
+        if base != m.name and m.name != base + ".bucket":
+            return None
+        entry = self._exemplars.for_series(m.name, m.tags)
+        if entry is not None:
+            exemplified.add(base)
+        return entry
+
     def _series(self, m: InterMetric):
         labels: Dict[str, str] = {"__name__": sanitize_name(m.name)}
         for t in m.tags:
@@ -205,6 +253,7 @@ class CortexMetricSink(MetricSink):
         import time as _time
 
         series = []
+        exemplified = set()
         for m in metrics:
             if m.type == MetricType.STATUS:
                 continue
@@ -214,7 +263,14 @@ class CortexMetricSink(MetricSink):
                 self._monotonic[key] = (
                     self._monotonic.get(key, 0.0) + float(m.value))
                 continue
-            series.append(self._series(m))
+            row = self._series(m)
+            entry = self._exemplar_entry(m, exemplified)
+            if entry is not None:
+                from veneur_tpu.trace.store import trace_id_hex
+                tid, ev, ets = entry
+                row = row + ((trace_id_hex(tid), float(ev),
+                              int(ets * 1000)),)
+            series.append(row)
         if self.convert_counters_to_monotonic:
             # stamp the re-emitted monotonic series with the flush's own
             # metric timestamp so they align with the gauges in the same

@@ -33,8 +33,42 @@ def escape_label_value(v: str) -> str:
             .replace("\n", "\\n"))
 
 
-def render_exposition(metrics: List[InterMetric]) -> str:
+def exemplar_clause_for(m: InterMetric, exemplars, exemplified) -> str:
+    """The OpenMetrics exemplar clause for one exposition line, or ''.
+    Shared contract with the Cortex sink: COUNTER lines only (exemplars
+    on gauges are invalid OpenMetrics), at most one line per exemplar
+    BASE name (`exemplified` accumulates across the flush), and a
+    suffix-resolved exemplar attaches only to its `.bucket` family —
+    rendered cumulative smallest-le first, so the first bucket whose
+    bound contains the value (for_series' le check) is the tightest,
+    per the OpenMetrics contract. An exact-name entry (a heavy-hitter
+    counter) attaches to its own line."""
+    if exemplars is None or m.type != MetricType.COUNTER:
+        return ""
+    from veneur_tpu.trace.store import exemplar_base
+    base = exemplar_base(m.name)
+    if base in exemplified:
+        return ""
+    if base != m.name and m.name != base + ".bucket":
+        return ""
+    try:
+        clause = exemplars(m.name, m.tags) or ""
+    except Exception:
+        return ""
+    if clause:
+        exemplified.add(base)
+    return clause
+
+
+def render_exposition(metrics: List[InterMetric],
+                      exemplars=None) -> str:
+    """Prometheus text exposition; with an exemplar source (the
+    self-trace plane's `exemplar_for`, trace/store.py) counter lines
+    gain the OpenMetrics exemplar clause
+    `... # {trace_id="..."} value ts` per exemplar_clause_for's
+    one-per-family tightest-bucket rules."""
     lines = []
+    exemplified = set()
     for m in metrics:
         if m.type == MetricType.STATUS:
             continue
@@ -43,7 +77,9 @@ def render_exposition(metrics: List[InterMetric]) -> str:
             k, _, v = t.partition(":")
             labels.append(f'{sanitize_label(k)}="{escape_label_value(v)}"')
         label_str = "{" + ",".join(labels) + "}" if labels else ""
-        lines.append(f"{sanitize_name(m.name)}{label_str} {m.value}")
+        clause = exemplar_clause_for(m, exemplars, exemplified)
+        lines.append(f"{sanitize_name(m.name)}{label_str} {m.value}"
+                     f"{clause}")
     return "\n".join(lines) + ("\n" if lines else "")
 
 
@@ -54,9 +90,20 @@ class PrometheusMetricSink(MetricSink):
         self.repeater_address = repeater_address
         self.network = network
         self.expose_address = expose_address
+        # plain 0.0.4 is pre-rendered per flush (the common scrape);
+        # the OpenMetrics variant (exemplar clauses + EOF) renders
+        # LAZILY on the first openmetrics-negotiated scrape and is
+        # cached until the next flush — a mid-line `#` would break
+        # 0.0.4 parsers, and most deployments never request OM
         self._exposition = ""
+        self._exposition_om: Optional[str] = None
+        self._om_metrics: List[InterMetric] = []
         self._lock = threading.Lock()
         self._httpd: Optional[ThreadingHTTPServer] = None
+        # OpenMetrics exemplars: the owning server's self-trace plane
+        # (captured in start()) annotates matching exposition lines
+        # with the interval trace that produced the value
+        self._exemplars = None
 
     def name(self) -> str:
         return self._name
@@ -65,6 +112,9 @@ class PrometheusMetricSink(MetricSink):
         return "prometheus"
 
     def start(self, server) -> None:
+        plane = getattr(server, "trace_plane", None)
+        if plane is not None:
+            self._exemplars = plane.exemplar_for
         if not self.expose_address:
             return
         host, _, port = self.expose_address.rpartition(":")
@@ -75,11 +125,16 @@ class PrometheusMetricSink(MetricSink):
                 pass
 
             def do_GET(self):  # noqa: N802
-                with sink._lock:
-                    body = sink._exposition.encode()
+                want_om = "openmetrics" in (self.headers.get("Accept")
+                                            or "")
+                body = (sink.exposition_openmetrics() if want_om
+                        else sink.exposition_plain()).encode()
                 self.send_response(200)
-                self.send_header("Content-Type",
-                                 "text/plain; version=0.0.4")
+                self.send_header(
+                    "Content-Type",
+                    "application/openmetrics-text; version=1.0.0; "
+                    "charset=utf-8" if want_om
+                    else "text/plain; version=0.0.4")
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -93,9 +148,28 @@ class PrometheusMetricSink(MetricSink):
     def expose_port(self) -> int:
         return self._httpd.server_address[1] if self._httpd else 0
 
-    def flush(self, metrics: List[InterMetric]) -> None:
+    def exposition_plain(self) -> str:
         with self._lock:
-            self._exposition = render_exposition(metrics)
+            return self._exposition
+
+    def exposition_openmetrics(self) -> str:
+        """The OM variant for the last flush, rendered on first demand
+        and cached until the next flush invalidates it."""
+        with self._lock:
+            if self._exposition_om is None:
+                self._exposition_om = (
+                    render_exposition(self._om_metrics,
+                                      exemplars=self._exemplars)
+                    if self._exemplars is not None
+                    else self._exposition) + "# EOF\n"
+            return self._exposition_om
+
+    def flush(self, metrics: List[InterMetric]) -> None:
+        plain = render_exposition(metrics)
+        with self._lock:
+            self._exposition = plain
+            self._om_metrics = metrics
+            self._exposition_om = None
         if not self.repeater_address or not metrics:
             return
         host, _, port = self.repeater_address.rpartition(":")

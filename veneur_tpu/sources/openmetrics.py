@@ -33,6 +33,14 @@ _LINE = re.compile(
     r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
     r"(?:\{(?P<labels>.*)\})?\s+(?P<value>[^ ]+)(?:\s+\d+)?$")
 _LABEL = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+# OpenMetrics exemplar clause appended after a sample value
+# (`... # {trace_id="..."} value [ts]`) — stripped as a SECOND try
+# only when the raw line fails to match, so scraping an exemplified
+# exposition (this framework's own /metrics under Accept:
+# application/openmetrics-text) doesn't silently lose the series,
+# while lines whose quoted label values happen to contain ` # {...}`
+# keep parsing exactly as before
+_EXEMPLAR = re.compile(r"\s+#\s+\{.*\}\s+\S+(?:\s+\S+)?$")
 _ESCAPE = re.compile(r"\\(.)")
 _UNESCAPES = {"n": "\n", '"': '"', "\\": "\\"}
 
@@ -59,7 +67,7 @@ def parse_exposition(text: str) -> Iterator[Tuple[str, str, Dict[str, str],
             if len(parts) >= 4 and parts[1] == "TYPE":
                 types[parts[2]] = parts[3]
             continue
-        match = _LINE.match(line)
+        match = _LINE.match(line) or _LINE.match(_EXEMPLAR.sub("", line))
         if not match:
             continue
         name = match.group("name")

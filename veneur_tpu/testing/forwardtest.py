@@ -18,6 +18,9 @@ class ForwardTestServer:
         # port a stopped instance held (grpc listeners use SO_REUSEADDR),
         # so a reconnecting client/destination finds the "restarted node"
         self._handler = handler
+        # per-call invocation metadata, as dicts — tracing tests assert
+        # the x-veneur-* sidecars ride every transport path here
+        self.call_metadata: List[dict] = []
         self._grpc = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
         h = grpc.method_handlers_generic_handler("forwardrpc.Forward", {
             "SendMetricsV2": grpc.stream_unary_rpc_method_handler(
@@ -35,6 +38,11 @@ class ForwardTestServer:
         return f"127.0.0.1:{self.port}"
 
     def _recv(self, request_iterator, ctx):
+        try:
+            self.call_metadata.append(
+                dict(ctx.invocation_metadata() or ()))
+        except Exception:
+            pass
         self._handler(list(request_iterator))
         return b""
 

@@ -259,8 +259,13 @@ class Client:
     backend (reference trace/client.go:56-170)."""
 
     def __init__(self, backend, capacity: int = 1024,
-                 buffer: Optional["queue.Queue"] = None):
+                 buffer: Optional["queue.Queue"] = None, tee=None):
         self.backend = backend
+        # tee: callable(span_proto) invoked synchronously on every
+        # record() — the self-trace plane's assembly hook (the bounded
+        # trace store behind /debug/traces); must never raise into the
+        # recording caller
+        self.tee = tee
         # a caller may supply the buffer (the server passes an
         # InstrumentedQueue so span dwell shows up in queue.dwell)
         self._q: "queue.Queue" = (buffer if buffer is not None
@@ -294,6 +299,11 @@ class Client:
                 self._q.task_done()
 
     def record(self, span: ssf.SSFSpan) -> None:
+        if self.tee is not None:
+            try:
+                self.tee(span)
+            except Exception:
+                pass
         if self._closed.is_set():
             self._count_drop()
             return

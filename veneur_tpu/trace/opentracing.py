@@ -33,6 +33,11 @@ from veneur_tpu.trace import context as trace_ctx
 FORMAT_TEXT_MAP = "text_map"
 FORMAT_HTTP_HEADERS = "http_headers"
 FORMAT_BINARY = "binary"
+# gRPC metadata carrier (forward/wire.py TRACE_KEY): the forward plane's
+# wire form. Inject writes onto a list of (key, value) pairs (the shape
+# grpc's `metadata=` takes) or a dict; extract reads a ServicerContext,
+# a pair sequence, or a dict.
+FORMAT_GRPC_METADATA = "grpc_metadata"
 
 
 class UnsupportedFormatException(Exception):
@@ -201,6 +206,19 @@ class Tracer:
             for k, v in span_context.baggage.items():
                 carrier[f"baggage-{k}"] = v
             return
+        if format == FORMAT_GRPC_METADATA:
+            from veneur_tpu.forward import wire
+            md = wire.trace_metadata(span_context.trace_id,
+                                     span_context.span_id)
+            if md is None:
+                raise SpanContextCorruptedException(
+                    "cannot inject an unidentified span context")
+            if hasattr(carrier, "append"):
+                carrier.extend(md)
+            else:
+                for key, value in md:
+                    carrier[key] = value
+            return
         if format == FORMAT_BINARY:
             span = ssf.SSFSpan(id=span_context.span_id,
                                trace_id=span_context.trace_id)
@@ -221,6 +239,22 @@ class Tracer:
             baggage = {k[len("baggage-"):]: v for k, v in carrier.items()
                        if k.lower().startswith("baggage-")}
             return SpanContext(trace_id, span_id, baggage)
+        if format == FORMAT_GRPC_METADATA:
+            from veneur_tpu.forward import wire
+            if hasattr(carrier, "invocation_metadata"):
+                trace_id, span_id = wire.extract_trace(carrier)
+            else:
+                items = (carrier.items() if hasattr(carrier, "items")
+                         else carrier)
+                trace_id = span_id = 0
+                for key, value in items:
+                    if key == wire.TRACE_KEY:
+                        trace_id, span_id = wire.parse_trace_value(value)
+                        break
+            if not trace_id:
+                raise SpanContextCorruptedException(
+                    "no trace metadata in carrier")
+            return SpanContext(trace_id, span_id)
         if format == FORMAT_BINARY:
             import io
             data = carrier.read() if hasattr(carrier, "read") else bytes(

@@ -1,0 +1,627 @@
+"""Cross-tier self-trace plane: bounded trace store + exemplars.
+
+The pipeline has traced its own flushes since PR 1 (`flush` spans with
+per-family and per-sink children ride the SSF span pipeline), but the
+spans died at the process boundary: the forward RPC carried only an
+idempotency token, so the proxy's routing work and the global's merge
+appeared as disconnected islands. This module is the assembly side of
+closing that seam:
+
+- `TraceStore`: a bounded in-memory store of COMPLETED spans grouped by
+  trace id (LRU across traces, hard cap per trace), serving
+  `GET /debug/traces` on server, proxy, and global. It holds only this
+  framework's own spans — application SSF traffic never lands here.
+- `ExemplarStore`: per-series `(trace_id, raw value, timestamp)`
+  exemplars, latest-wins on merge, bounded by name count. Captured at
+  ingest for heavy-hitter and llhist series, carried across the forward
+  tier as gRPC metadata, and rendered in OpenMetrics exemplar syntax
+  (`... # {trace_id="..."} value ts`) by `/metrics` and the
+  Prometheus/Cortex sinks.
+- `SelfTracePlane`: one process's trace posture — the pre-minted
+  per-interval trace id (so ingest-time exemplar capture can stamp the
+  id the interval's flush span will use), the sampling decision
+  (`trace_self_sample_rate` bounds overhead), span recording for tiers
+  that have no SSF span pipeline of their own (proxy, import server),
+  and the telemetry rows.
+
+Deliberately jax-free: the proxy imports this module.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence, Tuple
+
+# suffixes a flushed series name grows on top of the base metric name;
+# exemplar lookups strip them so `foo.bucket{le:...}` / the observatory's
+# `pipeline.sample_age.p99` row find the exemplar stored under the base
+SERIES_SUFFIXES = (".bucket", ".sum", ".count", ".p50", ".p99", ".max")
+
+
+def exemplar_base(name: str) -> str:
+    """The base metric name an exemplar is stored under — the series
+    name with any known flush/observatory suffix stripped."""
+    for suffix in SERIES_SUFFIXES:
+        if name.endswith(suffix):
+            return name[: -len(suffix)]
+    return name
+
+
+# the SAME id generator the trace client uses (trace/__init__.py):
+# follow()'s low-bit-shift sampling math depends on its ids-are-odd
+# invariant, so there must be exactly one implementation
+from veneur_tpu.trace import _gen_id  # noqa: E402
+
+
+def trace_id_hex(trace_id: int) -> str:
+    return format(int(trace_id), "x") if trace_id else ""
+
+
+def parse_trace_id(value: str) -> int:
+    """Hex (the /debug/traces and exemplar rendering form) or decimal."""
+    value = str(value or "").strip()
+    if not value:
+        return 0
+    try:
+        return int(value, 16)
+    except ValueError:
+        try:
+            return int(value)
+        except ValueError:
+            return 0
+
+
+class TraceStore:
+    """Completed spans grouped by trace id. Bounded two ways: at most
+    `max_traces` traces (oldest-recorded-into evicted first) and at most
+    `max_spans` spans per trace (later spans dropped, counted)."""
+
+    def __init__(self, max_traces: int = 128, max_spans: int = 256):
+        self.max_traces = max(1, int(max_traces))
+        self.max_spans = max(1, int(max_spans))
+        self._lock = threading.Lock()
+        # trace_id -> {"spans": [...], "interval": int|None, ...}
+        self._traces: "OrderedDict[int, dict]" = OrderedDict()
+        self.spans_recorded = 0
+        self.spans_dropped = 0
+        self.traces_evicted = 0
+
+    def record(self, trace_id: int, span_id: int, parent_id: int,
+               name: str, service: str, start_ns: int, end_ns: int,
+               tags: Optional[Dict[str, str]] = None,
+               error: bool = False) -> None:
+        if not trace_id or not span_id:
+            return
+        span = {
+            "span_id": int(span_id),
+            "parent_id": int(parent_id),
+            "name": name,
+            "service": service,
+            "start_ns": int(start_ns),
+            "end_ns": int(end_ns),
+        }
+        if tags:
+            span["tags"] = dict(tags)
+        if error:
+            span["error"] = True
+        interval = None
+        if tags and "interval" in tags:
+            try:
+                interval = int(tags["interval"])
+            except (TypeError, ValueError):
+                interval = None
+        with self._lock:
+            trace = self._traces.get(trace_id)
+            if trace is None:
+                trace = self._traces[trace_id] = {
+                    "spans": [], "interval": None,
+                    "first_unix": round(time.time(), 3)}
+                while len(self._traces) > self.max_traces:
+                    self._traces.popitem(last=False)
+                    self.traces_evicted += 1
+            else:
+                self._traces.move_to_end(trace_id)
+            if interval is not None and trace["interval"] is None:
+                trace["interval"] = interval
+            if len(trace["spans"]) >= self.max_spans:
+                self.spans_dropped += 1
+                return
+            trace["spans"].append(span)
+            self.spans_recorded += 1
+
+    def get(self, trace_id: int) -> Optional[dict]:
+        with self._lock:
+            trace = self._traces.get(trace_id)
+            if trace is None:
+                return None
+            return self._render(trace_id, trace)
+
+    @staticmethod
+    def _render(trace_id: int, trace: dict) -> dict:
+        spans = list(trace["spans"])
+        ids = {s["span_id"] for s in spans}
+        roots = [s for s in spans
+                 if not s["parent_id"] or s["parent_id"] not in ids]
+        return {
+            "trace_id": trace_id_hex(trace_id),
+            "interval": trace.get("interval"),
+            "first_unix": trace.get("first_unix"),
+            "span_count": len(spans),
+            # connected iff every non-root span's parent is present;
+            # locally-rooted sub-trees (a tier that only holds its own
+            # spans) count their top spans as roots
+            "roots": [s["span_id"] for s in roots],
+            "spans": spans,
+        }
+
+    def report(self, trace_id: str = "", interval: int = 0,
+               limit: int = 0) -> dict:
+        """The GET /debug/traces payload: all traces newest-last, or one
+        trace (?trace_id=, hex) / one flush interval (?interval=)."""
+        tid = parse_trace_id(trace_id)
+        with self._lock:
+            items = [(t, dict(rec, spans=list(rec["spans"])))
+                     for t, rec in self._traces.items()]
+            counters = {
+                "spans_recorded": self.spans_recorded,
+                "spans_dropped": self.spans_dropped,
+                "traces_evicted": self.traces_evicted,
+            }
+        if tid:
+            items = [(t, rec) for t, rec in items if t == tid]
+        if interval:
+            items = [(t, rec) for t, rec in items
+                     if rec.get("interval") == interval]
+        if limit and limit > 0:
+            items = items[-limit:]
+        return {
+            "generated_unix": round(time.time(), 3),
+            "max_traces": self.max_traces,
+            "max_spans_per_trace": self.max_spans,
+            "counters": counters,
+            "traces": [self._render(t, rec) for t, rec in items],
+        }
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._traces)
+
+
+class ExemplarStore:
+    """Per-series exemplars: base metric name -> (trace_id, value, ts).
+    Latest-wins everywhere (capture and merge compare timestamps), so a
+    forward merge keeps exactly one exemplar per series and it is the
+    freshest one any tier saw. Bounded at `max_names` (LRU)."""
+
+    def __init__(self, max_names: int = 64):
+        self.max_names = max(1, int(max_names))
+        self._lock = threading.Lock()
+        # name -> (trace_id, value, unix_ts)
+        self._entries: "OrderedDict[str, tuple]" = OrderedDict()
+        self.captured_total = 0
+        self.merged_total = 0
+
+    def capture(self, name: str, value: float, trace_id: int,
+                ts: Optional[float] = None) -> None:
+        if not trace_id:
+            return
+        ts = time.time() if ts is None else ts
+        with self._lock:
+            self._entries[name] = (int(trace_id), float(value),
+                                   round(float(ts), 3))
+            self._entries.move_to_end(name)
+            while len(self._entries) > self.max_names:
+                self._entries.popitem(last=False)
+            self.captured_total += 1
+
+    def merge(self, name: str, trace_id: int, value: float,
+              ts: float) -> None:
+        """Forward-merge one exemplar: latest-wins per series."""
+        if not trace_id:
+            return
+        with self._lock:
+            cur = self._entries.get(name)
+            if cur is not None and cur[2] > ts:
+                return
+            self._entries[name] = (int(trace_id), float(value),
+                                   round(float(ts), 3))
+            self._entries.move_to_end(name)
+            while len(self._entries) > self.max_names:
+                self._entries.popitem(last=False)
+            self.merged_total += 1
+
+    def get(self, name: str) -> Optional[tuple]:
+        with self._lock:
+            return self._entries.get(name)
+
+    def for_series(self, name: str,
+                   tags: Sequence[str] = ()) -> Optional[tuple]:
+        """Exemplar for one exposition line: exact name first, then the
+        base name behind a known series suffix. A `.bucket{le:}` line
+        only carries the exemplar when the bucket's bound contains the
+        exemplar value (the OpenMetrics contract: an exemplar must lie
+        within its bucket), attached to the tightest such bucket by
+        construction of the lookup (callers render cumulative buckets
+        smallest-le first and stop after the first line that takes it —
+        see `attach_once`)."""
+        entry = self.get(name)
+        base = name
+        if entry is None:
+            for suffix in SERIES_SUFFIXES:
+                if name.endswith(suffix):
+                    base = name[: -len(suffix)]
+                    entry = self.get(base)
+                    break
+        if entry is None:
+            return None
+        if name == base + ".bucket":
+            le = ""
+            for tag in tags:
+                if tag.startswith("le:"):
+                    le = tag[3:]
+                    break
+            if le and le != "+Inf":
+                try:
+                    if entry[1] > float(le):
+                        return None
+                except ValueError:
+                    return None
+        return entry
+
+    def snapshot(self) -> List[tuple]:
+        with self._lock:
+            return [(name, tid, value, ts)
+                    for name, (tid, value, ts) in self._entries.items()]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+
+def render_openmetrics_exemplar(entry: tuple) -> str:
+    """The OpenMetrics exemplar clause appended after a sample value:
+    `# {trace_id="..."} value ts`."""
+    tid, value, ts = entry
+    value = float(value)
+    v = str(int(value)) if value.is_integer() and abs(value) < 1e15 \
+        else repr(value)
+    return f' # {{trace_id="{trace_id_hex(tid)}"}} {v} {ts}'
+
+
+# -- exemplar wire form (gRPC metadata) -----------------------------------
+#
+# Exemplars cross the forward tier as one bounded metadata entry per RPC:
+# key x-veneur-exemplars-bin, value a JSON array of
+# [name, trace_id_hex, value, unix_ts]. -bin keys carry bytes (grpc
+# base64s them on the wire), so metric names need no ASCII escaping.
+
+EXEMPLAR_KEY = "x-veneur-exemplars-bin"
+# wire budget for the blob BEFORE grpc's base64 expansion (~4/3): the
+# receiving channel's default metadata cap is 8 KiB, and the token +
+# trace entries ride the same header block — 4 KiB keeps the whole
+# set comfortably under it even at the default 64-name store
+EXEMPLAR_WIRE_MAX = 4 * 1024
+
+
+def encode_exemplars(entries: List[tuple]) -> Optional[bytes]:
+    """[(name, trace_id, value, ts)] -> metadata bytes; None when empty.
+    Bounded: newest-first until the wire budget is spent."""
+    if not entries:
+        return None
+    out = []
+    size = 2
+    for name, tid, value, ts in reversed(entries):
+        piece = [name, trace_id_hex(tid), value, ts]
+        enc = len(json.dumps(piece)) + 1
+        if size + enc > EXEMPLAR_WIRE_MAX:
+            break
+        out.append(piece)
+        size += enc
+    if not out:
+        return None
+    out.reverse()  # selection was newest-first; emit in original order
+    return json.dumps(out).encode()
+
+
+def decode_exemplars(data: bytes) -> List[tuple]:
+    """Metadata bytes -> [(name, trace_id, value, ts)]; malformed input
+    decodes to [] (an un-upgraded or hostile peer must not break the
+    import path)."""
+    try:
+        parsed = json.loads(data)
+        out = []
+        for piece in parsed:
+            name, tid_hex, value, ts = piece
+            tid = parse_trace_id(tid_hex)
+            if not tid:
+                continue
+            out.append((str(name), tid, float(value), float(ts)))
+        return out
+    except Exception:
+        # broad on purpose: a hostile blob (e.g. deeply nested JSON
+        # raising RecursionError) must degrade to "no exemplars", never
+        # escape into the import handler's token bookkeeping
+        return []
+
+
+class _PlaneSpan:
+    """A span recorded straight into a plane's store (for tiers with no
+    SSF span pipeline: the proxy's route/send spans, the global's
+    import.merge). finish() stamps the end and records."""
+
+    __slots__ = ("_plane", "trace_id", "id", "parent_id", "name",
+                 "tags", "start_ns", "_error", "_done")
+
+    def __init__(self, plane: "SelfTracePlane", name: str, trace_id: int,
+                 parent_id: int, tags: Optional[Dict[str, str]] = None):
+        self._plane = plane
+        self.trace_id = int(trace_id)
+        self.id = _gen_id()
+        self.parent_id = int(parent_id)
+        self.name = name
+        self.tags = dict(tags or {})
+        self.start_ns = time.time_ns()
+        self._error = False
+        self._done = False
+
+    def set_tag(self, key: str, value) -> None:
+        self.tags[key] = str(value)
+
+    def error(self, flag: bool = True) -> None:
+        self._error = flag
+
+    def finish(self) -> None:
+        if self._done:
+            return
+        self._done = True
+        self._plane.store.record(
+            self.trace_id, self.id, self.parent_id, self.name,
+            self._plane.service, self.start_ns, time.time_ns(),
+            tags=self.tags, error=self._error)
+
+
+class SelfTracePlane:
+    """One process's cross-tier self-tracing state.
+
+    On a LOCAL server the plane pre-mints the next interval's trace id
+    (`interval_trace_id`), so exemplars captured at ingest stamp the id
+    the interval's flush span will carry; `roll()` at the end of each
+    flush mints the next one and applies the sampling decision. On the
+    proxy and the global the plane follows incoming metadata instead:
+    `adopt()` marks a remote trace id recordable, and `span()` opens
+    continuation spans parented on the sender's span."""
+
+    # sampled trace ids recently marked recordable; bounds the member-
+    # ship set that gates record_proto (late sink-span stragglers from
+    # a few intervals back still land)
+    SAMPLED_TIDS_MAX = 64
+    # exemplar capture budget per interval: first-sample-per-name, at
+    # most this many distinct names between rolls
+    CAPTURE_BUDGET = 128
+
+    def __init__(self, service: str = "veneur-tpu",
+                 sample_rate: float = 1.0,
+                 max_traces: int = 128, max_spans: int = 256,
+                 exemplar_names: int = 64):
+        self.service = service
+        self.sample_rate = min(1.0, max(0.0, float(sample_rate)))
+        self.store = TraceStore(max_traces=max_traces, max_spans=max_spans)
+        self.exemplars = ExemplarStore(max_names=exemplar_names)
+        self._lock = threading.Lock()
+        self._sampled: "OrderedDict[int, None]" = OrderedDict()
+        self._seq = 0
+        self.intervals_sampled = 0
+        self.intervals_unsampled = 0
+        # the running interval's pre-minted identity
+        self.interval_trace_id = 0
+        self.interval_sampled = False
+        # active-trace override: a GLOBAL adopting a local's interval
+        # trace runs its flush (and stamps its events/ledger) under the
+        # adopted id instead of its own pre-minted one
+        self._override_tid = 0
+        self._mint_interval()
+        # ingest-side exemplar capture state: names worth an exemplar
+        # (heavy hitters, refreshed each roll) and this interval's
+        # already-captured set (first sample per name wins the slot
+        # until the forward merge's latest-wins refreshes it)
+        self._watch: frozenset = frozenset()
+        self._captured: set = set()
+
+    # -- interval lifecycle (local server) --------------------------------
+
+    def _sampled_decision(self) -> bool:
+        if self.sample_rate >= 1.0:
+            return True
+        if self.sample_rate <= 0.0:
+            return False
+        # deterministic 1-in-N: overhead bounded, soak-friendly
+        period = max(1, round(1.0 / self.sample_rate))
+        return self._seq % period == 0
+
+    def _mint_interval(self) -> None:
+        self.interval_trace_id = _gen_id()
+        self.interval_sampled = self._sampled_decision()
+        self._seq += 1
+        if self.interval_sampled:
+            self._mark_sampled(self.interval_trace_id)
+            self.intervals_sampled += 1
+        else:
+            self.intervals_unsampled += 1
+
+    def roll(self, watch_names: Sequence[str] = ()) -> None:
+        """End-of-flush rollover: mint the next interval's trace id,
+        reset the exemplar capture budget, refresh the watch list."""
+        with self._lock:
+            self._mint_interval()
+            self._override_tid = 0
+            self._captured = set()
+            if watch_names:
+                self._watch = frozenset(watch_names)
+
+    def set_active(self, trace_id: int) -> None:
+        """Override the active trace id (the global's flush running
+        under an adopted local trace); cleared at the next roll()."""
+        self._override_tid = int(trace_id or 0)
+
+    def active_trace_hex(self) -> str:
+        """The active trace id (hex) when sampled, else '' — the stamp
+        flight-recorder events and ledger intervals carry. The override
+        (an adopted remote trace) wins over the pre-minted interval."""
+        tid = self._override_tid
+        if not tid:
+            tid = self.interval_trace_id if self.interval_sampled else 0
+        return trace_id_hex(tid) if tid and tid in self._sampled else ""
+
+    # -- sampling membership ----------------------------------------------
+
+    def _mark_sampled(self, trace_id: int) -> None:
+        self._sampled[trace_id] = None
+        self._sampled.move_to_end(trace_id)
+        while len(self._sampled) > self.SAMPLED_TIDS_MAX:
+            self._sampled.popitem(last=False)
+
+    def is_sampled(self, trace_id: int) -> bool:
+        return trace_id in self._sampled
+
+    def adopt(self, trace_id: int) -> None:
+        """Mark a REMOTE trace id recordable on this tier (the proxy and
+        the global follow whatever the local sampled)."""
+        if not trace_id:
+            return
+        with self._lock:
+            self._mark_sampled(trace_id)
+
+    def follow(self, trace_id: int) -> bool:
+        """Adopt a remote trace for recording, honoring sample_rate as
+        a deterministic per-trace gate — a receiving tier's overhead
+        knob. Metadata PASS-THROUGH is never gated (the proxy re-sends
+        lineage it declined to record, so downstream tiers still get a
+        connected trace)."""
+        if not trace_id:
+            return False
+        if trace_id in self._sampled:
+            return True
+        if self.sample_rate >= 1.0:
+            ok = True
+        elif self.sample_rate <= 0.0:
+            ok = False
+        else:
+            period = max(1, round(1.0 / self.sample_rate))
+            # shift out the low bit before the modulo: _gen_id() forces
+            # it to 1 (ids are always odd), so `trace_id % 2` would
+            # never hit and every even period would record nothing
+            ok = (trace_id >> 1) % period == 0
+        if ok:
+            self.adopt(trace_id)
+        return ok
+
+    # -- span recording ---------------------------------------------------
+
+    def span(self, name: str, trace_id: int, parent_id: int = 0,
+             tags: Optional[Dict[str, str]] = None) -> Optional[_PlaneSpan]:
+        """Open a continuation span recorded straight into the store;
+        None when the trace isn't sampled here (callers skip tracing
+        work entirely)."""
+        if not trace_id or not self.is_sampled(trace_id):
+            return None
+        return _PlaneSpan(self, name, trace_id, parent_id, tags=tags)
+
+    def record_proto(self, proto) -> None:
+        """Tee for the SSF trace client (trace.Client tee=): completed
+        self-spans land in the store when their trace was sampled."""
+        try:
+            if not self.is_sampled(proto.trace_id):
+                return
+            self.store.record(
+                proto.trace_id, proto.id, proto.parent_id, proto.name,
+                proto.service, proto.start_timestamp, proto.end_timestamp,
+                tags=dict(proto.tags) if proto.tags else None,
+                error=bool(proto.error))
+        except Exception:
+            pass
+
+    # -- exemplar capture (ingest hot path) -------------------------------
+
+    def set_watch(self, names: Sequence[str]) -> None:
+        self._watch = frozenset(names)
+
+    def maybe_capture(self, name: str, value,
+                      always: bool = False) -> None:
+        """Ingest-time exemplar capture: first sample per watched name
+        per interval (llhist-typed series pass `always`). Hot-path cost
+        when the name isn't interesting: two set lookups."""
+        if name in self._captured:
+            return
+        if not always and name not in self._watch:
+            return
+        if not self.interval_sampled:
+            return
+        try:
+            value = float(value)
+        except (TypeError, ValueError):
+            return
+        captured = self._captured
+        if len(captured) >= self.CAPTURE_BUDGET:
+            return
+        captured.add(name)
+        self.exemplars.capture(name, value, self.interval_trace_id)
+
+    def exemplar_wire(self) -> Optional[bytes]:
+        """This node's exemplars as forward-RPC metadata bytes."""
+        return encode_exemplars(self.exemplars.snapshot())
+
+    def merge_exemplar_wire(self, data: bytes) -> int:
+        """Merge a sender's exemplar metadata, latest-wins; returns the
+        number of entries merged."""
+        entries = decode_exemplars(data)
+        for name, tid, value, ts in entries:
+            self.exemplars.merge(name, tid, value, ts)
+        return len(entries)
+
+    def exemplar_for(self, name: str,
+                     tags: Sequence[str] = ()) -> Optional[str]:
+        """Rendered OpenMetrics exemplar clause for one exposition line,
+        or None — the lookup /metrics and the sinks share."""
+        entry = self.exemplars.for_series(name, tags)
+        if entry is None:
+            return None
+        return render_openmetrics_exemplar(entry)
+
+    # -- surfaces ---------------------------------------------------------
+
+    def report(self, trace_id: str = "", interval: int = 0,
+               limit: int = 0) -> dict:
+        out = self.store.report(trace_id=trace_id, interval=interval,
+                                limit=limit)
+        out["service"] = self.service
+        out["sample_rate"] = self.sample_rate
+        out["active_trace_id"] = self.active_trace_hex()
+        out["exemplars"] = {
+            name: {"trace_id": trace_id_hex(tid), "value": value,
+                   "ts": ts}
+            for name, tid, value, ts in self.exemplars.snapshot()}
+        return out
+
+    def telemetry_rows(self) -> List[Tuple]:
+        """(name, kind, value, tags) rows for the /metrics registry."""
+        store = self.store
+        ex = self.exemplars
+        return [
+            ("trace.store.traces", "gauge", float(len(store)), ()),
+            ("trace.store.spans_recorded", "counter",
+             float(store.spans_recorded), ()),
+            ("trace.store.spans_dropped", "counter",
+             float(store.spans_dropped), ()),
+            ("trace.store.traces_evicted", "counter",
+             float(store.traces_evicted), ()),
+            ("trace.intervals_sampled", "counter",
+             float(self.intervals_sampled), ()),
+            ("trace.intervals_unsampled", "counter",
+             float(self.intervals_unsampled), ()),
+            ("exemplar.names", "gauge", float(len(ex)), ()),
+            ("exemplar.captured", "counter", float(ex.captured_total), ()),
+            ("exemplar.merged", "counter", float(ex.merged_total), ()),
+        ]
