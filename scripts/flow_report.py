@@ -80,7 +80,13 @@ def format_report(report: dict, intervals: int = 0) -> str:
     if stocks:
         add("live stocks:")
         for name in sorted(stocks):
-            add(f"  {name}: {_fmt(stocks[name])}")
+            line = f"  {name}: {_fmt(stocks[name])}"
+            if name == "spool_quarantine" and float(stocks[name] or 0) > 0:
+                # quarantined WAL segments are inventoried, not lost —
+                # but an operator should know they exist (restore or
+                # purge them; see the README backfill runbook)
+                line += "  ** quarantined segments on disk **"
+            add(line)
         add("")
     totals = report.get("stage_totals", {})
     if totals:

@@ -381,6 +381,66 @@ class TestHTTPPlane:
             urllib.request.urlopen(req, timeout=5)
         assert ei.value.code == 404
 
+    def _post_encoded(self, src, body, ctype, encoding):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{src.port}/v1/metrics", data=body,
+            headers={"Content-Type": ctype,
+                     "Content-Encoding": encoding})
+        return urllib.request.urlopen(req, timeout=5)
+
+    def test_gzip_protobuf_post(self, otlp_source):
+        """Real collector peers ship gzip request bodies by default
+        (otlphttpexporter `compression: gzip`)."""
+        import gzip
+        src, ingest = otlp_source
+        body = _request(
+            _metric_gauge("otlp.gz", [_np_attr("core", "0")
+                                      + _f64(4, 0.75)]))
+        resp = self._post_encoded(src, gzip.compress(body),
+                                  "application/x-protobuf", "gzip")
+        assert resp.status == 200
+        assert ingest.by_name()["otlp.gz"][0].value == 0.75
+
+    def test_gzip_json_post(self, otlp_source):
+        import gzip
+        src, ingest = otlp_source
+        doc = {"resourceMetrics": [{"scopeMetrics": [{"metrics": [
+            {"name": "otlp.gzj", "gauge": {
+                "dataPoints": [{"asDouble": 1.25}]}}]}]}]}
+        resp = self._post_encoded(src, gzip.compress(
+            json.dumps(doc).encode()), "application/json", "gzip")
+        assert resp.status == 200
+        assert ingest.by_name()["otlp.gzj"][0].value == 1.25
+
+    def test_gzip_bomb_rejected_bounded(self, otlp_source, monkeypatch):
+        """The decompressed-size guard fires DURING inflation: a body
+        that would expand past the bound answers 400, and the expansion
+        never materializes."""
+        import gzip
+        from veneur_tpu.sources.otlp import OTLPSource
+        src, ingest = otlp_source
+        monkeypatch.setattr(OTLPSource, "GZIP_MAX_DECOMPRESSED", 4096)
+        bomb = gzip.compress(b"\x00" * 1_000_000)  # ~1 KB compressed
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            self._post_encoded(src, bomb, "application/x-protobuf",
+                               "gzip")
+        assert ei.value.code == 400
+        assert not ingest.by_name()
+
+    def test_garbage_gzip_rejected(self, otlp_source):
+        src, _ = otlp_source
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            self._post_encoded(src, b"\x1f\x8bnot really gzip",
+                               "application/x-protobuf", "gzip")
+        assert ei.value.code == 400
+
+    def test_unsupported_encoding_is_415(self, otlp_source):
+        src, _ = otlp_source
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            self._post_encoded(src, b"x", "application/x-protobuf",
+                               "zstd")
+        assert ei.value.code == 415
+
 
 # -- acceptance: OTLP -> flush -> Prometheus/Cortex ------------------------
 

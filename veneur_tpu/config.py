@@ -176,6 +176,45 @@ class Config:
     carryover_spool_dir: str = ""
     carryover_spool_max_bytes: int = 256 * 1024 * 1024
     carryover_spool_max_segments: int = 1024
+    # quarantine bound: undeliverable segments move to
+    # <spool_dir>/quarantine (an inventory stock the flow ledger books,
+    # carryover.spool.quarantined) instead of dying in place; past
+    # these bounds the OLDEST quarantined segments are purged and their
+    # metrics booked as explained shed
+    carryover_spool_quarantine_max_bytes: int = 64 * 1024 * 1024
+    carryover_spool_quarantine_max_segments: int = 256
+    # -- durable interval WAL (util/spool.py + forward/backfill.py) -----
+    # forward_wal: with a spool dir configured, EVERY forwardable
+    # interval snapshot is appended to the spool — stamped with its
+    # interval-start timestamp, fsync'd — BEFORE the send attempt, and
+    # the oldest-first drain is the only send path. kill -9 anywhere
+    # between append and ack replays the interval at restart,
+    # exactly-once via per-segment idempotency tokens (stable across
+    # restarts). Off = the PR-7 behavior (spool only past the
+    # carryover bound).
+    forward_wal: bool = False
+    # segments whose interval stamp is older than this many flush
+    # intervals are BACKFILL: the local drains them behind fresh
+    # segments under the replay token bucket below, and the receiving
+    # global buckets them by original interval (bounded open buckets,
+    # original-timestamp emission) instead of the live flush
+    wal_stale_after_intervals: float = 2.0
+    # replay throttle (core/overload.py TokenBucket, metrics/second;
+    # 0 = full speed): bounds how fast an hours-stale backlog drains so
+    # live forward traffic is never starved of the flush budget. Each
+    # drain always moves at least one segment (progress + breaker
+    # probes stay live).
+    wal_replay_rate_limit: float = 0.0
+    wal_replay_burst: float = 2.0  # seconds of rate headroom
+    # bounded open historical buckets on the receiving tier (0 disables
+    # the backfill plane: stale imports merge into the live interval,
+    # the pre-WAL behavior)
+    backfill_max_open_intervals: int = 8
+    # persistent JAX compilation cache directory: a crash-restart-
+    # replay cycle (and any cold start) reuses compiled flush/ingest
+    # kernels from disk instead of paying the full retrace mid-
+    # recovery. Empty = in-memory compilation only.
+    jax_compilation_cache_dir: str = ""
     # (hedged forwards are a proxy-tier knob — `hedge_after` in the
     # proxy yaml; the local forward client has one upstream and gets
     # duplicate-safety from its per-interval idempotency token alone)

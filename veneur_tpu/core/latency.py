@@ -190,8 +190,11 @@ class LatencyObservatory:
         self._queues: Dict[str, tuple] = {}
         self._marks: Dict[str, _PlaneMark] = {}
         # family -> pending recompile seconds, drained into the next
-        # flush round so retrace cost is tagged on the waterfall
+        # flush round so retrace cost is tagged on the waterfall;
+        # _retrace_cache carries the persistent-compilation-cache
+        # outcome ("hit"/"miss") per family when the cache is enabled
         self._retraces: Dict[str, float] = {}
+        self._retrace_cache: Dict[str, str] = {}
 
     # -- queue dwell -----------------------------------------------------
 
@@ -308,17 +311,28 @@ class LatencyObservatory:
 
     # -- retrace tagging -------------------------------------------------
 
-    def note_retrace(self, family: str, seconds: float) -> None:
+    def note_retrace(self, family: str, seconds: float,
+                     cache: Optional[str] = None) -> None:
         """Record a post-resize jit retrace (the PR-4 recompile hook);
-        the next flush round's waterfall tags the family with it."""
+        the next flush round's waterfall tags the family with it.
+        `cache` records whether the persistent JAX compilation cache
+        served the recompile ("hit") or had to be populated ("miss");
+        None when the cache is disabled or undetermined."""
         if not self.enabled:
             return
         with self._lock:
             self._retraces[family] = self._retraces.get(family, 0.0) + seconds
+            if cache:
+                self._retrace_cache[family] = cache
 
-    def drain_retraces(self) -> Dict[str, float]:
+    def drain_retraces(self) -> Dict[str, tuple]:
+        """{family: (recompile_seconds, cache_outcome_or_None)} since
+        the last drain."""
         with self._lock:
-            out, self._retraces = self._retraces, {}
+            out = {family: (secs, self._retrace_cache.get(family))
+                   for family, secs in self._retraces.items()}
+            self._retraces = {}
+            self._retrace_cache = {}
         return out
 
     # -- export ----------------------------------------------------------

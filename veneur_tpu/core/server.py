@@ -252,6 +252,15 @@ class Server:
         self.forward_client = None  # set in start() when forward_address
         self.import_server = None  # set in start() when grpc_address
         self.grpc_ingest_servers: List = []  # per grpc_listen_addresses
+        # timestamp-faithful backfill (forward/backfill.py): imports
+        # stamped with an interval older than backfill_after_s bucket
+        # by ORIGINAL interval and flush with original timestamps.
+        # Constructed below once the ledger exists.
+        self.backfill = None
+        self.backfill_after_s = 0.0
+        # the running interval's start (the previous flush boundary):
+        # WAL appends stamp it onto every forwardable snapshot
+        self._interval_start_unix = time.time()
 
         # pull-side telemetry: every statsd emission below tees into this
         # registry, and the HTTP API serves it (/metrics, /debug/events,
@@ -307,7 +316,28 @@ class Server:
             outputs=("forward.acked", "forward.merged_away",
                      "forward.shed"),
             stocks=("forward_carryover", "forward_spool",
-                    "forward_inflight"))
+                    "forward_inflight", "spool_quarantine"))
+        # backfill plane (forward/backfill.py, receivers only): every
+        # metric merged into a historical bucket is retired when its
+        # bucket closes, with the open buckets as inventory — WAL
+        # replay must not be able to lose state silently either
+        self.ledger.declare(
+            "backfill", inputs=("backfill.merged",),
+            outputs=("backfill.closed",), stocks=("backfill_open",))
+        if config.backfill_max_open_intervals > 0:
+            # built here (not start()) so a manually-wired ImportServer
+            # — the in-process test topology — finds the plane too
+            from veneur_tpu.forward.backfill import BackfillPlane
+            self.backfill = BackfillPlane(
+                percentiles=self.percentiles,
+                max_open=config.backfill_max_open_intervals,
+                ledger=(self.ledger if self.ledger.enabled else None),
+                on_event=self.telemetry.record_event)
+            self.backfill_after_s = (config.wal_stale_after_intervals
+                                     * self.interval)
+            bf = self.backfill
+            self.ledger.stock("backfill_open", lambda: bf.open_metrics)
+            self.telemetry.registry.add_collector(bf.telemetry_rows)
         # cross-tier reconciliation: what this local acked against what
         # the receiver reports it received/merged (FlowCounts responses)
         self.ledger.declare(
@@ -411,6 +441,9 @@ class Server:
             on_shed=self.overload.shed,
             on_event=self.telemetry.record_event)
         self.store.attach_cardinality(self.cardinality)
+        # persistent-compilation-cache probe state: entry counts
+        # snapshotted at resize time, compared after the recompile
+        self._cache_entries_at_resize: Dict[str, int] = {}
         self.store.attach_resize_hook(self._store_resize)
         self.telemetry.registry.add_collector(self.store.telemetry_rows)
         self.telemetry.registry.add_collector(
@@ -749,8 +782,36 @@ class Server:
 
     # -- lifecycle -------------------------------------------------------
 
+    def enable_compilation_cache(self) -> bool:
+        """Point JAX's persistent compilation cache at the configured
+        directory (no-op without one): a crash-restart-replay cycle
+        (SIGUSR2 handoff, WAL recovery) comes up with warm kernels from
+        disk instead of paying the full retrace tax mid-recovery.
+        Thresholds zeroed: restart warmth is the point, so every
+        compile is worth caching. Returns True when enabled."""
+        cache_dir = self.config.jax_compilation_cache_dir
+        if not cache_dir:
+            return False
+        try:
+            import jax
+            os.makedirs(cache_dir, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update(
+                "jax_persistent_cache_min_entry_size_bytes", -1)
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 0)
+            self.telemetry.record_event(
+                "compilation_cache_enabled", directory=cache_dir,
+                entries=max(0, self._compile_cache_entries()))
+            return True
+        except Exception:
+            logger.exception("could not enable the persistent JAX "
+                             "compilation cache")
+            return False
+
     def start(self) -> None:
         from veneur_tpu.util.crash import guarded
+        self.enable_compilation_cache()
         for sink in self.metric_sinks + self.span_sinks:
             sink.start(self)
         for sink in self.span_sinks:
@@ -797,6 +858,10 @@ class Server:
                     cfg.carryover_spool_dir,
                     max_bytes=cfg.carryover_spool_max_bytes,
                     max_segments=cfg.carryover_spool_max_segments,
+                    quarantine_max_bytes=(
+                        cfg.carryover_spool_quarantine_max_bytes),
+                    quarantine_max_segments=(
+                        cfg.carryover_spool_quarantine_max_segments),
                     dwell_hist=self.latency.queue_hist("forward_spool"),
                     ledger=ledger)
                 self.latency.register_queue(
@@ -804,7 +869,14 @@ class Server:
                     cfg.carryover_spool_max_segments)
                 self.telemetry.record_event(
                     "spool_attached", directory=cfg.carryover_spool_dir,
+                    wal=cfg.forward_wal,
                     replayed_segments=spool.replayed_total)
+            replay_limiter = None
+            if cfg.forward_wal and cfg.wal_replay_rate_limit > 0:
+                from veneur_tpu.core.overload import TokenBucket
+                replay_limiter = TokenBucket(
+                    cfg.wal_replay_rate_limit,
+                    cfg.wal_replay_rate_limit * cfg.wal_replay_burst)
             self.forward_client = ForwardClient(
                 cfg.forward_address, deadline=self.interval,
                 tls=fwd_tls or None,
@@ -819,7 +891,10 @@ class Server:
                 carryover=Carryover(cfg.carryover_max_intervals,
                                     ledger=ledger),
                 chaos=self.chaos, spool=spool, ledger=ledger,
-                trace_plane=self.trace_plane)
+                trace_plane=self.trace_plane,
+                wal=cfg.forward_wal, replay_limiter=replay_limiter,
+                replay_stale_after=(cfg.wal_stale_after_intervals
+                                    * self.interval))
             self.forwarder = self.forward_client.forward
             self.telemetry.registry.add_collector(
                 self.forward_client.telemetry_rows)
@@ -842,6 +917,11 @@ class Server:
             if spool is not None:
                 self.ledger.stock("forward_spool",
                                   lambda: spool.pending_metrics)
+                # quarantined segments are set ASIDE, not shed: the
+                # metrics stay booked as inventory until the quarantine
+                # bound purges them (explained shed at that point)
+                self.ledger.stock("spool_quarantine",
+                                  lambda: spool.quarantined_metrics)
         if self.chaos is not None:
             # make the plan visible to the object-less seams (http_post)
             from veneur_tpu.util import chaos as chaos_mod
@@ -973,6 +1053,20 @@ class Server:
             "pipeline_stall", component=component,
             heartbeat_age_s=round(age, 3))
 
+    def _compile_cache_entries(self) -> int:
+        """Entry count of the persistent JAX compilation cache dir
+        (-1 = cache disabled/unreadable) — the hit/miss probe: a
+        recompile that ADDED entries was a miss, one that didn't was
+        served from disk."""
+        cache_dir = self.config.jax_compilation_cache_dir
+        if not cache_dir:
+            return -1
+        try:
+            return sum(1 for name in os.listdir(cache_dir)
+                       if name.endswith("-cache"))
+        except OSError:
+            return -1
+
     def _store_resize(self, family: str, old_cap: int, new_cap: int,
                       seconds: float, kind: str = "resize") -> None:
         """Flight-recorder hook for every column-store capacity doubling
@@ -980,13 +1074,24 @@ class Server:
         buffer lock — event recording only, never statsd) and for the
         first post-resize batch apply (kind=recompile: the jit retrace
         the new capacity forces, the TPU-specific cost)."""
+        cache = None
+        if kind == "resize":
+            self._cache_entries_at_resize[family] = \
+                self._compile_cache_entries()
+        elif kind == "recompile":
+            before = self._cache_entries_at_resize.pop(family, -1)
+            after = self._compile_cache_entries()
+            if before >= 0 and after >= 0:
+                cache = "miss" if after > before else "hit"
         self.telemetry.record_event(
             f"columnstore_{kind}", family=family, old_capacity=old_cap,
-            new_capacity=new_cap, duration_s=round(seconds, 6))
+            new_capacity=new_cap, duration_s=round(seconds, 6),
+            **({"compile_cache": cache} if cache else {}))
         if kind == "recompile":
             # tag the next flush round's waterfall: recompile cost must
-            # be separable from steady-state execute cost
-            self.latency.note_retrace(family, seconds)
+            # be separable from steady-state execute cost (and, with
+            # the persistent cache on, whether disk served it)
+            self.latency.note_retrace(family, seconds, cache=cache)
 
     def adopt_flush_trace(self, trace_id: int, parent_span_id: int) -> None:
         """Called by the import server when a fresh (non-duplicate)
@@ -1132,6 +1237,9 @@ class Server:
             if self.forward_client.spool is not None:
                 self.latency.unregister_queue("forward_spool")
                 self.ledger.unstock("forward_spool")
+                self.ledger.unstock("spool_quarantine")
+        if self.backfill is not None:
+            self.ledger.unstock("backfill_open")
         if self.diagnostics is not None:
             self.diagnostics.stop()
         self.trace_client.close()
@@ -1217,6 +1325,11 @@ class Server:
         from veneur_tpu.trace.store import trace_id_hex
         flush_start = time.perf_counter()
         self.last_flush_unix = time.time()
+        # the interval this flush's snapshot covers began at the
+        # previous flush boundary: the WAL stamps it onto the
+        # forwardable snapshot so a replay lands under THIS interval
+        interval_start = self._interval_start_unix
+        self._interval_start_unix = self.last_flush_unix
         self.flush_count += 1
         # the flush span IS the interval trace root: a local roots it on
         # the plane's pre-minted interval trace id (the same id ingest-
@@ -1344,6 +1457,15 @@ class Server:
             self.store, self.is_local, self.percentiles, self.aggregates,
             collect_forward=self.forwarder is not None,
             timings=phases, attribute=self.latency.enabled)
+        if self.backfill is not None:
+            # closed historical buckets flush alongside the live
+            # interval, each series timestamped at its ORIGINAL
+            # interval start — backfilled history, not a traffic spike
+            backfilled = self.backfill.drain()
+            if backfilled:
+                batch.extras.extend(backfilled)
+                self.statsd.count("flush.backfilled_series_total",
+                                  len(backfilled))
         self.stats.inc("metrics_flushed", len(batch))
         phases["store_flush_s"] = time.perf_counter() - t_store
         phases["preflush_s"] = t_store - flush_start
@@ -1366,7 +1488,8 @@ class Server:
             # flow ledger: everything snapshotted for the forward plane
             # is owed an outcome (ack / merge-away / shed / inventory)
             self.ledger.note("forward.snapshot", len(fwd))
-            if not _start_sink_thread("forward", self._forward_safe, fwd) \
+            if not _start_sink_thread("forward", self._forward_safe, fwd,
+                                      interval_start) \
                     and self.forward_client is not None and len(fwd):
                 # undispatched interval (previous forward still hung):
                 # the snapshot is mergeable state, so it carries over
@@ -1448,11 +1571,14 @@ class Server:
                 flush_span.trace_id, ts=ack_unix)
         families = phases.get("families")
         if families:
-            for family, secs in self.latency.drain_retraces().items():
+            for family, (secs, cache) in \
+                    self.latency.drain_retraces().items():
                 rec = families.get(family)
                 if rec is not None:
                     rec["retrace"] = True
                     rec["recompile_s"] = round(secs, 6)
+                    if cache:
+                        rec["compile_cache"] = cache
             self._record_family_spans(flush_span, families)
         flush_span.finish()
         duration = time.perf_counter() - flush_start
@@ -1581,6 +1707,8 @@ class Server:
             if rec.get("retrace"):
                 tags["retrace"] = "true"
                 tags["recompile_s"] = f"{rec.get('recompile_s', 0.0):.6f}"
+                if rec.get("compile_cache"):
+                    tags["compile_cache"] = rec["compile_cache"]
             child = flush_span.child("flush.family", tags=tags)
             child.proto.start_timestamp = int((base + start_off) * 1e9)
             child.finish(end_time=base + end_off)
@@ -1648,13 +1776,36 @@ class Server:
                 flush=round_info["flush"],
                 duration_s=outcome["duration_s"])
 
-    def _forward_safe(self, fwd: ForwardableState) -> bool:
+    def _forward_safe(self, fwd: ForwardableState,
+                      interval_start: float = 0.0) -> bool:
         try:
-            self.forwarder(fwd)
+            if self._forwarder_takes_interval():
+                self.forwarder(fwd, interval_start)
+            else:
+                # duck-typed forwarder predating the interval stamp
+                self.forwarder(fwd)
             return True
         except Exception:
             logger.exception("forward failed")
             return False
+
+    def _forwarder_takes_interval(self) -> bool:
+        """Signature-based capability check (NOT a TypeError catch: a
+        TypeError from inside the forwarder must never re-invoke it —
+        in WAL mode a second call would append the same snapshot under
+        a second token and double-merge)."""
+        import inspect
+        try:
+            sig = inspect.signature(self.forwarder)
+            params = list(sig.parameters.values())
+        except (TypeError, ValueError):
+            return True  # builtins/partials: assume the full contract
+        if any(p.kind == p.VAR_POSITIONAL for p in params):
+            return True
+        positional = [p for p in params
+                      if p.kind in (p.POSITIONAL_ONLY,
+                                    p.POSITIONAL_OR_KEYWORD)]
+        return len(positional) >= 2
 
     def _flush_span_sink_safe(self, sink) -> bool:
         try:
@@ -1786,6 +1937,6 @@ def _apply_sink_filters(metrics: List[InterMetric], sc: SinkConfig
                 name=metric.name, timestamp=metric.timestamp,
                 value=metric.value, tags=tags, type=metric.type,
                 message=metric.message, hostname=metric.hostname,
-                sinks=metric.sinks)
+                sinks=metric.sinks, backfilled=metric.backfilled)
         out.append(metric)
     return out

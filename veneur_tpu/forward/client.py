@@ -28,7 +28,8 @@ from veneur_tpu.core.flusher import ForwardableState
 from veneur_tpu.forward.convert import forwardable_to_wire
 from veneur_tpu.forward.wire import (_frame_v1, _serialize_metric,
                                      combine_metadata, decode_flow_counts,
-                                     send_batch, token_metadata,
+                                     interval_metadata, send_batch,
+                                     stamp_interval_wire, token_metadata,
                                      trace_metadata)
 from veneur_tpu.util import chaos as chaos_mod
 from veneur_tpu.util.chaos import ChaosError
@@ -63,7 +64,9 @@ class ForwardClient:
                  carryover: Optional[Carryover] = None,
                  chaos: Optional[chaos_mod.Chaos] = None,
                  spool: Optional[CarryoverSpool] = None,
-                 ledger=None, trace_plane=None):
+                 ledger=None, trace_plane=None,
+                 wal: bool = False, replay_limiter=None,
+                 replay_stale_after: float = 0.0):
         self.address = address
         self.deadline = deadline
         # resilience: callers that want fail-and-forget (veneur-emit's
@@ -81,6 +84,26 @@ class ForwardClient:
         self.spool = spool
         if spool is not None and self.carryover.spill is None:
             self.carryover.spill = self._spill
+        # durable WAL mode (`forward_wal: true`): EVERY interval
+        # snapshot is serialized and appended to the spool — stamped
+        # with its interval-start timestamp — BEFORE the send attempt,
+        # and the drain loop IS the send path. A kill -9 anywhere
+        # between the append's fsync and the receiver's ack replays the
+        # interval at restart, exactly-once via the per-segment token
+        # (derived from the on-disk name, stable across restarts).
+        self.wal = bool(wal) and spool is not None
+        # backfill throttle: a drain of segments older than
+        # `replay_stale_after` seconds (an hours-stale spool restored
+        # from a dead peer, a long-outage backlog) pays metric tokens
+        # from `replay_limiter` (a core.overload.TokenBucket) — so a
+        # bulk replay can never starve live forward traffic of the
+        # flush budget or the receiver of cycles. Fresh segments (the
+        # live WAL write of the current interval) are never throttled.
+        self.replay_limiter = replay_limiter
+        self.replay_stale_after = float(replay_stale_after)
+        self.wal_appended_metrics = 0
+        self.wal_acked_metrics = 0
+        self.wal_replay_throttled = 0
         self.chaos = chaos
         # flow ledger (core/ledger.py): acked/shed stamps plus the
         # in-flight inventory stock, so a close landing mid-send still
@@ -164,14 +187,20 @@ class ForwardClient:
                 parts.append(((EXEMPLAR_KEY, blob),))
         return combine_metadata(*parts)
 
-    def forward(self, fwd: ForwardableState) -> int:
+    def forward(self, fwd: ForwardableState,
+                interval_start: float = 0.0) -> int:
         """Serialize and send one flush's state; returns count sent.
+        `interval_start` is the unix timestamp the snapshot's interval
+        began at (0 = unstamped): the WAL stamps it into the segment
+        header and every send carries it as x-veneur-interval metadata,
+        so a replayed interval lands under its ORIGINAL interval on the
+        receiving tier.
 
         Any pending carryover from failed intervals is first merged into
         `fwd` (counters sum, digests recompress, HLL registers max), so a
         success delivers everything owed. On final failure the MERGED
-        state is stashed back; nothing is lost until the carryover bound
-        sheds it.
+        state is stashed back (legacy mode) or already durable on disk
+        (WAL mode); nothing is lost until the spool bound sheds it.
 
         Serialization goes through the native digest encoder
         (convert.forwardable_to_wire) — the per-centroid Python proto
@@ -181,7 +210,7 @@ class ForwardClient:
         stream for importers that reject V1."""
         self.inflight_metrics = len(fwd)
         try:
-            return self._forward_inner(fwd)
+            return self._forward_inner(fwd, interval_start)
         finally:
             # an unexpected exception past this point loses the state
             # with no outcome stamped — clearing the in-flight stock
@@ -215,9 +244,12 @@ class ForwardClient:
         if received > merged:
             self._note("forward.remote_rejected", received - merged)
 
-    def _forward_inner(self, fwd: ForwardableState) -> int:
+    def _forward_inner(self, fwd: ForwardableState,
+                       interval_start: float = 0.0) -> int:
         fwd = self.carryover.drain_into(fwd)
         self.inflight_metrics = len(fwd)
+        if self.wal:
+            return self._forward_wal(fwd, interval_start)
         spool_pending = self.spool is not None and self.spool.depth > 0
         if not len(fwd) and not spool_pending:
             return 0
@@ -332,7 +364,55 @@ class ForwardClient:
             "could not forward %d metrics to %s: %s (carryover depth %d)",
             n_protos, self.address, code, self.carryover.depth)
 
-    # -- durable spool ---------------------------------------------------
+    # -- durable WAL -----------------------------------------------------
+
+    def _forward_wal(self, fwd: ForwardableState,
+                     interval_start: float) -> int:
+        """WAL-mode forward: append the interval to disk FIRST (fsync'd,
+        stamped with its interval-start), then drain the log oldest-
+        first. The drain is the only send path, so ordering across
+        crashes is the on-disk segment order and the breaker/budget
+        logic has exactly one seam. Returns metrics delivered."""
+        if len(fwd):
+            protos = forwardable_to_wire(fwd)
+            if len(fwd) > len(protos):
+                # rows the wire conversion dropped leave the pipeline at
+                # the append boundary (the WAL only ever holds sendable
+                # bytes), explained as a convert shed
+                self._note("forward.shed", len(fwd) - len(protos),
+                           key="convert")
+            if protos:
+                stamp = interval_start or time.time()
+                self.spool.append(
+                    [stamp_interval_wire(p, stamp) for p in protos],
+                    interval_unix=stamp)
+                self.wal_appended_metrics += len(protos)
+        # durable now: the spool stock carries the state, so the
+        # in-flight stock must stop double-counting it
+        self.inflight_metrics = 0
+        if self.spool.depth == 0:
+            return 0
+        if not self.breaker.allow():
+            self.stats["breaker_refused_total"] += 1
+            return 0
+        deadline_ts = time.monotonic() + self.deadline
+        sidecar = self._trace_sidecar()
+        drained, err, attempted = self._drain_spool(
+            deadline_ts, destination_up=False, sidecar=sidecar)
+        if drained:
+            self.breaker.record_success()
+            self.carryover.clear_age()
+            self.stats["forwarded_total"] += drained
+            self.wal_acked_metrics += drained
+        elif err is not None:
+            code = err.code() if hasattr(err, "code") else None
+            self._record_failure(code, ForwardableState(), 0)
+        else:
+            # no RPC evidence the peer is up (every segment quarantined
+            # on read): release a half-open probe pessimistically
+            # rather than close the breaker on a no-op
+            self.breaker.record_failure()
+        return drained
 
     def _spill(self, fwd: ForwardableState) -> int:
         """Carryover's overflow hook: serialize the shed-bound state to
@@ -365,12 +445,41 @@ class ForwardClient:
         drained = 0
         err = None
         attempted = False
-        while True:
-            seg = self.spool.oldest()
-            if seg is None:
-                break
+        sent_any = False
+        now = time.time()
+        stale_after = self.replay_stale_after if self.wal else 0.0
+        ordered = self.spool.segments()
+        if stale_after > 0:
+            # WAL backfill isolation: fresh segments (the live interval,
+            # a short outage's backlog) drain first at full speed; an
+            # hours-stale backlog (a restored peer's disk) drains BEHIND
+            # them under the replay token bucket — ordering across
+            # buckets is free because every family merges commutatively
+            # and the receiver buckets by the segment's interval stamp,
+            # not arrival order
+            fresh = [s for s in ordered
+                     if not s.interval_unix
+                     or now - s.interval_unix <= stale_after]
+            fresh_set = set(id(s) for s in fresh)
+            ordered = fresh + [s for s in ordered
+                               if id(s) not in fresh_set]
+        for seg in ordered:
             remaining = deadline_ts - time.monotonic()
             if remaining <= 0.05:
+                break
+            is_stale = (stale_after > 0 and seg.interval_unix
+                        and now - seg.interval_unix > stale_after)
+            if (is_stale and sent_any and self.replay_limiter is not None
+                    and not self.replay_limiter.admit(seg.count)):
+                # out of replay tokens: everything after this segment is
+                # at least as stale (fresh-first ordering), so stop the
+                # drain here and let the backlog trickle next interval.
+                # `sent_any` exempts the first segment — every drain
+                # makes progress and resolves a half-open breaker probe.
+                self.wal_replay_throttled += 1
+                logger.info(
+                    "WAL replay throttled at %s (%d segments remain)",
+                    seg.path, self.spool.depth)
                 break
             try:
                 metrics = seg.read_metrics()
@@ -391,21 +500,27 @@ class ForwardClient:
                                grpc.StatusCode.RESOURCE_EXHAUSTED),
                     # spilled segments drain inside the CURRENT flush's
                     # trace (the spans show replay work where it costs)
+                    # and carry their ORIGINAL interval stamp, so the
+                    # receiver backfills them into the right interval
                     metadata=combine_metadata(
-                        token_metadata(token), sidecar))
+                        token_metadata(token),
+                        interval_metadata(seg.interval_unix), sidecar))
             except (grpc.RpcError, ChaosError) as e:
                 err = e
                 code = e.code() if hasattr(e, "code") else None
                 attempts = self._segment_attempts.get(seg.path, 0)
                 # count toward quarantine only failures that indict the
-                # SEGMENT: the peer answered (destination_up) with a
+                # SEGMENT: the peer answered (destination_up, or an
+                # earlier segment landed this drain) with a
                 # non-transient error. DEADLINE_EXCEEDED is usually a
                 # near-exhausted flush budget after a slow main send,
                 # UNAVAILABLE the node dying mid-drain, chaos an
                 # injected transport fault — quarantining a deliverable
                 # interval on those would BE the loss the spool
                 # prevents.
-                if destination_up and not isinstance(e, ChaosError)                         and code not in (
+                if (destination_up or sent_any) \
+                        and not isinstance(e, ChaosError) \
+                        and code not in (
                             grpc.StatusCode.DEADLINE_EXCEEDED,
                             grpc.StatusCode.UNAVAILABLE):
                     attempts += 1
@@ -417,7 +532,7 @@ class ForwardClient:
                     # quarantine it so it can't wedge everything behind
                     logger.error(
                         "spool segment %s failed %d drain attempts; "
-                        "quarantining (.corrupt)", seg.path, attempts)
+                        "quarantining", seg.path, attempts)
                     self.spool.discard(seg)
                     self._segment_attempts.pop(seg.path, None)
                     continue
@@ -426,6 +541,7 @@ class ForwardClient:
                     "remain)", self.address, seg.path, e, self.spool.depth)
                 break
             self.spool.pop(seg)
+            sent_any = True
             self._segment_attempts.pop(seg.path, None)
             # the popped segment's stock delta is seg.count; ack the
             # same figure so a header/body count drift surfaces as
@@ -466,6 +582,15 @@ class ForwardClient:
                      float(self.carryover.spilled_total), ()))
         if self.spool is not None:
             rows.extend(self.spool.telemetry_rows())
+        if self.wal:
+            rows.append(("wal.appended", "counter",
+                         float(self.wal_appended_metrics), ()))
+            rows.append(("wal.acked", "counter",
+                         float(self.wal_acked_metrics), ()))
+            rows.append(("wal.replay_throttled", "counter",
+                         float(self.wal_replay_throttled), ()))
+            rows.append(("wal.pending", "gauge",
+                         float(self.spool.pending_metrics), ()))
         return rows
 
     def send_protos(self, protos) -> int:

@@ -148,6 +148,42 @@ class ImportServer:
         return [("forward.hedge.duplicates_dropped", "counter",
                  float(self.duplicates_dropped_total), ())]
 
+    # -- timestamp-faithful backfill --------------------------------------
+
+    def _stale_interval(self, ctx) -> float:
+        """The RPC's interval stamp when it names an interval old enough
+        to backfill (and the owning server runs a backfill plane);
+        0.0 routes the import to the live store. Live forwards stamp the
+        interval that JUST closed — always younger than the threshold —
+        so only WAL/spool replays of genuinely historical intervals
+        divert."""
+        if getattr(self._server, "backfill", None) is None:
+            return 0.0
+        stale_after = getattr(self._server, "backfill_after_s", 0.0)
+        if stale_after <= 0:
+            return 0.0
+        from veneur_tpu.forward.wire import extract_interval
+        import time
+        iv = extract_interval(ctx)
+        if iv > 0 and time.time() - iv >= stale_after:
+            return iv
+        return 0.0
+
+    def _merge_backfill(self, metrics, iv: float) -> tuple:
+        """Merge an iterable of upb Metrics into the backfill plane's
+        interval buckets (forward/backfill.py) instead of the live
+        device store: the per-metric field-11 stamp picks the exact
+        bucket, the RPC-level stamp is the fallback. Returns
+        (received, merged) for the FlowCounts response — the sender's
+        forward_tier reconciliation works unchanged for backfill."""
+        plane = self._server.backfill
+        received = merged = 0
+        for pbm in metrics:
+            received += 1
+            if plane.merge_proto(pbm, iv):
+                merged += 1
+        return received, merged
+
     @property
     def address(self) -> str:
         return f"127.0.0.1:{self.port}"
@@ -192,18 +228,28 @@ class ImportServer:
             # refused forever
             tspan = self._trace_begin(ctx)
             self._note_arrival()
-            res = self._merge_native(body)
-            if res is None:
+            stale_iv = self._stale_interval(ctx)
+            if stale_iv:
+                # historical interval (WAL replay / restored spool):
+                # bucket by ORIGINAL interval instead of folding into
+                # the live flush — upb parse; the native path's speed
+                # is for the per-interval hot loop, not backfill
                 req = forward_pb2.MetricList.FromString(body)
-                buf = _MergeBuffer(self)
-                for pbm in req.metrics:
-                    buf.add(pbm)
-                buf.flush_all()
-                received, merged = len(req.metrics), buf.admitted
+                received, merged = self._merge_backfill(
+                    req.metrics, stale_iv)
             else:
-                received, merged = res
+                res = self._merge_native(body)
+                if res is None:
+                    req = forward_pb2.MetricList.FromString(body)
+                    buf = _MergeBuffer(self)
+                    for pbm in req.metrics:
+                        buf.add(pbm)
+                    buf.flush_all()
+                    received, merged = len(req.metrics), buf.admitted
+                else:
+                    received, merged = res
+                self._note_flow(received, merged)
             self.imported_total += received
-            self._note_flow(received, merged)
             ok = True
         finally:
             self._token_end(token, ok)
@@ -384,14 +430,19 @@ class ImportServer:
             # begin and this try, or a failure wedges the token
             tspan = self._trace_begin(ctx)
             self._note_arrival()
-            buf = _MergeBuffer(self)
-            for pbm in request_iterator:
-                buf.add(pbm)
-                count += 1
-            buf.flush_all()
-            merged = buf.admitted
+            stale_iv = self._stale_interval(ctx)
+            if stale_iv:
+                count, merged = self._merge_backfill(
+                    request_iterator, stale_iv)
+            else:
+                buf = _MergeBuffer(self)
+                for pbm in request_iterator:
+                    buf.add(pbm)
+                    count += 1
+                buf.flush_all()
+                merged = buf.admitted
+                self._note_flow(count, merged)
             self.imported_total += count
-            self._note_flow(count, merged)
             ok = True
         finally:
             self._token_end(token, ok)

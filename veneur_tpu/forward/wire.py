@@ -18,19 +18,24 @@ def _serialize_metric(m) -> bytes:
     return m if type(m) is bytes else m.SerializeToString()
 
 
+def _append_varint(out: bytearray, value: int) -> None:
+    """Append one protobuf varint — the single encode loop every
+    hand-rolled frame in this module shares."""
+    while value >= 0x80:
+        out.append(value & 0x7F | 0x80)
+        value >>= 7
+    out.append(value)
+
+
 def _frame_v1(m) -> bytes:
     """Wraps one serialized Metric as a MetricList `metrics` entry
     (field 1, length-delimited); concatenating the frames IS the
     MetricList wire body."""
     b = _serialize_metric(m)
-    n = len(b)
-    out = [b"\x0a"]
-    while n >= 0x80:
-        out.append(bytes((n & 0x7F | 0x80,)))
-        n >>= 7
-    out.append(bytes((n,)))
-    out.append(b)
-    return b"".join(out)
+    out = bytearray(b"\x0a")
+    _append_varint(out, len(b))
+    out += b
+    return bytes(out)
 
 
 # -- flow-count responses ---------------------------------------------
@@ -58,10 +63,7 @@ def encode_flow_counts(received: int, merged: int,
 
     def field(tag: int, value: int) -> None:
         out.append(tag << 3)  # wire type 0 (varint)
-        while value >= 0x80:
-            out.append(value & 0x7F | 0x80)
-            value >>= 7
-        out.append(value)
+        _append_varint(out, value)
 
     # field 1 is always present (even at 0) so any response bytes at
     # all mean "counts reported"
@@ -120,6 +122,58 @@ IDEMPOTENCY_KEY = "x-veneur-idempotency-token"
 def token_metadata(token: str):
     """Metadata tuple for one send attempt; None disables the header."""
     return ((IDEMPOTENCY_KEY, token),) if token else None
+
+
+# gRPC metadata key carrying the sender's interval-start timestamp
+# (unix seconds, decimal): a live forward stamps the interval its
+# snapshot covers, and a WAL/spool drain stamps the ORIGINAL interval
+# of the replayed segment — so a receiving tier can bucket hours-stale
+# backfill under the interval it belongs to instead of folding it into
+# the current flush (a recovered fleet reports backfilled history, not
+# a false traffic spike). Absent from un-upgraded peers; extraction
+# degrades to 0.0 and the receiver merges into the live interval.
+INTERVAL_KEY = "x-veneur-interval"
+
+# metricpb.Metric's interval field (field 11, int64 unix seconds):
+# the per-metric copy of the same stamp, set on WAL segment bytes so a
+# segment is self-describing even off its spool (a dead peer's disk,
+# restored elsewhere). proto3 unknown-field rules make it invisible to
+# reference Go peers and the native V1 parser alike.
+INTERVAL_FIELD_NUMBER = 11
+
+
+def interval_metadata(interval_unix: float):
+    """Metadata tuple stamping one send's interval; None when
+    unstamped."""
+    if not interval_unix:
+        return None
+    return ((INTERVAL_KEY, format(float(interval_unix), ".3f")),)
+
+
+def extract_interval(ctx) -> float:
+    """Interval-start unix seconds from a gRPC ServicerContext's
+    invocation metadata; 0.0 when absent or undecodable."""
+    value = metadata_value(ctx, INTERVAL_KEY)
+    if not value:
+        return 0.0
+    try:
+        return float(value)
+    except (TypeError, ValueError):
+        return 0.0
+
+
+def stamp_interval_wire(metric_bytes: bytes, interval_unix: float) -> bytes:
+    """Append metricpb.Metric's interval field (field 11, varint) to
+    one already-serialized Metric — field concatenation is valid proto3
+    wire format (last value wins), so the native digest encoder's
+    output never needs to know about the stamp."""
+    value = int(interval_unix)
+    if value <= 0:
+        return metric_bytes
+    out = bytearray(metric_bytes)
+    out.append(INTERVAL_FIELD_NUMBER << 3)  # wire type 0 (varint)
+    _append_varint(out, value)
+    return bytes(out)
 
 
 # gRPC metadata key carrying the sender's trace lineage: every forward

@@ -169,3 +169,8 @@ class InterMetric:
     message: str = ""
     hostname: str = ""
     sinks: RouteInformation = None
+    # True for series replayed from the durable WAL into a historical
+    # interval (forward/backfill.py): `timestamp` is the ORIGINAL
+    # interval start, and timestamp-aware sinks (Cortex remote-write,
+    # Prometheus exposition) must render it explicitly
+    backfilled: bool = False

@@ -61,12 +61,14 @@ def exemplar_clause_for(m: InterMetric, exemplars, exemplified) -> str:
 
 
 def render_exposition(metrics: List[InterMetric],
-                      exemplars=None) -> str:
+                      exemplars=None, openmetrics: bool = False) -> str:
     """Prometheus text exposition; with an exemplar source (the
     self-trace plane's `exemplar_for`, trace/store.py) counter lines
     gain the OpenMetrics exemplar clause
     `... # {trace_id="..."} value ts` per exemplar_clause_for's
-    one-per-family tightest-bucket rules."""
+    one-per-family tightest-bucket rules. `openmetrics` switches
+    timestamp units: text 0.0.4 stamps milliseconds, OpenMetrics
+    stamps seconds."""
     lines = []
     exemplified = set()
     for m in metrics:
@@ -78,8 +80,17 @@ def render_exposition(metrics: List[InterMetric],
             labels.append(f'{sanitize_label(k)}="{escape_label_value(v)}"')
         label_str = "{" + ",".join(labels) + "}" if labels else ""
         clause = exemplar_clause_for(m, exemplars, exemplified)
+        # backfilled series (WAL replay of a historical interval) carry
+        # an explicit exposition timestamp — their value belongs to the
+        # ORIGINAL interval, not scrape time. Live series stay
+        # timestamp-free, the usual exposition contract.
+        if m.backfilled:
+            stamp = (f" {int(m.timestamp)}" if openmetrics
+                     else f" {int(m.timestamp) * 1000}")
+        else:
+            stamp = ""
         lines.append(f"{sanitize_name(m.name)}{label_str} {m.value}"
-                     f"{clause}")
+                     f"{stamp}{clause}")
     return "\n".join(lines) + ("\n" if lines else "")
 
 
@@ -157,11 +168,9 @@ class PrometheusMetricSink(MetricSink):
         and cached until the next flush invalidates it."""
         with self._lock:
             if self._exposition_om is None:
-                self._exposition_om = (
-                    render_exposition(self._om_metrics,
-                                      exemplars=self._exemplars)
-                    if self._exemplars is not None
-                    else self._exposition) + "# EOF\n"
+                self._exposition_om = render_exposition(
+                    self._om_metrics, exemplars=self._exemplars,
+                    openmetrics=True) + "# EOF\n"
             return self._exposition_om
 
     def flush(self, metrics: List[InterMetric]) -> None:

@@ -432,6 +432,25 @@ class OTLPSource(Source):
 
     # -- request handling ------------------------------------------------
 
+    # decompressed-size guard for gzip request bodies: a 4 KB zip bomb
+    # expands ~1000x, so the cap is enforced DURING streaming inflate,
+    # never after (the real OTLP default collector limit neighborhood)
+    GZIP_MAX_DECOMPRESSED = 64 * 1024 * 1024
+
+    def _gunzip_bounded(self, body: bytes) -> bytes:
+        """Inflate a gzip request body, raising ValueError past the
+        decompressed-size bound (checked incrementally — the bomb never
+        materializes in memory)."""
+        import zlib
+        limit = self.GZIP_MAX_DECOMPRESSED
+        d = zlib.decompressobj(wbits=31)  # gzip framing
+        out = d.decompress(body, limit + 1)
+        if len(out) > limit or (d.unconsumed_tail
+                                and len(out) >= limit):
+            raise ValueError(
+                f"gzip body inflates past {limit} bytes")
+        return out
+
     def _handle(self, req: BaseHTTPRequestHandler) -> None:
         if req.path.rstrip("/") != "/v1/metrics":
             req.send_error(404)
@@ -443,6 +462,26 @@ class OTLPSource(Source):
         latency = getattr(getattr(self, "_ingest", None), "latency", None)
         if latency is not None:
             latency.note_arrival("otlp")
+        encoding = (req.headers.get("Content-Encoding") or "").strip().lower()
+        if encoding == "gzip":
+            # real collector peers ship gzip by default
+            # (otlphttpexporter compression: gzip) — without this the
+            # OTLP edge only spoke to curl
+            import zlib
+            try:
+                body = self._gunzip_bounded(body)
+            except (ValueError, zlib.error) as e:
+                logger.warning("rejected gzip OTLP body (%d bytes): %s",
+                               len(body), e)
+                self._count("otlp.gzip_rejected_total")
+                req.send_error(400, explain=str(e))
+                return
+            self._count("otlp.gzip_requests_total")
+        elif encoding and encoding != "identity":
+            self._count("otlp.unsupported_encoding_total")
+            req.send_error(415, explain=f"unsupported Content-Encoding: "
+                                        f"{encoding}")
+            return
         ctype = (req.headers.get("Content-Type") or "").split(";")[0].strip()
         is_json = ctype == "application/json"
         self._count("otlp.requests_total", 1,
