@@ -35,6 +35,8 @@ METRIC_NAMES = {
     "device": "device_samples_per_sec",
     "sustained": "sustained_samples_per_sec",
     "tdigest": "tdigest_samples_per_sec",
+    "mesh": "mesh_samples_per_sec",
+    "mesh-worker": "mesh_samples_per_sec",
 }
 
 # accumulates fields as stages complete, so the deadline guard can emit a
@@ -1129,6 +1131,123 @@ def run_scenario_llhist(duration_s: float, num_keys: int = 1000):
                              num_keys * 2)
 
 
+def run_scenario_mesh(duration_s: float, num_keys: int = 2000):
+    """BASELINE config 7: mesh scaling — per-shard sustained throughput
+    of the partitioned column store on 1/2/4 virtual CPU devices
+    (xla_force_host_platform_device_count). Device count must be fixed
+    before the backend initializes, so each rung runs in a fresh
+    subprocess (run_scenario_mesh_worker); this parent collects the
+    ladder and reports the widest rung's rate, with per-rung rates and
+    scaling ratios (rate_N / rate_1) in the extra fields. On real TPU
+    hardware the same scenario runs over the local chips instead
+    (ROADMAP item 2's acceptance: >= 0.7*N scaling, bit-identical
+    global percentiles — the exactness half is pinned by
+    tests/test_mesh_plane.py)."""
+    import subprocess
+
+    ladder = {}
+    for n in (1, 2, 4):
+        if time_left() < 30:
+            log(f"mesh rung {n} skipped: {time_left():.0f}s left")
+            break
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (
+            f"--xla_force_host_platform_device_count={max(n, 2)}")
+        env["VENEUR_TPU_MESH_N"] = str(n)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        cmd = [sys.executable, os.path.abspath(__file__),
+               "--scenario", "mesh-worker",
+               "--duration", str(max(2.0, duration_s / 3)),
+               "--keys", str(num_keys), "--deadline", "0"]
+        try:
+            proc = subprocess.run(cmd, env=env, capture_output=True,
+                                  timeout=max(60, time_left() - 5))
+            line = proc.stdout.decode().strip().splitlines()[-1]
+            ladder[str(n)] = json.loads(line)
+        except Exception as e:
+            ladder[str(n)] = {"error": f"{type(e).__name__}: {e}"}
+            log(f"mesh rung {n} failed: {e}")
+        else:
+            log(f"mesh rung {n}: "
+                f"{ladder[str(n)].get('value', 0):,.0f} samples/s")
+    rates = {n: r.get("value", 0.0) for n, r in ladder.items()
+             if isinstance(r, dict) and r.get("value")}
+    base = rates.get("1", 0.0)
+    RESULT["mesh_ladder"] = ladder
+    if base > 0:
+        RESULT["mesh_scaling"] = {
+            n: round(rates[n] / base, 3) for n in rates}
+    best = max(rates.values()) if rates else 0.0
+    return best
+
+
+def run_scenario_mesh_worker(duration_s: float, num_keys: int) -> float:
+    """One mesh rung (fresh process): drive the partitioned column
+    store's batch fast path — pre-interned keys, digest-home routed
+    dispatches across all four batched families, one columnar flush per
+    ~second — and report aggregated samples/s. VENEUR_TPU_MESH_N picks
+    the shard count (1 = single-device control, the exactness
+    baseline)."""
+    import numpy as np
+
+    from veneur_tpu.core.columnstore import ColumnStore
+    from veneur_tpu.core.flusher import flush_columnstore_batch
+    from veneur_tpu.samplers.metrics import HistogramAggregates
+    from veneur_tpu.samplers.parser import Parser
+
+    shards = int(os.environ.get("VENEUR_TPU_MESH_N", "1"))
+    cap = max(256, 1 << (num_keys - 1).bit_length())
+    store = ColumnStore(
+        counter_capacity=cap, gauge_capacity=cap, histo_capacity=cap,
+        set_capacity=cap, llhist_capacity=cap, batch_cap=BATCH_CAP[0],
+        shard_devices=shards if shards > 1 else 0)
+    RESULT["mesh_shards"] = (store.shard_plane.n
+                             if store.shard_plane is not None else 1)
+    parser = Parser()
+    for i in range(num_keys):
+        parser.parse_metric_fast(b"mesh.c.%d:1|c" % i, store.process)
+        parser.parse_metric_fast(b"mesh.t.%d:5|ms" % i, store.process)
+        parser.parse_metric_fast(b"mesh.l.%d:5|l" % i, store.process)
+        parser.parse_metric_fast(b"mesh.s.%d:x|s" % i, store.process)
+    store.apply_all_pending()
+
+    aggs = HistogramAggregates.from_names(["min", "max", "count"])
+    ps = (0.5, 0.99)
+
+    def flush():
+        return flush_columnstore_batch(store, False, ps, aggs,
+                                       collect_forward=False)
+
+    flush()  # compile the flush kernels off the timed window
+
+    rng = np.random.default_rng(13)
+    b = BATCH_CAP[0]
+    rows = rng.integers(0, num_keys, b).astype(np.int32)
+    vals = rng.normal(100, 15, b).astype(np.float32)
+    ones = np.ones(b, np.float32)
+    from veneur_tpu.ops import batch_hll
+    s_idx = rng.integers(0, batch_hll.M, b).astype(np.int32)
+    s_rho = rng.integers(1, 30, b).astype(np.int32)
+
+    samples = 0
+    t0 = time.perf_counter()
+    next_flush = t0 + 1.0
+    while time.perf_counter() - t0 < duration_s:
+        store.counters.add_batch(rows, vals, ones)
+        store.histos.add_batch(rows, vals, ones)
+        store.llhists.add_batch(rows, vals, ones)
+        store.sets.add_batch(rows, s_idx, s_rho)
+        samples += 4 * b
+        if time.perf_counter() >= next_flush:
+            flush()
+            next_flush = time.perf_counter() + 1.0
+    batch, _fwd = flush()  # final flush inside the measurement contract
+    elapsed = time.perf_counter() - t0
+    RESULT["mesh_flush_metrics"] = len(batch)
+    return samples / max(elapsed, 1e-9)
+
+
 def run_scenario_hll(duration_s: float, num_keys: int = 10_000,
                      cardinality: int = 100):
     """BASELINE config 3: mixed keys at tag cardinality 100 — HLL stress
@@ -1147,7 +1266,8 @@ def run_scenario_hll(duration_s: float, num_keys: int = 10_000,
 
 
 SCENARIOS = ["default", "mixed", "single", "counter", "timers", "hll",
-             "llhist", "forward", "ssf", "device", "sustained", "tdigest"]
+             "llhist", "forward", "ssf", "device", "sustained", "tdigest",
+             "mesh", "mesh-worker"]
 
 
 def clamp_keys(keys: int, on_tpu: bool) -> int:
@@ -1221,6 +1341,10 @@ def run_one(scenario: str, duration: float, keys: int, on_tpu: bool = True):
             clamp_keys(keys, on_tpu), interval_s=10.0 if on_tpu else 2.0)
     elif scenario == "tdigest":
         rate, extra = run_scenario_tdigest(duration, clamp_keys(keys, on_tpu))
+    elif scenario == "mesh":
+        rate = run_scenario_mesh(duration, min(keys, 2000))
+    elif scenario == "mesh-worker":
+        rate = run_scenario_mesh_worker(duration, min(keys, 2000))
     else:
         rate = run_scenario_ssf(duration, keys)
     return metric, rate, extra

@@ -175,8 +175,14 @@ class TestProfilingEndpoints:
 
             t = threading.Thread(target=burn, daemon=True)
             t.start()
+            # the trace itself is 0.3s but the xplane dump on exit
+            # scales with accumulated in-process XLA state (~8s deep
+            # into the suite): give the request room past vhttp.get's
+            # default 10s so the pin is "endpoint works", not "dump is
+            # fast under full-suite load"
             status, body = vhttp.get(
-                api_url(api, "/debug/profile/device?seconds=0.3"))
+                api_url(api, "/debug/profile/device?seconds=0.3"),
+                timeout=120.0)
             t.join()
             assert status == 200
             zf = zipfile.ZipFile(io.BytesIO(body))

@@ -78,7 +78,7 @@ class TestParityWithUpbPath:
 
         srv_a, obs_a = mk_server()
         imp_a = ImportServer(srv_a, "127.0.0.1:0")
-        assert imp_a._merge_native(body) == len(metrics)
+        assert imp_a._merge_native(body) == (len(metrics), len(metrics))
 
         srv_b, obs_b = mk_server()
         imp_b = ImportServer(srv_b, "127.0.0.1:0")
@@ -113,7 +113,7 @@ class TestParityWithUpbPath:
         body = body_of([pbm])
         srv, obs = mk_server()
         imp = ImportServer(srv, "127.0.0.1:0")
-        assert imp._merge_native(body) == 1
+        assert imp._merge_native(body) == (1, 1)
         got = flush_names_values(srv, obs)
         assert got["s1"] == pytest.approx(500, rel=0.05)
         srv.shutdown()
@@ -135,7 +135,7 @@ class TestForeignShapes:
                                       scope=metric_pb2.Global)])
         srv, obs = mk_server()
         imp = ImportServer(srv, "127.0.0.1:0")
-        assert imp._merge_native(body) == 1
+        assert imp._merge_native(body) == (1, 1)
         got = flush_names_values(srv, obs)
         assert got["big.count"] == pytest.approx(weights.sum(), rel=1e-3)
         assert got["big.min"] == pytest.approx(vals.min(), rel=1e-4)
@@ -150,7 +150,7 @@ class TestForeignShapes:
         body = _frame_v1(bytes(raw))
         srv, obs = mk_server()
         imp = ImportServer(srv, "127.0.0.1:0")
-        assert imp._merge_native(body) == 1
+        assert imp._merge_native(body) == (1, 1)
         got = flush_names_values(srv, obs)
         assert got["x.count"] == pytest.approx(2.0)
         srv.shutdown()
@@ -168,7 +168,7 @@ class TestForeignShapes:
         alt = metric_pb2.Metric.FromString(pbm.SerializeToString())
         alt.type = 9
         body2 = body_of([alt])
-        assert imp._merge_native(body2) == 1  # consumed but not merged
+        assert imp._merge_native(body2) == (1, 0)  # consumed, not merged
         got = flush_names_values(srv, obs)
         assert "odd" not in got
         srv.shutdown()
@@ -177,7 +177,7 @@ class TestForeignShapes:
         body = body_of([digest_metric("empty", [], [])])
         srv, obs = mk_server()
         imp = ImportServer(srv, "127.0.0.1:0")
-        assert imp._merge_native(body) == 1
+        assert imp._merge_native(body) == (1, 0)  # consumed, not merged
         got = flush_names_values(srv, obs)
         assert not any(k.startswith("empty") for k in got)
         srv.shutdown()
@@ -223,7 +223,7 @@ class TestForeignShapes:
         body = body_of([pbm])
         srv, obs = mk_server()
         imp = ImportServer(srv, "127.0.0.1:0")
-        assert imp._merge_native(body) == 1  # consumed, not merged
+        assert imp._merge_native(body) == (1, 0)  # consumed, not merged
         assert len(srv.store.counters.rows) == 0
         srv.shutdown()
 
@@ -250,7 +250,7 @@ class TestShardedStore:
                           dmin=float(vals.min()), dmax=float(vals.max()),
                           scope=metric_pb2.Global)
             for i in range(32)])
-        assert imp._merge_native(body) == 32
+        assert imp._merge_native(body) == (32, 32)
         got = flush_names_values(srv, obs)
         assert got["sh7.count"] == pytest.approx(40.0)
         assert got["sh7.min"] == pytest.approx(vals.min(), rel=1e-4)

@@ -1,0 +1,678 @@
+"""Sharded mesh serving-plane tests: digest-home routing, the
+partitioned scalar/llhist families' exactness pins (bit-identical to
+single-device), the shard-group ring's failure confinement, the
+proxy-tier interval-stamp carry, and the chip-failure soak (one shard
+group member ejected for 3 intervals under 30 % forward faults — zero
+counter loss, group-confined re-homing, strict ledgers clean)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from veneur_tpu.config import Config
+from veneur_tpu.core.columnstore import ColumnStore
+from veneur_tpu.forward.protos import metric_pb2
+from veneur_tpu.ops import llhist_ref
+from veneur_tpu.proxy.ring import (ConsistentRing, EmptyRingError,
+                                   ShardGroupRing, parse_shard_suffix)
+from veneur_tpu.samplers.parser import Parser
+from veneur_tpu.testing.forwardtest import ForwardTestServer
+
+pytestmark = pytest.mark.mesh
+
+
+def wait_until(fn, timeout=10.0, step=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(step)
+    return False
+
+
+def parse_into(store_process, packets):
+    parser = Parser()
+    for pkt in packets:
+        parser.parse_metric_fast(pkt, store_process)
+
+
+def collect_stubs(packets):
+    """Parsed UDPMetric stubs (the import-path merge_batch input)."""
+    parser = Parser()
+    out = []
+    for pkt in packets:
+        parser.parse_metric_fast(pkt, out.append)
+    return out
+
+
+# -------------------------------------------------------------------------
+# Digest-home routing
+# -------------------------------------------------------------------------
+
+
+class TestDigestRouting:
+    def test_home_assignment_stamped_at_mint(self):
+        store = ColumnStore(counter_capacity=64, llhist_capacity=64,
+                            batch_cap=32, shard_devices=4)
+        plane = store.shard_plane
+        assert plane is not None and plane.n == 4
+        stubs = collect_stubs([b"mp.home.%d:1|c" % i for i in range(40)])
+        for stub in stubs:
+            store.counters.add(stub)
+        table = store.counters
+        for stub in stubs:
+            row = table.rows[(stub.digest64 << 2) | int(stub.scope)]
+            assert table._shard_of[row] == plane.home(stub.digest64)
+        # every shard serves some keys at this count (4 shards, 40 keys)
+        assert len(set(table._shard_of[:40].tolist())) == 4
+
+    def test_llhist_state_partitioned_by_home(self):
+        """After dispatch, each row's registers live ONLY on its home
+        shard's slice of the stacked state."""
+        store = ColumnStore(llhist_capacity=64, batch_cap=16,
+                            shard_devices=4)
+        parse_into(store.process,
+                   [b"mp.part.%d:%d|l" % (i, i + 1) for i in range(30)])
+        store.apply_all_pending()
+        table = store.llhists
+        state = np.asarray(table.state)  # (4, K, BINS_PAD)
+        per_shard_mass = state.sum(axis=2)  # (4, K)
+        for row in range(30):
+            nz = np.flatnonzero(per_shard_mass[:, row])
+            assert nz.tolist() == [int(table._shard_of[row])]
+
+    def test_mesh_telemetry_rows(self):
+        store = ColumnStore(counter_capacity=64, batch_cap=16,
+                            shard_devices=2)
+        parse_into(store.process, [b"mp.tel.%d:1|c" % i for i in range(8)])
+        store.apply_all_pending()
+        rows = {name: value for name, _kind, value, _tags
+                in store.telemetry_rows()
+                if name.startswith(("mesh.", "shard."))}
+        assert rows.get("mesh.shards") == 2.0
+        assert rows.get("mesh.batches_dispatched", 0) >= 1
+        assert any(name == "shard.samples_routed"
+                   for name, *_ in store.telemetry_rows())
+
+
+# -------------------------------------------------------------------------
+# Partitioned-family exactness: sharded == single-device, bit for bit
+# -------------------------------------------------------------------------
+
+
+class TestScalarShardExactness:
+    def test_counters_bit_identical(self):
+        s1 = ColumnStore(counter_capacity=128, batch_cap=32)
+        s4 = ColumnStore(counter_capacity=128, batch_cap=32,
+                         shard_devices=4)
+        rng = np.random.default_rng(5)
+        packets = []
+        for i in range(60):
+            for _ in range(6):
+                packets.append(b"mp.c.%d:%.4f|c|@0.5" % (
+                    i % 20, rng.random() * 50))
+        parse_into(s1.process, packets)
+        parse_into(s4.process, packets)
+        # import-path merge rides the host-side f64 accumulator in both
+        stubs = collect_stubs([b"mp.c.%d:1|c" % i for i in range(20)])
+        s1.counters.merge_batch(stubs, [7.0] * len(stubs))
+        s4.counters.merge_batch(stubs, [7.0] * len(stubs))
+        s1.apply_all_pending()
+        s4.apply_all_pending()
+        v1, t1, _ = s1.counters.snapshot_and_reset()
+        v4, t4, _ = s4.counters.snapshot_and_reset()
+        np.testing.assert_array_equal(t1, t4)
+        np.testing.assert_array_equal(v1[t1], v4[t4])  # exact, not close
+
+    def test_gauges_last_write_wins_across_dispatches(self):
+        """Interleaved writes spanning many batch dispatches: the home
+        shard serializes every key's writes, so the final value matches
+        single-device exactly (the property round-robin destroyed)."""
+        s1 = ColumnStore(gauge_capacity=64, batch_cap=8)
+        s4 = ColumnStore(gauge_capacity=64, batch_cap=8, shard_devices=4)
+        packets = []
+        for step in range(50):
+            for key in range(10):
+                packets.append(b"mp.g.%d:%d|g" % (key, step * 10 + key))
+        parse_into(s1.process, packets)
+        parse_into(s4.process, packets)
+        s1.apply_all_pending()
+        s4.apply_all_pending()
+        v1, t1, _ = s1.gauges.snapshot_and_reset()
+        v4, t4, _ = s4.gauges.snapshot_and_reset()
+        np.testing.assert_array_equal(t1, t4)
+        np.testing.assert_array_equal(v1[t1], v4[t4])
+
+    def test_gauge_import_merge_routed_to_home(self):
+        s4 = ColumnStore(gauge_capacity=64, batch_cap=8, shard_devices=4)
+        stubs = collect_stubs([b"mp.gi.%d:0|g" % i for i in range(12)])
+        s4.gauges.merge_batch(stubs, [float(i * 3) for i in range(12)])
+        v4, t4, _ = s4.gauges.snapshot_and_reset()
+        got = {i: v4[s4.gauges.rows.get(
+            (stub.digest64 << 2) | int(stub.scope))]
+               for i, stub in enumerate(stubs)
+               if t4[s4.gauges.rows.get(
+                   (stub.digest64 << 2) | int(stub.scope))]}
+        assert got == {i: pytest.approx(i * 3.0) for i in range(12)}
+
+
+class TestLLHistShardExactness:
+    """The PR-5 bit-exactness pin generalized to the mesh: registers
+    ADD across shards, so sharded == single-device exactly."""
+
+    def _feed(self, store):
+        rng = np.random.default_rng(11)
+        packets = []
+        for i in range(25):
+            for v in rng.lognormal(3, 1, 6):
+                packets.append(b"mp.ll.%d:%.4f|l" % (i, v))
+        parse_into(store.process, packets)
+        # batch fast path
+        rows = []
+        parser = Parser()
+        for i in range(25):
+            parser.parse_metric_fast(
+                b"mp.ll.%d:1|l" % i,
+                lambda mm: rows.append(store.llhists.intern(mm)))
+        vals = rng.lognormal(3, 1, len(rows)).astype(np.float32)
+        store.llhists.add_batch(np.asarray(rows, np.int32), vals,
+                                np.ones(len(rows), np.float32))
+        # import-path register merge
+        stubs = collect_stubs([b"mp.ll.%d:1|l" % i for i in range(25)])
+        bins = np.zeros((len(stubs), llhist_ref.BINS), np.int64)
+        bins[:, llhist_ref.bin_index(np.full(len(stubs), 42.0))] = 5
+        store.llhists.merge_batch(stubs, bins)
+        store.apply_all_pending()
+
+    def test_registers_and_quantiles_bit_identical(self):
+        s1 = ColumnStore(llhist_capacity=64, batch_cap=32)
+        s4 = ColumnStore(llhist_capacity=64, batch_cap=32,
+                         shard_devices=4)
+        self._feed(s1)
+        self._feed(s4)
+        ps = (0.5, 0.9, 0.99)
+        out1, bins1, t1, _ = s1.llhists.snapshot_and_reset(ps)
+        out4, bins4, t4, _ = s4.llhists.snapshot_and_reset(ps)
+        np.testing.assert_array_equal(t1, t4)
+        np.testing.assert_array_equal(bins1, bins4)  # registers exact
+        np.testing.assert_array_equal(out1["count"], out4["count"])
+        np.testing.assert_array_equal(out1["quantiles"],
+                                      out4["quantiles"])
+
+    def test_capacity_growth_while_sharded(self):
+        store = ColumnStore(llhist_capacity=8, batch_cap=16,
+                            shard_devices=4)
+        parse_into(store.process,
+                   [b"mp.grow.%d:5|l" % i for i in range(40)])
+        store.apply_all_pending()
+        out, bins, touched, _ = store.llhists.snapshot_and_reset((0.5,))
+        assert int(touched.sum()) == 40
+        assert bins.sum() == 40
+        assert store.llhists.capacity >= 40
+
+
+class TestShardedServerFlush:
+    def test_flush_parity_with_circllhist_encoding(self):
+        """A server-level flush (histogram_encoding=circllhist routes
+        timers into the llhist family) must be bit-identical between a
+        sharded and a single-device store."""
+        from veneur_tpu.core.server import Server
+        from veneur_tpu.sinks.channel import ChannelMetricSink
+
+        def config(shards):
+            cfg = Config()
+            cfg.interval = 60.0
+            cfg.statsd_listen_addresses = []
+            cfg.percentiles = [0.5, 0.9, 0.99]
+            cfg.histogram_encoding = "circllhist"
+            cfg.tpu.counter_capacity = 128
+            cfg.tpu.gauge_capacity = 128
+            cfg.tpu.histo_capacity = 128
+            cfg.tpu.set_capacity = 64
+            cfg.tpu.llhist_capacity = 128
+            cfg.tpu.batch_cap = 64
+            cfg.tpu.shards = shards
+            return cfg.apply_defaults()
+
+        single = Server(config(1), extra_metric_sinks=[
+            s1 := ChannelMetricSink()])
+        sharded = Server(config(4), extra_metric_sinks=[
+            s4 := ChannelMetricSink()])
+        rng = np.random.default_rng(7)
+        for i in range(200):
+            v = rng.lognormal(3, 1)
+            for server in (single, sharded):
+                server.handle_metric_packet(
+                    b"mp.srv.t%d:%.4f|ms" % (i % 16, v))
+                server.handle_metric_packet(b"mp.srv.c:3|c")
+                server.handle_metric_packet(
+                    b"mp.srv.g%d:%d|g" % (i % 4, i))
+        single.store.apply_all_pending()
+        sharded.store.apply_all_pending()
+        single.flush()
+        sharded.flush()
+        got1 = {(m.name, tuple(sorted(m.tags))): m.value
+                for m in s1.wait_flush()}
+        got4 = {(m.name, tuple(sorted(m.tags))): m.value
+                for m in s4.wait_flush()}
+        assert set(got1) == set(got4)
+        for key in got1:
+            # llhist registers merge exactly -> every emitted series
+            # (percentiles, counts, buckets, counters, gauges) matches
+            # bit for bit
+            assert got1[key] == got4[key], key
+
+
+# -------------------------------------------------------------------------
+# Shard-group ring
+# -------------------------------------------------------------------------
+
+
+class TestShardGroupRing:
+    def _ring(self):
+        ring = ShardGroupRing(2)
+        for addr, g in (("g0a:1", 0), ("g0b:1", 0),
+                        ("g1a:1", 1), ("g1b:1", 1)):
+            ring.assign(addr, g)
+            ring.add(addr)
+        return ring
+
+    def test_parse_shard_suffix(self):
+        assert parse_shard_suffix("h:8128#3") == ("h:8128", 3)
+        assert parse_shard_suffix("h:8128") == ("h:8128", None)
+        assert parse_shard_suffix("h:8128#x") == ("h:8128#x", None)
+
+    def test_points_partition_into_contiguous_ranges(self):
+        ring = self._ring()
+        for key in range(1000):
+            point = ring.point_of(f"k{key}")
+            group = ring.group_of_point(point)
+            assert group == (point * 2) >> 64
+            owner = ring.get_at(point)
+            assert ring.group_of(owner) == group
+
+    def test_eject_confined_to_group_and_readmit_exact(self):
+        ring = self._ring()
+        keys = [f"mp.key.{i}" for i in range(2000)]
+        before = {k: ring.get(k) for k in keys}
+        ring.remove("g0a:1")
+        after = {k: ring.get(k) for k in keys}
+        moved = {k for k in keys if before[k] != after[k]}
+        # only g0a's keys moved, and ONLY onto its group sibling
+        assert moved == {k for k in keys if before[k] == "g0a:1"}
+        assert all(after[k] == "g0b:1" for k in moved)
+        # group 1's assignment is untouched
+        assert all(after[k] == before[k] for k in keys
+                   if ring.group_of(before[k]) == 1)
+        ring.add("g0a:1")
+        restored = {k: ring.get(k) for k in keys}
+        assert restored == before  # identical virtual points
+
+    def test_whole_group_down_spills_clockwise(self):
+        ring = self._ring()
+        ring.remove("g0a:1")
+        ring.remove("g0b:1")
+        keys = [f"mp.spill.{i}" for i in range(500)]
+        owners = {ring.get(k) for k in keys}
+        assert owners <= {"g1a:1", "g1b:1"}
+        ring.remove("g1a:1")
+        ring.remove("g1b:1")
+        with pytest.raises(EmptyRingError):
+            ring.get("anything")
+
+    def test_walk_prefers_own_group(self):
+        ring = self._ring()
+        for key in ("a", "b", "c", "d"):
+            point = ring.point_of(key)
+            group = ring.group_of_point(point)
+            walk = ring.walk_at(point, 4)
+            assert len(walk) == 4
+            # the key's own group's two members come first
+            assert {ring.group_of(m) for m in walk[:2]} == {group}
+
+    def test_live_member_cannot_change_group(self):
+        ring = self._ring()
+        with pytest.raises(ValueError):
+            ring.assign("g0a:1", 1)
+
+    def test_hash_fallback_assignment_is_stable(self):
+        ring = ShardGroupRing(4)
+        ring.add("h1:1")
+        g = ring.group_of("h1:1")
+        ring.remove("h1:1")
+        ring.add("h1:1")
+        assert ring.group_of("h1:1") == g
+
+    def test_group_siblings_confined_and_empty_without_peer(self):
+        """Hedge candidates come from the member's OWN group only (a
+        cross-group hedge would merge the primary's key range off-range
+        silently), and a member with no live group sibling gets none.
+        Note plain walk_at(point_of(member)) would start in whatever
+        group the address's hash bits land in — the bug this pins."""
+        ring = self._ring()
+        assert ring.group_siblings("g0a:1", 4) == ["g0b:1"]
+        assert ring.group_siblings("g1b:1", 4) == ["g1a:1"]
+        ring.remove("g0b:1")
+        assert ring.group_siblings("g0a:1", 4) == []
+
+    def test_hedge_peer_group_confined(self):
+        from veneur_tpu.proxy.destinations import Destinations
+
+        ft = {}
+        for name in ("g0a", "g0b", "g1a"):
+            ft[name] = ForwardTestServer(lambda _batch: None)
+            ft[name].start()
+        dests = Destinations(send_buffer=8, batch=8, flush_interval=0.1,
+                             shard_groups=2)
+        try:
+            dests.set_destinations([f"{ft['g0a'].address}#0",
+                                    f"{ft['g0b'].address}#0",
+                                    f"{ft['g1a'].address}#1"])
+            peer = dests.hedge_peer_for(ft["g0a"].address)
+            assert peer is not None
+            assert peer.address == ft["g0b"].address
+            # a group of one never hedges cross-group
+            assert dests.hedge_peer_for(ft["g1a"].address) is None
+        finally:
+            dests.clear()
+            for srv in ft.values():
+                srv.stop()
+
+    def test_failover_walk_outside_group_counts_spill(self):
+        """A failover walk deep enough to leave the key's group books
+        every off-range route in group_spill — not only the empty-group
+        clockwise spill at the primary hop."""
+        from veneur_tpu.proxy.destinations import Destinations
+
+        ft = {}
+        for name in ("g0a", "g1a"):
+            ft[name] = ForwardTestServer(lambda _batch: None)
+            ft[name].start()
+        dests = Destinations(send_buffer=8, batch=8, flush_interval=0.1,
+                             shard_groups=2, failover_walk=2)
+        try:
+            dests.set_destinations([f"{ft['g0a'].address}#0",
+                                    f"{ft['g1a'].address}#1"])
+            ring = dests.ring
+            # a key homed in group 0, with its only member breaker-open
+            point = next(p for p in (ring.point_of(f"k{i}")
+                                     for i in range(200))
+                         if ring.group_of_point(p) == 0)
+            primary = dests._pool[ft["g0a"].address]
+            for _ in range(primary.breaker.failure_threshold + 1):
+                primary.breaker.record_failure()
+            before = dests.group_spill_total
+            alt = dests.get_at(point)
+            assert alt.address == ft["g1a"].address
+            assert dests.group_spill_total == before + 1
+        finally:
+            dests.clear()
+            for srv in ft.values():
+                srv.stop()
+
+
+class TestPeerShardsWindow:
+    def test_peer_shards_gauge_decays(self):
+        """mesh.peer_shards is a rolling two-window max: a local that
+        falls back to single-device tables (header gone) rolls the
+        window with its notes and the gauge drops to 0 — the
+        degraded-mesh runbook's alert, impossible with a lifetime
+        max."""
+        from veneur_tpu.forward.server import ImportServer
+
+        class Srv:  # minimal duck-typed owner
+            trace_plane = None
+            store = None
+
+        imp = ImportServer.__new__(ImportServer)
+        imp.PEER_SHARDS_WINDOW_S = 60.0
+        imp._peer_shards_cur = 0
+        imp._peer_shards_prev = 0
+        imp._peer_shards_t0 = time.monotonic()
+
+        class Ctx:
+            def __init__(self, n):
+                self._md = ((("x-veneur-shards", str(n)),)
+                            if n else ())
+
+            def invocation_metadata(self):
+                return self._md
+
+        imp._note_peer_shards(Ctx(4))
+        assert imp.peer_shards == 4
+        # sender narrows: notes keep arriving without the header
+        imp._peer_shards_t0 -= 61.0
+        imp._note_peer_shards(Ctx(0))
+        assert imp.peer_shards == 4  # previous window still in view
+        imp._peer_shards_t0 -= 61.0
+        imp._note_peer_shards(Ctx(0))
+        assert imp.peer_shards == 0  # decayed
+
+
+class TestRingCompat:
+    def test_consistent_ring_compat_surface(self):
+        """The pool swaps ring implementations; both must expose the
+        same call surface."""
+        for ring in (ConsistentRing(), ShardGroupRing(2)):
+            ring.add("m:1")
+            assert ring.members() == ["m:1"]
+            assert len(ring) == 1
+            point = ring.point_of("k")
+            assert ring.get_at(point) == "m:1"
+            assert ring.walk_at(point, 2) == ["m:1"]
+            ring.set_members(["m:1", "m:2"])
+            assert len(ring) == 2
+            ring.remove("m:2")
+            assert ring.members() == ["m:1"]
+
+
+# -------------------------------------------------------------------------
+# Proxy interval-stamp carry (WAL replay through the routing tier)
+# -------------------------------------------------------------------------
+
+
+def mkmetric(name, value=1):
+    pbm = metric_pb2.Metric(name=name, type=metric_pb2.Counter,
+                            scope=metric_pb2.Global)
+    pbm.counter.value = value
+    return pbm
+
+
+class TestProxyIntervalCarry:
+    def test_destination_batches_split_and_stamp_interval(self):
+        from veneur_tpu.proxy.destinations import Destinations
+
+        received = []
+        ft = ForwardTestServer(received.extend)
+        ft.start()
+        dests = Destinations(send_buffer=64, batch=64, flush_interval=0.1)
+        try:
+            dests.set_destinations([ft.address])
+            dest = dests.get("any")
+            stale = 1_700_000_000.0
+            assert dest.send(mkmetric("mp.live.a"))
+            assert dest.send(mkmetric("mp.old.a"), interval=stale)
+            assert dest.send(mkmetric("mp.old.b"), interval=stale)
+            assert dest.send(mkmetric("mp.live.b"))
+            assert wait_until(lambda: len(received) >= 4)
+            # the stale run rode its own RPC with the interval stamp;
+            # live runs carry none
+            stamped = [md for md in ft.call_metadata
+                       if "x-veneur-interval" in md]
+            assert len(stamped) == 1
+            assert float(stamped[0]["x-veneur-interval"]) == stale
+            unstamped = [md for md in ft.call_metadata
+                         if "x-veneur-interval" not in md]
+            assert len(unstamped) == 2
+        finally:
+            dests.clear()
+            ft.stop()
+
+    def test_proxy_handler_carries_interval_to_destination(self):
+        from veneur_tpu.proxy.proxy import create_static_proxy
+
+        received = []
+        ft = ForwardTestServer(received.extend)
+        ft.start()
+        proxy = create_static_proxy(
+            [ft.address], health_check_interval=0,
+            latency_observatory=False)
+        try:
+            proxy.start()
+            stale = 1_700_000_123.0
+
+            class Ctx:
+                def invocation_metadata(self):
+                    return (("x-veneur-interval", f"{stale:.3f}"),)
+
+            proxy._send_metrics_v2(iter([mkmetric("mp.carry.a", 3)]),
+                                   Ctx())
+            assert wait_until(lambda: len(received) >= 1)
+            stamped = [md for md in ft.call_metadata
+                       if "x-veneur-interval" in md]
+            assert stamped and float(
+                stamped[0]["x-veneur-interval"]) == stale
+        finally:
+            proxy.stop()
+            ft.stop()
+
+
+# -------------------------------------------------------------------------
+# Chip-failure soak: one shard-group member ejected for 3 intervals
+# under 30 % forward faults — zero counter loss, group-confined
+# re-homing, strict ledgers clean every interval.
+# -------------------------------------------------------------------------
+
+
+class TestChipFailureSoak:
+    def _topology(self):
+        from veneur_tpu.proxy.proxy import create_static_proxy
+
+        servers = {}
+        received = {}
+        for name in ("g0a", "g0b", "g1a", "g1b"):
+            received[name] = []
+            servers[name] = ForwardTestServer(received[name].extend)
+            servers[name].start()
+        group_of_addr = {servers["g0a"].address: 0,
+                         servers["g0b"].address: 0,
+                         servers["g1a"].address: 1,
+                         servers["g1b"].address: 1}
+        proxy = create_static_proxy(
+            [f"{addr}#{g}" for addr, g in group_of_addr.items()],
+            shard_groups=2, health_check_interval=0,
+            ledger_strict=True)
+        proxy.start()
+        return servers, received, group_of_addr, proxy
+
+    def test_soak_eject_3_intervals_30pct_faults(self):
+        from veneur_tpu.core.server import Server
+        from veneur_tpu.sinks.channel import ChannelMetricSink
+        from veneur_tpu.util.chaos import Chaos
+
+        servers, received, group_of_addr, proxy = self._topology()
+        local = None
+        try:
+            cfg = Config()
+            cfg.interval = 60.0
+            cfg.hostname = "mesh-soak"
+            cfg.statsd_listen_addresses = []
+            cfg.forward_address = proxy.address
+            cfg.tpu.counter_capacity = 256
+            cfg.tpu.batch_cap = 512
+            cfg.forward_retry_max_attempts = 2
+            cfg.forward_retry_base = 0.01
+            cfg.forward_retry_max = 0.02
+            cfg.carryover_max_intervals = 10
+            cfg.circuit_breaker_failure_threshold = 10_000
+            cfg.ledger_strict = True
+            cfg.ledger_history = 64
+            local = Server(cfg.apply_defaults(),
+                           extra_metric_sinks=[ChannelMetricSink()])
+            local.start()
+            # 30 % faults on the LOCAL's forward seam only (never
+            # installed globally, so the proxy's fault-free senders
+            # model a healthy intra-mesh fabric): failed local sends
+            # recover via retry + carryover — the zero-loss pin
+            local.forward_client.chaos = Chaos(
+                enabled=True, error_rate=0.3, seams={"forward_send"},
+                seed=23)
+
+            ejected_addr = servers["g0a"].address
+            keys = [b"mp.soak.%d" % i for i in range(40)]
+            sent = {k.decode(): 0 for k in keys}
+            rounds = 8
+            eject_at, readmit_at = 2, 5  # 3 ejected intervals
+            for rnd in range(rounds):
+                if rnd == eject_at:
+                    proxy.destinations.eject(ejected_addr)
+                if rnd == readmit_at:
+                    proxy.destinations.readmit(ejected_addr)
+                for j, key in enumerate(keys):
+                    delta = rnd + j + 1
+                    local.handle_metric_packet(
+                        b"%s:%d|c|#veneurglobalonly" % (key, delta))
+                    sent[key.decode()] += delta
+                local.flush()
+                proxy.ledger.close_interval()  # strict: raises on leak
+            # drain: faults off, everything owed must deliver
+            local.forward_client.chaos = None
+            for _ in range(6):
+                local.flush()
+                if local.forward_client.carryover.depth == 0:
+                    break
+            assert local.forward_client.carryover.depth == 0
+
+            def totals():
+                # only the soak's own keys: the local also forwards its
+                # self-metrics (e.g. ssf.names_unique from the native
+                # engine), which ride the same path but aren't in `sent`
+                got = {}
+                for name in servers:
+                    for pbm in received[name]:
+                        if pbm.name.startswith("mp.soak."):
+                            got[pbm.name] = got.get(pbm.name, 0) \
+                                + pbm.counter.value
+                return got
+
+            assert wait_until(
+                lambda: sum(totals().values()) >= sum(sent.values()),
+                timeout=15.0)
+            proxy.destinations.flush_wait(timeout=5.0)
+            got = totals()
+            # zero counter loss across ejection + faults + readmission
+            assert got == sent
+            # strict already raised on any live breach; pin the history
+            for interval in local.ledger.history_imbalances():
+                assert all(v == 0.0 for v in interval.values()), interval
+
+            # group-confined re-homing: every key that ever landed on a
+            # group-0 member belongs to group 0's digest range, group-1
+            # members only ever saw group-1 keys, and the ejected
+            # member's keys went ONLY to its group sibling
+            ring = proxy.destinations.ring
+            owners = {}
+            for name, srv in servers.items():
+                for pbm in received[name]:
+                    if pbm.name.startswith("mp.soak."):
+                        owners.setdefault(pbm.name, set()).add(srv.address)
+            for metric_name, seen in owners.items():
+                point = ring.point_of(
+                    f"{metric_name}counter")  # name+type+tags key
+                home_group = ring.group_of_point(point)
+                assert {group_of_addr[a] for a in seen} == {home_group}, \
+                    (metric_name, seen)
+            # the ejection window re-homed some keys onto the sibling —
+            # the failover actually happened
+            g0a_keys = {p.name for p in received["g0a"]
+                        if p.name.startswith("mp.soak.")}
+            g0b_keys = {p.name for p in received["g0b"]
+                        if p.name.startswith("mp.soak.")}
+            assert g0a_keys & g0b_keys, "no key re-homed during ejection"
+        finally:
+            if local is not None:
+                local.shutdown()
+            proxy.stop()
+            for srv in servers.values():
+                srv.stop()

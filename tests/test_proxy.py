@@ -271,7 +271,7 @@ class TestNativeRouting:
                     time.sleep(0.05)
                 assert sum(map(len, received)) == n
 
-            assert proxy._route_native(body) == want
+            assert proxy._route_native(body) == (want, want)
             wait_total(want)
             native_placement = [
                 sorted(m.SerializeToString() for m in dest)

@@ -52,12 +52,19 @@ class TestShardedServerEquivalence:
             s1 := ChannelMetricSink()]), None
         sharded = Server(_config(8), extra_metric_sinks=[
             s8 := ChannelMetricSink()])
-        # confirm the sharded store actually took the sharded path
+        # confirm the sharded store actually took the sharded path —
+        # with digest routing ALL five families partition over the mesh
         from veneur_tpu.core.sharded_tables import (
-            ShardedHistoTable, ShardedSetTable)
+            ShardedCounterTable, ShardedGaugeTable, ShardedHistoTable,
+            ShardedLLHistTable, ShardedSetTable)
         assert isinstance(sharded.store.histos, ShardedHistoTable)
         assert isinstance(sharded.store.sets, ShardedSetTable)
+        assert isinstance(sharded.store.counters, ShardedCounterTable)
+        assert isinstance(sharded.store.gauges, ShardedGaugeTable)
+        assert isinstance(sharded.store.llhists, ShardedLLHistTable)
         assert len(sharded.store.histos._devices) == 8
+        assert sharded.store.shard_plane is not None
+        assert sharded.store.shard_plane.routing == "digest"
 
         _traffic(single)
         _traffic(sharded)
@@ -132,6 +139,33 @@ class TestShardedServerEquivalence:
         assert int(stouched.sum()) == 40
         np.testing.assert_allclose(est[stouched[: est.shape[0]]], 1.0,
                                    rtol=1e-2)
+
+
+class TestRoundRobinEscapeHatch:
+    def test_roundrobin_shards_only_sketch_families(self):
+        """The legacy routing mode keeps the scalar/llhist families
+        single-device (rotation destroys gauge ordering) while the
+        histogram/set families still shard."""
+        from veneur_tpu.core.columnstore import (CounterTable, GaugeTable,
+                                                 LLHistTable)
+        from veneur_tpu.core.sharded_tables import (ShardedHistoTable,
+                                                    ShardedSetTable)
+        store = ColumnStore(histo_capacity=64, set_capacity=64,
+                            batch_cap=32, shard_devices=4,
+                            shard_routing="roundrobin")
+        assert isinstance(store.histos, ShardedHistoTable)
+        assert isinstance(store.sets, ShardedSetTable)
+        assert type(store.counters) is CounterTable
+        assert type(store.gauges) is GaugeTable
+        assert type(store.llhists) is LLHistTable
+        from veneur_tpu.samplers.parser import Parser
+        parser = Parser()
+        for i in range(100):
+            parser.parse_metric_fast(b"rr.t:%d|ms" % i, store.process)
+        store.apply_all_pending()
+        out, _, touched, _ = store.histos.snapshot_and_reset((0.5,))
+        row = int(np.nonzero(touched)[0][0])
+        assert out["count"][row] == pytest.approx(100.0)
 
 
 class TestShardedExport:

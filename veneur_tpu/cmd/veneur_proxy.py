@@ -181,6 +181,9 @@ def build_from_config(raw: dict, args, log):
         health_http_url_template=raw.get("health_http_url_template", ""),
         hedge_after=hedge_after,
         failover_walk=int(raw.get("failover_walk", 2)),
+        # shard-aware ring: key-digest ranges onto shard groups of
+        # global instances (destinations may pin groups with addr#g)
+        shard_groups=int(raw.get("shard_groups") or 0),
         ledger_enabled=bool(raw.get("ledger_enabled", True)),
         ledger_strict=bool(raw.get("ledger_strict", False)),
         trace_self_sample_rate=float(

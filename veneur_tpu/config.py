@@ -87,10 +87,19 @@ class TpuConfig:
     # device); size to the llhist-keyed cardinality, not total keys
     llhist_capacity: int = 1024
     batch_cap: int = 8192
-    # local devices to shard the HBM-heavy families (histograms, HLL
-    # sets) across; ingest round-robins batches, flush merges over ICI
-    # collectives (core.sharded_tables). 0/1 = single-device tables.
+    # local devices to shard the column store across: every family's
+    # interval state partitions over this many devices (digest-home
+    # routing, collective interval merges — core.sharded_tables /
+    # parallel.collectives). 0/1 = single-device tables.
     shards: int = 1
+    # shard routing policy: "digest" (default — a key's 64-bit digest
+    # picks its home shard at mint time; all five families shard and
+    # the merged flush is bit-identical to single-device) or
+    # "roundrobin" (legacy A/B escape hatch — batches rotate across
+    # shards; only the histogram/set families shard, because rotation
+    # destroys the per-key ordering gauges need and the key-range
+    # invariant failover re-homing relies on)
+    shard_routing: str = "digest"
     # force the pure-Python per-packet parser (the C++ batch parser is
     # used whenever it compiles; this is the escape hatch)
     disable_native_parser: bool = False

@@ -288,11 +288,18 @@ class _BaseTable:
                 # since generation 0 and tombstone on its first flush
                 self._last_touched[row] = self._generation
             self.scope_code[row] = int(metric.scope)
+            self._note_minted(row, metric)
             self.rows[dict_key] = row
             self.minted_total += 1
             if card is not None:
                 card.note_mint(self.family, metric.key.name)
         return row
+
+    def _note_minted(self, row: int, metric: UDPMetric) -> None:
+        """Mint hook, fired once per fresh/recycled row assignment under
+        the buffer lock. The sharded tables (core/sharded_tables.py)
+        record the row's digest-derived home shard here; the base table
+        does nothing."""
 
     def _note_applied(self, n: int) -> None:
         """Stamp n samples accepted into this family (flow ledger)."""
@@ -576,12 +583,19 @@ class CounterTable(_BaseTable):
         try:
             if cols is not None:
                 self._apply_cols(cols)
-            dev = (self.state["sum"], self.state["comp"])
-            self.state = scalars.init_counters(self.capacity)
+            dev = self._capture_and_reset()
         finally:
             self.apply_lock.release()
         return {"dev": dev, "import_acc": import_acc,
                 "touched": touched, "meta": meta}
+
+    def _capture_and_reset(self):
+        """Grab the interval's device handles and swap in fresh state
+        (caller holds apply_lock). The sharded table overrides this with
+        the collective shard merge."""
+        dev = (self.state["sum"], self.state["comp"])
+        self.state = scalars.init_counters(self.capacity)
+        return dev
 
     @staticmethod
     def snapshot_finish(snap: dict
@@ -666,11 +680,16 @@ class GaugeTable(_BaseTable):
         try:
             if cols is not None:
                 self._apply_cols(cols)
-            dev = self.state["value"]
-            self.state = scalars.init_gauges(self.capacity)
+            dev = self._capture_and_reset()
         finally:
             self.apply_lock.release()
         return {"dev": dev, "touched": touched, "meta": meta}
+
+    def _capture_and_reset(self):
+        """See CounterTable._capture_and_reset."""
+        dev = self.state["value"]
+        self.state = scalars.init_gauges(self.capacity)
+        return dev
 
     @staticmethod
     def snapshot_finish(snap: dict):
@@ -1422,18 +1441,25 @@ class LLHistTable(_BaseTable):
         try:
             if cols is not None:
                 self._apply_cols(cols)
-            ps = tuple(percentiles)
-            packed = batch_llhist.flush_packed(self.state, ps)
-            rows = np.flatnonzero(touched)
-            bins_dev = None
-            if need_bins and rows.size:
-                bins_dev = jnp.take(self.state,
-                                    jnp.asarray(rows, jnp.int32), axis=0)
-            self.state = batch_llhist.init_state(self.capacity)
+            packed, bins_dev = self._flush_device(
+                tuple(percentiles), need_bins, touched)
         finally:
             self.apply_lock.release()
         return {"packed": packed, "bins_dev": bins_dev,
                 "touched": touched, "meta": meta}
+
+    def _flush_device(self, ps: tuple, need_bins: bool, touched):
+        """Dispatch the readout + bins gather and reset the device state
+        (caller holds apply_lock). The sharded table overrides this with
+        the register-ADD collective merge before the same readout."""
+        packed = batch_llhist.flush_packed(self.state, ps)
+        rows = np.flatnonzero(touched)
+        bins_dev = None
+        if need_bins and rows.size:
+            bins_dev = jnp.take(self.state,
+                                jnp.asarray(rows, jnp.int32), axis=0)
+        self.state = batch_llhist.init_state(self.capacity)
+        return packed, bins_dev
 
     @staticmethod
     def snapshot_finish(snap: dict):
@@ -1502,21 +1528,22 @@ class StatusTable(_BaseTable):
 
 
 class ColumnStore:
-    """All four device families plus host-side status checks.
+    """All five device families plus host-side status checks.
 
-    With shard_devices > 1 the histogram and set families spread their
-    interval state across that many local devices (core.sharded_tables);
-    counters/gauges are (K,) scalars and always stay single-device."""
+    With shard_devices > 1 the store becomes a partitioned mesh
+    (core/sharded_tables.py): every family's interval state spreads
+    across that many local devices, keys routed to a digest-derived
+    home shard and flushes merged with collectives. The legacy
+    `shard_routing="roundrobin"` mode shards only the HBM-heavy
+    histogram/set families (round-robin batches destroy the per-key
+    ordering the scalar families need)."""
 
     def __init__(self, counter_capacity=1024, gauge_capacity=1024,
                  histo_capacity=1024, set_capacity=256, batch_cap=8192,
                  shard_devices=0, max_rows=0, pallas_flush=False,
                  set_promote_samples=0, set_max_dev_slots=0,
-                 llhist_capacity=1024, histogram_encoding="tdigest"):
-        self.counters = CounterTable(counter_capacity, batch_cap,
-                                     max_rows=max_rows)
-        self.gauges = GaugeTable(gauge_capacity, batch_cap,
-                                 max_rows=max_rows)
+                 llhist_capacity=1024, histogram_encoding="tdigest",
+                 shard_routing="digest"):
         # histogram_encoding chooses the family DogStatsD histogram/timer
         # samples aggregate in: "tdigest" (reference parity, approximate
         # merges) or "circllhist" (log-linear bins, exact merges).
@@ -1526,21 +1553,38 @@ class ColumnStore:
             raise ValueError(
                 f"unknown histogram_encoding: {histogram_encoding!r}")
         self.histogram_encoding = histogram_encoding
-        self.llhists = LLHistTable(llhist_capacity, batch_cap,
-                                   max_rows=max_rows)
-        devices = None
+        self.shard_plane = None
         if shard_devices and shard_devices > 1:
-            from veneur_tpu.core import sharded_tables
-            devices = sharded_tables.local_shard_devices(shard_devices)
-            if len(devices) < 2:
-                devices = None
-        if devices is not None:
+            from veneur_tpu.parallel.sharded_server import build_plane
+            self.shard_plane = build_plane(shard_devices, shard_routing)
+        plane = self.shard_plane
+        digest_routed = plane is not None and plane.routing == "digest"
+        if digest_routed:
+            from veneur_tpu.core.sharded_tables import (
+                ShardedCounterTable, ShardedGaugeTable,
+                ShardedLLHistTable)
+            self.counters = ShardedCounterTable(
+                counter_capacity, batch_cap, max_rows=max_rows,
+                plane=plane)
+            self.gauges = ShardedGaugeTable(
+                gauge_capacity, batch_cap, max_rows=max_rows, plane=plane)
+            self.llhists = ShardedLLHistTable(
+                llhist_capacity, batch_cap, max_rows=max_rows,
+                plane=plane)
+        else:
+            self.counters = CounterTable(counter_capacity, batch_cap,
+                                         max_rows=max_rows)
+            self.gauges = GaugeTable(gauge_capacity, batch_cap,
+                                     max_rows=max_rows)
+            self.llhists = LLHistTable(llhist_capacity, batch_cap,
+                                       max_rows=max_rows)
+        if plane is not None:
             from veneur_tpu.core.sharded_tables import (
                 ShardedHistoTable, ShardedSetTable)
             self.histos = ShardedHistoTable(
-                histo_capacity, batch_cap, devices, max_rows=max_rows)
-            self.sets = ShardedSetTable(set_capacity, batch_cap, devices,
-                                        max_rows=max_rows)
+                histo_capacity, batch_cap, max_rows=max_rows, plane=plane)
+            self.sets = ShardedSetTable(set_capacity, batch_cap,
+                                        max_rows=max_rows, plane=plane)
         else:
             self.histos = HistoTable(histo_capacity, batch_cap,
                                      max_rows=max_rows)
@@ -1645,6 +1689,10 @@ class ColumnStore:
                      float(self.llhists.samples_total), ()))
         rows.append(("llhist.clamped_total", "counter",
                      float(self.llhists.clamped_total), ()))
+        # sharded serving plane: mesh topology + per-shard routed volume
+        # (parallel/sharded_server.py), absent on single-device stores
+        if self.shard_plane is not None:
+            rows.extend(self.shard_plane.telemetry_rows())
         return rows
 
     def capacity_report(self) -> dict:

@@ -722,4 +722,10 @@ def flush_columnstore_batch(
         timings["assembly_s"] = t_end - t_sync
         if fam_seg is not None:
             timings["families"] = fam_seg
+        if store.shard_plane is not None:
+            # mesh topology alongside the phase numbers (a dict, so the
+            # per-phase statsd emission loop skips it): the bench's
+            # mesh-scaling scenario and the waterfall view read the
+            # shard width the measured flush actually merged over
+            timings["mesh"] = store.shard_plane.describe()
     return FlushBatch(now, sections, extras), fwd

@@ -179,7 +179,8 @@ class Server:
             set_promote_samples=config.tpu.set_promote_samples,
             set_max_dev_slots=config.tpu.set_max_dev_slots,
             llhist_capacity=config.tpu.llhist_capacity,
-            histogram_encoding=config.histogram_encoding)
+            histogram_encoding=config.histogram_encoding,
+            shard_routing=config.tpu.shard_routing)
         self._keys_dropped_reported = 0
         self.aggregates = HistogramAggregates.from_names(config.aggregates)
         self.percentiles = tuple(config.percentiles)
@@ -894,7 +895,9 @@ class Server:
                 trace_plane=self.trace_plane,
                 wal=cfg.forward_wal, replay_limiter=replay_limiter,
                 replay_stale_after=(cfg.wal_stale_after_intervals
-                                    * self.interval))
+                                    * self.interval),
+                shards=(self.store.shard_plane.n
+                        if self.store.shard_plane is not None else 0))
             self.forwarder = self.forward_client.forward
             self.telemetry.registry.add_collector(
                 self.forward_client.telemetry_rows)
@@ -1016,9 +1019,13 @@ class Server:
         # listener bound, so a wedged startup never wins a handoff
         from veneur_tpu.core import restart
         restart.mark_ready()
-        self.telemetry.record_event(
-            "startup", pid=os.getpid(),
-            mode="local" if self.is_local else "global")
+        startup = {"pid": os.getpid(),
+                   "mode": "local" if self.is_local else "global"}
+        if self.store.shard_plane is not None:
+            # mesh topology in the flight recorder: which devices this
+            # store partitioned over, under which routing policy
+            startup["mesh"] = self.store.shard_plane.describe()
+        self.telemetry.record_event("startup", **startup)
 
     def local_addr(self, scheme: str = "udp"):
         for listener in self._listeners:
@@ -1304,7 +1311,8 @@ class Server:
                 shard_devices=cfg.tpu.shards,
                 pallas_flush=cfg.tpu.pallas_tdigest_flush,
                 llhist_capacity=cfg.tpu.llhist_capacity,
-                histogram_encoding=cfg.histogram_encoding)
+                histogram_encoding=cfg.histogram_encoding,
+                shard_routing=cfg.tpu.shard_routing)
             # collect_forward must match the live flush's value: need_export
             # selects between two distinct JIT specializations (fold_staging
             # is a static arg), and warming the wrong one would leave the

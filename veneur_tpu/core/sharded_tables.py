@@ -1,22 +1,39 @@
-"""Live multi-device sharding for the column store's HBM-heavy families.
+"""Live multi-device sharding: the column store as a partitioned mesh.
 
 The reference scales its hot path by sharding metric keys across worker
 goroutines and re-merging forwarded state on a global instance (reference
 server.go:1016, worker.go:410-467, flusher.go:516-591). On a multi-chip
-host the TPU-native equivalent keeps ONE host intern table but spreads the
-interval state of the two big families across the local devices:
+host the TPU-native equivalent keeps ONE host intern table but
+PARTITIONS the interval state of every device family across the local
+mesh (parallel/collectives.py owns the kernels and the
+`Mesh`/`NamedSharding` layout):
 
-  histograms  (K, C) slot grids      merge = centroid re-insertion
-  sets        (K, 16384) registers   merge = elementwise max
+  counters    (n, K) Kahan pairs      merge = psum (selection)
+  gauges      (n, K) LWW + set mask   merge = home-shard selection
+  histograms  per-shard slot grids    merge = centroid re-insertion
+  sets        per-shard registers     merge = elementwise max
+  llhists     (n, K, BINS) int32      merge = register ADD (bit-exact)
 
-Batches round-robin across per-device states during ingest (pure data
-parallelism — no communication), and the flush-time global merge runs as
-one jitted computation over a stacked array sharded on the device axis, so
-XLA SPMD lowers the merges to ICI collectives (all-reduce-max for HLL,
-all-gather + batched recompress for digests). Counters and gauges stay
-single-device: their state is (K,) scalars — too small to shard — and
-gauges additionally need cross-batch ordering that a round-robin split
-would destroy.
+Routing is **digest-home** by default: a key's 64-bit fnv1a digest picks
+its home shard at mint time (parallel/sharded_server.py), and every
+sample, batch chunk, and import merge for that key lands on that shard.
+That single invariant is what makes the whole plane exact:
+
+  * gauges keep last-write-wins ordering (all of a key's writes serialize
+    on one shard — the reason the round-robin era could not shard them);
+  * counter Kahan pairs and llhist/HLL registers merge by selection
+    (summing n-1 zeros), so flush output is bit-identical to a
+    single-device table over the same stream;
+  * a dead chip's blast radius is exactly its key range — the failover
+    tier (proxy shard groups) re-homes only those keys.
+
+Ingest dispatches keep their compiled shapes: the pending buffer is
+masked per shard (non-home rows -> PAD_ROW, dropped by the scatter
+kernels) instead of split, so kernels never retrace on data-dependent
+sub-batch lengths. `shard_routing: roundrobin` keeps the legacy
+round-robin behavior for the histogram/set families (A/B escape hatch);
+the scalar and llhist families require digest routing and stay
+single-device under round-robin.
 
 Enable with config `tpu.shards: N` (0/1 = single-device tables).
 """
@@ -24,105 +41,295 @@ Enable with config `tpu.shards: N` (0/1 = single-device tables).
 from __future__ import annotations
 
 import logging
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from veneur_tpu.core.columnstore import HistoTable, SetTable, _SetRegisters
-from veneur_tpu.ops import batch_hll, batch_tdigest
+from veneur_tpu.core.columnstore import (CounterTable, GaugeTable,
+                                         HistoTable, LLHistTable, PAD_ROW,
+                                         SetTable, _SetRegisters)
+from veneur_tpu.ops import batch_hll, batch_llhist, batch_tdigest, scalars
+from veneur_tpu.parallel import collectives
+from veneur_tpu.parallel.collectives import SHARD_AXIS
+from veneur_tpu.parallel.sharded_server import (ROUTING_DIGEST,
+                                                ROUTING_ROUNDROBIN,
+                                                ShardedServingPlane,
+                                                local_shard_devices)
 
 logger = logging.getLogger("veneur_tpu.sharded")
 
-SHARD_AXIS = "shard"
+__all__ = [
+    "ShardedCounterTable", "ShardedGaugeTable", "ShardedHistoTable",
+    "ShardedLLHistTable", "ShardedSetTable", "local_shard_devices",
+    "SHARD_AXIS",
+]
 
 
-def local_shard_devices(n: int) -> List:
-    """The n local devices to shard over; falls back to the virtual CPU
-    devices when the default platform is smaller (validation topologies)."""
-    devices = jax.local_devices()
-    if len(devices) < n:
-        try:
-            cpu = jax.devices("cpu")
-            if len(cpu) >= n:
-                logger.warning(
-                    "shard_devices=%d > %d local devices; using the "
-                    "virtual CPU mesh (validation only)", n, len(devices))
-                devices = cpu
-        except RuntimeError:
-            pass
-    if len(devices) < n:
-        logger.warning("shard_devices=%d > %d available; clamping",
-                       n, len(devices))
-        n = len(devices)
-    return list(devices[:n])
+# kept as aliases so pre-mesh callers (tests, notebooks) keep working;
+# the implementations moved to parallel/collectives.py
+_stack_on_mesh = collectives.stack_on_mesh
+_merge_hll_stacked = collectives.merge_hll_stacked
+_merge_histo_stacked = collectives.merge_histo_stacked
 
 
-def _stack_on_mesh(mesh: Mesh, leaves: List[jnp.ndarray]) -> jnp.ndarray:
-    """Assemble per-device arrays (one per mesh device, already resident)
-    into a single (n, ...) jax.Array sharded on the leading axis — no
-    host round-trip, no device copy."""
-    n = len(leaves)
-    shard_shape = (1,) + leaves[0].shape
-    global_shape = (n,) + leaves[0].shape
-    sharding = NamedSharding(mesh, P(SHARD_AXIS))
-    expanded = [leaf[None] for leaf in leaves]  # dispatched on-device
-    return jax.make_array_from_single_device_arrays(
-        global_shape, sharding, [x for x in expanded])
+class _DigestRouted:
+    """Mixin: per-row home-shard assignment + batch masking, shared by
+    every sharded family table. Initialized BEFORE _BaseTable.__init__
+    (whose _init_arrays builds device state on the mesh)."""
+
+    def _routing_init(self, capacity: int, devices: Optional[List],
+                      plane: Optional[ShardedServingPlane]) -> None:
+        if plane is None:
+            plane = ShardedServingPlane(
+                devices or local_shard_devices(2))
+        self._plane = plane
+        self._devices = plane.devices
+        self._mesh = plane.mesh
+        self._n_shards = plane.n
+        self._shard_sharding = collectives.shard_sharding(plane.mesh)
+        # row -> home shard, stamped at mint time (see _note_minted);
+        # int8 bounds the mesh at 128 shards, far past any host
+        self._shard_of = np.zeros(capacity, np.int8)
+        self._rr_next = 0  # roundrobin mode's rotation cursor
+
+    @property
+    def _digest_routed(self) -> bool:
+        return self._plane.routing == ROUTING_DIGEST
+
+    def _note_minted(self, row: int, metric) -> None:
+        if row < self._shard_of.shape[0]:
+            self._shard_of[row] = self._plane.home(metric.digest64)
+
+    def _grow_shard_of(self, new_cap: int) -> None:
+        grown = np.zeros(new_cap, np.int8)
+        grown[: self._shard_of.shape[0]] = self._shard_of
+        self._shard_of = grown
+
+    def _home_of(self, rows: np.ndarray) -> np.ndarray:
+        """(batch,) rows -> home shard per sample, -1 for padding."""
+        cap = self._shard_of.shape[0]
+        safe = np.minimum(rows, cap - 1)
+        return np.where(rows < cap, self._shard_of[safe],
+                        np.int8(-1)).astype(np.int32)
+
+    def _shard_counts_of(self, home: np.ndarray) -> np.ndarray:
+        return np.bincount(home[home >= 0],
+                           minlength=self._n_shards).astype(np.int64)
+
+    def _put_sharded(self, host_arr: np.ndarray):
+        return jax.device_put(host_arr, self._shard_sharding)
+
+    def _stacked_batch(self, rows: np.ndarray, value_cols: Tuple
+                       ) -> Tuple:
+        """Masked (n, batch) row column + tiled value columns for one
+        fixed-shape stacked dispatch, plus the per-shard sample counts
+        for the plane's accounting."""
+        home = self._home_of(np.asarray(rows))
+        srows = collectives.mask_batch_for_shards(
+            home, self._n_shards, np.asarray(rows))
+        tiled = tuple(
+            np.ascontiguousarray(
+                collectives.tile_batch(self._n_shards, np.asarray(c)))
+            for c in value_cols)
+        return (self._put_sharded(srows),
+                tuple(self._put_sharded(t) for t in tiled),
+                self._shard_counts_of(home))
 
 
-@jax.jit
-def _merge_hll_stacked(stacked: jnp.ndarray) -> jnp.ndarray:
-    """(n, K, M) int8 sharded on axis 0 -> (K, M) register max. XLA SPMD
-    lowers the reduction over the sharded axis to an all-reduce-max."""
-    return jnp.max(stacked, axis=0)
+# ---------------------------------------------------------------------------
+# Scalar families: stacked (n, K) state under one NamedSharding, one
+# jitted vmapped scatter per dispatch, collective selection at flush.
+# ---------------------------------------------------------------------------
 
 
-@jax.jit
-def _merge_histo_stacked(stacked: Dict[str, jnp.ndarray]
-                         ) -> Dict[str, jnp.ndarray]:
-    """Per-shard digest states stacked on axis 0 -> one merged state.
-    Mirrors parallel.mesh._merge_digest_keysharded: concatenate every
-    shard's centroids per key and recompress once as a batched kernel
-    (the global veneur's re-insertion, reference worker.go:455-457);
-    scalar stats reduce with sum/min/max."""
-    w = stacked["weights"]                      # (n, K, C)
-    m = jnp.where(w > 0, stacked["wv"] / jnp.maximum(w, 1e-30), 0.0)
-    sw = stacked["sweights"]                    # staged-but-uncompacted
-    sm = jnp.where(sw > 0, stacked["swv"] / jnp.maximum(sw, 1e-30), 0.0)
-    n, num_keys, c = w.shape
-    cat_m = jnp.concatenate([m, sm], axis=-1)   # (n, K, 2C)
-    cat_w = jnp.concatenate([w, sw], axis=-1)
-    cat_m = jnp.moveaxis(cat_m, 0, 1).reshape(num_keys, n * 2 * c)
-    cat_w = jnp.moveaxis(cat_w, 0, 1).reshape(num_keys, n * 2 * c)
-    new_m, new_w = batch_tdigest._recompress(cat_m, cat_w, num_keys)
-    return {
-        "wv": new_m * new_w,
-        "weights": new_w,
-        "swv": jnp.zeros_like(new_w),
-        "sweights": jnp.zeros_like(new_w),
-        "dmin": jnp.min(stacked["dmin"], axis=0),
-        "dmax": jnp.max(stacked["dmax"], axis=0),
-        "drecip": jnp.sum(stacked["drecip"], axis=0),
-        "lmin": jnp.min(stacked["lmin"], axis=0),
-        "lmax": jnp.max(stacked["lmax"], axis=0),
-        "lsum": jnp.sum(stacked["lsum"], axis=0),
-        "lweight": jnp.sum(stacked["lweight"], axis=0),
-        "lrecip": jnp.sum(stacked["lrecip"], axis=0),
-    }
-
-
-class ShardedHistoTable(HistoTable):
-    """HistoTable whose interval state lives round-robin across N local
-    devices; flush merges across the device axis with collectives."""
+class ShardedCounterTable(_DigestRouted, CounterTable):
+    """CounterTable partitioned across the mesh: each key's deltas
+    accumulate in its home shard's Kahan pair; flush merges by psum
+    (pure selection under digest routing, so the f64 host readout is
+    bit-identical to single-device)."""
 
     def __init__(self, capacity: int = 1024, batch_cap: int = 8192,
-                 devices: List = None, max_rows: int = 0):
-        self._devices = devices or local_shard_devices(2)
-        self._mesh = Mesh(np.asarray(self._devices), (SHARD_AXIS,))
-        self._next = 0
+                 devices: Optional[List] = None, max_rows: int = 0,
+                 plane: Optional[ShardedServingPlane] = None):
+        self._routing_init(capacity, devices, plane)
+        super().__init__(capacity, batch_cap, max_rows=max_rows)
+
+    def _init_arrays(self):
+        super()._init_arrays()
+        self.state = collectives.init_stacked(
+            self._mesh, scalars.init_counters, self.capacity)
+
+    def _grow_arrays(self, new_cap):
+        self._grow_shard_of(new_cap)
+        self.state = collectives.grow_stacked(self._mesh, self.state,
+                                              new_cap)
+
+    def _apply_cols(self, cols):
+        rows, vals, rates = cols
+        srows, (svals, srates), counts = self._stacked_batch(
+            rows, (vals, rates))
+        self.state = collectives.apply_counters_sharded(
+            self.state, srows, svals, srates)
+        self._plane.note_routed(self.family, counts)
+
+    def _capture_and_reset(self):
+        dev = collectives.merge_counters_stacked(self.state)
+        self._plane.note_merge_round()
+        self.state = collectives.init_stacked(
+            self._mesh, scalars.init_counters, self.capacity)
+        return dev
+
+
+class ShardedGaugeTable(_DigestRouted, GaugeTable):
+    """GaugeTable partitioned across the mesh. Digest-home routing is
+    load-bearing here: every write for a key serializes on its home
+    shard, so last-write-wins ordering survives sharding (the property
+    the round-robin split destroyed, which is why gauges stayed
+    single-device until this plane)."""
+
+    def __init__(self, capacity: int = 1024, batch_cap: int = 8192,
+                 devices: Optional[List] = None, max_rows: int = 0,
+                 plane: Optional[ShardedServingPlane] = None):
+        self._routing_init(capacity, devices, plane)
+        super().__init__(capacity, batch_cap, max_rows=max_rows)
+
+    def _init_arrays(self):
+        super()._init_arrays()
+        self.state = collectives.init_stacked(
+            self._mesh, scalars.init_gauges, self.capacity)
+
+    def _grow_arrays(self, new_cap):
+        self._grow_shard_of(new_cap)
+        self.state = collectives.grow_stacked(self._mesh, self.state,
+                                              new_cap)
+
+    def _apply_cols(self, cols):
+        rows, vals = cols
+        srows, (svals,), counts = self._stacked_batch(rows, (vals,))
+        self.state = collectives.apply_gauges_sharded(
+            self.state, srows, svals)
+        self._plane.note_routed(self.family, counts)
+
+    def merge_batch(self, stubs, values) -> None:
+        """Import-path overwrite, routed to each row's home shard (the
+        same masked-batch shape as ingest, so ordering semantics
+        match)."""
+        with self.lock:
+            rows = np.fromiter(
+                (self.row_for(s) for s in stubs), np.int32, len(stubs))
+            ok = rows >= 0  # cardinality-capped stubs drop out
+            rows = rows[ok]
+            self.touched[rows] = True
+            self._note_applied(int(rows.size))
+            self.apply_lock.acquire()
+        try:
+            if rows.size:
+                srows, (svals,), _counts = self._stacked_batch(
+                    rows, (np.asarray(values, np.float32)[ok],))
+                self.state = collectives.merge_gauges_sharded(
+                    self.state, srows, svals)
+        finally:
+            self.apply_lock.release()
+
+    def _capture_and_reset(self):
+        dev, _set = collectives.merge_gauges_stacked(self.state)
+        self._plane.note_merge_round()
+        self.state = collectives.init_stacked(
+            self._mesh, scalars.init_gauges, self.capacity)
+        return dev
+
+
+class ShardedLLHistTable(_DigestRouted, LLHistTable):
+    """LLHistTable partitioned across the mesh: a (n, K, BINS_PAD) int32
+    register bank sharded on the leading axis; ingest scatter-adds into
+    each key's home shard, flush merges with one register-ADD reduction.
+    Integer addition is associative and commutative, so the merged
+    registers — and therefore every percentile, count, sum, and bucket
+    the flusher emits, and every forwarded bin payload — are
+    BIT-IDENTICAL to a single-device table (the PR-5 exactness pin,
+    generalized to the mesh)."""
+
+    def __init__(self, capacity: int = 1024, batch_cap: int = 8192,
+                 devices: Optional[List] = None, max_rows: int = 0,
+                 plane: Optional[ShardedServingPlane] = None):
+        self._routing_init(capacity, devices, plane)
+        super().__init__(capacity, batch_cap, max_rows=max_rows)
+
+    def _init_arrays(self):
+        super()._init_arrays()
+        self.state = collectives.init_stacked(
+            self._mesh, batch_llhist.init_state, self.capacity)
+
+    def _grow_arrays(self, new_cap):
+        self._grow_shard_of(new_cap)
+        self.state = collectives.grow_stacked(self._mesh, self.state,
+                                              new_cap)
+
+    def _apply_cols(self, cols):
+        rows, bins, wts = cols
+        srows, (sbins, swts), counts = self._stacked_batch(
+            rows, (bins, wts))
+        self.state = collectives.apply_llhist_sharded(
+            self.state, srows, sbins, swts)
+        self._plane.note_routed(self.family, counts)
+
+    def merge_batch(self, stubs, in_bins) -> None:
+        """Import-path register ADD, each incoming row landed on its
+        home shard (exact under any routing — addition commutes — but
+        home routing keeps the shard-is-the-key-range invariant that
+        failover re-homing relies on)."""
+        with self.lock:
+            rows = np.fromiter(
+                (self.row_for(s) for s in stubs), np.int32, len(stubs))
+            ok = rows >= 0  # cardinality-capped stubs drop out
+            rows = rows[ok]
+            self.touched[rows] = True
+            self._note_applied(int(rows.size))
+            padded = batch_llhist.pad_rows_to_device(
+                np.asarray(in_bins)[ok])
+            self.samples_total += int(padded.sum())
+            home = self._home_of(rows)
+            self.apply_lock.acquire()
+        try:
+            if rows.size:
+                self.state = collectives.merge_llhist_rows_at(
+                    self.state, jnp.asarray(home), jnp.asarray(rows),
+                    jnp.asarray(padded))
+        finally:
+            self.apply_lock.release()
+
+    def _flush_device(self, ps: tuple, need_bins: bool, touched):
+        merged = collectives.merge_llhist_stacked(self.state)
+        self._plane.note_merge_round()
+        packed = batch_llhist.flush_packed(merged, ps)
+        rows = np.flatnonzero(touched)
+        bins_dev = None
+        if need_bins and rows.size:
+            bins_dev = jnp.take(merged, jnp.asarray(rows, jnp.int32),
+                                axis=0)
+        self.state = collectives.init_stacked(
+            self._mesh, batch_llhist.init_state, self.capacity)
+        return packed, bins_dev
+
+
+# ---------------------------------------------------------------------------
+# Sketch families with per-shard grids (histograms, sets): per-device
+# states, digest-home masked dispatch, stacked collective flush merge.
+# ---------------------------------------------------------------------------
+
+
+class ShardedHistoTable(_DigestRouted, HistoTable):
+    """HistoTable whose interval state lives across N local devices;
+    ingest routes each key's samples to its home shard (digest mode) or
+    round-robins whole batches (legacy mode); flush merges across the
+    device axis with collectives."""
+
+    def __init__(self, capacity: int = 1024, batch_cap: int = 8192,
+                 devices: Optional[List] = None, max_rows: int = 0,
+                 plane: Optional[ShardedServingPlane] = None):
+        self._routing_init(capacity, devices, plane)
         super().__init__(capacity, batch_cap, max_rows=max_rows)
 
     def _init_arrays(self):
@@ -135,6 +342,7 @@ class ShardedHistoTable(HistoTable):
         self.state = None  # unused; all device state lives in .states
 
     def _grow_arrays(self, new_cap):
+        self._grow_shard_of(new_cap)
         grown = []
         for dev, st in zip(self._devices, self.states):
             new = batch_tdigest.init_state(new_cap)
@@ -149,26 +357,46 @@ class ShardedHistoTable(HistoTable):
             extended.append(e)
         self._shard_counts = extended
 
-    def _apply_cols(self, cols):
-        i = self._next
-        self._next = (i + 1) % len(self._devices)
+    def _apply_to_shard(self, i: int, rows, vals, wts) -> None:
+        """One shard's masked fixed-shape batch apply (caller holds
+        apply_lock); handles the per-shard staging compact."""
         dev = self._devices[i]
         slots, overflow = batch_tdigest.host_slots(
-            cols[0], cols[1], cols[2], self._shard_counts[i])
+            rows, vals, wts, self._shard_counts[i])
         if overflow:
             self.states[i] = batch_tdigest.compact(self.states[i])
             self._shard_counts[i][:] = 0
             slots, _ = batch_tdigest.host_slots(
-                cols[0], cols[1], cols[2], self._shard_counts[i])
-        rows, vals, wts = (jax.device_put(c, dev) for c in cols)
+                rows, vals, wts, self._shard_counts[i])
         self.states[i] = batch_tdigest.apply_batch(
-            self.states[i], rows, vals, wts, jax.device_put(slots, dev))
+            self.states[i], jax.device_put(rows, dev),
+            jax.device_put(vals, dev), jax.device_put(wts, dev),
+            jax.device_put(slots, dev))
+
+    def _apply_cols(self, cols):
+        rows, vals, wts = cols
+        if not self._digest_routed:
+            # legacy round-robin: whole batch to the next shard
+            i = self._rr_next
+            self._rr_next = (i + 1) % self._n_shards
+            self._apply_to_shard(i, rows, vals, wts)
+            self._applies += 1
+            return
+        home = self._home_of(rows)
+        counts = self._shard_counts_of(home)
+        for i in np.flatnonzero(counts).tolist():
+            # masked, not split: the kernels' compiled (batch_cap,)
+            # shape is preserved; non-home rows scatter-drop
+            rows_i = np.where(home == i, rows, PAD_ROW)
+            self._apply_to_shard(i, rows_i, vals, wts)
         self._applies += 1
+        self._plane.note_routed(self.family, counts)
 
     def merge_batch(self, stubs, in_means, in_weights, in_min, in_max,
                     in_recip) -> None:
-        """Import-path digest merge lands on one shard (digest merge is
-        commutative across shards)."""
+        """Import-path digest merge, routed per home shard (digest mode;
+        digest merge is commutative across shards, so the legacy mode's
+        single-shard landing stays correct too)."""
         with self.lock:
             rows = np.fromiter(
                 (self.row_for(s) for s in stubs), np.int32, len(stubs))
@@ -178,27 +406,35 @@ class ShardedHistoTable(HistoTable):
             rows = rows[ok]
             self.touched[rows] = True
             self._note_applied(int(rows.size))
+            home = (self._home_of(rows) if self._digest_routed
+                    else np.full(rows.shape, self._rr_next, np.int32))
+            if not self._digest_routed:
+                self._rr_next = (self._rr_next + 1) % self._n_shards
             self.apply_lock.acquire()
         try:
-            i = self._next
-            self._next = (i + 1) % len(self._devices)
-            dev = self._devices[i]
-            put = lambda a, t: jax.device_put(np.asarray(a, t)[ok], dev)
-            self.states[i] = batch_tdigest.merge_centroid_rows(
-                self.states[i], jax.device_put(rows, dev),
-                put(in_means, np.float32), put(in_weights, np.float32),
-                put(in_min, np.float32), put(in_max, np.float32),
-                put(in_recip, np.float32))
-            # merge_centroid_rows folds every staged row on this shard
-            self._shard_counts[i][:] = 0
+            sel_arrs = tuple(np.asarray(a, np.float32)[ok]
+                             for a in (in_means, in_weights, in_min,
+                                       in_max, in_recip))
+            for i in np.unique(home[home >= 0]).tolist():
+                sel = home == i
+                dev = self._devices[i]
+                put = lambda a: jax.device_put(a, dev)  # noqa: E731
+                self.states[i] = batch_tdigest.merge_centroid_rows(
+                    self.states[i], put(rows[sel]),
+                    *(put(a[sel]) for a in sel_arrs))
+                # merge_centroid_rows folds every staged row on this
+                # shard
+                self._shard_counts[i][:] = 0
         finally:
             self.apply_lock.release()
 
     def _merged_state(self) -> Dict[str, jnp.ndarray]:
         stacked = {
-            k: _stack_on_mesh(self._mesh, [st[k] for st in self.states])
+            k: collectives.stack_on_mesh(
+                self._mesh, [st[k] for st in self.states])
             for k in self.states[0]}
-        return _merge_histo_stacked(stacked)
+        self._plane.note_merge_round()
+        return collectives.merge_histo_stacked(stacked)
 
     def snapshot_and_reset(self, percentiles: Tuple[float, ...],
                            need_export: bool = True):
@@ -241,15 +477,16 @@ class ShardedHistoTable(HistoTable):
                 "ps": ps, "touched": touched, "meta": meta}
 
 
-class ShardedSetTable(SetTable):
-    """SetTable whose HLL register banks live round-robin across N local
-    devices; flush merges registers with an all-reduce max."""
+class ShardedSetTable(_DigestRouted, SetTable):
+    """SetTable whose HLL register banks live across N local devices;
+    ingest routes each key's stream to its home shard, flush merges
+    registers with an all-reduce max (exact under any routing — max
+    commutes — with digest routing keeping the key-range invariant)."""
 
     def __init__(self, capacity: int = 256, batch_cap: int = 8192,
-                 devices: List = None, max_rows: int = 0):
-        self._devices = devices or local_shard_devices(2)
-        self._mesh = Mesh(np.asarray(self._devices), (SHARD_AXIS,))
-        self._next = 0
+                 devices: Optional[List] = None, max_rows: int = 0,
+                 plane: Optional[ShardedServingPlane] = None):
+        self._routing_init(capacity, devices, plane)
         # dense path: sharding already spreads register memory across
         # devices, and the collective merge needs uniform dense rows
         super().__init__(capacity, batch_cap, sparse=False,
@@ -263,18 +500,31 @@ class ShardedSetTable(SetTable):
         self.state = None
 
     def _grow_arrays(self, new_cap):
+        self._grow_shard_of(new_cap)
         self.states = [
             jax.device_put(
                 jnp.pad(st, [(0, new_cap - st.shape[0]), (0, 0)]), dev)
             for dev, st in zip(self._devices, self.states)]
 
     def _apply_cols(self, cols):
-        i = self._next
-        self._next = (i + 1) % len(self._devices)
-        dev = self._devices[i]
-        rows, idxs, rhos = (jax.device_put(c, dev) for c in cols)
-        self.states[i] = batch_hll.apply_batch(
-            self.states[i], rows, idxs, rhos)
+        rows, idxs, rhos = cols
+        if not self._digest_routed:
+            i = self._rr_next
+            self._rr_next = (i + 1) % self._n_shards
+            dev = self._devices[i]
+            r, ix, rh = (jax.device_put(c, dev) for c in cols)
+            self.states[i] = batch_hll.apply_batch(self.states[i], r, ix,
+                                                   rh)
+            return
+        home = self._home_of(rows)
+        counts = self._shard_counts_of(home)
+        for i in np.flatnonzero(counts).tolist():
+            dev = self._devices[i]
+            rows_i = np.where(home == i, rows, PAD_ROW)
+            self.states[i] = batch_hll.apply_batch(
+                self.states[i], jax.device_put(rows_i, dev),
+                jax.device_put(idxs, dev), jax.device_put(rhos, dev))
+        self._plane.note_routed(self.family, counts)
 
     def merge_batch(self, stubs, in_regs) -> None:
         with self.lock:
@@ -286,20 +536,26 @@ class ShardedSetTable(SetTable):
             rows = rows[ok]
             self.touched[rows] = True
             self._note_applied(int(rows.size))
+            home = (self._home_of(rows) if self._digest_routed
+                    else np.full(rows.shape, self._rr_next, np.int32))
+            if not self._digest_routed:
+                self._rr_next = (self._rr_next + 1) % self._n_shards
             self.apply_lock.acquire()
         try:
-            i = self._next
-            self._next = (i + 1) % len(self._devices)
-            dev = self._devices[i]
-            self.states[i] = batch_hll.merge_rows(
-                self.states[i], jax.device_put(rows, dev),
-                jax.device_put(np.asarray(in_regs, np.int8)[ok], dev))
+            regs_sel = np.asarray(in_regs, np.int8)[ok]
+            for i in np.unique(home[home >= 0]).tolist():
+                sel = home == i
+                dev = self._devices[i]
+                self.states[i] = batch_hll.merge_rows(
+                    self.states[i], jax.device_put(rows[sel], dev),
+                    jax.device_put(regs_sel[sel], dev))
         finally:
             self.apply_lock.release()
 
     def _merged_state(self) -> jnp.ndarray:
-        stacked = _stack_on_mesh(self._mesh, self.states)
-        return _merge_hll_stacked(stacked)
+        stacked = collectives.stack_on_mesh(self._mesh, self.states)
+        self._plane.note_merge_round()
+        return collectives.merge_hll_stacked(stacked)
 
     def snapshot_and_reset(self):
         with self.lock:

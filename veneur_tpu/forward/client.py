@@ -29,8 +29,8 @@ from veneur_tpu.forward.convert import forwardable_to_wire
 from veneur_tpu.forward.wire import (_frame_v1, _serialize_metric,
                                      combine_metadata, decode_flow_counts,
                                      interval_metadata, send_batch,
-                                     stamp_interval_wire, token_metadata,
-                                     trace_metadata)
+                                     shards_metadata, stamp_interval_wire,
+                                     token_metadata, trace_metadata)
 from veneur_tpu.util import chaos as chaos_mod
 from veneur_tpu.util.chaos import ChaosError
 from veneur_tpu.util.grpctls import GrpcTLS, secure_or_insecure_channel
@@ -66,9 +66,13 @@ class ForwardClient:
                  spool: Optional[CarryoverSpool] = None,
                  ledger=None, trace_plane=None,
                  wal: bool = False, replay_limiter=None,
-                 replay_stale_after: float = 0.0):
+                 replay_stale_after: float = 0.0,
+                 shards: int = 0):
         self.address = address
         self.deadline = deadline
+        # the owning server's mesh width, stamped as x-veneur-shards on
+        # every attempt so the receiving tier can export it
+        self.shards = max(0, int(shards))
         # resilience: callers that want fail-and-forget (veneur-emit's
         # one-shot send) pass retry/carryover explicitly disabled via
         # RetryPolicy(max_attempts=1) / Carryover(0); the server wires
@@ -176,6 +180,9 @@ class ForwardClient:
         clients) and the interval's exemplar blob."""
         from veneur_tpu.trace import context as trace_ctx
         parts = []
+        shard_md = shards_metadata(self.shards)
+        if shard_md:
+            parts.append(shard_md)
         parent = trace_ctx.current_span()
         if parent is not None:
             parts.append(trace_metadata(parent.trace_id, parent.id))

@@ -12,6 +12,7 @@ calls rather than one per metric.
 from __future__ import annotations
 
 import logging
+import time
 from concurrent import futures
 from typing import List, Optional
 
@@ -83,10 +84,40 @@ class ImportServer:
         # shared implementation also runs in the proxy's handlers.
         from veneur_tpu.forward.wire import TokenDeduper
         self._deduper = TokenDeduper()
+        # widest sender mesh seen (x-veneur-shards), as a rolling
+        # two-window max so the gauge DECAYS: a local that falls back
+        # to single-device tables keeps sending (without the header),
+        # its notes roll the window, and mesh.peer_shards drops to 0
+        # within ~2 windows — the detection the degraded-mesh runbook
+        # instructs operators to alert on. A lifetime max could never
+        # fire it.
+        self.PEER_SHARDS_WINDOW_S = 60.0
+        self._peer_shards_cur = 0
+        self._peer_shards_prev = 0
+        self._peer_shards_t0 = time.monotonic()
 
     @property
     def duplicates_dropped_total(self) -> int:
         return self._deduper.duplicates_dropped_total
+
+    @property
+    def peer_shards(self) -> int:
+        return max(self._peer_shards_cur, self._peer_shards_prev)
+
+    def _note_peer_shards(self, ctx) -> None:
+        from veneur_tpu.forward.wire import extract_shards
+        n = extract_shards(ctx)
+        now = time.monotonic()
+        elapsed = now - self._peer_shards_t0
+        if elapsed >= self.PEER_SHARDS_WINDOW_S:
+            # roll; a gap longer than two windows clears both slots
+            self._peer_shards_prev = (
+                self._peer_shards_cur
+                if elapsed < 2 * self.PEER_SHARDS_WINDOW_S else 0)
+            self._peer_shards_cur = 0
+            self._peer_shards_t0 = now
+        if n > self._peer_shards_cur:
+            self._peer_shards_cur = n
 
     def _token_begin(self, ctx):
         token, disposition = self._deduper.begin(ctx)
@@ -146,7 +177,9 @@ class ImportServer:
     def telemetry_rows(self) -> List[tuple]:
         """Scrape-time rows for the owning server's /metrics registry."""
         return [("forward.hedge.duplicates_dropped", "counter",
-                 float(self.duplicates_dropped_total), ())]
+                 float(self.duplicates_dropped_total), ()),
+                ("mesh.peer_shards", "gauge",
+                 float(self.peer_shards), ())]
 
     # -- timestamp-faithful backfill --------------------------------------
 
@@ -228,6 +261,7 @@ class ImportServer:
             # refused forever
             tspan = self._trace_begin(ctx)
             self._note_arrival()
+            self._note_peer_shards(ctx)
             stale_iv = self._stale_interval(ctx)
             if stale_iv:
                 # historical interval (WAL replay / restored spool):
@@ -430,6 +464,7 @@ class ImportServer:
             # begin and this try, or a failure wedges the token
             tspan = self._trace_begin(ctx)
             self._note_arrival()
+            self._note_peer_shards(ctx)
             stale_iv = self._stale_interval(ctx)
             if stale_iv:
                 count, merged = self._merge_backfill(

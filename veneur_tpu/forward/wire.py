@@ -176,6 +176,34 @@ def stamp_interval_wire(metric_bytes: bytes, interval_unix: float) -> bytes:
     return bytes(out)
 
 
+# gRPC metadata key carrying the sender's mesh width (local device
+# shards): informational, but it lets a receiving tier export how wide
+# the meshes feeding it are (mesh.peer_shards) and an operator spot a
+# local that silently fell back to single-device tables after a chip
+# loss. Absent from un-upgraded peers; extraction degrades to 0.
+SHARDS_KEY = "x-veneur-shards"
+
+
+def shards_metadata(n_shards: int):
+    """Metadata tuple carrying the sender's shard count; None when the
+    sender is unsharded (the common single-device topology)."""
+    if not n_shards or n_shards <= 1:
+        return None
+    return ((SHARDS_KEY, str(int(n_shards))),)
+
+
+def extract_shards(ctx) -> int:
+    """Sender mesh width from a gRPC ServicerContext's invocation
+    metadata; 0 when absent or undecodable."""
+    value = metadata_value(ctx, SHARDS_KEY)
+    if not value:
+        return 0
+    try:
+        return max(0, int(value))
+    except (TypeError, ValueError):
+        return 0
+
+
 # gRPC metadata key carrying the sender's trace lineage: every forward
 # RPC (client sends, proxy re-sends, hedges, spool drains, and the
 # V1->V2 fallback of any of them) rides `<trace_id>:<span_id>` in

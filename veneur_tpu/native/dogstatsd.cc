@@ -278,7 +278,7 @@ inline bool parse_line(const Engine* e, const uint8_t* line, size_t len,
   keybuf.append(reinterpret_cast<const char*>(line), value_start);
   keybuf.append(reinterpret_cast<const char*>(line + type_start),
                 len - type_start);
-  auto it = e->table.find(std::string_view(keybuf));
+  auto it = e->table.find(keybuf);
   if (it == e->table.end()) return false;
   const Entry& ent = it->second;
 
@@ -1293,7 +1293,7 @@ int64_t vnt_ssf_parse(void* ep, const uint8_t* buf, const int64_t* offs,
       }
       ssf_key(keybuf, sv.name, kFamilyChar[sv.metric], sv.sample_rate,
               sv.tags, sv.scope);
-      auto it = e->table.find(std::string_view(keybuf));
+      auto it = e->table.find(keybuf);
       if (it == e->table.end()) {
         defer(static_cast<int32_t>(i),
               reinterpret_cast<const uint8_t*>(raw.data()),
@@ -1374,7 +1374,7 @@ int64_t vnt_ssf_parse(void* ep, const uint8_t* buf, const int64_t* offs,
         utags.push_back({"service", sp.service});
         ssf_key(keybuf, "ssf.names_unique", 's',
                 static_cast<float>(uniq_rate), utags, 0);
-        auto uit = e->table.find(std::string_view(keybuf));
+        auto uit = e->table.find(keybuf);
         if (uit != e->table.end() && all_ascii(sp.name) &&
             o.s_n < o.s_cap) {
           int32_t idx, rho;

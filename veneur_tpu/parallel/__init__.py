@@ -1,0 +1,14 @@
+"""Multi-device serving plane: mesh topology, collective interval
+merges, and the dryrun shard_map path.
+
+- `parallel.collectives` — jitted merge kernels + `Mesh`/`NamedSharding`
+  plumbing the live sharded tables run on
+- `parallel.sharded_server` — the ShardedServingPlane (topology,
+  digest-home routing, `mesh.*`/`shard.*` telemetry)
+- `parallel.mesh` — the shard_map dryrun/validation path
+
+Submodules import jax lazily enough that the proxy tier (which never
+aggregates) still avoids the TPU stack: only importing
+`parallel.collectives`/`parallel.mesh` pulls jax in, so this package
+__init__ stays import-light.
+"""

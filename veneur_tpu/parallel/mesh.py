@@ -33,6 +33,15 @@ from veneur_tpu.ops import batch_hll, batch_tdigest, scalars
 
 logger = logging.getLogger("veneur_tpu.parallel.mesh")
 
+# shard_map moved to the jax top level (and renamed its replication-
+# check kwarg check_rep -> check_vma) after 0.4.x; accept both so the
+# collective path runs on every toolchain the image ships
+if hasattr(jax, "shard_map"):
+    _shard_map, _CHECK_KW = jax.shard_map, "check_vma"
+else:  # jax 0.4.x
+    from jax.experimental.shard_map import shard_map as _shard_map
+    _CHECK_KW = "check_rep"
+
 SHARD_AXIS = "shard"
 
 
@@ -154,7 +163,10 @@ def _merge_shards_local(state):
 
     sets = jax.lax.pmax(state["sets"].astype(jnp.int32), SHARD_AXIS).astype(
         jnp.int8)
-    n = jax.lax.axis_size(SHARD_AXIS)
+    # lax.axis_size only exists on newer jax; psum(1) is the portable
+    # spelling of the same constant
+    n = (jax.lax.axis_size(SHARD_AXIS) if hasattr(jax.lax, "axis_size")
+         else jax.lax.psum(1, SHARD_AXIS))
     histos = _merge_digest_keysharded(state["histos"], n)
     return {
         "counters": counters,
@@ -172,11 +184,12 @@ def merge_shards(mesh: Mesh, state: Dict) -> Dict:
     out_specs = jax.tree.map(lambda _: P(), {
         "counters": 0, "gauges": {"value": 0, "set": 0}, "sets": 0,
         "histos": {k: 0 for k in batch_tdigest.init_state(1)}})
-    # check_vma off: outputs are replicated by construction (derived from
-    # all_gather/psum results) but the tracker can't prove it through sort
-    fn = jax.shard_map(
+    # replication check off: outputs are replicated by construction
+    # (derived from all_gather/psum results) but the tracker can't prove
+    # it through sort
+    fn = _shard_map(
         _merge_shards_local, mesh=mesh, in_specs=(spec_in,),
-        out_specs=out_specs, check_vma=False)
+        out_specs=out_specs, **{_CHECK_KW: False})
     return fn(state)
 
 

@@ -1,0 +1,134 @@
+"""The sharded serving plane: topology + accounting for the partitioned
+column store.
+
+One instance per server owns the device mesh the sharded tables
+(core/sharded_tables.py) run on and the digest-home routing function
+every family shares: a metric key's 64-bit fnv1a digest picks its home
+shard once, at mint time, and every sample / import merge for that key
+lands on that shard's slice of the partitioned state. The flush-time
+merge is then a collective *selection* (parallel/collectives.py), which
+is what keeps the llhist/HLL registers bit-identical to a single-device
+table — the PR-5 exactness pin generalized to the mesh.
+
+The plane is also the mesh's self-telemetry root: `mesh.*` rows
+describe the topology, `shard.*` rows the per-shard routing volume, so
+an operator can see a skewed key space (one hot shard) or a dead chip
+(a shard's routed-sample counter flatlining) straight off /metrics.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from veneur_tpu.parallel import collectives
+
+logger = logging.getLogger("veneur_tpu.parallel.sharded_server")
+
+ROUTING_DIGEST = "digest"
+ROUTING_ROUNDROBIN = "roundrobin"
+
+
+def local_shard_devices(n: int) -> List:
+    """The n local devices to shard over; falls back to the virtual CPU
+    devices when the default platform is smaller (validation
+    topologies)."""
+    import jax
+
+    devices = jax.local_devices()
+    if len(devices) < n:
+        try:
+            cpu = jax.devices("cpu")
+            if len(cpu) >= n:
+                logger.warning(
+                    "shard_devices=%d > %d local devices; using the "
+                    "virtual CPU mesh (validation only)", n, len(devices))
+                devices = cpu
+        except RuntimeError:
+            pass
+    if len(devices) < n:
+        logger.warning("shard_devices=%d > %d available; clamping",
+                       n, len(devices))
+        n = len(devices)
+    return list(devices[:n])
+
+
+class ShardedServingPlane:
+    """Mesh topology + per-shard routing accounting, shared by every
+    sharded family table of one column store."""
+
+    def __init__(self, devices: List, routing: str = ROUTING_DIGEST):
+        if routing not in (ROUTING_DIGEST, ROUTING_ROUNDROBIN):
+            raise ValueError(f"unknown shard routing {routing!r}")
+        self.devices = list(devices)
+        self.n = len(self.devices)
+        self.routing = routing
+        self.mesh = collectives.local_mesh(self.devices)
+        # per-shard routed-sample counters, written under each table's
+        # buffer lock (GIL-atomic int adds; a torn scrape is one row
+        # stale, never corrupt), keyed by family
+        self._samples: Dict[str, np.ndarray] = {}
+        self.batches_dispatched = 0
+        self.merge_rounds = 0
+
+    # -- routing ---------------------------------------------------------
+
+    def home(self, digest64: int) -> int:
+        """One key's home shard (digest routing)."""
+        return int(np.uint64(digest64) % np.uint64(self.n))
+
+    def homes(self, digest64_arr) -> np.ndarray:
+        return collectives.home_shards(digest64_arr, self.n)
+
+    # -- accounting ------------------------------------------------------
+
+    def note_routed(self, family: str, per_shard_counts) -> None:
+        """Fold one dispatch's per-shard sample counts (len n array)."""
+        acc = self._samples.get(family)
+        if acc is None:
+            acc = self._samples[family] = np.zeros(self.n, np.int64)
+        acc += np.asarray(per_shard_counts, np.int64)
+        self.batches_dispatched += 1
+
+    def note_merge_round(self) -> None:
+        self.merge_rounds += 1
+
+    # -- surfaces --------------------------------------------------------
+
+    def describe(self) -> dict:
+        """Topology summary for the startup flight-recorder event and
+        /debug surfaces."""
+        return {
+            "shards": self.n,
+            "routing": self.routing,
+            "devices": [f"{d.platform}:{d.id}" for d in self.devices],
+        }
+
+    def telemetry_rows(self) -> List[tuple]:
+        rows: List[tuple] = [
+            ("mesh.shards", "gauge", float(self.n), ()),
+            ("mesh.merge_rounds", "counter", float(self.merge_rounds), ()),
+            ("mesh.batches_dispatched", "counter",
+             float(self.batches_dispatched), ()),
+        ]
+        for family, acc in list(self._samples.items()):
+            for shard, count in enumerate(acc.tolist()):
+                rows.append(("shard.samples_routed", "counter",
+                             float(count),
+                             [f"family:{family}", f"shard:{shard}"]))
+        return rows
+
+
+def build_plane(shards: int, routing: str = ROUTING_DIGEST
+                ) -> Optional[ShardedServingPlane]:
+    """Plane for `shards` local devices; None when the topology can't
+    shard (fewer than 2 devices) so callers fall back to single-device
+    tables."""
+    if not shards or shards <= 1:
+        return None
+    devices = local_shard_devices(shards)
+    if len(devices) < 2:
+        return None
+    return ShardedServingPlane(devices, routing=routing)

@@ -23,10 +23,11 @@ import grpc
 from veneur_tpu.forward.protos import metric_pb2
 from veneur_tpu.forward.wire import (_frame_v1, _serialize_metric,
                                      combine_metadata, decode_flow_counts,
-                                     send_batch, token_metadata,
-                                     trace_metadata)
+                                     interval_metadata, send_batch,
+                                     token_metadata, trace_metadata)
 from veneur_tpu.ops import hll_ref
-from veneur_tpu.proxy.ring import ConsistentRing, EmptyRingError
+from veneur_tpu.proxy.ring import (ConsistentRing, EmptyRingError,
+                                   ShardGroupRing, parse_shard_suffix)
 from veneur_tpu.util import chaos as chaos_mod
 from veneur_tpu.util.chaos import ChaosError
 from veneur_tpu.util.grpctls import GrpcTLS, secure_or_insecure_channel
@@ -152,10 +153,17 @@ class Destination:
         routing path would cost more than the estimate is worth."""
         self.key_hll.insert_hash(key_hash)
 
-    def send(self, metric: metric_pb2.Metric) -> bool:
+    def send(self, metric: metric_pb2.Metric,
+             interval: float = 0.0) -> bool:
         """Non-blocking enqueue first; fall back to a short blocking wait;
         drop if the destination is closed or still saturated (reference
         handlers.go:100-164 semantics).
+
+        `interval` is the sender's ``x-veneur-interval`` stamp (0.0 =
+        live traffic): it rides the queue with the metric and is
+        re-attached as metadata on the outgoing batch, so a WAL replay
+        routed THROUGH the proxy still backfills into its original
+        interval on the global instead of folding into the live flush.
 
         The blocking fallback intentionally applies backpressure to the
         caller's stream — matching the reference, where a saturated
@@ -179,8 +187,9 @@ class Destination:
                 self.dropped_total += 1
                 self.shed_open_total += 1
             return False
+        entry = (metric, float(interval))
         try:
-            self._queue.put_nowait(metric)
+            self._queue.put_nowait(entry)
             with self._counter_lock:
                 self.enqueued_total += 1
             return True
@@ -195,7 +204,7 @@ class Destination:
                 self.shed_open_total += 1
             return False
         try:
-            self._queue.put(metric, timeout=self._flush_interval)
+            self._queue.put(entry, timeout=self._flush_interval)
             with self._counter_lock:
                 self.enqueued_total += 1
             return True
@@ -204,8 +213,9 @@ class Destination:
                 self.dropped_total += 1
             return False
 
-    def _drain_batch(self) -> List[metric_pb2.Metric]:
-        out: List[metric_pb2.Metric] = []
+    def _drain_batch(self) -> List[tuple]:
+        """Up to one batch of (metric, interval) queue entries."""
+        out: List[tuple] = []
         try:
             out.append(self._queue.get(timeout=self._flush_interval))
         except queue.Empty:
@@ -242,67 +252,101 @@ class Destination:
 
     def _run(self) -> None:
         while not self.closed.is_set():
-            batch = self._drain_batch()
-            if not batch:
+            entries = self._drain_batch()
+            if not entries:
                 continue
-            self.inflight_batch = len(batch)
-            self._token_seq += 1
-            token = f"dest:{self._token_id}:{self._token_seq}"
-            extra_md, send_span = self._trace_open(len(batch))
-            try:
-                hedge_won = False
-                if self._hedge_after > 0 and self._hedge_peer is not None:
-                    # the chaos seam runs INSIDE the hedge-timed window
-                    # (chaos_forward_latency_ms makes THIS the slow
-                    # primary the budget fires against)
-                    hedge_won = self._send_hedged(batch, token,
-                                                  extra_md=extra_md)
-                else:
-                    # the forward_send chaos seam covers proxy senders
-                    # too: injected errors exercise the breaker and
-                    # ejection paths deterministically
-                    chaos_mod.inject("forward_send")
-                    self.send_now(batch, token, extra_md=extra_md)
-                if send_span is not None and hedge_won:
-                    send_span.set_tag("hedged", "true")
-                if hedge_won:
-                    # the PEER delivered (and was credited inside
-                    # _send_hedged); the blown budget is a failure
-                    # signal for THIS node — a destination that never
-                    # completes inside the budget must eventually trip
-                    # its breaker so routing fails over instead of
-                    # paying hedge_after + a doubled RPC forever. No
-                    # close() here: probes/half-open own recovery.
-                    self.breaker.record_failure()
-                else:
-                    # credit + in-flight clear under ONE lock hold so a
-                    # concurrent flow_totals() never sees the batch as
-                    # both sent and in flight
-                    with self._counter_lock:
-                        self.sent_total += len(batch)
-                        self.inflight_batch = 0
-                    self.breaker.record_success()
-            except (grpc.RpcError, ChaosError) as e:
-                if send_span is not None:
-                    send_span.error()
-                self.breaker.record_failure()
-                with self._counter_lock:
-                    self.dropped_total += len(batch)
-                    self.dropped_enqueued_total += len(batch)
-                    self.inflight_batch = 0
-                code = e.code() if hasattr(e, "code") else None
-                logger.warning("send to %s failed (%s), breaker %s",
-                               self.address, code, self.breaker.state)
-                if not self.breaker.is_dispatchable:
-                    self.inflight_batch = 0
-                    if send_span is not None:
-                        send_span.finish()
-                    self.close(notify=True)
+            # one RPC per interval stamp: queue entries carry the
+            # sender's x-veneur-interval (0.0 = live), and metadata is
+            # per-RPC, so a drained batch mixing a WAL replay with live
+            # traffic splits on the stamp boundaries (order preserved —
+            # consecutive runs, no reordering)
+            # every drained entry must be accounted (sent or dropped)
+            # before this thread exits, or the proxy_egress identity
+            # (enqueued == sent + dropped_enqueued + queued) leaks: a
+            # mid-batch abort books the not-yet-attempted remainder as
+            # dropped — close() only drains what is still queued
+            start = 0
+            while start < len(entries):
+                interval = entries[start][1]
+                end = start
+                while end < len(entries) and entries[end][1] == interval:
+                    end += 1
+                batch = [m for m, _iv in entries[start:end]]
+                start = end
+                if not self._send_one(batch, interval):
+                    leftover = len(entries) - start
+                    if leftover:
+                        with self._counter_lock:
+                            self.dropped_total += leftover
+                            self.dropped_enqueued_total += leftover
                     return
-            finally:
+
+    def _send_one(self, batch: List, interval: float) -> bool:
+        """One batch's send attempt (breaker, hedging, accounting);
+        returns False when the destination closed itself (breaker
+        open) and the sender thread must exit."""
+        self.inflight_batch = len(batch)
+        self._token_seq += 1
+        token = f"dest:{self._token_id}:{self._token_seq}"
+        extra_md, send_span = self._trace_open(len(batch))
+        if interval:
+            extra_md = combine_metadata(extra_md,
+                                        interval_metadata(interval))
+        try:
+            hedge_won = False
+            if self._hedge_after > 0 and self._hedge_peer is not None:
+                # the chaos seam runs INSIDE the hedge-timed window
+                # (chaos_forward_latency_ms makes THIS the slow
+                # primary the budget fires against)
+                hedge_won = self._send_hedged(batch, token,
+                                              extra_md=extra_md)
+            else:
+                # the forward_send chaos seam covers proxy senders
+                # too: injected errors exercise the breaker and
+                # ejection paths deterministically
+                chaos_mod.inject("forward_send")
+                self.send_now(batch, token, extra_md=extra_md)
+            if send_span is not None and hedge_won:
+                send_span.set_tag("hedged", "true")
+            if hedge_won:
+                # the PEER delivered (and was credited inside
+                # _send_hedged); the blown budget is a failure
+                # signal for THIS node — a destination that never
+                # completes inside the budget must eventually trip
+                # its breaker so routing fails over instead of
+                # paying hedge_after + a doubled RPC forever. No
+                # close() here: probes/half-open own recovery.
+                self.breaker.record_failure()
+            else:
+                # credit + in-flight clear under ONE lock hold so a
+                # concurrent flow_totals() never sees the batch as
+                # both sent and in flight
+                with self._counter_lock:
+                    self.sent_total += len(batch)
+                    self.inflight_batch = 0
+                self.breaker.record_success()
+        except (grpc.RpcError, ChaosError) as e:
+            if send_span is not None:
+                send_span.error()
+            self.breaker.record_failure()
+            with self._counter_lock:
+                self.dropped_total += len(batch)
+                self.dropped_enqueued_total += len(batch)
+                self.inflight_batch = 0
+            code = e.code() if hasattr(e, "code") else None
+            logger.warning("send to %s failed (%s), breaker %s",
+                           self.address, code, self.breaker.state)
+            if not self.breaker.is_dispatchable:
                 self.inflight_batch = 0
                 if send_span is not None:
                     send_span.finish()
+                self.close(notify=True)
+                return False
+        finally:
+            self.inflight_batch = 0
+            if send_span is not None:
+                send_span.finish()
+        return True
 
     def send_now(self, batch, token: str, timeout: float = 10.0,
                  extra_md=None):
@@ -463,7 +507,8 @@ class Destinations:
                  observatory=None,
                  hedge_after: float = 0.0,
                  failover_walk: int = 2,
-                 ledger=None, trace_plane=None):
+                 ledger=None, trace_plane=None,
+                 shard_groups: int = 0):
         self._ledger = ledger
         # latest active trace lineage: (trace_id, parent_span_id,
         # exemplar_blob), set by the routing handlers per RPC (plain
@@ -473,7 +518,15 @@ class Destinations:
         self._active_trace = (0, 0, None)
         self._lock = threading.RLock()
         self._pool: Dict[str, Destination] = {}
-        self.ring = ConsistentRing()
+        # shard-aware ring (shard_groups > 1): the key-digest space
+        # splits into G contiguous ranges, each with its own consistent
+        # ring of the global instances serving that range's device
+        # shards — ejection re-homes only the sick member's key range
+        # within its group (proxy/ring.py ShardGroupRing)
+        self.shard_groups = max(0, int(shard_groups))
+        self.ring = (ShardGroupRing(self.shard_groups)
+                     if self.shard_groups > 1 else ConsistentRing())
+        self.group_spill_total = 0
         self._send_buffer = send_buffer
         self._batch = batch
         self._flush_interval = flush_interval
@@ -514,8 +567,25 @@ class Destinations:
         self.retired_hedge_wins_total = 0
 
     def set_destinations(self, addresses: List[str]) -> None:
-        """Reconcile the pool with a fresh discovery result."""
+        """Reconcile the pool with a fresh discovery result. With shard
+        groups enabled, a discovered address may pin its group with an
+        ``addr#<g>`` suffix (stripped before dialing); unsuffixed
+        addresses hash to a group stably."""
         with self._lock:
+            parsed = []
+            for raw_addr in addresses:
+                address, group = parse_shard_suffix(raw_addr)
+                if group is not None \
+                        and isinstance(self.ring, ShardGroupRing):
+                    try:
+                        self.ring.assign(address, group)
+                    except ValueError:
+                        logger.warning(
+                            "discovery tried to move live member %s to "
+                            "shard group %d; keeping its current group",
+                            address, group)
+                parsed.append(address)
+            addresses = parsed
             wanted = set(addresses)
             for address in list(self._pool):
                 if address not in wanted:
@@ -604,16 +674,53 @@ class Destinations:
         with self._lock:
             return sorted(self._ejected)
 
+    def group_table(self) -> List[dict]:
+        """Per-shard-group membership/health snapshot (ready-state and
+        /debug surfaces); empty when shard groups are disabled. A group
+        with live=[] has lost its whole key range to clockwise spill —
+        the degraded-mesh runbook's page-now condition."""
+        if self.shard_groups <= 1:
+            return []
+        with self._lock:
+            live = self.ring.group_members()
+            ejected_by_group: List[List[str]] = [
+                [] for _ in range(self.shard_groups)]
+            for address in sorted(self._ejected):
+                ejected_by_group[self.ring.group_of(address)].append(
+                    address)
+        return [{"group": g, "live": live[g],
+                 "ejected": ejected_by_group[g]}
+                for g in range(self.shard_groups)]
+
+    def _note_group_spill(self, point: int, address: str) -> None:
+        """Count a metric routed onto a member outside its key's shard
+        group (caller holds _lock). Every return path of get_at runs
+        this — primary hop, failover cache hit, failover walk — so
+        proxy.ring.group_spill is the complete off-range routing count,
+        not just the empty-group clockwise spill."""
+        if (self.shard_groups > 1
+                and self.ring.group_of(address)
+                != self.ring.group_of_point(point)):
+            self.group_spill_total += 1
+
     def hedge_peer_for(self, address: str) -> Optional[Destination]:
         """The next healthy DISTINCT ring member clockwise from
         `address`'s own first virtual point — the deterministic hedge
-        target for a slow primary."""
+        target for a slow primary. With shard groups the candidates are
+        confined to `address`'s OWN group (a hedge duplicates a batch
+        of the primary's key range; landing it on another group would
+        merge those keys off-range silently) — a group with no healthy
+        sibling simply doesn't hedge."""
         with self._lock:
-            try:
-                candidates = self.ring.walk_at(
-                    self.ring.point_of(address), len(self._pool) or 1)
-            except EmptyRingError:
-                return None
+            if isinstance(self.ring, ShardGroupRing):
+                candidates = self.ring.group_siblings(
+                    address, len(self._pool) or 1)
+            else:
+                try:
+                    candidates = self.ring.walk_at(
+                        self.ring.point_of(address), len(self._pool) or 1)
+                except EmptyRingError:
+                    return None
             for candidate in candidates:
                 if candidate == address:
                     continue
@@ -639,6 +746,12 @@ class Destinations:
         instead of shedding at the sick node's door."""
         with self._lock:
             address = self.ring.get_at(point)
+            # a routed member outside the key's shard group means its
+            # range spilled — whole group empty at the primary hop, or
+            # a failover walk that ran past the group's live members —
+            # loud either way: these keys merge on instances that don't
+            # serve their device-shard range
+            self._note_group_spill(point, address)
             dest = self._pool.get(address)
             # likely_dispatchable: lock-free in the common healthy case
             # — this runs per routed metric, and send() re-checks the
@@ -653,6 +766,7 @@ class Destinations:
                 if (alt is not None and not alt.closed.is_set()
                         and alt.breaker.likely_dispatchable):
                     self.failover_routed_total += 1
+                    self._note_group_spill(point, cached[0])
                     return alt
             for candidate in self.ring.walk_at(
                     point, self._failover_walk + 1)[1:]:
@@ -660,6 +774,7 @@ class Destinations:
                 if (alt is not None and not alt.closed.is_set()
                         and alt.breaker.likely_dispatchable):
                     self.failover_routed_total += 1
+                    self._note_group_spill(point, candidate)
                     if len(self._failover_cache) > 100_000:
                         self._failover_cache.clear()
                     self._failover_cache[point] = (candidate, now)
@@ -714,6 +829,10 @@ class Destinations:
                        self.retired_hedge_wins_total)
         rows: List[tuple] = [
             ("proxy.ring.failover_routed", "counter", float(failover), ()),
+            ("proxy.ring.shard_groups", "gauge",
+             float(self.shard_groups), ()),
+            ("proxy.ring.group_spill", "counter",
+             float(self.group_spill_total), ()),
             # churn-proof totals: per-destination rows below reset when a
             # destination is replaced; these fold in the retired ones
             ("proxy.dest.retired_sent", "counter", float(retired[0]), ()),

@@ -52,6 +52,7 @@ class ProxyServer:
                  health_http_url_template: str = "",
                  hedge_after: float = 0.0,
                  failover_walk: int = 2,
+                 shard_groups: int = 0,
                  telemetry=None,
                  ledger_enabled: bool = True,
                  ledger_strict: bool = False,
@@ -119,7 +120,11 @@ class ProxyServer:
             observatory=self.latency,
             hedge_after=hedge_after, failover_walk=failover_walk,
             ledger=self.ledger if self.ledger.enabled else None,
-            trace_plane=self.trace_plane)
+            trace_plane=self.trace_plane,
+            # shard-aware ring (proxy/ring.py ShardGroupRing): keys
+            # shard by digest range onto the shard group serving that
+            # range; health ejection re-homes only within the group
+            shard_groups=shard_groups)
         # probe the pool's monotonic flow totals (retired folds make
         # them churn-proof) and its live queue depth as a stock. ONE
         # flow_totals() snapshot per close, shared by all four readers:
@@ -311,12 +316,24 @@ class ProxyServer:
         ejected = sum(1 for m in members if m.get("ejected"))
         body = {"destinations": total, "ejected": ejected,
                 "members": members}
+        groups = self.destinations.group_table()
+        if groups:
+            body["shard_groups"] = groups
         if total == 0:
             body["reason"] = "no destinations connected"
             return False, body
         if ejected * 2 > total:
             body["reason"] = (f"{ejected}/{total} ring members ejected "
                               "(>50%)")
+            return False, body
+        # a shard group with no live members has lost its whole key
+        # range to clockwise spill: those keys merge on instances that
+        # don't serve their device-shard range — degraded enough that
+        # orchestrators should stop routing here
+        dead = [g["group"] for g in groups if not g["live"]]
+        if dead:
+            body["reason"] = (f"shard group(s) {dead} have no live "
+                              "members (key ranges spilling)")
             return False, body
         return True, body
 
@@ -470,12 +487,17 @@ class ProxyServer:
             # must still reach _deduper.end, or the token wedges
             # in-flight and every retry is refused
             tspan = self._trace_begin(ctx)
-            res = self._route_native(body)
+            # satellite of the WAL/backfill plane: a replayed interval's
+            # x-veneur-interval stamp must survive the routing hop, or
+            # the global folds hours-stale history into its live flush
+            from veneur_tpu.forward.wire import extract_interval
+            interval = extract_interval(ctx)
+            res = self._route_native(body, interval=interval)
             if res is None:
                 metric_list = forward_pb2.MetricList.FromString(body)
                 for pbm in metric_list.metrics:
                     received += 1
-                    if self.handle_metric(pbm):
+                    if self.handle_metric(pbm, interval=interval):
                         routed += 1
             else:
                 received, routed = res
@@ -488,7 +510,8 @@ class ProxyServer:
         # no-destination are this proxy's accounted loss)
         return encode_flow_counts(received, routed)
 
-    def _route_native(self, body) -> Optional[tuple]:
+    def _route_native(self, body, interval: float = 0.0
+                      ) -> Optional[tuple]:
         """Re-scatter a V1 body without deserializing: the native walk
         (vnt_route_parse) yields each metric's identity key + raw bytes;
         the ring key derives from the identity key once per key lifetime
@@ -553,7 +576,7 @@ class ProxyServer:
                     no_dest += 1
                     continue
                 dest.note_key(key_hash)
-                if dest.send(raw):
+                if dest.send(raw, interval=interval):
                     routed += 1
                 else:
                     dropped += 1
@@ -584,9 +607,11 @@ class ProxyServer:
         received = routed = 0
         try:
             tspan = self._trace_begin(ctx)  # see _send_metrics_v1
+            from veneur_tpu.forward.wire import extract_interval
+            interval = extract_interval(ctx)  # see _send_metrics_v1
             for pbm in request_iterator:
                 received += 1
-                if self.handle_metric(pbm):
+                if self.handle_metric(pbm, interval=interval):
                     routed += 1
             ok = True
         finally:
@@ -594,11 +619,14 @@ class ProxyServer:
             self._trace_end(tspan, received, routed, ok)
         return encode_flow_counts(received, routed)
 
-    def handle_metric(self, pbm: metric_pb2.Metric) -> bool:
+    def handle_metric(self, pbm: metric_pb2.Metric,
+                      interval: float = 0.0) -> bool:
         """Route one metric (handlers.go:100-164): hash key is
         name + lowercase type + joined tags minus ignored tags.
         Returns True when the metric landed on a destination queue
-        (the FlowCounts "merged" figure for this tier)."""
+        (the FlowCounts "merged" figure for this tier). `interval`
+        carries the sender's x-veneur-interval stamp through to the
+        destination batch (WAL replay timestamp fidelity)."""
         with self._stats_lock:
             self.stats["received_total"] += 1
         tags = [t for t in pbm.tags
@@ -624,7 +652,7 @@ class ProxyServer:
                 self.stats["no_destination_total"] += 1
             return False
         dest.note_key(key_hash)
-        routed = dest.send(pbm)
+        routed = dest.send(pbm, interval=interval)
         with self._stats_lock:
             self.stats["routed_total" if routed else "dropped_total"] += 1
         return routed

@@ -130,3 +130,175 @@ class ConsistentRing:
                 if member != first:
                     return first, member
             return first, first
+
+
+def parse_shard_suffix(address: str):
+    """Split an optional shard-group suffix off a discovered address:
+    ``host:port#3`` -> (``host:port``, 3); plain addresses give
+    (address, None) and fall back to hash assignment."""
+    base, sep, group = address.rpartition("#")
+    if sep and group.isdigit():
+        return base, int(group)
+    return address, None
+
+
+class ShardGroupRing:
+    """Shard-aware consistent hashing: the 64-bit key-digest space is
+    split into G contiguous ranges, each owned by a *shard group* — the
+    set of global instances that hold that key range's device shards —
+    with an independent ConsistentRing inside every group.
+
+    This is the proxy-tier mirror of the serving mesh's digest-home
+    routing (parallel/sharded_server.py): a key's digest picks its
+    group exactly the way it picks its home shard on a local's mesh, so
+    a global instance only ever receives keys whose partitioned state
+    it actually serves. The payoff is failure confinement — ejecting
+    one member re-shards ONLY its group's key range onto the group's
+    survivors (~1/|group| of 1/G of the keyspace), while every other
+    group's assignment is untouched; readmission restores it exactly
+    (same virtual points, same ring). Only when a group loses its last
+    member does its range spill clockwise to the next non-empty group
+    (loud, counted by the caller) — shedding a whole key range at the
+    door would be worse than merging it on the wrong shard group.
+
+    Group membership comes from the caller: an explicit `#<g>` suffix
+    on the discovered address, or a stable hash of the address. The
+    assignment is remembered across remove/add cycles so health
+    ejection + readmission can never migrate a member between groups.
+
+    The class is call-compatible with ConsistentRing (`point_of`,
+    `get_at`, `walk_at`, `add`, `remove`, `set_members`, `members`), so
+    the destination pool and the route caches work unchanged on top of
+    either."""
+
+    def __init__(self, groups: int, replicas: int = DEFAULT_REPLICAS):
+        if groups < 1:
+            raise ValueError("shard group count must be >= 1")
+        self.groups = int(groups)
+        self._lock = threading.RLock()
+        self._rings = [ConsistentRing(replicas) for _ in range(groups)]
+        # address -> group, sticky for the address's lifetime (and past
+        # it: ejection/readmission must round-trip to the same group)
+        self._group_assign: Dict[str, int] = {}
+
+    point_of = staticmethod(ConsistentRing.point_of)
+
+    def group_of_point(self, point: int) -> int:
+        """Contiguous range partition of the point space: the top bits
+        of the 64-bit ring point pick the group, so each group owns one
+        digest range (the property that makes 'this group's key range'
+        a meaningful unit to re-home or drain)."""
+        return (int(point) & 0xFFFFFFFFFFFFFFFF) * self.groups >> 64
+
+    def group_of(self, member: str) -> int:
+        with self._lock:
+            group = self._group_assign.get(member)
+            if group is None:
+                group = fnv.fnv1a_64(member.encode()) % self.groups
+            return group
+
+    def assign(self, member: str, group: int) -> None:
+        """Pin a member to a group (from the `addr#g` discovery suffix).
+        Must happen before the member is added; re-pinning a live
+        member to a different group is refused (a silent migration
+        would leak its old range's keys to the wrong group)."""
+        group = int(group) % self.groups
+        with self._lock:
+            current = self._group_assign.get(member)
+            if current is not None and current != group \
+                    and member in self._rings[current]._members:
+                raise ValueError(
+                    f"{member} is live in shard group {current}; "
+                    f"cannot reassign to {group}")
+            self._group_assign[member] = group
+
+    def add(self, member: str) -> None:
+        with self._lock:
+            group = self.group_of(member)
+            self._group_assign[member] = group
+            self._rings[group].add(member)
+
+    def remove(self, member: str) -> None:
+        with self._lock:
+            group = self._group_assign.get(member)
+            if group is not None:
+                self._rings[group].remove(member)
+
+    def set_members(self, members: List[str]) -> None:
+        with self._lock:
+            current = set(self.members())
+            for member in current - set(members):
+                self.remove(member)
+            for member in members:
+                self.add(member)
+
+    def members(self) -> List[str]:
+        with self._lock:
+            out: List[str] = []
+            for ring in self._rings:
+                out.extend(ring.members())
+            return sorted(out)
+
+    def group_members(self) -> List[List[str]]:
+        """Per-group live membership (ready-state / debug surfaces)."""
+        with self._lock:
+            return [ring.members() for ring in self._rings]
+
+    def __len__(self) -> int:
+        return len(self.members())
+
+    def get(self, key: str) -> str:
+        return self.get_at(self.point_of(key))
+
+    def get_at(self, point: int) -> str:
+        with self._lock:
+            group = self.group_of_point(point)
+            for step in range(self.groups):
+                ring = self._rings[(group + step) % self.groups]
+                try:
+                    return ring.get_at(point)
+                except EmptyRingError:
+                    continue  # whole group down: spill clockwise
+            raise EmptyRingError("every shard group is empty")
+
+    def group_siblings(self, member: str, max_members: int) -> List[str]:
+        """Deterministic distinct-member walk CONFINED to `member`'s own
+        shard group, clockwise from its first virtual point — the hedge
+        candidate order. Strictly group-confined because a hedge carries
+        a batch of the primary's key range: duplicating it onto another
+        group's instance would merge those keys off-range silently.
+        Empty when the member has no live group siblings (then don't
+        hedge; the breaker/failover path owns recovery). Note the walk
+        key is the member's OWN point inside its group's ring — the
+        plain walk_at from point_of(member) would start in whatever
+        group those point bits land in, not the member's."""
+        with self._lock:
+            ring = self._rings[self.group_of(member)]
+            try:
+                walked = ring.walk_at(self.point_of(member), max_members)
+            except EmptyRingError:
+                return []
+            return [m for m in walked if m != member]
+
+    def walk_at(self, point: int, max_members: int) -> List[str]:
+        """Deterministic failover order, group-confined first: the
+        key's own group's members (primary first), then — only past
+        them — neighboring groups clockwise. A sick primary therefore
+        re-homes within its shard group, and cross-group spill happens
+        only when the walk is allowed to run that deep."""
+        with self._lock:
+            out: List[str] = []
+            group = self.group_of_point(point)
+            for step in range(self.groups):
+                ring = self._rings[(group + step) % self.groups]
+                try:
+                    for member in ring.walk_at(
+                            point, max_members - len(out)):
+                        out.append(member)
+                except EmptyRingError:
+                    continue
+                if len(out) >= max_members:
+                    break
+            if not out:
+                raise EmptyRingError("every shard group is empty")
+            return out
