@@ -171,6 +171,11 @@ class TestServerChurnBounded:
         server = Server(cfg, extra_metric_sinks=[ch])
         if server._ingester is None:
             pytest.skip("native unavailable")
+        # determinism: a flush self-span's 1% ssf.names_unique roll
+        # would make an idle interval's flush non-empty, desyncing the
+        # wait_flush consumer and padding store.processed (the pattern
+        # test_stress pins the same way)
+        server.metric_extraction._uniqueness_rate = 0.0
         server.start()
         try:
             addr = server.local_addr("udp")

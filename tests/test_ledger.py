@@ -275,11 +275,24 @@ class TestForwardIdentity:
                 forward_retry_max_attempts=1,
                 carryover_max_intervals=1000,
                 circuit_breaker_failure_threshold=10_000))
+            # determinism: each flush self-span rolls a 1% chance of an
+            # ssf.names_unique SET sample (global scope — it would
+            # forward and intermittently become a second carryover row,
+            # breaking the exact row-count assert below)
+            server.metric_extraction._uniqueness_rate = 0.0
             server.start()
             for i in range(3):
                 server.handle_metric_packet(
                     b"fwd.c:%d|c|#veneurglobalonly" % (i + 1))
                 server.flush()  # strict: every faulted interval balances
+                # settle the per-sink flush threads before the next
+                # manual flush: on a loaded host an in-flight forward
+                # send overlapping the next snapshot re-adds its failed
+                # rows AFTER that flush drained the carryover, leaving
+                # two same-key rows until the interval after
+                assert wait_until(lambda: all(
+                    not t.is_alive()
+                    for t in server._sink_flush_threads.values()))
             # stocks hold the undelivered state
             assert server.ledger.report()["stocks"][
                 "forward_carryover"] == 1  # same key merged down to 1 row

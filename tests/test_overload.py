@@ -333,17 +333,19 @@ class TestShedLadder:
         finally:
             server.shutdown()
 
-    def test_over_limit_statsd_packet_keeps_counters(self):
-        """Rate-limited packets parse in essential-only mode: histogram
-        and set samples shed, counter/gauge deltas kept."""
+    def test_over_limit_statsd_batch_keeps_counters(self):
+        """Rate-limited BATCHES parse in essential-only mode: histogram
+        and set columns shed with exact per-class sample counts,
+        counter/gauge deltas kept. Admission is batch-granular (one
+        token take per parsed batch, cost = its sample count)."""
         cfg = make_config(ingest_rate_limit_statsd=1.0,
                           ingest_rate_limit_burst=1.0)
         server = Server(cfg, extra_metric_sinks=[ChannelMetricSink()])
         try:
-            # the bucket holds exactly 1 token: first packet is clean,
-            # the rest are over-limit
-            batches = [b"rl.c:1|c\nrl.h:1|ms" for _ in range(5)]
-            server.handle_packet_batch(batches)
+            # the bucket holds exactly 1 token (the clamped batch ask):
+            # the first batch is clean, the rest are over-limit
+            for _ in range(5):
+                server.handle_packet_batch([b"rl.c:1|c\nrl.h:1|ms"])
             server.flush()
             got = by_name(server.metric_sinks[0].wait_flush())
             assert got["rl.c"][0].value == 5.0         # every delta kept

@@ -263,14 +263,29 @@ class Config:
     # false hands out plain queues and skips all attribution.
     latency_observatory: bool = True
     # -- ingest admission control (core/overload.py) --------------------
-    # per-plane token-bucket rate limits, in packets/second (0 =
-    # unlimited). An over-limit statsd packet is parsed in
-    # essential-only mode (histogram/set samples shed, counter/gauge
-    # deltas kept); an over-limit span is dropped and counted.
+    # per-plane token-bucket rate limits (0 = unlimited). The statsd
+    # batch plane meters SAMPLES/second — admission gates each parsed
+    # batch with one bucket take costing its sample count — while the
+    # TCP line path and the span plane still meter per intake unit. An
+    # over-limit statsd batch is parsed in essential-only mode
+    # (histogram/llhist/set columns shed with exact per-class counts,
+    # counter/gauge deltas kept); an over-limit span is dropped and
+    # counted.
     ingest_rate_limit_statsd: float = 0.0
     ingest_rate_limit_spans: float = 0.0
     # bucket capacity = rate * this many seconds of burst headroom
     ingest_rate_limit_burst: float = 1.0
+    # -- batch ingest pipeline (core/ingest.py, native/dogstatsd.cc) ----
+    # samples per sealed pump chunk: readers seal a chunk when any
+    # family column fills, so this bounds both the hand-off batch size
+    # and the per-chunk native memory (~52 B/sample across the columns)
+    ingest_batch_max_samples: int = 65536
+    # SPSC ring slots PER READER thread (chunks cycling through each
+    # reader's free/ready rings; min 3). A full ring blocks its reader
+    # — backpressure into the kernel socket buffer, never a silent
+    # in-process drop — and every such wait is a counted stall
+    # (ingest.ring.stalls_total).
+    ingest_ring_slots: int = 4
     # -- cardinality watermarks (core/cardinality.py) -------------------
     # per-NAME new-key mint budgets per flush interval (0 = disabled).
     # Past soft, further mints for that name are admitted 1-in-N

@@ -1352,7 +1352,11 @@ class LLHistTable(_BaseTable):
     def add(self, metric: UDPMetric):
         value = float(metric.value)
         bin_idx = int(llhist_ref.bin_index(value))
-        weight = max(1, round(1.0 / max(metric.sample_rate, 1e-9)))
+        # clamp into int32: registers are int32, and an absurd-but-valid
+        # sample rate (@1e-10) must saturate, not overflow the buffer
+        # assignment (same clamp as bin_batch_host and the C++ parser)
+        weight = min(max(1, round(1.0 / max(metric.sample_rate, 1e-9))),
+                     2**31 - 1)
         with self.lock:
             row = self.row_for(metric)
             if row < 0:
@@ -1388,6 +1392,21 @@ class LLHistTable(_BaseTable):
             self.clamped_total += int(
                 wts[llhist_ref.clamped_mask(vals)].sum())
             self._append_batch((np.asarray(rows, np.int32), bins, wts))
+
+    def add_batch_binned(self, rows, bins, wts, clamped: int = 0) -> None:
+        """Batch fast path for ALREADY-binned samples — the native (C++)
+        batch parser bins the `l` wire type itself (llhist_ref.bin_index
+        parity pinned by the ingest fuzz corpus), so the hand-off is
+        three int32 columns and no host float work at all. `clamped` is
+        the parser's count of weight that fell outside the bin window
+        (the accuracy-loss accounting bins alone can't reconstruct)."""
+        with self.lock:
+            self._note_applied(len(rows))
+            self.samples_total += int(np.sum(wts))
+            self.clamped_total += int(clamped)
+            self._append_batch((np.asarray(rows, np.int32),
+                                np.asarray(bins, np.int32),
+                                np.asarray(wts, np.int32)))
 
     def merge_batch(self, stubs: List[UDPMetric], in_bins) -> None:
         """Import-path merge: register add. Interning atomic under the

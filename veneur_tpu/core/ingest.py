@@ -25,6 +25,7 @@ from typing import Optional
 import numpy as np
 
 from veneur_tpu import native
+from veneur_tpu.core import batchdecode
 from veneur_tpu.samplers import metrics as m
 
 logger = logging.getLogger("veneur_tpu.ingest")
@@ -35,10 +36,19 @@ _FAMILY_BY_TYPE = {
     m.HISTOGRAM: native.FAM_HISTO,
     m.TIMER: native.FAM_HISTO,
     m.SET: native.FAM_SET,
+    m.LLHIST: native.FAM_LLHIST,
 }
 
 # SSF metric enum -> DogStatsD family char (dogstatsd.cc kFamilyChar)
 _SSF_TC = {0: b"c", 1: b"g", 2: b"h", 3: b"s"}
+
+
+def addr_label(address) -> str:
+    """Human-readable listener address for ring/queue names:
+    ('127.0.0.1', 8126) -> '127.0.0.1:8126'."""
+    if isinstance(address, (tuple, list)):
+        return ":".join(str(part) for part in address)
+    return str(address)
 
 
 def ssf_meta_key(sample) -> Optional[bytes]:
@@ -62,69 +72,68 @@ def ssf_meta_key(sample) -> Optional[bytes]:
     return b"".join(parts)
 
 
-class BatchIngester:
-    """One native intern table + parse buffers per server.
+class _ColumnarIngesterBase:
+    """Shared columnar apply path: parsed per-family COO columns (from
+    the C++ parser, a pump chunk, or the numpy fallback decoder — all
+    the same duck type) land in the column store as batch applies, with
+    batch-granular admission, the shed ladder in column form, ordered
+    gauge replay-merge, and the slow-path deferral contract.
 
-    Falls back to None from `create` when the native library is
-    unavailable; callers then stay on the per-packet Python path.
-    """
+    Subclasses provide the parse step and the intern-table registration
+    hook (`_register_entry`)."""
 
-    def __init__(self, server):
-        self.server = server
-        self.store = server.store
-        self.parser = server.parser
-        self._engine = native.Engine()  # shared intern table
-        self._tls = threading.local()   # per-thread parse buffers
+    # flow-ledger key stamped on admitted batch columns (tells the
+    # /debug/ledger reader which parse plane took the sample)
+    LEDGER_KEY = "native"
 
-    @classmethod
-    def create(cls, server) -> Optional["BatchIngester"]:
-        if not native.available():
-            return None
-        try:
-            return cls(server)
-        except Exception:
-            logger.exception("native batch ingester unavailable")
-            return None
+    server = None
+    store = None
+    parser = None  # the scalar (Python) parser, for the slow path
 
-    def _parser(self) -> native.NativeParser:
-        p = getattr(self._tls, "parser", None)
-        if p is None:
-            p = native.NativeParser(engine=self._engine)
-            self._tls.parser = p
-        return p
+    def _table_for_family(self, family: int):
+        return {
+            native.FAM_COUNTER: self.store.counters,
+            native.FAM_GAUGE: self.store.gauges,
+            native.FAM_HISTO: self.store.histos,
+            native.FAM_SET: self.store.sets,
+            native.FAM_LLHIST: self.store.llhists,
+        }[family]
 
-    def ingest_buffer(self, buf: bytes,
-                      shed_nonessential: bool = False) -> int:
-        """Parse and aggregate one newline-joined packet buffer; returns
-        the number of samples taken (native + slow path not counted).
-        `shed_nonessential` is the over-limit (rate-limited) intake
-        mode: the buffer still rides the columnar fast path — shedding
-        load must not COST more CPU per packet than admitting it — but
-        its histogram/set columns are dropped (counted) and only the
-        counter/gauge columns land."""
-        parser = self._parser()
-        return self._ingest(parser.parse(buf), shed_nonessential)
-
-    def ingest_ptr(self, ptr, length: int) -> int:
-        """Zero-copy variant over a native reader's joined buffer."""
-        parser = self._parser()
-        return self._ingest(parser.parse_ptr(ptr, length))
+    def _register_entry(self, meta_key: bytes, family: int, row: int,
+                        rate: float) -> None:
+        raise NotImplementedError
 
     def _ingest(self, res, shed_nonessential: bool = False) -> int:
         store = self.store
         server = self.server
-        # native lines count as received; unknown lines are counted in the
-        # replay loop below
+        # batch admission (PR-3's token bucket, re-pointed at batches):
+        # ONE bucket take per parsed batch, token cost = the batch's
+        # sample count. An over-limit batch still rides the columnar
+        # fast path — shedding must not cost more CPU than admitting —
+        # but its histogram/set/llhist columns shed with exact per-class
+        # counts below, and only counter/gauge columns land.
+        overload = getattr(server, "overload", None)
+        # token cost = the batch's sample count; deferred lines count
+        # one each (they are load the slow path still has to parse)
+        n_ask = res.samples + len(res.unknown)
+        if (not shed_nonessential and overload is not None and n_ask
+                and not overload.admit_statsd_batch(n_ask)):
+            shed_nonessential = True
+        # columnar lines count as received; unknown lines are counted in
+        # the replay loop below. The processed counter is stamped at the
+        # END of this method, after every column landed in a pending
+        # buffer — a waiter that observes the count and flushes must see
+        # the samples in that flush, not the next one.
         server.stats.inc("packets_received", res.lines - len(res.unknown))
-        store.count_processed(res.samples)
-        # flow ledger: the native counter/gauge columns are admitted
-        # here (histogram/set columns stamp in _add_histo_set, where
+        server.stats.inc("batches_dispatched")
+        # flow ledger: the counter/gauge columns are admitted here
+        # (histogram/set/llhist columns stamp in _add_histo_set, where
         # the shed ladder decides what actually reaches the store)
         ledger = getattr(server, "ledger", None)
         if ledger is not None:
             n = len(res.c_rows) + len(res.g_rows)
             if n:
-                ledger.note("ingest.admitted", n, key="native")
+                ledger.note("ingest.admitted", n, key=self.LEDGER_KEY)
         unknown = res.unknown
 
         # Counters/histograms/sets merge commutatively, so replay order
@@ -155,7 +164,6 @@ class BatchIngester:
                     gauge_rows.append(row)
                     gauge_vals.append(metric.value)
                     gauge_lines.append(line_no)
-                    store.count_processed(1)
                 else:
                     essential_cb(metric)
 
@@ -193,21 +201,27 @@ class BatchIngester:
         elif len(res.g_rows):
             store.gauges.add_batch(res.g_rows, res.g_vals)
         self._add_histo_set(res, shed_nonessential)
+        # processed stamp LAST (see above): columns are in pending
+        # buffers now, so a flush racing this count still emits them
+        store.count_processed(res.samples +
+                              (len(gauge_rows) if gauge_rows else 0))
         return res.samples
 
     def _add_histo_set(self, res, shed_nonessential: bool = False) -> None:
-        """Append the histogram/set columns, applying the overload shed
-        ladder in batch form: shedding (or over-limit intake) drops the
-        columns whole, degraded stride-subsamples them (precision shed,
-        counters untouched — the SALSA ladder). Every shed sample is
-        counted."""
+        """Append the histogram/llhist/set columns, applying the
+        overload shed ladder in batch form: shedding (or an over-limit
+        batch) drops the columns whole, degraded stride-subsamples them
+        (precision shed, counters untouched — the SALSA ladder). Every
+        shed sample is counted with its exact per-class count straight
+        off the batch's own type-code columns — a rejected batch books
+        len(h)/len(l)/len(s) sample counts, never packet counts."""
         store = self.store
         overload = getattr(self.server, "overload", None)
         ledger = getattr(self.server, "ledger", None)
 
         def admit(n):
             if ledger is not None and n:
-                ledger.note("ingest.admitted", n, key="native")
+                ledger.note("ingest.admitted", n, key=self.LEDGER_KEY)
 
         if shed_nonessential and overload is not None:
             keep = 0.0
@@ -217,6 +231,10 @@ class BatchIngester:
             if len(res.h_rows):
                 admit(len(res.h_rows))
                 store.histos.add_batch(res.h_rows, res.h_vals, res.h_wts)
+            if len(res.l_rows):
+                admit(len(res.l_rows))
+                store.llhists.add_batch_binned(
+                    res.l_rows, res.l_bins, res.l_wts, res.l_clamped)
             if len(res.s_rows):
                 admit(len(res.s_rows))
                 store.sets.add_batch(res.s_rows, res.s_idx, res.s_rho)
@@ -224,11 +242,23 @@ class BatchIngester:
         from veneur_tpu.core import overload as overload_mod
         stride = max(1, round(1.0 / keep)) if keep > 0 else 0
         shed_reason = "rate_limit" if shed_nonessential else "overload"
-        for cls, rows, cols in (
-                (overload_mod.CLASS_HISTOGRAM, res.h_rows,
-                 (res.h_vals, res.h_wts)),
-                (overload_mod.CLASS_SET, res.s_rows,
-                 (res.s_idx, res.s_rho))):
+        groups = (
+            (overload_mod.CLASS_HISTOGRAM, res.h_rows,
+             lambda k, s: store.histos.add_batch(
+                 k, res.h_vals[::s], res.h_wts[::s])),
+            # llhist shares the histogram shed class (it loses precision,
+            # not truth); truly-subsampled batches skip the clamped
+            # credit (the aggregate can't be attributed to surviving
+            # samples), but stride 1 keeps every sample and the credit
+            (overload_mod.CLASS_HISTOGRAM, res.l_rows,
+             lambda k, s: store.llhists.add_batch_binned(
+                 k, res.l_bins[::s], res.l_wts[::s],
+                 res.l_clamped if s == 1 else 0)),
+            (overload_mod.CLASS_SET, res.s_rows,
+             lambda k, s: store.sets.add_batch(
+                 k, res.s_idx[::s], res.s_rho[::s])),
+        )
+        for cls, rows, apply_fn in groups:
             n = len(rows)
             if not n:
                 continue
@@ -237,15 +267,13 @@ class BatchIngester:
                 continue
             kept = rows[::stride]
             overload.shed(cls, n - len(kept), reason="degraded")
-            table = (store.histos if cls == overload_mod.CLASS_HISTOGRAM
-                     else store.sets)
             admit(len(kept))
-            table.add_batch(kept, cols[0][::stride], cols[1][::stride])
+            apply_fn(kept, stride)
 
     def _register_line(self, line: bytes) -> None:
         """After the slow path interned a metric line's key, teach the
-        native table its (family, row, rate) so the next occurrence never
-        leaves C++."""
+        intern table its (family, row, rate) so the next occurrence
+        stays on the columnar fast path."""
         type_start = line.find(b"|")
         if type_start < 0:
             return
@@ -260,16 +288,107 @@ class BatchIngester:
         family = _FAMILY_BY_TYPE.get(key.type)
         if family is None:
             return
-        table = {
-            native.FAM_COUNTER: self.store.counters,
-            native.FAM_GAUGE: self.store.gauges,
-            native.FAM_HISTO: self.store.histos,
-            native.FAM_SET: self.store.sets,
-        }[family]
+        table = self._table_for_family(family)
         dict_key = (h64 << 2) | int(scope)
         row = table.rows.get(dict_key)
         if row is None:
             return
+        self._register_entry(meta_key, family, row, rate)
+
+
+class PyBatchIngester(_ColumnarIngesterBase):
+    """The numpy columnar fallback: same batch pipeline as the native
+    ingester — intern-table columnar parse, one add_batch per family,
+    batch admission, slow-path deferral — with the parse step in pure
+    Python (core/batchdecode.py). Hosts without a compiler keep the
+    batched shape of the speedup instead of dropping all the way to the
+    per-sample object path."""
+
+    LEDGER_KEY = "columnar"
+
+    def __init__(self, server):
+        self.server = server
+        self.store = server.store
+        self.parser = server.parser
+        self.decoder = batchdecode.ColumnarDecoder()
+
+    def ingest_buffer(self, buf: bytes,
+                      shed_nonessential: bool = False) -> int:
+        """Parse and aggregate one newline-joined packet buffer; same
+        contract as BatchIngester.ingest_buffer."""
+        return self._ingest(self.decoder.parse(buf), shed_nonessential)
+
+    def _register_entry(self, meta_key: bytes, family: int, row: int,
+                        rate: float) -> None:
+        self.decoder.register(meta_key, family, row, rate)
+
+    def unregister_rows_multi(self, pairs) -> None:
+        """Idle-row reclamation hook (same contract as
+        native.Engine.unregister_rows_multi)."""
+        self.decoder.unregister_rows(
+            {(int(f), int(r)) for f, r in pairs})
+
+    def size(self) -> int:
+        """Intern-table size (native.Engine duck type, for the
+        intern.native_table_size gauge)."""
+        return self.decoder.size()
+
+    @property
+    def interned_keys(self) -> int:
+        return self.decoder.size()
+
+
+class BatchIngester(_ColumnarIngesterBase):
+    """One native intern table + parse buffers per server.
+
+    Falls back to None from `create` when the native library is
+    unavailable; callers then use PyBatchIngester's numpy columnar
+    decoder instead.
+    """
+
+    def __init__(self, server):
+        self.server = server
+        self.store = server.store
+        self.parser = server.parser
+        self._engine = native.Engine()  # shared intern table
+        self._tls = threading.local()   # per-thread parse buffers
+
+    @classmethod
+    def create(cls, server) -> Optional["BatchIngester"]:
+        if not native.available():
+            return None
+        try:
+            return cls(server)
+        except Exception:
+            logger.exception("native batch ingester unavailable")
+            return None
+
+    def _parser(self) -> native.NativeParser:
+        p = getattr(self._tls, "parser", None)
+        if p is None:
+            p = native.NativeParser(engine=self._engine)
+            self._tls.parser = p
+        return p
+
+    def ingest_buffer(self, buf: bytes,
+                      shed_nonessential: bool = False) -> int:
+        """Parse and aggregate one newline-joined packet buffer; returns
+        the number of samples taken (native + slow path not counted).
+        `shed_nonessential` is the over-limit (rate-limited) intake
+        mode: the buffer still rides the columnar fast path — shedding
+        load must not COST more CPU per packet than admitting it — but
+        its histogram/set/llhist columns are dropped (counted) and only
+        the counter/gauge columns land."""
+        parser = self._parser()
+        return self._ingest(parser.parse(buf), shed_nonessential)
+
+    def ingest_ptr(self, ptr, length: int) -> int:
+        """Zero-copy variant over a native reader's joined buffer."""
+        parser = self._parser()
+        return self._ingest(parser.parse_ptr(ptr, length))
+
+    def _register_entry(self, meta_key: bytes, family: int, row: int,
+                        rate: float) -> None:
         self._engine.register(meta_key, family, row, rate)
 
     @property
@@ -319,7 +438,8 @@ class BatchIngester:
         bad = int(((flags & native.SSF_BAD) != 0).sum())
         if bad:
             server.stats.inc("parse_errors", bad)
-        store.count_processed(res.samples)
+        # processed is stamped after the batch applies (same flush-race
+        # rule as _ingest)
 
         spans_cache: dict = {}
 
@@ -359,7 +479,6 @@ class BatchIngester:
                     gauge_rows.append(row)
                     gauge_vals.append(metric.value)
                     gauge_lines.append(line_no)
-                    store.count_processed(1)
             else:
                 server.ingest_metric(metric)  # process() counts it
             replayed += 1
@@ -383,6 +502,7 @@ class BatchIngester:
         elif len(res.g_rows):
             store.gauges.add_batch(res.g_rows, res.g_vals)
         self._add_histo_set(res)
+        store.count_processed(res.samples + len(gauge_rows))
 
         # derived-metric replays the native path owed us
         for idx in np.nonzero((flags & native.SSF_NEEDS_UNIQ) != 0)[0]:
@@ -446,14 +566,20 @@ class BatchIngester:
     def start_pump(self, socks) -> Optional["native.Pump"]:
         """Build a native pump over the listener's sockets: the whole
         socket->parse->accumulate loop runs in C++ reader threads (one per
-        socket, GIL-free), and Python touches a chunk of ~tens of
-        thousands of samples at a time instead of one 512-datagram buffer.
-        Returns None when the native pump cannot start."""
+        socket, GIL-free) behind per-reader SPSC ring buffers, and Python
+        touches a chunk of ~tens of thousands of samples at a time
+        instead of one 512-datagram buffer. Returns None when the native
+        pump cannot start."""
         try:
-            max_len = self.server.config.metric_max_length
+            cfg = self.server.config
+            max_len = cfg.metric_max_length
             return native.Pump(
                 self._engine, [s.fileno() for s in socks],
-                max_dgram=max_len + 1, max_len=max_len)
+                max_dgram=max_len + 1, max_len=max_len,
+                chunk_cap=max(1024, int(getattr(
+                    cfg, "ingest_batch_max_samples", 65536))),
+                ring_slots=max(3, int(getattr(
+                    cfg, "ingest_ring_slots", 4))))
         except Exception:
             logger.exception("native pump unavailable")
             return None
@@ -474,10 +600,30 @@ class BatchIngester:
             supervisor = overload.supervisor
             supervisor.register(sup_name)
             supervisor.add_probe(sup_name, pump.stalls)
+        # ring observability: each reader's ready ring registers as an
+        # `ingest_ring:<reader>` queue in the latency observatory (depth
+        # gauge at scrape, dwell llhist fed per chunk below), so ring
+        # pressure shows up in /debug/latency next to every other
+        # bounded hand-off
+        latency = getattr(server, "latency", None)
+        ring_names = []
+        ring_hists = []
+        if latency is not None and getattr(latency, "enabled", False):
+            _d, caps, _s, _st = pump.ring_stats()
+            for i in range(pump.nreaders):
+                name = f"ingest_ring:{addr_label(listener.address)}:{i}"
+                ring_names.append(name)
+                ring_hists.append(latency.queue_hist(name))
+
+                def depth_of(idx=i):
+                    return int(pump.ring_stats()[0][idx])
+
+                latency.register_queue(name, depth_of, int(caps[i]))
         while not listener.closed:
             if supervisor is not None:
                 supervisor.beat(sup_name)
-            self._dispatch_one(pump, server, timeout_ms=200)
+            self._dispatch_one(pump, server, timeout_ms=200,
+                               ring_hists=ring_hists)
         # readers may be blocked waiting for a free chunk: keep draining
         # while they wind down so their partial chunks (and the samples in
         # them) make it into the store before the final flush
@@ -495,18 +641,29 @@ class BatchIngester:
         if supervisor is not None:
             # a deliberately-closed listener is not a stall
             supervisor.unregister(sup_name)
+        if latency is not None:
+            for name in ring_names:
+                latency.unregister_queue(name)
         # native memory is freed by Pump.__del__ once the listener drops
         # its reference: freeing here would race Listener.close()'s own
         # concurrent stop() call
 
-    def _dispatch_one(self, pump, server, timeout_ms: int) -> bool:
+    def _dispatch_one(self, pump, server, timeout_ms: int,
+                      ring_hists=None) -> bool:
         chunk = pump.next(timeout_ms)
         if chunk is None:
             return False
         # sample-age stamp: the closest Python point to the C++ socket
-        # read (the pump seals chunks within its 200 ms drain cadence)
+        # read (readers seal within seal_age_ms of the first sample)
         server.latency.note_arrival("dogstatsd",
                                     getattr(chunk, "samples", 0) or 1)
+        # ring dwell: seal -> dispatch, measured on the C++ monotonic
+        # clock (both stamps native-side, so no cross-clock skew)
+        if ring_hists:
+            try:
+                ring_hists[chunk.reader].observe(chunk.dwell_ms / 1000.0)
+            except IndexError:
+                pass
         try:
             if chunk.dropped:
                 # oversized datagrams, dropped in C++ (metric_max_length

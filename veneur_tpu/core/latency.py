@@ -22,7 +22,11 @@ or sink owns the time. This module is the attribution layer:
 - **Queue dwell** — every bounded hand-off (span channel, span-sink
   isolation buffers, trace client buffer, proxy destination queues,
   forward carryover) gains a continuous depth gauge plus an
-  enqueue->dequeue dwell llhist via `InstrumentedQueue`.
+  enqueue->dequeue dwell llhist via `InstrumentedQueue`. The ingest
+  pump's per-reader SPSC rings register the same way
+  (`ingest_ring:<listener>:<n>`, via `register_queue` + `queue_hist`):
+  depth reads the native ring counters at scrape, dwell is the
+  seal->dispatch latency stamped on each chunk by the C++ side.
 
 Every internal latency distribution dogfoods the Circllhist family
 (ops/llhist_ref): fixed log-linear bins, exact register-add merges, a

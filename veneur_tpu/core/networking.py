@@ -197,7 +197,9 @@ def _read_metric_socket(sock, server, listener: Listener) -> None:
     the kernel queue into one joined buffer which the batch parser
     consumes in place; Python only sees slow-path lines. Otherwise:
     block for the first datagram, drain without blocking, and hand the
-    batch to the parser."""
+    batch to the numpy columnar decoder (handle_packet_batch) — the
+    fallback keeps the batched pipeline shape, it only swaps the parse
+    step."""
     if getattr(server, "_ingester", None) is not None:
         try:
             from veneur_tpu import native

@@ -92,6 +92,25 @@ class TokenBucket:
                 return True
             return False
 
+    def admit_debt(self, n: float = 1.0) -> bool:
+        """Batch-metering variant: admit whenever the bucket is positive
+        and charge the FULL cost, letting the balance go negative (debt
+        repaid by refill before anything else admits). All-or-nothing
+        `admit` starves any batch larger than one burst forever; debt
+        admission keeps the long-run rate exactly `rate` for arbitrarily
+        large batches, with overshoot bounded by one batch."""
+        if self.rate <= 0:
+            return True
+        with self._lock:
+            now = self._clock()
+            self._tokens = min(self.burst,
+                               self._tokens + (now - self._last) * self.rate)
+            self._last = now
+            if self._tokens > 0:
+                self._tokens -= n
+                return True
+            return False
+
 
 class KernelDropMonitor:
     """Polls /proc/net/udp{,6} for the drops column of watched sockets.
@@ -542,10 +561,25 @@ class OverloadManager:
         return True
 
     def admit_statsd_packet(self) -> bool:
-        """Packet-level admission for the statsd plane. False does NOT
-        mean drop-the-packet — it means parse it in essential-only mode
+        """Packet-level admission for the statsd plane (the TCP line
+        path, where the line is the intake unit). False does NOT mean
+        drop-the-packet — it means parse it in essential-only mode
         (the shed ladder protects counter/gauge deltas)."""
         return self.statsd_bucket.admit()
+
+    def admit_statsd_batch(self, n: int) -> bool:
+        """Batch admission for the columnar statsd plane: ONE bucket
+        take per parsed batch, token cost = the batch's sample count —
+        so the rate limit meters actual sample load, not packet counts,
+        and admission overhead amortizes over tens of thousands of
+        samples. Debt-style (TokenBucket.admit_debt): the full cost is
+        always charged, so the limit holds exactly even when one pump
+        chunk carries more samples than a whole burst — while a batch
+        larger than the burst still gets through once the bucket is
+        positive instead of starving forever. False means the batch's
+        histogram/set/llhist columns are shed with exact per-class
+        counts; counter/gauge columns still land."""
+        return self.statsd_bucket.admit_debt(float(n))
 
     def histo_set_keep(self) -> float:
         """Fraction of histogram/set samples to admit right now, for

@@ -269,6 +269,7 @@ class Server:
         from veneur_tpu.core import telemetry as telemetry_mod
         self.telemetry = telemetry_mod.Telemetry()
         self.telemetry.registry.add_collector(self._live_telemetry_rows)
+        self.telemetry.registry.add_collector(self._ring_telemetry_rows)
         self.telemetry.registry.add_collector(
             telemetry_mod.device_memory_rows)
 
@@ -388,10 +389,16 @@ class Server:
             from veneur_tpu.core.diagnostics import DiagnosticsLoop
             self.diagnostics = DiagnosticsLoop(self.statsd, config.interval)
 
-        # native batch ingest engine (None -> pure-Python per-packet path)
-        from veneur_tpu.core.ingest import BatchIngester
+        # native batch ingest engine (None -> numpy columnar fallback)
+        from veneur_tpu.core.ingest import BatchIngester, PyBatchIngester
         self._ingester = (None if config.tpu.disable_native_parser
                           else BatchIngester.create(self))
+        # the numpy columnar decoder (core/batchdecode.py): same batch
+        # pipeline — intern-table columnar parse, per-family add_batch,
+        # batch admission — with the parse step in pure Python, so the
+        # ingest speedup survives hosts without the C++ extension
+        self._py_ingester = (PyBatchIngester(self)
+                             if self._ingester is None else None)
 
         self.http_api = None  # set in start() when http_address
         self.profiler = None  # set in start() when enable_profiling
@@ -461,7 +468,8 @@ class Server:
         from veneur_tpu.util.stats import StatCounters
         self.stats = StatCounters(
             "packets_received", "parse_errors", "metrics_flushed",
-            "tcp_overlong_dropped", "ssf_undecodable_dropped")
+            "tcp_overlong_dropped", "ssf_undecodable_dropped",
+            "batches_dispatched")
         # ledger feeds from counters that already exist: parse errors
         # and the overload shed table surface as informational ingress
         # stages in /debug/ledger (per-interval deltas, folded at close)
@@ -480,40 +488,28 @@ class Server:
     # -- ingest ----------------------------------------------------------
 
     def handle_packet_batch(self, datagrams) -> None:
-        """Fast path: parse a batch of datagrams through the native batch
-        parser straight into the column store. Falls back to the
-        per-packet Python path when the native library is unavailable.
-        Chaos ingest faults (drop/truncate/duplicate) and admission
-        control apply here — one token per datagram; an over-limit
-        datagram still parses, but in essential-only mode (histogram/set
-        samples shed, counter/gauge deltas kept)."""
+        """Fast path: parse a batch of datagrams through the columnar
+        batch decoder (native C++, or the numpy fallback) straight into
+        the column store. Chaos ingest faults (drop/truncate/duplicate)
+        apply here; admission control gates the parsed BATCH — one
+        token-bucket take whose cost is the batch's sample count, inside
+        the ingester's apply path — and an over-limit batch still parses
+        columnar, in essential-only mode (histogram/llhist/set columns
+        shed with exact per-class counts, counter/gauge deltas kept)."""
         chaos = self.chaos
         if chaos is not None and chaos.ingest_faults_planned:
             datagrams = chaos.mangle_packets(datagrams)
         # sample-age stamp at the socket-read boundary, one per batch
         self.latency.note_arrival("dogstatsd", len(datagrams))
-        if self._ingester is None:
-            for dgram in datagrams:
-                self.handle_packet_buffer(dgram)
-            return
+        ingester = self._ingester or self._py_ingester
         good = []
-        over = []
         for dgram in datagrams:
             if len(dgram) > self.config.metric_max_length:
                 self.stats.inc("parse_errors")
-            elif not self.overload.admit_statsd_packet():
-                over.append(dgram)
             else:
                 good.append(dgram)
         if good:
-            self._ingester.ingest_buffer(b"\n".join(good))
-        if over:
-            # over-limit datagrams STAY on the columnar fast path —
-            # shedding load must not cost more CPU per packet than
-            # admitting it — but their histogram/set columns are shed
-            # (counted) and only counter/gauge deltas land
-            self._ingester.ingest_buffer(b"\n".join(over),
-                                         shed_nonessential=True)
+            ingester.ingest_buffer(b"\n".join(good))
 
     def handle_metric_packet(self, packet: bytes,
                              shed_nonessential: bool = False) -> None:
@@ -633,6 +629,33 @@ class Server:
         for key, spill in list(self._sink_spill.items()):
             rows.append(("flush.spill_pending", "gauge", float(len(spill)),
                          [f"sink:{key}"]))
+        return rows
+
+    def _ring_telemetry_rows(self):
+        """Scrape-time /metrics rows for the ingest SPSC rings: per
+        reader, the ready-ring depth/capacity gauges plus sealed-chunk
+        and reader-stall counters. (Ring dwell rides the observatory's
+        queue.dwell llhists under the same ingest_ring names.)"""
+        from veneur_tpu.core.ingest import addr_label
+        rows = []
+        for listener in list(getattr(self, "_listeners", ()) or ()):
+            pump = getattr(listener, "pump", None)
+            if pump is None:
+                continue
+            try:
+                depths, caps, sealed, stalls = pump.ring_stats()
+            except Exception:
+                continue
+            for i in range(len(depths)):
+                tags = [f"ring:{addr_label(listener.address)}:{i}"]
+                rows.append(("ingest.ring.depth", "gauge",
+                             float(depths[i]), tags))
+                rows.append(("ingest.ring.capacity", "gauge",
+                             float(caps[i]), tags))
+                rows.append(("ingest.ring.sealed_total", "counter",
+                             float(sealed[i]), tags))
+                rows.append(("ingest.ring.stalls_total", "counter",
+                             float(stalls[i]), tags))
         return rows
 
     # -- spans -----------------------------------------------------------
@@ -1659,10 +1682,14 @@ class Server:
             (store.gauges, native.FAM_GAUGE),
             (store.histos, native.FAM_HISTO),
             (store.sets, native.FAM_SET),
+            (store.llhists, native.FAM_LLHIST),
             (store.statuses, None),  # never registered natively
         )
+        # intern-table sweep target: the C++ engine, or the numpy
+        # fallback decoder (same unregister_rows_multi contract)
         engine = (self._ingester._engine
-                  if getattr(self, "_ingester", None) is not None else None)
+                  if getattr(self, "_ingester", None) is not None
+                  else getattr(self, "_py_ingester", None))
         if idle > 0:
             pairs = []
             for table, family in tables:

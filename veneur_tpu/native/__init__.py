@@ -32,6 +32,7 @@ FAM_COUNTER = 0
 FAM_GAUGE = 1
 FAM_HISTO = 2
 FAM_SET = 3
+FAM_LLHIST = 4
 
 # per-packet flags from vnt_ssf_parse, mirroring dogstatsd.cc
 SSF_DECODED = 1
@@ -53,11 +54,15 @@ class ChunkDesc(ctypes.Structure):
         ("h_wts", ctypes.c_void_p), ("h_n", ctypes.c_int64),
         ("s_rows", ctypes.c_void_p), ("s_idx", ctypes.c_void_p),
         ("s_rho", ctypes.c_void_p), ("s_n", ctypes.c_int64),
+        ("l_rows", ctypes.c_void_p), ("l_bins", ctypes.c_void_p),
+        ("l_wts", ctypes.c_void_p), ("l_n", ctypes.c_int64),
+        ("l_clamped", ctypes.c_int64),
         ("arena", ctypes.c_void_p), ("unk_off", ctypes.c_void_p),
         ("unk_len", ctypes.c_void_p), ("unk_line", ctypes.c_void_p),
         ("unk_n", ctypes.c_int64),
         ("lines", ctypes.c_int64), ("samples", ctypes.c_int64),
         ("dgrams", ctypes.c_int64), ("dropped", ctypes.c_int64),
+        ("reader", ctypes.c_int64), ("dwell_ms", ctypes.c_int64),
     ]
 
 
@@ -110,6 +115,7 @@ def _declare(lib) -> None:
         i32p, f32p, i32p, i64, i64p,          # gauges (+line index)
         i32p, f32p, f32p, i64, i64p,          # histos
         i32p, i32p, i32p, i64, i64p,          # sets
+        i32p, i32p, i32p, i64, i64p, i64p,    # llhists (+clamped weight)
         i64p, i64p, i32p, i64, i64p,          # unknown lines (+line index)
         i64p,                                 # samples parsed
     ]
@@ -124,6 +130,11 @@ def _declare(lib) -> None:
     lib.vnt_pump_release.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
     lib.vnt_pump_stalls.restype = i64
     lib.vnt_pump_stalls.argtypes = [ctypes.c_void_p]
+    lib.vnt_pump_nreaders.restype = ctypes.c_int32
+    lib.vnt_pump_nreaders.argtypes = [ctypes.c_void_p]
+    lib.vnt_pump_ring_stats.restype = None
+    lib.vnt_pump_ring_stats.argtypes = [
+        ctypes.c_void_p, i64p, i64p, i64p, i64p]
     lib.vnt_pump_signal_stop.restype = None
     lib.vnt_pump_signal_stop.argtypes = [ctypes.c_void_p]
     lib.vnt_pump_live.restype = ctypes.c_int32
@@ -225,11 +236,14 @@ class ParseResult:
 
     __slots__ = ("lines", "samples", "c_rows", "c_vals", "c_rates",
                  "g_rows", "g_vals", "g_lines", "h_rows", "h_vals", "h_wts",
-                 "s_rows", "s_idx", "s_rho", "unknown", "unknown_lines")
+                 "s_rows", "s_idx", "s_rho",
+                 "l_rows", "l_bins", "l_wts", "l_clamped",
+                 "unknown", "unknown_lines")
 
     def __init__(self):
         self.lines = 0
         self.samples = 0
+        self.l_clamped = 0
         self.unknown = []
         self.unknown_lines = []
 
@@ -482,7 +496,8 @@ class NativeParser:
         self.engine = engine if engine is not None else Engine(self._lib)
         self._eng = self.engine.ptr
         self._cap = 0
-        self._outs = [ctypes.c_int64() for _ in range(6)]  # c,g,h,s,unk,samples
+        # c,g,h,s,unk,samples,llhist,llhist_clamped
+        self._outs = [ctypes.c_int64() for _ in range(8)]
 
     def _ensure_capacity(self, cap: int) -> None:
         if cap <= self._cap:
@@ -500,6 +515,9 @@ class NativeParser:
         self._s_rows = np.empty(cap, np.int32)
         self._s_idx = np.empty(cap, np.int32)
         self._s_rho = np.empty(cap, np.int32)
+        self._l_rows = np.empty(cap, np.int32)
+        self._l_bins = np.empty(cap, np.int32)
+        self._l_wts = np.empty(cap, np.int32)
         self._unk_off = np.empty(cap, np.int64)
         self._unk_len = np.empty(cap, np.int64)
         self._unk_lines = np.empty(cap, np.int32)
@@ -539,13 +557,18 @@ class NativeParser:
             _ptr(self._h_wts, f32), cap, ctypes.byref(ns[2]),
             _ptr(self._s_rows, i32), _ptr(self._s_idx, i32),
             _ptr(self._s_rho, i32), cap, ctypes.byref(ns[3]),
+            _ptr(self._l_rows, i32), _ptr(self._l_bins, i32),
+            _ptr(self._l_wts, i32), cap, ctypes.byref(ns[6]),
+            ctypes.byref(ns[7]),
             _ptr(self._unk_off, i64), _ptr(self._unk_len, i64),
             _ptr(self._unk_lines, i32), cap, ctypes.byref(ns[4]),
             ctypes.byref(ns[5]))
         res = ParseResult()
         res.lines = lines
         cn, gn, hn, sn, un = (ns[i].value for i in range(5))
+        ln = ns[6].value
         res.samples = ns[5].value
+        res.l_clamped = ns[7].value
         res.c_rows = self._c_rows[:cn]
         res.c_vals = self._c_vals[:cn]
         res.c_rates = self._c_rates[:cn]
@@ -558,6 +581,9 @@ class NativeParser:
         res.s_rows = self._s_rows[:sn]
         res.s_idx = self._s_idx[:sn]
         res.s_rho = self._s_rho[:sn]
+        res.l_rows = self._l_rows[:ln]
+        res.l_bins = self._l_bins[:ln]
+        res.l_wts = self._l_wts[:ln]
         base = ptr if isinstance(ptr, int) else ptr.value
         res.unknown = [
             ctypes.string_at(base + int(self._unk_off[i]),
@@ -617,6 +643,12 @@ class NativeParser:
         res.s_rows = self._s_rows[:sn]
         res.s_idx = self._s_idx[:sn]
         res.s_rho = self._s_rho[:sn]
+        # SSF's metric enum has no llhist member; empty columns keep the
+        # shared BatchIngester apply path uniform
+        res.l_rows = self._l_rows[:0]
+        res.l_bins = self._l_bins[:0]
+        res.l_wts = self._l_wts[:0]
+        res.l_clamped = 0
         res.deferred = [
             (int(self._def_pkt[i]),
              buf[int(self._unk_off[i]):
@@ -633,7 +665,8 @@ class SsfResult:
     __slots__ = ("decoded", "samples", "flags",
                  "c_rows", "c_vals", "c_rates",
                  "g_rows", "g_vals", "g_lines", "h_rows", "h_vals", "h_wts",
-                 "s_rows", "s_idx", "s_rho", "deferred")
+                 "s_rows", "s_idx", "s_rho",
+                 "l_rows", "l_bins", "l_wts", "l_clamped", "deferred")
 
 
 def _view(addr: int, n: int, dtype):
@@ -651,9 +684,12 @@ class PumpChunk:
     like ParseResult so BatchIngester._ingest consumes either."""
 
     __slots__ = ("handle", "lines", "samples", "dgrams", "dropped",
+                 "reader", "dwell_ms",
                  "c_rows", "c_vals", "c_rates",
                  "g_rows", "g_vals", "g_lines", "h_rows", "h_vals", "h_wts",
-                 "s_rows", "s_idx", "s_rho", "unknown", "unknown_lines")
+                 "s_rows", "s_idx", "s_rho",
+                 "l_rows", "l_bins", "l_wts", "l_clamped",
+                 "unknown", "unknown_lines")
 
 
 class Blaster:
@@ -716,7 +752,7 @@ class Pump:
 
     def __init__(self, engine: "Engine", fds, max_msgs: int = 512,
                  max_dgram: int = 65536, max_len: int = 65535,
-                 chunk_cap: int = 65536, nchunks: int = 8,
+                 chunk_cap: int = 65536, ring_slots: int = 4,
                  seal_age_ms: int = 100, poll_ms: int = 50, lib=None):
         self._lib = lib if lib is not None else load()
         if self._lib is None:
@@ -725,8 +761,9 @@ class Pump:
         fd_arr = (ctypes.c_int32 * len(fds))(*fds)
         self._p = self._lib.vnt_pump_new(
             engine.ptr, fd_arr, len(fds), max_msgs, max_dgram, max_len,
-            chunk_cap, nchunks, seal_age_ms, poll_ms)
+            chunk_cap, ring_slots, seal_age_ms, poll_ms)
         self._desc = ChunkDesc()
+        self.nreaders = int(self._lib.vnt_pump_nreaders(self._p))
 
     def next(self, timeout_ms: int = 200) -> "PumpChunk | None":
         """Blocks up to timeout_ms for a sealed chunk. The returned
@@ -742,6 +779,8 @@ class Pump:
         res.samples = d.samples
         res.dgrams = d.dgrams
         res.dropped = d.dropped
+        res.reader = d.reader
+        res.dwell_ms = d.dwell_ms
         res.c_rows = _view(d.c_rows, d.c_n, np.int32)
         res.c_vals = _view(d.c_vals, d.c_n, np.float32)
         res.c_rates = _view(d.c_rates, d.c_n, np.float32)
@@ -754,6 +793,10 @@ class Pump:
         res.s_rows = _view(d.s_rows, d.s_n, np.int32)
         res.s_idx = _view(d.s_idx, d.s_n, np.int32)
         res.s_rho = _view(d.s_rho, d.s_n, np.int32)
+        res.l_rows = _view(d.l_rows, d.l_n, np.int32)
+        res.l_bins = _view(d.l_bins, d.l_n, np.int32)
+        res.l_wts = _view(d.l_wts, d.l_n, np.int32)
+        res.l_clamped = d.l_clamped
         if d.unk_n:
             offs = _view(d.unk_off, d.unk_n, np.int64)
             lens = _view(d.unk_len, d.unk_n, np.int64)
@@ -772,6 +815,19 @@ class Pump:
 
     def stalls(self) -> int:
         return self._lib.vnt_pump_stalls(self._p)
+
+    def ring_stats(self):
+        """Per-reader ring telemetry: (depths, capacities, sealed_totals,
+        stall_totals) int64 arrays of length nreaders — the latency
+        observatory's ingest_ring depth gauges and the ingest.ring.*
+        /metrics rows read these. Fresh arrays per call: scrape threads
+        and the observatory's depth callables may overlap."""
+        out = np.empty((4, self.nreaders), np.int64)
+        i64 = ctypes.c_int64
+        self._lib.vnt_pump_ring_stats(
+            self._p, _ptr(out[0], i64), _ptr(out[1], i64),
+            _ptr(out[2], i64), _ptr(out[3], i64))
+        return out[0], out[1], out[2], out[3]
 
     def live_readers(self) -> int:
         return self._lib.vnt_pump_live(self._p)

@@ -55,6 +55,7 @@ enum Family : int32_t {
   FAM_GAUGE = 1,
   FAM_HISTO = 2,
   FAM_SET = 3,
+  FAM_LLHIST = 4,  // "l" wire type: Circllhist log-linear bins
 };
 
 struct Entry {
@@ -116,6 +117,61 @@ inline void pos_val(uint64_t h, int32_t* idx, int32_t* rho) {
   *idx = static_cast<int32_t>(h >> (64 - kHllP));
   uint64_t w = (h << kHllP) | (1ULL << (kHllP - 1));
   *rho = __builtin_clzll(w) + 1;
+}
+
+// ---- llhist binning (parity with veneur_tpu/ops/llhist_ref.py) ------------
+
+constexpr int kLLExpMin = -9;
+constexpr int kLLExpMax = 15;
+constexpr int kLLMant = 90;
+constexpr int kLLNExp = kLLExpMax - kLLExpMin + 1;  // 25
+constexpr int kLLNegOffset = kLLMant * kLLNExp;     // 2250
+constexpr double kLLMinMag = 1e-9;   // 10^EXP_MIN
+constexpr double kLLMaxMag = 1e16;   // 10^(EXP_MAX+1)
+
+// decimal literals are correctly rounded by the compiler, bit-identical
+// to numpy's 10.0**e for this range — the same doubles llhist_ref's
+// correction step compares against. Indexed by e - (kLLExpMin - 1).
+constexpr double kLLPow10[] = {
+    1e-10, 1e-9, 1e-8, 1e-7, 1e-6, 1e-5, 1e-4, 1e-3, 1e-2, 1e-1,
+    1e0,  1e1,  1e2,  1e3,  1e4,  1e5,  1e6,  1e7,  1e8,  1e9,
+    1e10, 1e11, 1e12, 1e13, 1e14, 1e15, 1e16, 1e17};
+
+inline double ll_p10(int e) { return kLLPow10[e - (kLLExpMin - 1)]; }
+
+// value -> dense bin id, the exact algorithm of llhist_ref.bin_index on
+// float64 (parity pinned by tests/test_ingest_batch.py's fuzz corpus):
+// 0 = zero bin, positive bins ordered (exponent, mantissa), negatives
+// offset by MANT*NEXP. The float-log correction forces
+// 10^e <= |v| < 10^(e+1) so a 1-ulp log10 difference can never move a
+// value across a bin edge.
+inline int32_t llhist_bin_index(double v) {
+  double a = fabs(v);
+  if (!(a >= kLLMinMag)) return 0;  // zero, tiny magnitudes, NaN
+  int e;
+  int mant;
+  if (a >= kLLMaxMag) {  // includes +/-inf
+    e = kLLExpMax;
+    mant = 99;
+  } else {
+    e = static_cast<int>(floor(log10(a)));
+    if (a < ll_p10(e)) {
+      e -= 1;
+    } else if (a >= ll_p10(e + 1)) {
+      e += 1;
+    }
+    if (e < kLLExpMin) e = kLLExpMin;
+    if (e > kLLExpMax) e = kLLExpMax;
+    double m = floor(a / ll_p10(e - 1));
+    mant = m < 10 ? 10 : (m > 99 ? 99 : static_cast<int>(m));
+  }
+  int32_t idx = 1 + (e - kLLExpMin) * kLLMant + (mant - 10);
+  return v < 0 ? idx + kLLNegOffset : idx;
+}
+
+inline bool llhist_clamped(double v) {
+  double a = fabs(v);
+  return (a > 0 && a < kLLMinMag) || a >= kLLMaxMag;
 }
 
 // ---- strict float parsing -------------------------------------------------
@@ -236,6 +292,11 @@ struct Out {
   int32_t* s_idx;
   int32_t* s_rho;
   int64_t s_cap, s_n = 0;
+  int32_t* l_rows = nullptr;  // llhist: pre-binned register adds
+  int32_t* l_bins = nullptr;
+  int32_t* l_wts = nullptr;
+  int64_t l_cap = 0, l_n = 0;
+  int64_t l_clamped = 0;  // weight that fell outside the bin window
   int64_t* unk_off;
   int64_t* unk_len;
   int32_t* unk_line;
@@ -286,8 +347,9 @@ inline bool parse_line(const Engine* e, const uint8_t* line, size_t len,
   // ignored, an empty segment elsewhere is an error (Python path parity)
   const uint8_t* vc = line + value_start + 1;
   size_t vlen = type_start - value_start - 1;
-  int64_t n_emitted[4] = {o->c_n, o->g_n, o->h_n, o->s_n};
+  int64_t n_emitted[5] = {o->c_n, o->g_n, o->h_n, o->s_n, o->l_n};
   int64_t samples_before = o->samples;
+  int64_t clamped_before = o->l_clamped;
   while (vlen > 0) {
     const uint8_t* next =
         static_cast<const uint8_t*>(memchr(vc, ':', vlen));
@@ -352,6 +414,28 @@ inline bool parse_line(const Engine* e, const uint8_t* line, size_t len,
         ok = true;
         break;
       }
+      case FAM_LLHIST: {
+        double v;
+        if (o->l_n >= o->l_cap || !parse_float(e, seg, seg_len, &v)) break;
+        // bin on the full-precision double (scalar-path parity: the
+        // Python path bins float64 too, so no f32 round-trip may move
+        // a value across a bin edge); weight = round(1/max(rate,1e-9))
+        // half-to-even like Python round() / np.rint, with the scalar
+        // path's 1e-9 rate floor, saturating into int32 as a guard
+        // against the UB cast
+        double r = static_cast<double>(ent.rate);
+        double w = nearbyint(1.0 / (r > 1e-9 ? r : 1e-9));
+        if (w < 1.0) w = 1.0;
+        if (w > 2147483647.0) w = 2147483647.0;
+        int32_t wt = static_cast<int32_t>(w);
+        o->l_rows[o->l_n] = ent.row;
+        o->l_bins[o->l_n] = llhist_bin_index(v);
+        o->l_wts[o->l_n] = wt;
+        o->l_n++;
+        if (llhist_clamped(v)) o->l_clamped += wt;
+        ok = true;
+        break;
+      }
       default:
         break;
     }
@@ -362,7 +446,9 @@ inline bool parse_line(const Engine* e, const uint8_t* line, size_t len,
       o->g_n = n_emitted[1];
       o->h_n = n_emitted[2];
       o->s_n = n_emitted[3];
+      o->l_n = n_emitted[4];
       o->samples = samples_before;
+      o->l_clamped = clamped_before;
       return false;
     }
     o->samples++;
@@ -437,6 +523,8 @@ int64_t vnt_parse(void* ep, const uint8_t* buf, int64_t buflen,
                   int64_t h_cap, int64_t* h_n,
                   int32_t* s_rows, int32_t* s_idx, int32_t* s_rho,
                   int64_t s_cap, int64_t* s_n,
+                  int32_t* l_rows, int32_t* l_bins, int32_t* l_wts,
+                  int64_t l_cap, int64_t* l_n, int64_t* l_clamped,
                   int64_t* unk_off, int64_t* unk_len, int32_t* unk_lines,
                   int64_t unk_cap, int64_t* unk_n, int64_t* samples_out) {
   Engine* e = static_cast<Engine*>(ep);
@@ -445,6 +533,7 @@ int64_t vnt_parse(void* ep, const uint8_t* buf, int64_t buflen,
   o.g_rows = g_rows; o.g_vals = g_vals; o.g_lines = g_lines; o.g_cap = g_cap;
   o.h_rows = h_rows; o.h_vals = h_vals; o.h_wts = h_wts; o.h_cap = h_cap;
   o.s_rows = s_rows; o.s_idx = s_idx; o.s_rho = s_rho; o.s_cap = s_cap;
+  o.l_rows = l_rows; o.l_bins = l_bins; o.l_wts = l_wts; o.l_cap = l_cap;
   o.unk_off = unk_off; o.unk_len = unk_len; o.unk_line = unk_lines;
   o.unk_cap = unk_cap;
 
@@ -470,6 +559,8 @@ int64_t vnt_parse(void* ep, const uint8_t* buf, int64_t buflen,
   *g_n = o.g_n;
   *h_n = o.h_n;
   *s_n = o.s_n;
+  *l_n = o.l_n;
+  *l_clamped = o.l_clamped;
   *unk_n = o.unk_n;
   *samples_out = o.samples;
   return lines;
@@ -642,6 +733,7 @@ struct Chunk {
   std::vector<int32_t> h_rows;
   std::vector<float> h_vals, h_wts;
   std::vector<int32_t> s_rows, s_idx, s_rho;
+  std::vector<int32_t> l_rows, l_bins, l_wts;
   std::vector<uint8_t> arena;
   std::vector<int64_t> unk_off, unk_len;
   std::vector<int32_t> unk_line;
@@ -651,6 +743,8 @@ struct Chunk {
   int64_t dgrams = 0;
   int64_t dropped = 0;
   int64_t first_ms = 0;  // when the first sample landed (seal aging)
+  int32_t lane = 0;      // owning reader: release returns it there
+  int64_t seal_ms = 0;   // when sealed (ring dwell attribution)
 
   explicit Chunk(int64_t sample_cap, int64_t max_line)
       : cap(sample_cap),
@@ -660,6 +754,7 @@ struct Chunk {
         g_rows(cap), g_vals(cap), g_lines(cap),
         h_rows(cap), h_vals(cap), h_wts(cap),
         s_rows(cap), s_idx(cap), s_rho(cap),
+        l_rows(cap), l_bins(cap), l_wts(cap),
         arena(arena_cap),
         unk_off(unk_cap), unk_len(unk_cap), unk_line(unk_cap) {
     reset();
@@ -675,6 +770,8 @@ struct Chunk {
     o.h_wts = h_wts.data(); o.h_cap = cap;
     o.s_rows = s_rows.data(); o.s_idx = s_idx.data();
     o.s_rho = s_rho.data(); o.s_cap = cap;
+    o.l_rows = l_rows.data(); o.l_bins = l_bins.data();
+    o.l_wts = l_wts.data(); o.l_cap = cap;
     o.unk_off = unk_off.data(); o.unk_len = unk_len.data();
     o.unk_line = unk_line.data(); o.unk_cap = unk_cap;
     arena_n = 0;
@@ -682,6 +779,7 @@ struct Chunk {
     dgrams = 0;
     dropped = 0;
     first_ms = 0;
+    seal_ms = 0;
   }
 
   bool empty() const {
@@ -694,70 +792,147 @@ struct ChunkDesc {
   int32_t* g_rows; float* g_vals; int32_t* g_lines; int64_t g_n;
   int32_t* h_rows; float* h_vals; float* h_wts; int64_t h_n;
   int32_t* s_rows; int32_t* s_idx; int32_t* s_rho; int64_t s_n;
+  int32_t* l_rows; int32_t* l_bins; int32_t* l_wts; int64_t l_n;
+  int64_t l_clamped;
   uint8_t* arena; int64_t* unk_off; int64_t* unk_len; int32_t* unk_line;
   int64_t unk_n;
   int64_t lines; int64_t samples; int64_t dgrams; int64_t dropped;
+  int64_t reader;    // lane index (which reader sealed this chunk)
+  int64_t dwell_ms;  // seal -> dispatch latency (ring dwell)
+};
+
+// Bounded lock-free single-producer/single-consumer ring of chunk
+// pointers. Each reader lane runs two of these: `ready` (reader
+// produces, dispatcher consumes) and `free_q` (dispatcher produces,
+// reader consumes) — so the steady-state hand-off between a socket
+// reader and the dispatcher is two atomic stores per CHUNK (tens of
+// thousands of samples), with no lock on the data path. The pump
+// mutex below exists only to park/wake sleeping threads; it never
+// guards ring state.
+struct SpscRing {
+  std::vector<Chunk*> slots;
+  uint64_t mask;
+  std::atomic<uint64_t> head{0};  // consumer position
+  std::atomic<uint64_t> tail{0};  // producer position
+
+  explicit SpscRing(uint64_t cap_pow2)
+      : slots(cap_pow2), mask(cap_pow2 - 1) {}
+
+  bool push(Chunk* c) {  // single producer only
+    uint64_t t = tail.load(std::memory_order_relaxed);
+    if (t - head.load(std::memory_order_acquire) > mask) return false;
+    slots[t & mask] = c;
+    tail.store(t + 1, std::memory_order_release);
+    return true;
+  }
+
+  Chunk* pop() {  // single consumer only
+    uint64_t h = head.load(std::memory_order_relaxed);
+    if (h == tail.load(std::memory_order_acquire)) return nullptr;
+    Chunk* c = slots[h & mask];
+    head.store(h + 1, std::memory_order_release);
+    return c;
+  }
+
+  int64_t depth() const {
+    return static_cast<int64_t>(tail.load(std::memory_order_relaxed) -
+                                head.load(std::memory_order_relaxed));
+  }
+};
+
+inline uint64_t next_pow2(uint64_t v) {
+  uint64_t p = 1;
+  while (p < v) p <<= 1;
+  return p;
+}
+
+// One socket reader's lane: its fd, its private chunk set, and the two
+// SPSC rings connecting it to the dispatcher. A full free ring BLOCKS
+// the reader (backpressure into the kernel buffer — never a silent
+// in-process drop); every such wait is a counted stall.
+struct ReaderLane {
+  int fd;
+  SpscRing ready;   // reader -> dispatcher (sealed chunks)
+  SpscRing free_q;  // dispatcher -> reader (recycled chunks)
+  std::atomic<int64_t> sealed{0};  // chunks sealed (ring throughput)
+  std::atomic<int64_t> stalls{0};  // reader waits for a free chunk
+
+  ReaderLane(int fd_, uint64_t ring_cap)
+      : fd(fd_), ready(ring_cap), free_q(ring_cap) {}
 };
 
 struct Pump {
   Engine* engine;
-  std::vector<int> fds;
   int32_t max_msgs;
   int64_t max_dgram;
   int64_t max_len;
   int64_t chunk_cap;
+  int32_t ring_slots = 0;  // chunks per lane (the ring's real capacity)
   int32_t seal_age_ms;
   int32_t poll_ms;
 
+  // mu/cv park sleeping threads only (see SpscRing): sealers and
+  // releasers take mu for the notify so a checked-then-waiting peer
+  // can never miss its wakeup, but ring pushes/pops happen outside it
   std::mutex mu;
   std::condition_variable cv_free, cv_ready;
-  std::deque<Chunk*> free_list;
-  std::deque<Chunk*> ready;
+  std::vector<ReaderLane*> lanes;
+  size_t next_lane = 0;  // dispatcher round-robin cursor
   std::vector<Chunk*> all;
   std::vector<std::thread> threads;
   std::mutex stop_mu;  // vnt_pump_stop is callable from several threads
   std::atomic<bool> stop{false};
   std::atomic<int32_t> live{0};        // reader threads still running
-  std::atomic<int64_t> stalls{0};      // times a reader waited for a chunk
+  std::atomic<int64_t> stalls{0};      // total reader waits for a chunk
   std::atomic<int64_t> lost_lines{0};  // lines discarded at shutdown
 
   ~Pump() {
     for (Chunk* c : all) delete c;
+    for (ReaderLane* l : lanes) delete l;
   }
 };
 
-// Moves a full/aged chunk to the ready queue and wakes the dispatcher.
-inline void pump_seal(Pump* p, Chunk* c) {
+// Seals a full/aged chunk onto the reader's ready ring and wakes the
+// dispatcher. The push cannot fail: each ring is sized to hold every
+// chunk its lane owns.
+inline void pump_seal(Pump* p, ReaderLane* lane, Chunk* c) {
+  c->seal_ms = now_ms();
+  lane->ready.push(c);
+  lane->sealed.fetch_add(1, std::memory_order_relaxed);
   std::lock_guard<std::mutex> lock(p->mu);
-  p->ready.push_back(c);
   p->cv_ready.notify_one();
 }
 
-// Blocks until a fresh chunk is available (dispatcher backpressure: while
-// a reader waits here it is not draining its socket, so the kernel buffer
-// absorbs or drops — standard UDP semantics). During stop the dispatcher
-// keeps draining, so freed chunks still arrive; only after a bounded wait
-// (dispatcher dead?) does this give up and return nullptr.
-inline Chunk* pump_take_free(Pump* p) {
-  std::unique_lock<std::mutex> lock(p->mu);
-  if (p->free_list.empty()) p->stalls.fetch_add(1);
+// Blocks until the lane has a recycled chunk (dispatcher backpressure:
+// while a reader waits here it is not draining its socket, so the
+// kernel buffer absorbs or drops — standard UDP semantics, with the
+// loss visible in ingest.kernel_drops). During stop the dispatcher
+// keeps draining, so freed chunks still arrive; only after a bounded
+// wait (dispatcher dead?) does this give up and return nullptr.
+inline Chunk* pump_take_free(Pump* p, ReaderLane* lane) {
+  Chunk* c = lane->free_q.pop();
+  if (c != nullptr) return c;
+  lane->stalls.fetch_add(1, std::memory_order_relaxed);
+  p->stalls.fetch_add(1, std::memory_order_relaxed);
   for (int waited_ms = 0;;) {
-    if (!p->free_list.empty()) break;
+    std::unique_lock<std::mutex> lock(p->mu);
+    c = lane->free_q.pop();  // re-check under mu: release notifies under it
+    if (c != nullptr) return c;
     if (p->stop && waited_ms >= 5000) return nullptr;
     p->cv_free.wait_for(lock, std::chrono::milliseconds(100));
-    waited_ms += 100;
-    if (!p->stop) waited_ms = 0;  // unbounded while running
+    lock.unlock();
+    c = lane->free_q.pop();
+    if (c != nullptr) return c;
+    waited_ms = p->stop ? waited_ms + 100 : 0;
   }
-  Chunk* c = p->free_list.front();
-  p->free_list.pop_front();
-  return c;
 }
 
 // Parses one joined buffer into the reader's current chunk, sealing and
 // swapping chunks mid-buffer whenever capacity could run out. Returns the
 // (possibly new) current chunk, or nullptr on stop.
-inline Chunk* pump_parse(Pump* p, Chunk* cur, const uint8_t* buf,
-                         int64_t buflen, std::string& keybuf, int64_t now) {
+inline Chunk* pump_parse(Pump* p, ReaderLane* lane, Chunk* cur,
+                         const uint8_t* buf, int64_t buflen,
+                         std::string& keybuf, int64_t now) {
   std::shared_lock lock(p->engine->mu);
   int64_t pos = 0;
   while (pos < buflen) {
@@ -771,11 +946,12 @@ inline Chunk* pump_parse(Pump* p, Chunk* cur, const uint8_t* buf,
       if (cur->o.g_n > fill) fill = cur->o.g_n;
       if (cur->o.h_n > fill) fill = cur->o.h_n;
       if (cur->o.s_n > fill) fill = cur->o.s_n;
+      if (cur->o.l_n > fill) fill = cur->o.l_n;
       if (fill + need > cur->cap || cur->o.unk_n + 1 > cur->unk_cap ||
           cur->arena_n + line_len > cur->arena_cap) {
         lock.unlock();
-        pump_seal(p, cur);
-        cur = pump_take_free(p);
+        pump_seal(p, lane, cur);
+        cur = pump_take_free(p, lane);
         if (cur == nullptr) {
           // shutdown with a dead dispatcher: account for what this
           // buffer still held so the loss is at least visible
@@ -807,19 +983,19 @@ inline Chunk* pump_parse(Pump* p, Chunk* cur, const uint8_t* buf,
   return cur;
 }
 
-void pump_reader(Pump* p, int fd) {
+void pump_reader(Pump* p, ReaderLane* lane) {
   struct Live {
     Pump* p;
     ~Live() { p->live.fetch_sub(1); }
   } live{p};
   Reader r(p->max_msgs, p->max_dgram);
   std::string keybuf;
-  Chunk* cur = pump_take_free(p);
+  Chunk* cur = pump_take_free(p, lane);
   if (cur == nullptr) return;
   while (!p->stop.load(std::memory_order_relaxed)) {
     int32_t nd = 0, ndrop = 0;
-    int64_t len = vnt_reader_read(&r, fd, p->max_len, p->poll_ms, &nd,
-                                  &ndrop);
+    int64_t len = vnt_reader_read(&r, lane->fd, p->max_len, p->poll_ms,
+                                  &nd, &ndrop);
     int64_t now = now_ms();
     if (len < 0) break;
     if (ndrop || len > 0) {
@@ -828,55 +1004,86 @@ void pump_reader(Pump* p, int fd) {
     }
     if (len > 0) {
       cur->dgrams += nd;
-      cur = pump_parse(p, cur, r.joined.data(), len, keybuf, now);
+      cur = pump_parse(p, lane, cur, r.joined.data(), len, keybuf, now);
       if (cur == nullptr) return;
     }
     // aging: never sit on samples longer than seal_age_ms, whether the
     // socket is quiet (poll timeout) or steadily trickling
     if (!cur->empty() && now - cur->first_ms >= p->seal_age_ms) {
-      pump_seal(p, cur);
-      cur = pump_take_free(p);
+      pump_seal(p, lane, cur);
+      cur = pump_take_free(p, lane);
       if (cur == nullptr) return;
     }
   }
   if (!cur->empty()) {
-    pump_seal(p, cur);  // drain on shutdown
-  } else {
-    std::lock_guard<std::mutex> lock(p->mu);
-    p->free_list.push_back(cur);
+    pump_seal(p, lane, cur);  // drain on shutdown
   }
+  // An empty final chunk is deliberately NOT returned to free_q: the
+  // dispatcher may be releasing chunks onto this lane's free ring
+  // concurrently during wind-down, and free_q's producer side belongs
+  // to it alone (SPSC). The chunk stays owned by Pump::all and is
+  // freed with the pump; readers never take from this lane again.
 }
 
 }  // namespace
 
 extern "C" {
 
+// ring_slots is PER READER: each lane owns ring_slots chunks cycling
+// through its private free/ready SPSC rings, so readers never contend
+// with each other for buffer space and the hand-off to the dispatcher
+// is lock-free.
 void* vnt_pump_new(void* ep, const int32_t* fds, int32_t nfds,
                    int32_t max_msgs, int64_t max_dgram, int64_t max_len,
-                   int64_t chunk_cap, int32_t nchunks, int32_t seal_age_ms,
-                   int32_t poll_ms) {
+                   int64_t chunk_cap, int32_t ring_slots,
+                   int32_t seal_age_ms, int32_t poll_ms) {
   Pump* p = new Pump();
   p->engine = static_cast<Engine*>(ep);
-  p->fds.assign(fds, fds + nfds);
   p->max_msgs = max_msgs;
   p->max_dgram = max_dgram;
   p->max_len = max_len;
   p->chunk_cap = chunk_cap;
   p->seal_age_ms = seal_age_ms;
   p->poll_ms = poll_ms;
-  // enough chunks that every reader can fill one while the dispatcher
-  // holds one and a couple queue up behind it
-  if (nchunks < nfds + 2) nchunks = nfds + 2;
-  for (int32_t i = 0; i < nchunks; i++) {
-    Chunk* c = new Chunk(chunk_cap, max_dgram);
-    p->all.push_back(c);
-    p->free_list.push_back(c);
+  // one chunk fills while the dispatcher holds one: 3 is the floor at
+  // which the reader never self-deadlocks waiting for its own seal
+  if (ring_slots < 3) ring_slots = 3;
+  p->ring_slots = ring_slots;
+  uint64_t ring_cap = next_pow2(static_cast<uint64_t>(ring_slots));
+  for (int32_t i = 0; i < nfds; i++) {
+    ReaderLane* lane = new ReaderLane(fds[i], ring_cap);
+    for (int32_t k = 0; k < ring_slots; k++) {
+      Chunk* c = new Chunk(chunk_cap, max_dgram);
+      c->lane = i;
+      p->all.push_back(c);
+      lane->free_q.push(c);
+    }
+    p->lanes.push_back(lane);
   }
-  for (int fd : p->fds) {
+  for (ReaderLane* lane : p->lanes) {
     p->live.fetch_add(1);
-    p->threads.emplace_back(pump_reader, p, fd);
+    p->threads.emplace_back(pump_reader, p, lane);
   }
   return p;
+}
+
+int32_t vnt_pump_nreaders(void* pp) {
+  return static_cast<int32_t>(static_cast<Pump*>(pp)->lanes.size());
+}
+
+// Per-lane ring telemetry: ready-ring depth, capacity (chunks the lane
+// owns — the real bound, not the pow2 slot array), chunks sealed, and
+// reader free-chunk stalls. Arrays must hold vnt_pump_nreaders entries.
+void vnt_pump_ring_stats(void* pp, int64_t* depth, int64_t* cap,
+                         int64_t* sealed, int64_t* stalls) {
+  Pump* p = static_cast<Pump*>(pp);
+  for (size_t i = 0; i < p->lanes.size(); i++) {
+    ReaderLane* lane = p->lanes[i];
+    depth[i] = lane->ready.depth();
+    cap[i] = p->ring_slots;
+    sealed[i] = lane->sealed.load(std::memory_order_relaxed);
+    stalls[i] = lane->stalls.load(std::memory_order_relaxed);
+  }
 }
 
 // Sets the stop flag without joining, so the caller (the dispatcher) can
@@ -896,18 +1103,41 @@ int64_t vnt_pump_lost_lines(void* pp) {
   return static_cast<Pump*>(pp)->lost_lines.load();
 }
 
-// Waits up to timeout_ms for a sealed chunk; fills *out and returns the
-// chunk handle (release it with vnt_pump_release), or nullptr on timeout.
+// Waits up to timeout_ms for a sealed chunk from any lane (round-robin
+// across lanes so one hot reader can't starve the others); fills *out
+// and returns the chunk handle (release it with vnt_pump_release), or
+// nullptr on timeout.
 void* vnt_pump_next(void* pp, int32_t timeout_ms, ChunkDesc* out) {
   Pump* p = static_cast<Pump*>(pp);
-  std::unique_lock<std::mutex> lock(p->mu);
-  if (!p->cv_ready.wait_for(lock, std::chrono::milliseconds(timeout_ms),
-                            [p] { return !p->ready.empty(); })) {
-    return nullptr;
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms);
+  Chunk* c = nullptr;
+  size_t nl = p->lanes.size();
+  for (;;) {
+    for (size_t k = 0; k < nl && c == nullptr; k++) {
+      size_t i = (p->next_lane + k) % nl;
+      c = p->lanes[i]->ready.pop();
+      if (c != nullptr) p->next_lane = (i + 1) % nl;
+    }
+    if (c != nullptr) break;
+    std::unique_lock<std::mutex> lock(p->mu);
+    // re-check under mu: a sealer pushes BEFORE it takes mu to notify,
+    // so any push that won the race is visible here and the wait below
+    // can never sleep through it
+    bool any = false;
+    for (ReaderLane* lane : p->lanes) {
+      if (lane->ready.depth() > 0) {
+        any = true;
+        break;
+      }
+    }
+    if (any) continue;
+    if (p->cv_ready.wait_until(lock, deadline) ==
+            std::cv_status::timeout &&
+        std::chrono::steady_clock::now() >= deadline) {
+      return nullptr;
+    }
   }
-  Chunk* c = p->ready.front();
-  p->ready.pop_front();
-  lock.unlock();
   out->c_rows = c->c_rows.data(); out->c_vals = c->c_vals.data();
   out->c_rates = c->c_rates.data(); out->c_n = c->o.c_n;
   out->g_rows = c->g_rows.data(); out->g_vals = c->g_vals.data();
@@ -916,6 +1146,9 @@ void* vnt_pump_next(void* pp, int32_t timeout_ms, ChunkDesc* out) {
   out->h_wts = c->h_wts.data(); out->h_n = c->o.h_n;
   out->s_rows = c->s_rows.data(); out->s_idx = c->s_idx.data();
   out->s_rho = c->s_rho.data(); out->s_n = c->o.s_n;
+  out->l_rows = c->l_rows.data(); out->l_bins = c->l_bins.data();
+  out->l_wts = c->l_wts.data(); out->l_n = c->o.l_n;
+  out->l_clamped = c->o.l_clamped;
   out->arena = c->arena.data();
   out->unk_off = c->unk_off.data(); out->unk_len = c->unk_len.data();
   out->unk_line = c->unk_line.data(); out->unk_n = c->o.unk_n;
@@ -923,16 +1156,20 @@ void* vnt_pump_next(void* pp, int32_t timeout_ms, ChunkDesc* out) {
   out->samples = c->o.samples;
   out->dgrams = c->dgrams;
   out->dropped = c->dropped;
+  out->reader = c->lane;
+  int64_t dwell = now_ms() - c->seal_ms;
+  out->dwell_ms = dwell > 0 ? dwell : 0;
   return c;
 }
 
 void vnt_pump_release(void* pp, void* cp) {
   Pump* p = static_cast<Pump*>(pp);
   Chunk* c = static_cast<Chunk*>(cp);
+  int32_t lane = c->lane;
   c->reset();
+  p->lanes[lane]->free_q.push(c);
   std::lock_guard<std::mutex> lock(p->mu);
-  p->free_list.push_back(c);
-  p->cv_free.notify_one();
+  p->cv_free.notify_all();  // any lane's reader may be parked
 }
 
 int64_t vnt_pump_stalls(void* pp) {

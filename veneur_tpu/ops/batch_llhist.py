@@ -119,8 +119,10 @@ def bin_batch_host(values, weights=None):
     if weights is None:
         w = np.ones(idx.shape, np.int32)
     else:
-        w = np.maximum(np.rint(np.asarray(weights, np.float64)),
-                       1.0).astype(np.int32)
+        # clip BEFORE the cast: registers are int32, and 1/rate for an
+        # absurd-but-valid rate (@1e-10) would otherwise wrap negative
+        w = np.clip(np.rint(np.asarray(weights, np.float64)),
+                    1.0, np.iinfo(np.int32).max).astype(np.int32)
     return idx, w
 
 
