@@ -37,6 +37,7 @@ METRIC_NAMES = {
     "tdigest": "tdigest_samples_per_sec",
     "mesh": "mesh_samples_per_sec",
     "mesh-worker": "mesh_samples_per_sec",
+    "resize_storm": "resize_storm_flush_p99_ratio",
 }
 
 # accumulates fields as stages complete, so the deadline guard can emit a
@@ -587,14 +588,20 @@ def run_scenario_sustained(num_keys: int = 100_000, interval_s: float = 10.0,
     if own_rig:
         packets, samples = make_packets(num_keys)
         datagrams = make_datagrams(packets)
+        # flush_async: the overlapped flush is the production shape this
+        # gate now measures — the swap is the only store work on the
+        # tick, readouts drain on the background executor, and the
+        # overlap acceptance below compares ingest rate during flush
+        # windows against the between-flush rate
         rig = UdpRig(num_keys, datagrams, samples / len(datagrams),
                      families=4, interval=interval_s,
-                     synchronize_with_interval=False)
+                     synchronize_with_interval=False, flush_async=True)
         log(f"sustained: warmup ({num_keys} keys)")
         rig.warmup()
         log("sustained: warmup done")
     server = rig.server
     flush_times = []
+    flush_windows = []  # (start, end) perf_counter stamps per flush tick
     flush_phases = []  # per-flush attribution (server.flush_phase_timings)
     # per-flush self-tracing cost counters (trace/store.py): spans
     # recorded + exemplars captured per flush, so the next BENCH round
@@ -613,7 +620,9 @@ def run_scenario_sustained(num_keys: int = 100_000, interval_s: float = 10.0,
         t0 = time.perf_counter()
         mark = _trace_mark()
         orig_flush_locked()
-        flush_times.append(time.perf_counter() - t0)
+        end = time.perf_counter()
+        flush_times.append(end - t0)
+        flush_windows.append((t0, end))
         flush_phases.append(dict(getattr(server, "flush_phase_timings", {})))
         after = _trace_mark()
         trace_marks.append((after[0] - mark[0], after[1] - mark[1]))
@@ -628,8 +637,26 @@ def run_scenario_sustained(num_keys: int = 100_000, interval_s: float = 10.0,
         log(f"sustained: offering {offered:,.0f} samples/s for "
             f"{intervals}x{interval_s:g}s")
         flush_times.clear()
+        flush_windows.clear()
+        # overlap acceptance sampler: the processed counter every 25ms,
+        # classified against the flush windows afterwards — with
+        # flush_async the during-flush ingest rate must track the
+        # between-flush rate (it used to stall behind ~1.7s of dispatch)
+        ingest_samples = []
+        sampler_stop = threading.Event()
+
+        def _sample_ingest():
+            while not sampler_stop.is_set():
+                ingest_samples.append(
+                    (time.perf_counter(), server.store.processed))
+                sampler_stop.wait(0.025)
+
+        sampler = threading.Thread(target=_sample_ingest, daemon=True)
+        sampler.start()
         off_rate, rate, elapsed = rig.blast(
             intervals * interval_s + 0.5, offered)
+        sampler_stop.set()
+        sampler.join(timeout=2)
         # let an in-flight ticker flush finish so its wall time lands
         wait_deadline = time.perf_counter() + interval_s * 2
         while (len(flush_times) < intervals
@@ -663,7 +690,29 @@ def run_scenario_sustained(num_keys: int = 100_000, interval_s: float = 10.0,
         "interval_s": interval_s,
         "offered_samples_per_sec": round(off_rate, 1),
         "sustained_keys": num_keys,
+        "flush_async": bool(server.config.flush_async),
     }
+    # overlap acceptance: ingest processed-rate inside flush windows vs
+    # between them (PR-15's pin — was gated behind the dispatch stall)
+    if len(ingest_samples) >= 3 and flush_windows:
+        def _in_flush(a, b):
+            return any(a < fe and b > fs for fs, fe in flush_windows)
+
+        dur_n = dur_t = btw_n = btw_t = 0.0
+        for (ta, pa), (tb, pb) in zip(ingest_samples, ingest_samples[1:]):
+            if _in_flush(ta, tb):
+                dur_n += pb - pa
+                dur_t += tb - ta
+            else:
+                btw_n += pb - pa
+                btw_t += tb - ta
+        if dur_t > 0 and btw_t > 0:
+            r_during = dur_n / dur_t
+            r_between = btw_n / btw_t
+            extra["ingest_rate_during_flush"] = round(r_during, 1)
+            extra["ingest_rate_between_flush"] = round(r_between, 1)
+            extra["ingest_overlap_ratio"] = round(
+                r_during / r_between, 4) if r_between else None
     if trace_marks:
         extra["trace_spans_per_flush"] = {
             "max": max(s for s, _e in trace_marks),
@@ -691,6 +740,12 @@ def run_scenario_sustained(num_keys: int = 100_000, interval_s: float = 10.0,
         # trajectory captures the phase distribution, not one outlier
         extra["flush_phase_series"] = {
             k: series([p.get(k, 0.0) for p in scalar]) for k in keys}
+        # the PR-15 acceptance row pulled out by name: join-only wall
+        # time per flush tick (excludes dispatch/sync/transfer when the
+        # readout ran on the background executor)
+        if "critical_path_s" in extra["flush_phase_series"]:
+            extra["flush_critical_path"] = \
+                extra["flush_phase_series"]["critical_path_s"]
         # per-family dispatch attribution (core/latency.py observatory):
         # per family, host dispatch vs summed per-device sync vs host
         # transfer, aggregated across the measured flushes
@@ -1268,6 +1323,132 @@ def run_scenario_mesh_worker(duration_s: float, num_keys: int) -> float:
     return samples / max(elapsed, 1e-9)
 
 
+def run_scenario_resize_storm(duration_s: float = 0.0,
+                              interval_s: float = 1.5,
+                              intervals: int = 3):
+    """PR-15 acceptance gate: flush-latency FLATNESS across capacity
+    doublings. A live ticker server with deliberately small family
+    capacities (1024 rows), the overlapped flush, and the shape-ladder
+    prewarmer takes a steady baseline (keys below capacity), then a
+    cardinality storm (scripts/cardinality_storm.py's driver, pointed
+    at the server's own UDP port) mints enough counter series to force
+    TWO capacity doublings (1024 -> 2048 -> 4096), then the baseline
+    runs again. Reports flush p99 before/during/after the storm (the
+    acceptance: during <= 1.25x pre), plus every post-resize round's
+    retrace tag — each must read prewarmed/cache-hit, never a bare
+    hot-path retrace. Returns the during/pre p99 ratio."""
+    import sys as _sys
+
+    storm_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "scripts")
+    if storm_dir not in _sys.path:
+        _sys.path.insert(0, storm_dir)
+    import cardinality_storm
+
+    from veneur_tpu.config import Config
+    from veneur_tpu.core.server import Server
+    from veneur_tpu.sinks.blackhole import BlackholeMetricSink
+
+    # built directly (not _mk_server, which floors capacities at 4096):
+    # the storm needs small rungs it can actually climb twice
+    cfg = Config()
+    cfg.interval = interval_s
+    cfg.statsd_listen_addresses = ["udp://127.0.0.1:0"]
+    cfg.flush_async = True
+    cfg.prewarm_ladder = True
+    cfg.tpu.counter_capacity = 1024
+    cfg.tpu.gauge_capacity = 1024
+    cfg.tpu.histo_capacity = 1024
+    cfg.tpu.set_capacity = 512
+    cfg.tpu.batch_cap = BATCH_CAP[0]
+    cfg.apply_defaults()
+    server = Server(cfg, extra_metric_sinks=[BlackholeMetricSink()])
+    server.start()
+    host, port = server.local_addr("udp")
+
+    flush_times = []
+    orig = server._flush_locked
+
+    def timed():
+        t0 = time.perf_counter()
+        orig()
+        flush_times.append(time.perf_counter() - t0)
+
+    server._flush_locked = timed
+
+    def storm(keys, pps, duration):
+        cardinality_storm.main([
+            "--hostport", f"udp://{host}:{port}",
+            "--name", "storm.resize", "--tag-key", "rid",
+            "--keys", str(keys), "--pps", str(pps),
+            "--duration", str(duration), "--type", "c"])
+
+    def phase(keys, label):
+        flush_times.clear()
+        storm(keys, 20_000, intervals * interval_s)
+        deadline = time.perf_counter() + interval_s * 2
+        while len(flush_times) < intervals and \
+                time.perf_counter() < deadline and time_left() > 10:
+            time.sleep(0.1)
+        times = sorted(flush_times) or [0.0]
+        p99 = times[min(len(times) - 1, int(len(times) * 0.99))]
+        log(f"resize_storm {label}: {len(times)} flushes, "
+            f"p99={p99:.3f}s")
+        return p99
+
+    try:
+        if server._warmup_thread is not None:
+            server._warmup_thread.join(timeout=120)
+        # let the initial prewarm rungs land before the baseline
+        deadline = time.perf_counter() + 60
+        while (server.prewarmer is not None
+               and server.prewarmer.compiled_total < 4
+               and time.perf_counter() < deadline and time_left() > 30):
+            time.sleep(0.2)
+        pre_p99 = phase(800, "pre-storm")       # below capacity: no resize
+        cap0 = server.store.counters.capacity
+        during_p99 = phase(3600, "storm")       # forces two doublings
+        cap1 = server.store.counters.capacity
+        post_p99 = phase(800, "post-storm")
+    finally:
+        server._flush_locked = orig
+        server.config.flush_on_shutdown = False
+        server.shutdown()
+
+    doublings = 0
+    c = cap0
+    while c < cap1:
+        c *= 2
+        doublings += 1
+    # every post-resize round's retrace tag, straight off the recorder
+    retrace_tags = []
+    for r in server.telemetry.flushes.snapshot():
+        for fam, rec in (r.get("families") or {}).items():
+            if rec.get("retrace"):
+                retrace_tags.append({
+                    "family": fam,
+                    "recompile_s": rec.get("recompile_s"),
+                    "compile_cache": rec.get("compile_cache")})
+    prewarmed_ok = bool(retrace_tags) and all(
+        t["compile_cache"] in ("prewarmed", "hit")
+        for t in retrace_tags)
+    ratio = during_p99 / pre_p99 if pre_p99 > 0 else 0.0
+    RESULT.update(
+        resize_storm_flush_p99_pre_s=round(pre_p99, 4),
+        resize_storm_flush_p99_during_s=round(during_p99, 4),
+        resize_storm_flush_p99_post_s=round(post_p99, 4),
+        resize_storm_capacity=f"{cap0}->{cap1}",
+        resize_storm_doublings=doublings,
+        resize_storm_retrace_tags=retrace_tags,
+        resize_storm_prewarmed_ok=prewarmed_ok,
+        resize_storm_flat=bool(pre_p99 and ratio <= 1.25))
+    log(f"resize_storm: capacity {cap0}->{cap1} ({doublings} doublings), "
+        f"p99 pre={pre_p99:.3f}s during={during_p99:.3f}s "
+        f"post={post_p99:.3f}s ratio={ratio:.2f} "
+        f"prewarmed_ok={prewarmed_ok}")
+    return ratio
+
+
 def run_scenario_hll(duration_s: float, num_keys: int = 10_000,
                      cardinality: int = 100):
     """BASELINE config 3: mixed keys at tag cardinality 100 — HLL stress
@@ -1287,7 +1468,7 @@ def run_scenario_hll(duration_s: float, num_keys: int = 10_000,
 
 SCENARIOS = ["default", "mixed", "single", "counter", "timers", "hll",
              "llhist", "forward", "ssf", "device", "sustained", "tdigest",
-             "mesh", "mesh-worker"]
+             "mesh", "mesh-worker", "resize_storm"]
 
 
 def clamp_keys(keys: int, on_tpu: bool) -> int:
@@ -1365,6 +1546,8 @@ def run_one(scenario: str, duration: float, keys: int, on_tpu: bool = True):
         rate = run_scenario_mesh(duration, min(keys, 2000))
     elif scenario == "mesh-worker":
         rate = run_scenario_mesh_worker(duration, min(keys, 2000))
+    elif scenario == "resize_storm":
+        rate = run_scenario_resize_storm(duration)
     else:
         rate = run_scenario_ssf(duration, keys)
     return metric, rate, extra
@@ -1397,7 +1580,8 @@ def run_default(args, on_tpu: bool) -> None:
             datagrams = make_datagrams(packets)
             rig = UdpRig(keys, datagrams, samples / len(datagrams),
                          families=4, interval=interval_s,
-                         synchronize_with_interval=False)
+                         synchronize_with_interval=False,
+                         flush_async=True)
             log(f"pipeline: warmup (intern {keys} keys + compile)")
             rig.warmup()
             log("pipeline: warmup done; ticker live")

@@ -1,0 +1,489 @@
+"""Overlapped flush & shape ladder (the `flushperf` marker).
+
+The double-buffered flush (`flush_async`) swaps each family's device
+generation at the interval boundary and runs the readout on a
+background executor, delivering the PREVIOUS interval's joined readout
+each tick. These tests pin the contract that makes that safe to ship:
+
+- exactness: the overlapped flush's output is bit-identical to the
+  synchronous flush for all five families (values, tags, llhist bins,
+  HLL registers), single-device AND on the virtual mesh;
+- the recycled (donated, re-initialized) spare generation is
+  indistinguishable from a fresh allocation — interval N+1 over the
+  recycled buffers equals interval N over fresh ones, including the
+  t-digest ±inf min/max re-init;
+- the ledger stays strict-clean through the overlap, with the
+  in-flight snapshot booked as the `flush_inflight_snapshot` stock;
+- shutdown (the SIGUSR2 handoff's drain) joins and delivers the
+  in-flight snapshot — nothing is lost at the seam, and in WAL mode
+  the snapshot reaches disk before the process exits;
+- the waterfall renders the overlapped shape (async lane, join-only
+  `critical_path_s`) and async `flush.family` spans parent under the
+  originating interval's flush trace;
+- a prewarmed capacity rung's post-resize round tags
+  `prewarmed`/`compile_cache` instead of paying a hot-path retrace,
+  and the cold (un-prewarmed) fallback stays correct.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from veneur_tpu.config import Config
+from veneur_tpu.core.columnstore import ColumnStore, CounterTable
+from veneur_tpu.core.flusher import (flush_columnstore_batch,
+                                     readout_columnstore,
+                                     swap_columnstore)
+from veneur_tpu.core.server import Server
+from veneur_tpu.samplers.metrics import HistogramAggregates
+from veneur_tpu.samplers.parser import Parser
+from veneur_tpu.sinks.channel import ChannelMetricSink
+
+pytestmark = pytest.mark.flushperf
+
+PCTS = (0.5, 0.99)
+AGGS = HistogramAggregates.from_names(
+    ["min", "max", "median", "avg", "count", "sum"])
+
+
+def wait_until(fn, timeout=10.0, step=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(step)
+    return False
+
+
+def corpus(round_no: int = 0):
+    lines = []
+    for i in range(8):
+        lines.append(b"c.%d:%d|c|#env:t" % (i, i + 1 + round_no))
+        lines.append(b"g.%d:%.2f|g" % (i, i * 1.5 + round_no))
+        lines.append(b"t.%d:%.2f|ms" % (i, 10.0 + i + round_no))
+        lines.append(b"t.%d:%.2f|ms" % (i, 40.0 + i))
+        lines.append(b"s.%d:m%d|s" % (i, i))
+        lines.append(b"s.%d:m%d|s" % (i, i + 50 + round_no))
+        lines.append(b"ll.%d:%.2f|l" % (i, 3.0 + i + round_no))
+    lines.append(b"sc.ok:0|sc")
+    return lines
+
+
+def _mk_store(**kw):
+    kw.setdefault("counter_capacity", 64)
+    kw.setdefault("gauge_capacity", 64)
+    kw.setdefault("histo_capacity", 64)
+    kw.setdefault("set_capacity", 32)
+    kw.setdefault("llhist_capacity", 64)
+    kw.setdefault("batch_cap", 128)
+    return ColumnStore(**kw)
+
+
+def _feed(store, lines):
+    p = Parser()
+    for line in lines:
+        p.parse_metric_fast(line, store.process)
+    store.apply_all_pending()
+
+
+def _batch_keys(batch):
+    return sorted(
+        (m.name, float(m.value), tuple(sorted(m.tags)), int(m.type))
+        for m in batch.materialize())
+
+
+def _fwd_keys(fwd):
+    """Bit-level ForwardableState fingerprint: scalar values exact,
+    llhist bins and HLL registers compared register-for-register."""
+    return {
+        "counters": sorted((m.name, v) for m, v in fwd.counters),
+        "gauges": sorted((m.name, v) for m, v in fwd.gauges),
+        "histos": sorted(
+            (m.name, means.tobytes(), weights.tobytes(), lo, hi, recip)
+            for m, means, weights, lo, hi, recip in fwd.histograms),
+        "sets": sorted((m.name, np.asarray(regs).tobytes())
+                       for m, regs in fwd.sets),
+        "llhists": sorted((m.name, np.asarray(bins).tobytes())
+                          for m, bins in fwd.llhists),
+    }
+
+
+def _overlapped_flush(store, is_local, collect_forward=True):
+    """Swap on this thread (the interval boundary), read out on a
+    background thread while this thread keeps ingesting — the exact
+    overlap shape the server runs under flush_async."""
+    swap = swap_columnstore(store, is_local, PCTS,
+                            collect_forward=collect_forward)
+    result = {}
+
+    def _readout():
+        result["out"] = readout_columnstore(
+            store, swap, is_local, AGGS,
+            collect_forward=collect_forward)
+
+    t = threading.Thread(target=_readout)
+    t.start()
+    # ingest the NEXT interval concurrently with the readout
+    _feed(store, corpus(round_no=7))
+    t.join(30.0)
+    assert not t.is_alive()
+    return result["out"]
+
+
+class TestOverlapExactness:
+    @pytest.mark.parametrize("is_local", [False, True])
+    def test_async_bit_identical_single_device(self, is_local):
+        """Overlapped flush == synchronous flush, all five families,
+        for both server modes — AND the recycled spare generation's
+        second interval equals a fresh store's."""
+        sync_store, async_store = _mk_store(), _mk_store()
+        _feed(sync_store, corpus())
+        _feed(async_store, corpus())
+        sync_batch, sync_fwd = flush_columnstore_batch(
+            sync_store, is_local, PCTS, AGGS)
+        async_batch, async_fwd = _overlapped_flush(async_store, is_local)
+        assert _batch_keys(async_batch) == _batch_keys(sync_batch)
+        assert _fwd_keys(async_fwd) == _fwd_keys(sync_fwd)
+        # interval 2: the async store now flushes over RECYCLED
+        # (donated, re-initialized) generations; feed the sync store
+        # the same second-interval corpus and compare again
+        _feed(sync_store, corpus(round_no=7))
+        sync2, sfwd2 = flush_columnstore_batch(
+            sync_store, is_local, PCTS, AGGS)
+        async2, afwd2 = flush_columnstore_batch(
+            async_store, is_local, PCTS, AGGS)
+        assert _batch_keys(async2) == _batch_keys(sync2)
+        assert _fwd_keys(afwd2) == _fwd_keys(sfwd2)
+
+    @pytest.mark.mesh
+    def test_async_bit_identical_on_mesh(self):
+        """The overlapped flush over the sharded mesh store (stacked
+        donated merges) matches the single-device synchronous flush
+        bit-for-bit — the PR-11 exactness pin survives the overlap."""
+        single = _mk_store()
+        mesh_store = _mk_store(shard_devices=2)
+        assert mesh_store.shard_plane is not None, "virtual mesh missing"
+        _feed(single, corpus())
+        _feed(mesh_store, corpus())
+        sync_batch, sync_fwd = flush_columnstore_batch(
+            single, True, PCTS, AGGS)
+        async_batch, async_fwd = _overlapped_flush(mesh_store, True)
+        assert _batch_keys(async_batch) == _batch_keys(sync_batch)
+        assert _fwd_keys(async_fwd) == _fwd_keys(sync_fwd)
+        # second interval over the recycled stacked generations
+        _feed(single, corpus(round_no=7))
+        sync2, sfwd2 = flush_columnstore_batch(single, True, PCTS, AGGS)
+        async2, afwd2 = flush_columnstore_batch(mesh_store, True, PCTS,
+                                                AGGS)
+        assert _batch_keys(async2) == _batch_keys(sync2)
+        assert _fwd_keys(afwd2) == _fwd_keys(sfwd2)
+
+
+# -------------------------------------------------------------------------
+# Server pipeline: delivery cadence, ledger, waterfall, drain
+# -------------------------------------------------------------------------
+
+
+def mk_server(**kw):
+    cfg = Config()
+    cfg.interval = 60.0
+    cfg.hostname = "test"
+    cfg.statsd_listen_addresses = []
+    cfg.tpu.counter_capacity = 128
+    cfg.tpu.gauge_capacity = 128
+    cfg.tpu.histo_capacity = 128
+    cfg.tpu.set_capacity = 64
+    cfg.tpu.llhist_capacity = 64
+    cfg.tpu.batch_cap = 512
+    cfg.ledger_strict = True
+    for k, v in kw.items():
+        if "." in k:
+            ns, field = k.split(".", 1)
+            setattr(getattr(cfg, ns), field, v)
+        else:
+            setattr(cfg, k, v)
+    cfg.apply_defaults()
+    obs = ChannelMetricSink()
+    return Server(cfg, extra_metric_sinks=[obs]), obs
+
+
+def _server_feed(server, lines):
+    for line in lines:
+        server.handle_metric_packet(line)
+    server.store.apply_all_pending()
+
+
+def _obs_keys(metrics):
+    return sorted((m.name, float(m.value), tuple(sorted(m.tags)),
+                   int(m.type)) for m in metrics)
+
+
+class TestServerPipeline:
+    def test_async_delivers_previous_interval_strict_ledger(self):
+        """Tick N delivers interval N-1's readout bit-identically to a
+        synchronous server, the first tick delivers nothing, and
+        ledger_strict stays green through the overlap (the in-flight
+        snapshot is stock, not loss)."""
+        sync_server, sync_obs = mk_server(flush_async=False)
+        async_server, async_obs = mk_server(flush_async=True)
+        try:
+            _server_feed(sync_server, corpus())
+            sync_server.flush()
+            sync_metrics = sync_obs.drain()
+            assert sync_metrics
+
+            _server_feed(async_server, corpus())
+            async_server.flush()  # tick 1: swap + submit, no delivery
+            assert async_obs.drain() == []
+            # while interval 1's readout drains in the background, the
+            # inflight stock is visible to the ledger
+            assert async_server._inflight_flushes
+            assert async_server._inflight_rows > 0
+            wait_until(lambda: async_server._inflight_flushes[0]["pending"].done())
+            async_server.flush()  # tick 2: joins + delivers interval 1
+            got = async_obs.drain()
+            assert _obs_keys(got) == _obs_keys(sync_metrics)
+            ri = async_server.telemetry.flushes.snapshot()[-1]
+            assert ri["async"] is True
+            assert ri["delivered_flush"] == 1
+            # critical path excludes the dispatch/sync/transfer phases
+            # by construction: they ran on the executor thread
+            assert "critical_path_s" in ri["phases"]
+            assert ri["ledger"] == {} or all(
+                abs(v) < 1e-6 for v in ri["ledger"].values())
+        finally:
+            sync_server.config.flush_on_shutdown = False
+            async_server.config.flush_on_shutdown = False
+            sync_server.shutdown()
+            async_server.shutdown()
+
+    def test_waterfall_renders_async_lane(self):
+        server, obs = mk_server(flush_async=True)
+        try:
+            from veneur_tpu.core.latency import waterfall_rounds
+            _server_feed(server, corpus())
+            server.flush()
+            wait_until(lambda: server._inflight_flushes[0]["pending"].done())
+            server.flush()
+            rounds = waterfall_rounds(server.telemetry.flushes.snapshot())
+            tree = rounds[-1]
+            assert tree["async_readout"] is True
+            assert tree["delivered_flush"] == 1
+            assert tree["critical_path_s"] >= 0.0
+            assert tree["families"]
+            for rec in tree["families"].values():
+                assert rec["lane"] == "async"
+            # the segments-sum pin holds for the overlapped shape too:
+            # segments AND phase totals come from the same readout
+            assert tree["segments_sum_s"] <= tree["device_total_s"] * 1.10
+        finally:
+            server.config.flush_on_shutdown = False
+            server.shutdown()
+
+    def test_async_family_spans_parent_under_origin_interval(self):
+        """PR-9 single-root pin under overlap: the async readout's
+        flush.family spans land in the ORIGINATING interval's trace,
+        parented under its flush span — not the delivering tick's."""
+        server, obs = mk_server(flush_async=True)
+        try:
+            _server_feed(server, corpus())
+            server.flush()
+            tid1 = server.telemetry.flushes.snapshot()[-1].get("trace_id")
+            assert tid1
+            wait_until(lambda: server._inflight_flushes[0]["pending"].done())
+            server.flush()
+            trace = server.trace_plane.store.get(int(tid1, 16))
+            spans = trace["spans"]
+            assert len(trace["roots"]) == 1  # PR-9 single-root pin
+            root = next(s for s in spans
+                        if s["span_id"] == trace["roots"][0])
+            assert root["name"] == "flush"
+            fam_spans = [s for s in spans if s["name"] == "flush.family"]
+            assert fam_spans, "async flush.family spans missing"
+            for s in fam_spans:
+                assert s["parent_id"] == root["span_id"]
+        finally:
+            server.config.flush_on_shutdown = False
+            server.shutdown()
+
+    def test_shutdown_drains_inflight_and_final_interval(self):
+        """The drain seam (shutdown / SIGUSR2 handoff): an in-flight
+        async readout AND the just-swapped final interval both deliver
+        before the process exits."""
+        server, obs = mk_server(flush_async=True,
+                                flush_on_shutdown=True)
+        sync_server, sync_obs = mk_server(flush_async=False)
+        try:
+            _server_feed(sync_server, corpus())
+            sync_server.flush()
+            want = _obs_keys(sync_obs.drain())
+
+            _server_feed(server, corpus())
+            server.flush()  # interval 1 swapped, readout in flight
+            assert server._inflight_flushes
+            _server_feed(server, corpus(round_no=3))
+        finally:
+            sync_server.config.flush_on_shutdown = False
+            sync_server.shutdown()
+            server.shutdown()
+        got = obs.drain()
+        assert _obs_keys([m for m in got])  # both intervals landed
+        # interval 1's metrics are exactly the sync server's
+        names = {m.name for m in got}
+        assert {n for n, *_ in want} <= names
+        # and the final interval's distinct corpus landed too
+        assert len(got) > len(want) / 2
+
+    def test_shutdown_drain_reaches_wal(self, tmp_path):
+        """WAL mode + dead upstream: the handoff drain appends the
+        in-flight snapshot to the on-disk WAL before exiting — a crash
+        after shutdown loses nothing (PR-10's replay picks it up)."""
+        from veneur_tpu.forward.client import ForwardClient
+        from veneur_tpu.util.resilience import CircuitBreaker, RetryPolicy
+        from veneur_tpu.util.spool import CarryoverSpool
+
+        server, obs = mk_server(flush_async=True, forward_only=True,
+                                forward_address="127.0.0.1:1")
+        spool = CarryoverSpool(str(tmp_path))
+        client = ForwardClient(  # dead upstream: WAL append still lands
+            "127.0.0.1:1", deadline=3.0, spool=spool, wal=True,
+            retry=RetryPolicy(max_attempts=1),
+            breaker=CircuitBreaker(failure_threshold=10_000, name="t"))
+        server.forwarder = client.forward
+        server.forward_client = client
+        # the stocks start() would have registered: the strict forward
+        # identity must see WAL-spooled metrics as inventory
+        server.ledger.stock("forward_carryover",
+                            lambda: client.carryover.pending_metrics)
+        server.ledger.stock("forward_inflight",
+                            lambda: client.inflight_metrics)
+        server.ledger.stock("forward_spool",
+                            lambda: spool.pending_metrics)
+        server.ledger.stock("spool_quarantine",
+                            lambda: spool.quarantined_metrics)
+        try:
+            _server_feed(server, corpus())
+            server.flush()  # swap + submit; nothing on disk yet
+            assert server._inflight_flushes
+        finally:
+            server.config.flush_on_shutdown = False
+            server.shutdown()  # drain: join + deliver -> WAL append
+            client.close()
+        assert client.wal_appended_metrics > 0
+        assert spool.depth >= 1  # durable, awaiting replay
+
+
+# -------------------------------------------------------------------------
+# Shape-ladder prewarm
+# -------------------------------------------------------------------------
+
+
+class TestShapeLadder:
+    def _force_resize(self, table, parser, n=80):
+        for i in range(n):
+            parser.parse_metric_fast(b"pw.%d:1|c" % i, table.add)
+        table.apply_pending()
+
+    def test_prewarmed_resize_tags_and_stays_correct(self):
+        """A prewarmed rung's post-resize apply reports prewarmed=True
+        through the resize hook (the waterfall tag), and the values
+        coming out of the resized table are exact."""
+        store = _mk_store(counter_capacity=64)
+        table = store.counters
+        events = []
+        table.on_resize = lambda *a, **kw: events.append((a, kw))
+        assert table.prewarm_rung(128, PCTS)
+        assert 128 in table._prewarmed_caps
+        self._force_resize(table, Parser())
+        recompiles = [kw for a, kw in events
+                      if kw.get("kind") == "recompile"]
+        assert recompiles and recompiles[0]["prewarmed"] is True
+        vals, touched, meta = table.snapshot_and_reset()
+        got = {meta[r].name: vals[r] for r in np.flatnonzero(touched)}
+        assert got == {f"pw.{i}": 1.0 for i in range(80)}
+
+    def test_cold_resize_fallback_still_correct(self):
+        """Without prewarm the resize retraces on the hot path (the
+        pre-ladder behavior): tagged prewarmed=False, values exact."""
+        store = _mk_store(counter_capacity=64)
+        table = store.counters
+        events = []
+        table.on_resize = lambda *a, **kw: events.append((a, kw))
+        self._force_resize(table, Parser())
+        recompiles = [kw for a, kw in events
+                      if kw.get("kind") == "recompile"]
+        assert recompiles and recompiles[0]["prewarmed"] is False
+        vals, touched, meta = table.snapshot_and_reset()
+        assert len(np.flatnonzero(touched)) == 80
+
+    def test_prewarmer_thread_compiles_queued_rungs(self):
+        """ShapeLadderPrewarmer end to end: initial prewarm queues 2x
+        rungs for every device family; a resize event queues the rung
+        after; every compile lands in the table's prewarmed set."""
+        from veneur_tpu.core.flushexec import ShapeLadderPrewarmer
+
+        store = _mk_store()
+        events = []
+        pw = ShapeLadderPrewarmer(
+            store, percentiles=PCTS, need_export=True,
+            on_event=lambda kind, **kw: events.append((kind, kw)))
+        pw.start()
+        try:
+            pw.prewarm_initial()
+            assert wait_until(
+                lambda: 128 in store.counters._prewarmed_caps
+                and 128 in store.gauges._prewarmed_caps
+                and 128 in store.histos._prewarmed_caps
+                and 128 in store.llhists._prewarmed_caps, timeout=60.0)
+            # the sparse set table's rung prewarm is a documented no-op
+            assert not store.sets._prewarmed_caps
+            pw.note_resize("counter", 128)
+            assert wait_until(
+                lambda: 256 in store.counters._prewarmed_caps,
+                timeout=60.0)
+            assert pw.compiled_total >= 5
+            rows = {name: v for name, _k, v, _t in pw.telemetry_rows()}
+            assert rows["prewarm.compiled_total"] >= 5
+        finally:
+            pw.stop()
+
+    def test_server_recompile_event_reads_prewarmed(self):
+        """Server-side tag plumbing: a prewarmed recompile lands in the
+        flight recorder + retrace cache as prewarmed (the waterfall's
+        `compile_cache: prewarmed` tag the acceptance reads)."""
+        server, obs = mk_server()
+        try:
+            server._store_resize("counter", 64, 128, 0.01, kind="resize")
+            server._store_resize("counter", 64, 128, 0.002,
+                                 kind="recompile", prewarmed=True)
+            events = [e for e in server.telemetry.events.snapshot()
+                      if e["kind"] == "columnstore_recompile"]
+            assert events and events[-1]["prewarmed"] is True
+            assert events[-1].get("compile_cache") in ("prewarmed", "hit")
+            drained = server.latency.drain_retraces()
+            secs, cache = drained["counter"]
+            assert cache in ("prewarmed", "hit")
+        finally:
+            server.config.flush_on_shutdown = False
+            server.shutdown()
+
+
+class TestReadoutExecutor:
+    def test_join_reraises_and_survives(self):
+        from veneur_tpu.core.flushexec import FlushReadoutExecutor
+
+        beats = []
+        ex = FlushReadoutExecutor(beat=beats.append)
+        try:
+            boom = ex.submit(lambda: 1 / 0)
+            with pytest.raises(ZeroDivisionError):
+                boom.result(5.0)
+            ok = ex.submit(lambda: 42)
+            assert ok.result(5.0) == 42
+            assert beats  # supervisor heartbeats flowed
+        finally:
+            ex.stop()

@@ -31,6 +31,7 @@ import logging
 import threading
 import time
 from dataclasses import dataclass, field
+from functools import partial
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -47,6 +48,38 @@ logger = logging.getLogger("veneur_tpu.core.columnstore")
 # pending-buffer padding marker: any out-of-range row is dropped by the
 # scatter kernels (mode="drop"), independent of table capacity
 PAD_ROW = np.int32(2**31 - 1)
+
+
+@partial(jax.jit, donate_argnums=0)
+def _zeros_like_donated(tree):
+    """Zero a drained interval generation IN PLACE (buffer donation —
+    the SNIPPETS pjit donation vectors): the returned fresh generation
+    aliases the donated input's buffers, so the double-buffered flush
+    ping-pongs two device allocations per family instead of allocating
+    a new interval state every flush."""
+    return jax.tree.map(jnp.zeros_like, tree)
+
+
+def _zeros_like_spare(captured):
+    """Donate-and-zero one captured generation — a state pytree, or a
+    per-device list of them (the sharded histo/set tables), which must
+    zero per device because one jit call cannot mix committed devices."""
+    if isinstance(captured, list):
+        return [_zeros_like_donated(st) for st in captured]
+    return _zeros_like_donated(captured)
+
+
+@partial(jax.jit, donate_argnums=0)
+def _reset_tdigest_donated(state):
+    """Donated t-digest generation reset: rebuilds init_state's values
+    (±inf min/max, zero grids) in the donated buffers."""
+    return batch_tdigest.init_state(state["wv"].shape[0])
+
+
+def _reset_tdigest_spare(captured):
+    if isinstance(captured, list):
+        return [_reset_tdigest_donated(st) for st in captured]
+    return _reset_tdigest_donated(captured)
 
 
 @dataclass
@@ -165,6 +198,16 @@ class _BaseTable:
         self.scope_code = np.full(capacity, -1, np.int8)
         self._tags_cache = np.empty(capacity, object)
         self._flush_name_cache: Dict[object, np.ndarray] = {}
+        # double-buffered flush: the recycled (already-zeroed) device
+        # generation the next swap_out installs, and the capacity it was
+        # shaped for (a resize in between invalidates it). Guarded by
+        # apply_lock.
+        self._spare = None
+        self._spare_cap = -1
+        # capacities whose kernels the shape-ladder prewarmer has
+        # already compiled (core/flushexec.py): the post-resize
+        # recompile probe reads this to tag the round prewarmed
+        self._prewarmed_caps = set()
         self._init_arrays()
 
     # subclasses define _init_arrays / _grow_arrays / _apply_cols / reset
@@ -224,7 +267,8 @@ class _BaseTable:
                 if hook is not None:
                     try:
                         hook(self.family, self.capacity, self.capacity,
-                             elapsed, kind="recompile")
+                             elapsed, kind="recompile",
+                             prewarmed=self.capacity in self._prewarmed_caps)
                     except Exception:
                         logger.exception("resize hook failed")
             else:
@@ -233,6 +277,193 @@ class _BaseTable:
         finally:
             self.apply_lock.release()
             self.lock.acquire()
+
+    # -- two-phase flush: critical-path swap / background readout --------
+    #
+    # The flush used to be one synchronous pass: swap pending columns,
+    # dispatch the readout kernels, sync, transfer — all on the flush
+    # loop's critical path, with ingest applies blocked on apply_lock
+    # for the full dispatch window (~1.7s of `dispatch_s` at the 100k
+    # shape, BENCH_r05). The split below makes the interval boundary a
+    # pure generation swap:
+    #
+    #   swap_out()   O(1) under the table locks: swap the pending
+    #                columns out, capture touched/meta, capture the live
+    #                device generation and install a fresh one (the
+    #                recycled spare when capacity still matches). NO
+    #                device dispatch — ingest continues into the fresh
+    #                generation the moment the locks drop.
+    #   readout()    lock-free on the CAPTURED generation (it is private
+    #                to the snapshot): apply the final pending columns,
+    #                dispatch the readout kernels. Runs on the server's
+    #                background flush executor when `flush_async` is on.
+    #   snapshot_finish()  transfer + host assembly (unchanged).
+    #   recycle()    after the transfer: donate the drained generation
+    #                to the zeroing kernel and park it as the spare —
+    #                the second buffer of the double-buffer.
+
+    def swap_out(self, **kw) -> dict:
+        """Critical-path flush half: swap this table's interval out with
+        no device work. Extra kwargs ride into the snap (family readout
+        parameters: ps, need_export, need_bins)."""
+        snap = dict(kw)
+        with self.lock:
+            if self._idle_swap_locked(snap):
+                return snap
+            snap["cols"] = self._swap_locked()
+            with self.apply_lock:
+                self._note_generation_locked()
+                snap["touched"] = self.touched.copy()
+                snap["meta"] = list(self.meta)
+                self.touched[:] = False
+                self._swap_extras_locked(snap)
+                snap["state"] = self._swap_device_locked()
+                snap["cap"] = self._state_capacity()
+        return snap
+
+    def _idle_swap_locked(self, snap: dict) -> bool:
+        """Family-specific idle fast path (caller holds ``lock``):
+        return True to skip the generation swap entirely (the llhist
+        table skips its capacity-proportional readout when untouched)."""
+        return False
+
+    def _swap_extras_locked(self, snap: dict) -> None:
+        """Capture family-specific host-side interval state into the
+        snap and reset it (caller holds ``lock`` + ``apply_lock``)."""
+
+    def _swap_device_locked(self):
+        """Capture the live device generation and install a fresh one
+        (caller holds ``apply_lock``). The recycled spare is used when
+        its capacity still matches — a resize in between falls back to
+        a fresh allocation."""
+        captured = self.state
+        spare, self._spare = self._spare, None
+        if spare is not None and self._spare_cap == self._state_capacity():
+            self.state = spare
+        else:
+            self.state = self._fresh_state()
+        return captured
+
+    def _state_capacity(self) -> int:
+        """Key-axis capacity the device state is shaped for (the set
+        table's dense bank rides its own slot ladder)."""
+        return self.capacity
+
+    def _reset_state_donated(self, captured):
+        """Donate the drained generation into a kernel that rewrites its
+        buffers to the family's INIT values. Zeros for most families;
+        the t-digest table overrides (its min/max fields initialize to
+        ±inf, which zeros would corrupt into fabricated 0.0 extrema)."""
+        return _zeros_like_spare(captured)
+
+    def _fresh_state(self):
+        return self._fresh_state_at(self._state_capacity())
+
+    def _fresh_state_at(self, capacity: int):
+        raise NotImplementedError
+
+    def readout(self, snap: dict) -> dict:
+        """Background flush half: apply the snap's final pending columns
+        to the captured generation and dispatch its readout kernels.
+        Touches no live table state beyond monotonic telemetry counters,
+        so it needs no locks and may run concurrently with ingest."""
+        if "state" not in snap:
+            return snap  # idle fast path: nothing was swapped
+        state = snap.pop("state")
+        cols = snap.pop("cols")
+        if cols is not None:
+            state = self._readout_apply(state, cols, snap)
+        self._readout_device(state, snap)
+        return snap
+
+    def _readout_apply(self, state, cols, snap: dict):
+        return self._apply_cols_state(state, cols)
+
+    def _readout_device(self, state, snap: dict) -> None:
+        raise NotImplementedError
+
+    def _finish_and_recycle(self, snap: dict):
+        """snapshot_finish + recycle in the order the donation protocol
+        requires (transfer first, then donate the drained generation) —
+        the one place the invariant lives for every snapshot_and_reset."""
+        out = self.snapshot_finish(snap)
+        self.recycle(snap)
+        return out
+
+    def recycle(self, snap: dict) -> None:
+        """Donate the drained snapshot's device generation back as the
+        next spare (call only after snapshot_finish — the zeroing kernel
+        consumes the buffers the transfer just read). Sharded merges
+        produce an already-zeroed generation (`_spare`) from their fused
+        merge+reset kernel; everything else zero-donates the captured
+        state (`_recycle`)."""
+        cap = snap.pop("cap", -1)
+        spare = snap.pop("_spare", None)
+        captured = snap.pop("_recycle", None)
+        if spare is None and captured is not None:
+            try:
+                spare = self._reset_state_donated(captured)
+            except Exception:
+                logger.exception("%s generation recycle failed",
+                                 self.family)
+                return
+        if spare is None:
+            return
+        with self.apply_lock:
+            if cap == self._state_capacity() and self._spare is None:
+                self._spare = spare
+                self._spare_cap = cap
+
+    # -- shape-ladder prewarm --------------------------------------------
+
+    def prewarm_rung(self, capacity: int, percentiles=(),
+                     need_export: bool = True) -> bool:
+        """Compile this family's batch-apply, readout, and zeroing
+        kernels for a FUTURE capacity rung against a throwaway state
+        (background thread; never touches live state or locks). The jit
+        caches — and the persistent compilation cache — are
+        process-global, so the first post-resize dispatch at this
+        capacity finds them warm instead of retracing on the hot path.
+        Returns True when the rung was compiled."""
+        cols = self._prewarm_cols()
+        if cols is None:
+            return False
+        state = self._fresh_state_at(capacity)
+        state = self._prewarm_apply(state, cols, capacity)
+        out = self._prewarm_readout(state, capacity, tuple(percentiles),
+                                    need_export)
+        jax.block_until_ready([leaf for leaf in jax.tree.leaves(out)
+                               if leaf is not None])
+        self._prewarmed_caps.add(capacity)
+        return True
+
+    def _prewarm_cols(self):
+        """An all-padding pending batch with the live buffer dtypes
+        (None = family has no batch apply to prewarm)."""
+        pcols = getattr(self, "_pcols", None)
+        if not pcols:
+            return None
+        return (np.full(self.batch_cap, PAD_ROW, np.int32),) + tuple(
+            np.zeros(self.batch_cap, c.dtype) for c in pcols[1:])
+
+    def _prewarm_apply(self, state, cols, capacity: int):
+        return self._apply_cols_state(state, cols)
+
+    def _prewarm_readout(self, state, capacity: int, ps: tuple,
+                         need_export: bool):
+        """Dispatch the family's flush-readout + zeroing kernels for the
+        rung; returns device handles to block on. Base: the zeroing
+        kernel only (scalar families read out by pure transfer)."""
+        return _zeros_like_spare(state)
+
+    def _apply_cols_state(self, state, cols):
+        """Pure batch apply: fold one swapped pending-column batch into
+        `state` and return it. The live path (`_apply_cols`) targets
+        self.state; the flush readout targets the captured generation."""
+        raise NotImplementedError
+
+    def _apply_cols(self, cols):
+        self.state = self._apply_cols_state(self.state, cols)
 
     def row_for(self, metric: UDPMetric) -> int:
         # scope is part of row identity: the reference keeps separate maps
@@ -438,6 +669,10 @@ class _BaseTable:
         # _grow_arrays re-lays-out the device state, so it needs the state
         # lock; caller already holds the buffer lock (correct lock order)
         with self.apply_lock:
+            # the recycled spare generation is shaped for the OLD
+            # capacity; drop it rather than let a stale swap install it
+            self._spare = None
+            self._spare_cap = -1
             self._grow_arrays(new_cap)
         old_cap, self.capacity = self.capacity, new_cap
         # capacity doublings are permanent HBM growth AND a pending jit
@@ -525,11 +760,14 @@ class CounterTable(_BaseTable):
             if self._n >= self.batch_cap:
                 self._dispatch_pending_locked()
 
-    def _apply_cols(self, cols):
+    def _apply_cols_state(self, state, cols):
         # cols are copies: execution is async and jax may alias numpy
         # buffers zero-copy, while the live buffers are refilled immediately
         rows, vals, rates = cols
-        self.state = scalars.apply_counters(self.state, rows, vals, rates)
+        return scalars.apply_counters(state, rows, vals, rates)
+
+    def _fresh_state_at(self, capacity: int):
+        return scalars.init_counters(capacity)
 
     def apply_pending(self):
         with self.lock:
@@ -563,39 +801,23 @@ class CounterTable(_BaseTable):
                 self._import_acc = grown
             np.add.at(self._import_acc, rows, np.asarray(vals, np.float64))
 
-    def snapshot_begin(self) -> dict:
-        """Dispatch-only half of snapshot_and_reset: swap + apply pending,
-        capture the pre-reset device arrays, reset state — but do NOT
-        transfer. The flusher begins every table first, then pays the
-        device sync once for all of them (over a remote device link the
-        per-table sync was a serialized round-trip each)."""
-        with self.lock:
-            cols = self._swap_locked()
-            self.apply_lock.acquire()
-            self._note_generation_locked()
-            touched = self.touched.copy()
-            meta = list(self.meta)
-            import_acc = self._import_acc
-            self._import_acc = np.zeros(self.capacity, np.float64)
-            self.touched[:] = False
-        # apply + reset happen outside the buffer lock: samples arriving
-        # during the flush land in the fresh buffers / next-interval state
-        try:
-            if cols is not None:
-                self._apply_cols(cols)
-            dev = self._capture_and_reset()
-        finally:
-            self.apply_lock.release()
-        return {"dev": dev, "import_acc": import_acc,
-                "touched": touched, "meta": meta}
+    def _swap_extras_locked(self, snap: dict) -> None:
+        snap["import_acc"] = self._import_acc
+        self._import_acc = np.zeros(self.capacity, np.float64)
 
-    def _capture_and_reset(self):
-        """Grab the interval's device handles and swap in fresh state
-        (caller holds apply_lock). The sharded table overrides this with
-        the collective shard merge."""
-        dev = (self.state["sum"], self.state["comp"])
-        self.state = scalars.init_counters(self.capacity)
-        return dev
+    def _readout_device(self, state, snap: dict) -> None:
+        """Counter readout is a pure transfer of the Kahan pair; the
+        sharded table overrides this with the collective merge. The
+        captured generation is recycled after the transfer."""
+        snap["dev"] = (state["sum"], state["comp"])
+        snap["_recycle"] = state
+
+    def snapshot_begin(self) -> dict:
+        """Dispatch half of snapshot_and_reset: swap + readout, but do
+        NOT transfer. The flusher begins every table first, then pays
+        the device sync once for all of them (over a remote device link
+        the per-table sync was a serialized round-trip each)."""
+        return self.readout(self.swap_out())
 
     @staticmethod
     def snapshot_finish(snap: dict
@@ -608,7 +830,7 @@ class CounterTable(_BaseTable):
         return values, snap["touched"], snap["meta"]
 
     def snapshot_and_reset(self) -> Tuple[np.ndarray, np.ndarray, List[RowMeta]]:
-        return self.snapshot_finish(self.snapshot_begin())
+        return self._finish_and_recycle(self.snapshot_begin())
 
 
 class GaugeTable(_BaseTable):
@@ -636,9 +858,12 @@ class GaugeTable(_BaseTable):
             if self._n >= self.batch_cap:
                 self._dispatch_pending_locked()
 
-    def _apply_cols(self, cols):
+    def _apply_cols_state(self, state, cols):
         rows, vals = cols
-        self.state = scalars.apply_gauges(self.state, rows, vals)
+        return scalars.apply_gauges(state, rows, vals)
+
+    def _fresh_state_at(self, capacity: int):
+        return scalars.init_gauges(capacity)
 
     def apply_pending(self):
         with self.lock:
@@ -668,35 +893,22 @@ class GaugeTable(_BaseTable):
         finally:
             self.apply_lock.release()
 
+    def _readout_device(self, state, snap: dict) -> None:
+        """Gauge readout is a pure transfer of the LWW values; the
+        sharded table overrides this with the collective merge."""
+        snap["dev"] = state["value"]
+        snap["_recycle"] = state
+
     def snapshot_begin(self) -> dict:
         """Dispatch-only snapshot half; see CounterTable.snapshot_begin."""
-        with self.lock:
-            cols = self._swap_locked()
-            self.apply_lock.acquire()
-            self._note_generation_locked()
-            touched = self.touched.copy()
-            meta = list(self.meta)
-            self.touched[:] = False
-        try:
-            if cols is not None:
-                self._apply_cols(cols)
-            dev = self._capture_and_reset()
-        finally:
-            self.apply_lock.release()
-        return {"dev": dev, "touched": touched, "meta": meta}
-
-    def _capture_and_reset(self):
-        """See CounterTable._capture_and_reset."""
-        dev = self.state["value"]
-        self.state = scalars.init_gauges(self.capacity)
-        return dev
+        return self.readout(self.swap_out())
 
     @staticmethod
     def snapshot_finish(snap: dict):
         return np.asarray(snap["dev"]), snap["touched"], snap["meta"]
 
     def snapshot_and_reset(self):
-        return self.snapshot_finish(self.snapshot_begin())
+        return self._finish_and_recycle(self.snapshot_begin())
 
 
 class HistoTable(_BaseTable):
@@ -800,17 +1012,30 @@ class HistoTable(_BaseTable):
                 self._dispatch_pending_locked()
 
     def _apply_cols(self, cols):
+        self.state = self._apply_cols_state(self.state, cols,
+                                            self._staged_counts)
+        self._applies += 1
+
+    def _apply_cols_state(self, state, cols, staged_counts):
+        """Pure batch apply over an explicit (state, staging-occupancy)
+        pair: the live path passes the table's own, the flush readout
+        passes the captured generation's."""
         rows, vals, wts = cols
         slots, overflow = batch_tdigest.host_slots(
-            rows, vals, wts, self._staged_counts)
+            rows, vals, wts, staged_counts)
         if overflow:
-            self.state = batch_tdigest.compact(self.state)
-            self._staged_counts[:] = 0
+            state = batch_tdigest.compact(state)
+            staged_counts[:] = 0
             slots, _ = batch_tdigest.host_slots(
-                rows, vals, wts, self._staged_counts)
-        self.state = batch_tdigest.apply_batch(
-            self.state, rows, vals, wts, slots)
-        self._applies += 1
+                rows, vals, wts, staged_counts)
+        return batch_tdigest.apply_batch(state, rows, vals, wts, slots)
+
+    def _fresh_state_at(self, capacity: int):
+        return batch_tdigest.init_state(capacity)
+
+    def _prewarm_apply(self, state, cols, capacity: int):
+        return self._apply_cols_state(state, cols,
+                                      np.zeros(capacity, np.int32))
 
     def apply_pending(self):
         with self.lock:
@@ -860,38 +1085,47 @@ class HistoTable(_BaseTable):
         pre-export compact is elided (flush_quantiles folds staging
         itself); the flush then transfers a single packed (K, P+10)
         array instead of ~50 MB of centroids at K=100k."""
-        return self.snapshot_finish(
+        return self._finish_and_recycle(
             self.snapshot_begin(percentiles, need_export))
+
+    def _swap_extras_locked(self, snap: dict) -> None:
+        snap["staged"] = self._staged_counts
+        self._staged_counts = np.zeros(self.capacity, np.int32)
+        self._applies = 0
+
+    def _readout_apply(self, state, cols, snap: dict):
+        return self._apply_cols_state(state, cols, snap.pop("staged"))
+
+    def _readout_device(self, state, snap: dict) -> None:
+        ps = snap["ps"]
+        if snap.pop("need_export"):
+            # fused forwarding flush: one dispatch, one sort, and
+            # two device->host transfers (the packed flush and the
+            # packed export) instead of compact+flush+export
+            packed, export_packed = self._flush_export(ps, state)
+        else:
+            packed = self._flush_packed(ps, state)
+            export_packed = None
+        snap["packed"] = packed
+        snap["export_packed"] = export_packed
+        snap["_recycle"] = state
+
+    def _reset_state_donated(self, captured):
+        return _reset_tdigest_spare(captured)
+
+    def _prewarm_readout(self, state, capacity: int, ps: tuple,
+                         need_export: bool):
+        if need_export:
+            out = self._flush_export(ps, state)
+        else:
+            out = self._flush_packed(ps, state)
+        return (out, self._reset_state_donated(state))
 
     def snapshot_begin(self, percentiles: Tuple[float, ...],
                        need_export: bool = True) -> dict:
         """Dispatch-only snapshot half; see CounterTable.snapshot_begin."""
-        with self.lock:
-            cols = self._swap_locked()
-            self.apply_lock.acquire()
-            self._note_generation_locked()
-            touched = self.touched.copy()
-            meta = list(self.meta)
-            self.touched[:] = False
-        try:
-            if cols is not None:
-                self._apply_cols(cols)
-            ps = tuple(percentiles)
-            if need_export:
-                # fused forwarding flush: one dispatch, one sort, and
-                # two device->host transfers (the packed flush and the
-                # packed export) instead of compact+flush+export
-                packed, export_packed = self._flush_export(ps)
-            else:
-                packed = self._flush_packed(ps)
-                export_packed = None
-            self._applies = 0
-            self._staged_counts[:] = 0
-            self.state = batch_tdigest.init_state(self.capacity)
-        finally:
-            self.apply_lock.release()
-        return {"packed": packed, "export_packed": export_packed,
-                "ps": ps, "touched": touched, "meta": meta}
+        return self.readout(self.swap_out(
+            ps=tuple(percentiles), need_export=need_export))
 
     @staticmethod
     def snapshot_finish(snap: dict):
@@ -1108,9 +1342,23 @@ class SetTable(_BaseTable):
             if self._n >= self.batch_cap:
                 self._dispatch_pending_locked()
 
-    def _apply_cols(self, cols):
+    def _apply_cols_state(self, state, cols):
         rows, idxs, rhos = cols
-        self.state = batch_hll.apply_batch(self.state, rows, idxs, rhos)
+        return batch_hll.apply_batch(state, rows, idxs, rhos)
+
+    def _state_capacity(self) -> int:
+        return self._dev_cap
+
+    def _fresh_state_at(self, capacity: int):
+        return batch_hll.init_state(capacity)
+
+    def prewarm_rung(self, capacity: int, percentiles=(),
+                     need_export: bool = True) -> bool:
+        """No-op: the set table's device bank rides its own 8x slot
+        ladder (`_dev_cap`), deliberately decoupled from row-capacity
+        doublings — see _promote_locked — so a capacity resize never
+        retraces its kernels (prewarm_dense climbs the slot ladder)."""
+        return False
 
     def apply_pending(self):
         with self.lock:
@@ -1243,81 +1491,98 @@ class SetTable(_BaseTable):
             / (beta + s) + 1.0)
         return urows, est.astype(np.float32)
 
+    def _swap_extras_locked(self, snap: dict) -> None:
+        """Capture the sparse tier's interval state (host COO backlog +
+        the slot assignment) atomically with the device generation: the
+        captured slot map is what makes the captured pending columns'
+        slot ids meaningful."""
+        if not self._sparse:
+            return
+        coo, self._coo = self._coo, []
+        sc, self._coo_scalar = self._coo_scalar, ([], [], [])
+        if sc[0]:
+            coo.append((np.asarray(sc[0], np.int32),
+                        np.asarray(sc[1], np.int32),
+                        np.asarray(sc[2], np.int32)))
+        snap["sparse"] = {"coo": coo, "slot_of": self._slot_of,
+                          "slot_row": self._slot_row,
+                          "nslots": self._nslots}
+        self._slot_of = np.full(self.capacity, -1, np.int32)
+        self._slot_row = []
+        self._nslots = 0
+        self._counts[:] = 0
+
+    def _readout_device(self, state, snap: dict) -> None:
+        """Estimate + register-provider assembly over the captured
+        generation. The register provider keeps a live device reference
+        (lazy transfer), so the captured generation escapes into the
+        snapshot and is NOT recycled."""
+        if not self._sparse:
+            snap["estimates"] = np.asarray(batch_hll.estimate(state))
+            snap["registers"] = _SetRegisters.dense(state, self.capacity)
+            return
+        sparse = snap.pop("sparse")
+        coo = sparse["coo"]
+        slot_of = sparse["slot_of"]
+        slot_row = sparse["slot_row"]
+        nslots = sparse["nslots"]
+        # fold promoted rows' pre-promotion backlog into the device
+        # table, then split the remaining COO per sparse row
+        if coo:
+            rows_all = np.concatenate([c[0] for c in coo])
+            idx_all = np.concatenate([c[1] for c in coo])
+            rho_all = np.concatenate([c[2] for c in coo])
+        else:
+            rows_all = np.zeros(0, np.int32)
+            idx_all = rho_all = rows_all
+        pslots = slot_of[rows_all] if rows_all.size else rows_all
+        hot = pslots >= 0
+        hot_slots = pslots[hot]
+        hot_idx, hot_rho = idx_all[hot], rho_all[hot]
+        for i in range(0, hot_slots.shape[0], self.batch_cap):
+            sl = slice(i, i + self.batch_cap)
+            chunk_rows = hot_slots[sl]
+            pad = self.batch_cap - chunk_rows.shape[0]
+            state = batch_hll.apply_batch(
+                state,
+                np.concatenate([chunk_rows,
+                                np.full(pad, PAD_ROW, np.int32)]),
+                np.concatenate([hot_idx[sl], np.zeros(pad, np.int32)]),
+                np.concatenate([hot_rho[sl], np.zeros(pad, np.int32)]))
+
+        estimates = np.zeros(self.capacity, np.float32)
+        dev_regs = None
+        if nslots:
+            dev_est = np.asarray(batch_hll.estimate(state))
+            dev_regs = state  # device ref; _SetRegisters is lazy
+            estimates[np.asarray(slot_row, np.int64)] = dev_est[:nslots]
+        s_rows = rows_all[~hot]
+        s_idx, s_rho = idx_all[~hot], rho_all[~hot]
+        if s_rows.size:
+            urows, est = self._host_estimates(s_rows, s_idx, s_rho)
+            estimates[urows] = est
+            order = np.argsort(s_rows, kind="stable")
+            s_rows, s_idx, s_rho = (s_rows[order], s_idx[order],
+                                    s_rho[order])
+        snap["estimates"] = estimates
+        snap["registers"] = _SetRegisters(dev_regs, slot_of, s_rows,
+                                          s_idx, s_rho)
+
+    def snapshot_begin(self) -> dict:
+        """Dispatch half: swap + estimate readout (the estimate is
+        realized eagerly — the set families are host-dominant)."""
+        return self.readout(self.swap_out())
+
+    @staticmethod
+    def snapshot_finish(snap: dict):
+        return (snap["estimates"], snap["registers"], snap["touched"],
+                snap["meta"])
+
     def snapshot_and_reset(self):
-        with self.lock:
-            cols = self._swap_locked()
-            self.apply_lock.acquire()
-            self._note_generation_locked()
-            touched = self.touched.copy()
-            meta = list(self.meta)
-            self.touched[:] = False
-            if self._sparse:
-                coo, self._coo = self._coo, []
-                sc, self._coo_scalar = self._coo_scalar, ([], [], [])
-                if sc[0]:
-                    coo.append((np.asarray(sc[0], np.int32),
-                                np.asarray(sc[1], np.int32),
-                                np.asarray(sc[2], np.int32)))
-                slot_of = self._slot_of
-                slot_row = self._slot_row
-                nslots = self._nslots
-                self._slot_of = np.full(self.capacity, -1, np.int32)
-                self._slot_row = []
-                self._nslots = 0
-                self._counts[:] = 0
-        try:
-            if cols is not None:
-                self._apply_cols(cols)
-            if not self._sparse:
-                estimates = np.asarray(batch_hll.estimate(self.state))
-                registers = _SetRegisters.dense(self.state, self.capacity)
-                self.state = batch_hll.init_state(self._dev_cap)
-                return estimates, registers, touched, meta
-
-            # fold promoted rows' pre-promotion backlog into the device
-            # table, then split the remaining COO per sparse row
-            if coo:
-                rows_all = np.concatenate([c[0] for c in coo])
-                idx_all = np.concatenate([c[1] for c in coo])
-                rho_all = np.concatenate([c[2] for c in coo])
-            else:
-                rows_all = np.zeros(0, np.int32)
-                idx_all = rho_all = rows_all
-            pslots = slot_of[rows_all] if rows_all.size else rows_all
-            hot = pslots >= 0
-            hot_slots = pslots[hot]
-            hot_idx, hot_rho = idx_all[hot], rho_all[hot]
-            for i in range(0, hot_slots.shape[0], self.batch_cap):
-                sl = slice(i, i + self.batch_cap)
-                chunk_rows = hot_slots[sl]
-                pad = self.batch_cap - chunk_rows.shape[0]
-                self.state = batch_hll.apply_batch(
-                    self.state,
-                    np.concatenate([chunk_rows,
-                                    np.full(pad, PAD_ROW, np.int32)]),
-                    np.concatenate([hot_idx[sl], np.zeros(pad, np.int32)]),
-                    np.concatenate([hot_rho[sl], np.zeros(pad, np.int32)]))
-
-            estimates = np.zeros(self.capacity, np.float32)
-            dev_regs = None
-            if nslots:
-                dev_est = np.asarray(batch_hll.estimate(self.state))
-                dev_regs = self.state  # device ref; _SetRegisters is lazy
-                estimates[np.asarray(slot_row, np.int64)] = dev_est[:nslots]
-            s_rows = rows_all[~hot]
-            s_idx, s_rho = idx_all[~hot], rho_all[~hot]
-            if s_rows.size:
-                urows, est = self._host_estimates(s_rows, s_idx, s_rho)
-                estimates[urows] = est
-                order = np.argsort(s_rows, kind="stable")
-                s_rows, s_idx, s_rho = (s_rows[order], s_idx[order],
-                                        s_rho[order])
-            registers = _SetRegisters(dev_regs, slot_of, s_rows, s_idx,
-                                      s_rho)
-            self.state = batch_hll.init_state(self._dev_cap)
-        finally:
-            self.apply_lock.release()
-        return estimates, registers, touched, meta
+        # recycle is a no-op for the sparse tier (its captured bank
+        # escapes into the register provider) and real for the sharded
+        # dense tier
+        return self._finish_and_recycle(self.snapshot_begin())
 
 
 class LLHistTable(_BaseTable):
@@ -1374,9 +1639,12 @@ class LLHistTable(_BaseTable):
             if self._n >= self.batch_cap:
                 self._dispatch_pending_locked()
 
-    def _apply_cols(self, cols):
+    def _apply_cols_state(self, state, cols):
         rows, bins, wts = cols
-        self.state = batch_llhist.apply_batch(self.state, rows, bins, wts)
+        return batch_llhist.apply_batch(state, rows, bins, wts)
+
+    def _fresh_state_at(self, capacity: int):
+        return batch_llhist.init_state(capacity)
 
     def apply_pending(self):
         with self.lock:
@@ -1430,6 +1698,22 @@ class LLHistTable(_BaseTable):
         finally:
             self.apply_lock.release()
 
+    def _idle_swap_locked(self, snap: dict) -> bool:
+        # idle-family fast path: every mutation path sets touched,
+        # so no pending samples + no touched rows means the state
+        # is still the all-zero array the last reset left — skip
+        # the capacity-proportional readout dispatch, the register
+        # gather, and the generation swap entirely. The generation
+        # still advances so idle-row reclamation of a gone-quiet
+        # keyset keeps working.
+        if self._n == 0 and not self.touched.any():
+            self._note_generation_locked()
+            snap.update(packed=None, bins_dev=None,
+                        touched=self.touched.copy(),
+                        meta=list(self.meta))
+            return True
+        return False
+
     def snapshot_begin(self, percentiles: Tuple[float, ...],
                        need_bins: bool = True) -> dict:
         """Dispatch-only snapshot half (see CounterTable.snapshot_begin):
@@ -1438,47 +1722,27 @@ class LLHistTable(_BaseTable):
         link — the full table at 100k keys would be ~2 GB), reset.
         `need_bins=False` (a server that neither forwards nor exports
         buckets) skips the register transfer entirely."""
-        with self.lock:
-            # idle-family fast path: every mutation path sets touched,
-            # so no pending samples + no touched rows means the state
-            # is still the all-zero array the last reset left — skip
-            # the capacity-proportional readout dispatch, the register
-            # gather, and the table reallocation entirely. The
-            # generation still advances so idle-row reclamation of a
-            # gone-quiet keyset keeps working.
-            if self._n == 0 and not self.touched.any():
-                self._note_generation_locked()
-                return {"packed": None, "bins_dev": None,
-                        "touched": self.touched.copy(),
-                        "meta": list(self.meta)}
-            cols = self._swap_locked()
-            self.apply_lock.acquire()
-            self._note_generation_locked()
-            touched = self.touched.copy()
-            meta = list(self.meta)
-            self.touched[:] = False
-        try:
-            if cols is not None:
-                self._apply_cols(cols)
-            packed, bins_dev = self._flush_device(
-                tuple(percentiles), need_bins, touched)
-        finally:
-            self.apply_lock.release()
-        return {"packed": packed, "bins_dev": bins_dev,
-                "touched": touched, "meta": meta}
+        return self.readout(self.swap_out(
+            ps=tuple(percentiles), need_bins=need_bins))
 
-    def _flush_device(self, ps: tuple, need_bins: bool, touched):
-        """Dispatch the readout + bins gather and reset the device state
-        (caller holds apply_lock). The sharded table overrides this with
-        the register-ADD collective merge before the same readout."""
-        packed = batch_llhist.flush_packed(self.state, ps)
-        rows = np.flatnonzero(touched)
+    def _readout_device(self, state, snap: dict) -> None:
+        """Dispatch the readout + bins gather over the captured
+        generation. The sharded table overrides this with the
+        register-ADD collective merge before the same readout."""
+        packed = batch_llhist.flush_packed(state, snap["ps"])
+        rows = np.flatnonzero(snap["touched"])
         bins_dev = None
-        if need_bins and rows.size:
-            bins_dev = jnp.take(self.state,
-                                jnp.asarray(rows, jnp.int32), axis=0)
-        self.state = batch_llhist.init_state(self.capacity)
-        return packed, bins_dev
+        if snap.pop("need_bins") and rows.size:
+            bins_dev = jnp.take(state, jnp.asarray(rows, jnp.int32),
+                                axis=0)
+        snap["packed"] = packed
+        snap["bins_dev"] = bins_dev
+        snap["_recycle"] = state
+
+    def _prewarm_readout(self, state, capacity: int, ps: tuple,
+                         need_export: bool):
+        return (batch_llhist.flush_packed(state, ps),
+                _zeros_like_spare(state))
 
     @staticmethod
     def snapshot_finish(snap: dict):
@@ -1498,7 +1762,7 @@ class LLHistTable(_BaseTable):
 
     def snapshot_and_reset(self, percentiles: Tuple[float, ...],
                            need_bins: bool = True):
-        return self.snapshot_finish(
+        return self._finish_and_recycle(
             self.snapshot_begin(percentiles, need_bins))
 
 

@@ -448,34 +448,80 @@ def _handles_by_device(handles) -> Dict[str, list]:
     return groups
 
 
-def flush_columnstore_batch(
+def swap_columnstore(
     store: ColumnStore,
     is_local: bool,
     percentiles: Sequence[float],
+    collect_forward: bool = True,
+    timings: Optional[dict] = None,
+) -> dict:
+    """Critical-path half of the columnar flush: swap every family's
+    pending columns and device-state generation out at ONE interval
+    boundary, with no device readout work at all (each table's swap_out
+    is O(1) under its locks — see columnstore._BaseTable). Ingest
+    continues into the fresh generations the moment this returns; the
+    swapped snapshot is private to the readout and can be drained on a
+    background executor (`readout_columnstore`). The host-dominant
+    families (statuses) snapshot in full here so every family shares
+    the same boundary."""
+    t0 = time.perf_counter()
+    full_ps = tuple(percentiles)
+    all_ps = tuple(sorted(set(full_ps) | {0.5}))
+    need_export = is_local and collect_forward
+    swap = {
+        "now": int(time.time()),
+        "full_ps": full_ps,
+        "all_ps": all_ps,
+        "histogram": store.histos.swap_out(ps=all_ps,
+                                           need_export=need_export),
+        "counter": store.counters.swap_out(),
+        "gauge": store.gauges.swap_out(),
+        # llhist bins always on: forwarding and bucket emission both
+        # need them — see _flush_llhist_family
+        "llhist": store.llhists.swap_out(ps=full_ps, need_bins=True),
+        "set": store.sets.swap_out(),
+        "status": store.statuses.snapshot_and_reset(),
+    }
+    # conservative in-flight snapshot size (touched rows across the
+    # device families): the ledger books this as the overlap stock
+    swap["rows"] = int(sum(
+        np.count_nonzero(swap[f].get("touched", ()))
+        for f in ("histogram", "counter", "gauge", "llhist", "set")))
+    if timings is not None:
+        timings["swap_s"] = time.perf_counter() - t0
+    return swap
+
+
+def readout_columnstore(
+    store: ColumnStore,
+    swap: dict,
+    is_local: bool,
     aggregates: HistogramAggregates,
     collect_forward: bool = True,
     timings: Optional[dict] = None,
     attribute: bool = False,
 ) -> Tuple[FlushBatch, ForwardableState]:
-    """Columnar flush_columnstore: same snapshot semantics and emission
-    rules (the docstring at module top), one device sync, numpy
-    assembly. Returns (FlushBatch, ForwardableState). `timings`, when
-    given, receives per-phase wall seconds (dispatch / device_sync /
-    assembly) so flush-latency claims can be attributed; with
-    `attribute` it additionally receives a `families` tree — per family
-    the host dispatch cost, per-device sync waits, and the host
-    transfer cost, with absolute start offsets so the flush span can
-    grow matching child spans. The attributed segments sum to the
-    `dispatch_s` + `device_sync_s` totals (pinned within 10% by
-    tests/test_latency.py)."""
+    """Background half of the columnar flush: dispatch every swapped
+    generation's readout kernels, sync, transfer, and assemble the
+    FlushBatch + ForwardableState. Same snapshot semantics and emission
+    rules as the legacy path (the docstring at module top); touches no
+    live table state (beyond telemetry counters and the donated-buffer
+    recycle), so it runs concurrently with ingest and with the next
+    interval's accumulation. `timings`, when given, receives per-phase
+    wall seconds (dispatch / device_sync / assembly); with `attribute`
+    it additionally receives a `families` tree — per family the host
+    dispatch cost, per-device sync waits, and the host transfer cost,
+    with absolute start offsets so the flush span can grow matching
+    child spans. The attributed segments sum to the `dispatch_s` +
+    `device_sync_s` totals (pinned within 10% by tests/test_latency.py)."""
     import jax
 
     t0 = time.perf_counter()
-    now = int(time.time())
+    now = swap["now"]
     fwd = ForwardableState()
     sections: List[FlushSection] = []
-    full_ps = tuple(percentiles)
-    all_ps = tuple(sorted(set(full_ps) | {0.5}))
+    full_ps = swap["full_ps"]
+    all_ps = swap["all_ps"]
     ps_index = {p: i for i, p in enumerate(all_ps)}
     need_export = is_local and collect_forward
     full_bits = int(aggregates.value)
@@ -497,23 +543,22 @@ def flush_columnstore_batch(
     # (per-family wall clocks: the dispatch segments are back-to-back,
     # so their sum IS the dispatch_s total minus timer overhead)
     tf = t0
-    h_snap = store.histos.snapshot_begin(all_ps, need_export=need_export)
+    h_snap = store.histos.readout(swap["histogram"])
     tf = _mark("histogram", tf)
-    c_snap = store.counters.snapshot_begin()
+    c_snap = store.counters.readout(swap["counter"])
     tf = _mark("counter", tf)
-    g_snap = store.gauges.snapshot_begin()
+    g_snap = store.gauges.readout(swap["gauge"])
     tf = _mark("gauge", tf)
-    # llhist rides the shared dispatch/sync phases too (bins always on:
-    # forwarding and bucket emission both need them — see
-    # _flush_llhist_family)
-    ll_snap = store.llhists.snapshot_begin(tuple(full_ps))
+    ll_snap = store.llhists.readout(swap["llhist"])
     tf = _mark("llhist", tf)
-    # sets and statuses are host-dominant (the sparse set path only
-    # touches the device when rows promoted this interval); snapshotting
-    # them here keeps every family on the same interval boundary
-    estimates, registers, s_touched, s_meta = store.sets.snapshot_and_reset()
+    # sets are host-dominant (the sparse set path only touches the
+    # device when rows promoted this interval): the estimate realizes
+    # eagerly inside readout
+    set_snap = store.sets.readout(swap["set"])
+    estimates, registers, s_touched, s_meta = \
+        store.sets.snapshot_finish(set_snap)
     tf = _mark("set", tf)
-    st_vals, st_touched, st_meta = store.statuses.snapshot_and_reset()
+    st_vals, st_touched, st_meta = swap["status"]
     _mark("status", tf)
     t_dispatch = time.perf_counter()
 
@@ -562,6 +607,16 @@ def flush_columnstore_batch(
     g_vals, g_touched, g_meta = finished["gauge"]
     out, export, h_touched, h_meta = finished["histogram"]
     t_sync = time.perf_counter()
+    # transfers done: donate the drained generations back as the next
+    # interval's spares (the second buffer of each family's
+    # double-buffer; no-op for snaps whose state escaped — sparse
+    # sets). Booked in the assembly phase: the zeroing dispatches are
+    # async and off the segment-attribution pin.
+    store.counters.recycle(c_snap)
+    store.gauges.recycle(g_snap)
+    store.histos.recycle(h_snap)
+    store.llhists.recycle(ll_snap)
+    store.sets.recycle(set_snap)
 
     # ---- counters & gauges ---------------------------------------------
     def scalar_family(table, vals, touched, meta_list, mtype, fwd_list):
@@ -729,3 +784,25 @@ def flush_columnstore_batch(
             # shard width the measured flush actually merged over
             timings["mesh"] = store.shard_plane.describe()
     return FlushBatch(now, sections, extras), fwd
+
+
+def flush_columnstore_batch(
+    store: ColumnStore,
+    is_local: bool,
+    percentiles: Sequence[float],
+    aggregates: HistogramAggregates,
+    collect_forward: bool = True,
+    timings: Optional[dict] = None,
+    attribute: bool = False,
+) -> Tuple[FlushBatch, ForwardableState]:
+    """Synchronous columnar flush: swap + readout in one call (the
+    pre-overlap shape; the server composes the two halves itself so the
+    readout can run on the background flush executor when `flush_async`
+    is on). Semantics identical to the legacy flush_columnstore — the
+    parity tests pin the two equal."""
+    swap = swap_columnstore(store, is_local, percentiles,
+                            collect_forward=collect_forward,
+                            timings=timings)
+    return readout_columnstore(store, swap, is_local, aggregates,
+                               collect_forward=collect_forward,
+                               timings=timings, attribute=attribute)

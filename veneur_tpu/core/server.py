@@ -22,7 +22,8 @@ from veneur_tpu.config import Config, SinkConfig
 from veneur_tpu.core import networking
 from veneur_tpu.core.columnstore import ColumnStore
 from veneur_tpu.core.flusher import (
-    FlushBatch, ForwardableState, flush_columnstore_batch)
+    FlushBatch, ForwardableState, flush_columnstore_batch,
+    readout_columnstore, swap_columnstore)
 from veneur_tpu.samplers import metrics as m
 from veneur_tpu.samplers.metrics import (
     HistogramAggregates, InterMetric, MetricScope, UDPMetric,
@@ -155,6 +156,13 @@ class _SpanSinkWorker:
 
 
 class Server:
+    # consecutive flush ticks a background readout may miss its join
+    # grace before being dropped: a transient device stall carries the
+    # completed interval forward to later ticks instead of losing it,
+    # while a truly wedged readout is bounded (and the supervisor's
+    # flush-readout deadline escalates it independently)
+    READOUT_MISS_LIMIT = 3
+
     def __init__(self, config: Config,
                  extra_metric_sinks: Optional[List] = None,
                  extra_span_sinks: Optional[List] = None):
@@ -346,6 +354,14 @@ class Server:
             "forward_tier", inputs=("forward.acked_reported",),
             outputs=("forward.remote_merged", "forward.remote_rejected",
                      "forward.remote_deduped"))
+        # the overlapped flush's in-flight snapshot (flush_async): an
+        # interval swapped out of the tables but not yet delivered is
+        # INVENTORY, not loss — booked as a stock so conservation stays
+        # provable through the overlap (it is informational — the
+        # ingest/forward identities note at apply/delivery time, which
+        # both land inside one ledger interval)
+        self.ledger.stock("flush_inflight_snapshot",
+                          lambda: float(self._inflight_rows))
         self.latency.ledger = self.ledger if self.ledger.enabled else None
         self.ledger.trace_source = self.trace_plane.active_trace_hex
         self.telemetry.registry.add_collector(self.ledger.telemetry_rows)
@@ -405,6 +421,21 @@ class Server:
         self._warmup_thread = None  # set in start()
         self._listeners: List[networking.Listener] = []
         self._flush_lock = threading.Lock()
+        # asynchronous flush pipeline (core/flushexec.py, flush_async):
+        # in-flight interval records — swapped out, readouts running on
+        # the background executor in submit order, joined+delivered by
+        # subsequent flush ticks. Normally at most one deep; a wedged
+        # readout lets it grow (bounded) so a transient device stall
+        # carries completed intervals forward instead of dropping them.
+        # All mutated under _flush_lock (plus shutdown's drain, which
+        # flushes under the same lock).
+        self._inflight_flushes: List[dict] = []
+        self._flush_executor = None  # created on the first async flush
+        # touched-row count of the in-flight snapshot: the ledger books
+        # the swapped-but-undelivered interval as an inventory stock so
+        # the overlap stays visible in /debug/ledger
+        self._inflight_rows = 0
+        self.prewarmer = None  # set in start() when prewarm_ladder
         # last flush thread per sink: a sink whose previous flush is still
         # running gets skipped — the hard cap is ONE concurrent flush
         # thread per sink, so a permanently hung sink costs one thread,
@@ -1016,6 +1047,19 @@ class Server:
         self._warmup_thread = threading.Thread(
             target=self._warmup, name="kernel-warmup", daemon=True)
         self._warmup_thread.start()
+        if self.config.prewarm_ladder:
+            # shape-ladder prewarmer (core/flushexec.py): compile each
+            # family's NEXT capacity rung in the background so resizes
+            # never retrace on the hot path; fed by the resize hook
+            from veneur_tpu.core.flushexec import ShapeLadderPrewarmer
+            self.prewarmer = ShapeLadderPrewarmer(
+                self.store, percentiles=self.percentiles,
+                need_export=(self.is_local and self.forwarder is not None),
+                on_event=self.telemetry.record_event)
+            self.telemetry.registry.add_collector(
+                self.prewarmer.telemetry_rows)
+            self.prewarmer.start()
+            self.prewarmer.prewarm_initial()
         if self.diagnostics is not None:
             self.diagnostics.start()
         self._flush_thread = threading.Thread(
@@ -1098,25 +1142,38 @@ class Server:
             return -1
 
     def _store_resize(self, family: str, old_cap: int, new_cap: int,
-                      seconds: float, kind: str = "resize") -> None:
+                      seconds: float, kind: str = "resize",
+                      prewarmed: bool = False) -> None:
         """Flight-recorder hook for every column-store capacity doubling
         (kind=resize: the array re-layout, fired under the table's
         buffer lock — event recording only, never statsd) and for the
         first post-resize batch apply (kind=recompile: the jit retrace
-        the new capacity forces, the TPU-specific cost)."""
+        the new capacity forces, the TPU-specific cost — or, when the
+        shape-ladder prewarmer compiled this rung ahead of time, a warm
+        dispatch tagged `prewarmed`)."""
         cache = None
         if kind == "resize":
             self._cache_entries_at_resize[family] = \
                 self._compile_cache_entries()
+            if self.prewarmer is not None:
+                # queue the rung AFTER the one just reached, so the
+                # next doubling is already compiled when it lands
+                self.prewarmer.note_resize(family, new_cap)
         elif kind == "recompile":
             before = self._cache_entries_at_resize.pop(family, -1)
             after = self._compile_cache_entries()
             if before >= 0 and after >= 0:
                 cache = "miss" if after > before else "hit"
+            if prewarmed and cache != "hit":
+                # the shape ladder compiled this rung ahead of the
+                # resize: the timed "recompile" window was a warm
+                # dispatch, not a retrace
+                cache = "prewarmed"
         self.telemetry.record_event(
             f"columnstore_{kind}", family=family, old_capacity=old_cap,
             new_capacity=new_cap, duration_s=round(seconds, 6),
-            **({"compile_cache": cache} if cache else {}))
+            **({"compile_cache": cache} if cache else {}),
+            **({"prewarmed": True} if prewarmed else {}))
         if kind == "recompile":
             # tag the next flush round's waterfall: recompile cost must
             # be separable from steady-state execute cost (and, with
@@ -1247,7 +1304,27 @@ class Server:
         for worker in self._span_sink_workers:
             worker.stop()
         if self.config.flush_on_shutdown:
+            # full final flush: _flush_locked runs synchronously here
+            # (shutdown is set), so the in-flight async readout AND the
+            # final partial interval both deliver before exit
             self.flush()
+        elif self.config.flush_async:
+            # flush_on_shutdown is OFF (the operator opted out of
+            # partial-interval emission), but an interval already
+            # SWAPPED for async readout is complete, committed data —
+            # join and deliver it (WAL append + forward + sinks)
+            # without opening a new interval boundary. The SIGUSR2
+            # handoff relies on this to stay loss-free. Gated on
+            # flush_async itself, not a racy _inflight_flush read: a
+            # ticker tick mid-swap right now submits its readout
+            # before releasing _flush_lock, and the deliver-only pass
+            # serializes behind it there and joins what it submitted.
+            with self._flush_lock:
+                self._flush_locked(deliver_only=True)
+        if self._flush_executor is not None:
+            self._flush_executor.stop()
+        if self.prewarmer is not None:
+            self.prewarmer.stop()
         if self.import_server is not None:
             self.import_server.stop()
         for gi in self.grpc_ingest_servers:
@@ -1351,7 +1428,7 @@ class Server:
         with self._flush_lock:
             self._flush_locked()
 
-    def _flush_locked(self) -> None:
+    def _flush_locked(self, deliver_only: bool = False) -> None:
         from veneur_tpu import trace as trace_mod
         from veneur_tpu.trace.store import trace_id_hex
         flush_start = time.perf_counter()
@@ -1417,11 +1494,20 @@ class Server:
             # the interval's /debug/traces entry
             round_info["trace_id"] = trace_id_hex(flush_span.trace_id)
 
-        def _start_sink_thread(key: str, target, *args) -> bool:
+        def _start_sink_thread(key: str, target, *args,
+                               parent_span=None,
+                               span_traced=None) -> bool:
             """Dispatch one sink flush thread; returns False when the
             interval was NOT dispatched (skip or open breaker) so the
             forward path can stash its state into carryover instead of
-            dropping it."""
+            dropping it. `parent_span`/`span_traced` re-home the sink's
+            child span under the interval trace whose data is being
+            delivered (an async round delivers the PREVIOUS interval's
+            readout — its spans must parent there, not here)."""
+            if parent_span is None:
+                parent_span = flush_span
+            if span_traced is None:
+                span_traced = traced
             prev = self._sink_flush_threads.get(key)
             if prev is not None and prev.is_alive():
                 # hard cap: one concurrent flush thread per sink. The
@@ -1465,7 +1551,8 @@ class Server:
                 return False
             t = threading.Thread(
                 target=self._timed_sink_flush,
-                args=(key, flush_span, round_info, target) + args,
+                args=(key, parent_span, span_traced, round_info,
+                      target) + args,
                 daemon=True, name=f"flush-{key}")
             t.start()
             self._sink_flush_threads[key] = t
@@ -1483,68 +1570,200 @@ class Server:
         # store snapshots: everything stamped before this flush's
         # snapshot is aged through to sink ack below
         watermarks = self.latency.take_watermarks()
+        # flush_async: swap the interval out (O(1) per table), hand the
+        # readout to the background executor, and DELIVER the previous
+        # interval's joined readout — dispatch/sync/transfer leave the
+        # critical path entirely. Shutdown drains synchronously so the
+        # in-flight snapshot and the final interval both land.
+        async_on = (bool(self.config.flush_async)
+                    and not self._shutdown.is_set()
+                    and not deliver_only)
         t_store = time.perf_counter()
-        batch, fwd = flush_columnstore_batch(
-            self.store, self.is_local, self.percentiles, self.aggregates,
-            collect_forward=self.forwarder is not None,
-            timings=phases, attribute=self.latency.enabled)
-        if self.backfill is not None:
-            # closed historical buckets flush alongside the live
-            # interval, each series timestamped at its ORIGINAL
-            # interval start — backfilled history, not a traffic spike
-            backfilled = self.backfill.drain()
-            if backfilled:
-                batch.extras.extend(backfilled)
-                self.statsd.count("flush.backfilled_series_total",
-                                  len(backfilled))
-        self.stats.inc("metrics_flushed", len(batch))
+        record = None
+        if not deliver_only:
+            swap = swap_columnstore(
+                self.store, self.is_local, self.percentiles,
+                collect_forward=self.forwarder is not None,
+                timings=phases)
+            record = {
+                "swap": swap,
+                "flush": self.flush_count,
+                "interval_start": interval_start,
+                "watermarks": watermarks,
+                "span": flush_span,
+                "traced": traced,
+            }
+        # join the in-flight readouts, oldest first: the head had a
+        # whole interval to finish, so this is normally a no-op wait —
+        # the only store wall time left on the critical path. A head
+        # that is NOT done (transient device stall) is CARRIED to the
+        # next tick after a short grace rather than dropped — its data
+        # is a completed, committed interval; only a readout that stays
+        # wedged past READOUT_MISS_LIMIT ticks (or fails outright) is
+        # dropped, loudly. Shutdown drains with the full timeout.
+        from concurrent.futures import TimeoutError as _JoinTimeout
+        t_join = time.perf_counter()
+        drain = deliver_only or self._shutdown.is_set()
+        inflight = self._inflight_flushes
+        delivered = []
+        while inflight:
+            head = inflight[0]
+            head["async"] = True
+            try:
+                head["result"] = head["pending"].result(
+                    timeout=(max(self.interval, 60.0) if drain
+                             else min(5.0, max(1.0, self.interval / 4))))
+            except _JoinTimeout:
+                if not drain:
+                    misses = head["join_misses"] = \
+                        head.get("join_misses", 0) + 1
+                    if misses < self.READOUT_MISS_LIMIT:
+                        # carry to the next tick; deliver nothing more
+                        break
+                logger.error(
+                    "flush readout for interval %s wedged%s; dropping "
+                    "it", head.get("flush"),
+                    " at shutdown" if drain else
+                    f" for {head['join_misses']} ticks")
+                self.statsd.count("flush.readout_failed_total", 1)
+                inflight.pop(0)
+                continue
+            except Exception:
+                logger.exception(
+                    "in-flight flush readout failed; interval %s lost",
+                    head.get("flush"))
+                self.statsd.count("flush.readout_failed_total", 1)
+                inflight.pop(0)
+                continue
+            inflight.pop(0)
+            delivered.append(head)
+        phases["join_s"] = time.perf_counter() - t_join
+        inline_device_s = 0.0
+        if deliver_only:
+            pass  # shutdown drain: no new interval boundary is opened
+        elif async_on:
+            record["pending"] = self._readout_executor().submit(
+                lambda rec=record: self._run_readout(rec))
+            inflight.append(record)
+        else:
+            record["result"] = self._run_readout(record)
+            r_phases = record["result"][2]
+            # device work that DID run inline this tick — subtracted
+            # from the critical-path row below
+            inline_device_s = sum(
+                r_phases.get(k, 0.0)
+                for k in ("dispatch_s", "device_sync_s", "assembly_s"))
+            delivered.append(record)
+        # the ledger's overlap stock: touched rows across every swapped-
+        # but-undelivered interval still in the pipeline
+        self._inflight_rows = sum(r["swap"]["rows"] for r in inflight)
         phases["store_flush_s"] = time.perf_counter() - t_store
         phases["preflush_s"] = t_store - flush_start
-        # flush-stage ledger rows (informational): what this interval's
-        # snapshot produced
-        self.ledger.note("flush.emitted", len(batch))
-        self.ledger.note("flush.forward_rows", len(fwd))
+        round_info["async"] = async_on
 
-        # dispatch even with an empty snapshot when a previous interval's
-        # failed state is pending (in carryover OR the durable spool) —
-        # otherwise a quiet interval would strand it until new traffic
-        # arrives
-        pending_carryover = (self.forward_client is not None
-                             and (self.forward_client.carryover.depth > 0
-                                  or (self.forward_client.spool is not None
-                                      and self.forward_client.spool.depth
-                                      > 0)))
-        if self.is_local and self.forwarder is not None and (
-                len(fwd) or pending_carryover):
-            # flow ledger: everything snapshotted for the forward plane
-            # is owed an outcome (ack / merge-away / shed / inventory)
-            self.ledger.note("forward.snapshot", len(fwd))
-            if not _start_sink_thread("forward", self._forward_safe, fwd,
-                                      interval_start) \
-                    and self.forward_client is not None and len(fwd):
-                # undispatched interval (previous forward still hung):
-                # the snapshot is mergeable state, so it carries over
-                # exactly like a failed send instead of being dropped
-                self.forward_client.carryover.stash(fwd)
-                self.statsd.count("flush.forward_undispatched_total", 1)
+        def _deliver_round(rec, other_samples, primary: bool) -> int:
+            """Fan one joined/inline readout out to the forward plane
+            and the metric sinks; returns its metric count. Only the
+            PRIMARY (first) round's readout phases land in this tick's
+            series — a drain tick delivering two intervals must not mix
+            one interval's phase totals with another's family segments
+            in the recorded round."""
+            batch, fwd, r_phases = rec["result"]
+            rec_span, rec_traced = rec["span"], rec["traced"]
+            # readout phases land in this round's series (one interval
+            # late under overlap — the bench gate reads distributions)
+            if primary:
+                for k, v in r_phases.items():
+                    if isinstance(v, (int, float)) or k in ("mesh",
+                                                            "families"):
+                        phases[k] = v
+            self.stats.inc("metrics_flushed", len(batch))
+            # flush-stage ledger rows (informational): what the
+            # delivered interval's snapshot produced
+            self.ledger.note("flush.emitted", len(batch))
+            self.ledger.note("flush.forward_rows", len(fwd))
 
-        if self._routing is not None:
-            # routing annotates per-metric sink sets, so it needs objects;
-            # materialize once here and every sink thread shares the list
-            for metric in batch.materialize():
-                route = set()
-                for rule in self._routing:
-                    route.update(rule.route(metric.name, metric.tags))
-                metric.sinks = route
+            # dispatch even with an empty snapshot when a previous
+            # interval's failed state is pending (in carryover OR the
+            # durable spool) — otherwise a quiet interval would strand
+            # it until new traffic arrives
+            pending_carryover = (
+                self.forward_client is not None
+                and (self.forward_client.carryover.depth > 0
+                     or (self.forward_client.spool is not None
+                         and self.forward_client.spool.depth > 0)))
+            if self.is_local and self.forwarder is not None and (
+                    len(fwd) or pending_carryover):
+                # flow ledger: everything snapshotted for the forward
+                # plane is owed an outcome (ack / merge-away / shed /
+                # inventory)
+                self.ledger.note("forward.snapshot", len(fwd))
+                if not _start_sink_thread(
+                        "forward", self._forward_safe, fwd,
+                        rec["interval_start"], parent_span=rec_span,
+                        span_traced=rec_traced) \
+                        and self.forward_client is not None and len(fwd):
+                    # undispatched interval (previous forward still
+                    # hung): the snapshot is mergeable state, so it
+                    # carries over exactly like a failed send instead
+                    # of being dropped
+                    self.forward_client.carryover.stash(fwd)
+                    self.statsd.count("flush.forward_undispatched_total",
+                                      1)
 
-        for sink in self.metric_sinks:
-            key = f"metric:{sink.name()}"
-            # per-sink gate: another sink's pending spill must not
-            # dispatch this one — a no-op flush would still thread-spawn
-            # and (worse) count as a probe against this sink's breaker
-            if len(batch) or samples or key in self._sink_spill:
-                _start_sink_thread(
-                    key, self._flush_sink_safe, key, sink, batch, samples)
+            if self._routing is not None:
+                # routing annotates per-metric sink sets, so it needs
+                # objects; materialize once here and every sink thread
+                # shares the list
+                for metric in batch.materialize():
+                    route = set()
+                    for rule in self._routing:
+                        route.update(rule.route(metric.name, metric.tags))
+                    metric.sinks = route
+
+            for sink in self.metric_sinks:
+                key = f"metric:{sink.name()}"
+                # per-sink gate: another sink's pending spill must not
+                # dispatch this one — a no-op flush would still
+                # thread-spawn and (worse) count as a probe against
+                # this sink's breaker
+                if len(batch) or other_samples or key in self._sink_spill:
+                    _start_sink_thread(
+                        key, self._flush_sink_safe, key, sink, batch,
+                        other_samples, parent_span=rec_span,
+                        span_traced=rec_traced)
+            return len(batch)
+
+        delivered_metrics = 0
+        for i, rec in enumerate(delivered):
+            # events/service checks belong to THIS tick: they ride the
+            # first delivery round only (a drain tick delivers two)
+            delivered_metrics += _deliver_round(
+                rec, samples if i == 0 else (), primary=(i == 0))
+            if i + 1 < len(delivered):
+                # drain tick delivering two intervals: the one-thread-
+                # per-sink cap means round 2 must wait for round 1's
+                # threads — ONE shared grace across all of them, not a
+                # fresh timeout per thread (N wedged sinks would
+                # otherwise stall shutdown for N x grace)
+                inter_deadline = (time.perf_counter()
+                                  + max(self.interval, 30.0))
+                for t in threads:
+                    remaining = inter_deadline - time.perf_counter()
+                    if remaining <= 0:
+                        break
+                    t.join(remaining)
+        if not delivered and (samples or self._sink_spill):
+            # empty-delivery tick (first async tick, or a failed/timed-
+            # out readout join): events/service checks still deliver on
+            # time, and sinks with a pending one-interval spill get
+            # their retry — an empty tick must not starve either
+            empty = FlushBatch(int(self.last_flush_unix), [], [])
+            for sink in self.metric_sinks:
+                key = f"metric:{sink.name()}"
+                if samples or key in self._sink_spill:
+                    _start_sink_thread(key, self._flush_sink_safe, key,
+                                       sink, empty, samples)
 
         # bounded wait: one interval from flush start, minus time already
         # spent; stragglers keep running on their daemon threads and are
@@ -1589,39 +1808,72 @@ class Server:
             self.import_server.rpc_stats.emit(self.statsd, prefix="import.rpc")
         # sink joins are the ack point: everything dispatched this round
         # has been delivered (or timed out, recorded above) — the moment
-        # the interval's samples stop aging
+        # the DELIVERED interval's samples stop aging. Under overlap the
+        # delivered watermarks are the previous interval's, so the age
+        # honestly includes the pipeline's one-interval delivery delay.
         ack_unix = time.time()
-        self.latency.observe_sample_age(watermarks, ack_unix)
-        if traced and watermarks:
-            # anchor this interval's worst-case staleness to its trace:
-            # the pipeline.sample_age rows in /metrics carry an
-            # OpenMetrics exemplar pointing at exactly this flush
-            oldest = min(mark[0] for mark in watermarks.values())
-            self.trace_plane.exemplars.capture(
-                "pipeline.sample_age", max(0.0, ack_unix - oldest),
-                flush_span.trace_id, ts=ack_unix)
-        families = phases.get("families")
-        if families:
-            for family, (secs, cache) in \
-                    self.latency.drain_retraces().items():
-                rec = families.get(family)
-                if rec is not None:
-                    rec["retrace"] = True
-                    rec["recompile_s"] = round(secs, 6)
-                    if cache:
-                        rec["compile_cache"] = cache
-            self._record_family_spans(flush_span, families)
+        # retrace tags drain ONCE per tick and land on the first
+        # delivered families tree (on a drain tick delivering two
+        # intervals, that is the async/previous one — the interval the
+        # pending recompile actually preceded)
+        retraces = self.latency.drain_retraces()
+        families = None
+        for rec in delivered:
+            self.latency.observe_sample_age(rec["watermarks"], ack_unix)
+            if rec["traced"] and rec["watermarks"]:
+                # anchor the delivered interval's worst-case staleness
+                # to ITS trace: the pipeline.sample_age rows in /metrics
+                # carry an OpenMetrics exemplar pointing at that flush
+                oldest = min(mark[0] for mark in rec["watermarks"].values())
+                self.trace_plane.exemplars.capture(
+                    "pipeline.sample_age", max(0.0, ack_unix - oldest),
+                    rec["span"].trace_id, ts=ack_unix)
+            rec_families = rec["result"][2].get("families")
+            if rec_families:
+                for family, (secs, cache) in retraces.items():
+                    frec = rec_families.get(family)
+                    if frec is not None:
+                        frec["retrace"] = True
+                        frec["recompile_s"] = round(secs, 6)
+                        if cache:
+                            frec["compile_cache"] = cache
+                retraces = {}
+                if rec.get("async"):
+                    # waterfall: these segments ran on the background
+                    # executor — render as the parallel (async) lane
+                    for frec in rec_families.values():
+                        frec["lane"] = "async"
+                # async readout spans still parent under the ORIGINATING
+                # interval's flush span, stamped with the readout's own
+                # wall-clock base (not this tick's)
+                self._record_family_spans(
+                    rec["span"], families=rec_families,
+                    base_unix=rec.get("readout_start_unix"))
+                if families is None:
+                    # the round's waterfall tree shows the FIRST
+                    # delivered interval's segments (the async one on a
+                    # drain tick), paired with its flush id — never a
+                    # mix of two intervals' evidence
+                    families = rec_families
+                    if rec.get("async"):
+                        round_info["delivered_flush"] = rec["flush"]
         flush_span.finish()
         duration = time.perf_counter() - flush_start
+        # the join-only critical path: total wall minus whatever device
+        # readout ran INLINE this tick (zero under flush_async — the
+        # acceptance row proving dispatch/sync/transfer left the path)
+        critical_path = max(0.0, duration - inline_device_s)
+        phases["critical_path_s"] = critical_path
+        self.statsd.timing("flush.critical_path_s", critical_path)
         self.statsd.gauge("flush.total_duration_ns", int(duration * 1e9))
         self.statsd.timing("flush.total_duration", duration)
         for phase, secs in phases.items():
             if isinstance(secs, (int, float)):
                 self.statsd.timing("flush.phase_duration", secs,
                                    tags=[f"phase:{phase}"])
-        self.statsd.count("flush.metrics_total", len(batch))
+        self.statsd.count("flush.metrics_total", delivered_metrics)
         round_info["duration_s"] = round(duration, 6)
-        round_info["metrics_flushed"] = len(batch)
+        round_info["metrics_flushed"] = delivered_metrics
         round_info["phases"] = {k: round(v, 6) for k, v in phases.items()
                                 if isinstance(v, (int, float))}
         if families:
@@ -1629,7 +1881,8 @@ class Server:
         self.telemetry.flushes.record(round_info)
         self.telemetry.record_event(
             "flush", flush=round_info["flush"],
-            duration_s=round_info["duration_s"], metrics=len(batch),
+            duration_s=round_info["duration_s"],
+            metrics=delivered_metrics,
             phases=round_info["phases"],
             sinks={k: v.get("status", "running")
                    for k, v in round_info["sinks"].items()})
@@ -1657,14 +1910,52 @@ class Server:
         # strict mode (tests) an imbalance raises out of flush(); in
         # production it exports ledger.imbalance and records an event.
         if self.ledger.enabled:
-            record = self.ledger.close_interval()
-            round_info["ledger"] = record.get("imbalance", {})
+            ledger_record = self.ledger.close_interval()
+            round_info["ledger"] = ledger_record.get("imbalance", {})
         # interval-trace rollover LAST (the ledger close above stamps
         # this interval's trace id): mint the next interval's id, reset
         # the exemplar capture budget, and refresh the watched
         # heavy-hitter names from the cardinality observatory
         self.trace_plane.roll(
             [rec["name"] for rec in self.cardinality.top(16)])
+
+    def _run_readout(self, record: dict):
+        """The background half of one flush (runs on the flush-readout
+        executor under flush_async, inline otherwise): drain the swapped
+        generations — kernel dispatch, device sync, transfer, assembly —
+        plus the backfill drain, whose metrics carry their ORIGINAL
+        timestamps and so lose nothing by riding the next delivery.
+        Returns (batch, fwd, readout_phases)."""
+        record["readout_start_unix"] = time.time()
+        r_phases: dict = {}
+        batch, fwd = readout_columnstore(
+            self.store, record["swap"], self.is_local, self.aggregates,
+            collect_forward=self.forwarder is not None,
+            timings=r_phases, attribute=self.latency.enabled)
+        if self.backfill is not None:
+            # closed historical buckets flush alongside the live
+            # interval, each series timestamped at its ORIGINAL
+            # interval start — backfilled history, not a traffic spike
+            backfilled = self.backfill.drain()
+            if backfilled:
+                batch.extras.extend(backfilled)
+                self.statsd.count("flush.backfilled_series_total",
+                                  len(backfilled))
+        return batch, fwd, r_phases
+
+    def _readout_executor(self):
+        """Get-or-create the background flush executor (flush_async),
+        supervised like the flush loop itself — a wedged readout (hung
+        device link mid-transfer) trips the same stall ladder."""
+        if self._flush_executor is None:
+            from veneur_tpu.core.flushexec import FlushReadoutExecutor
+            self.overload.supervisor.register(
+                "flush-readout", deadline=max(
+                    self.overload.supervisor.deadline,
+                    2.5 * self.interval, 60.0))
+            self._flush_executor = FlushReadoutExecutor(
+                beat=self.overload.supervisor.beat)
+        return self._flush_executor
 
     def _reclaim_idle_rows(self) -> None:
         """Idle-key reclamation + intern-table self-metrics, once per
@@ -1719,13 +2010,19 @@ class Server:
         # the per-name mint budgets (the shed rung's immediate recovery)
         self.cardinality.roll_interval()
 
-    def _record_family_spans(self, flush_span, families: dict) -> None:
+    def _record_family_spans(self, flush_span, families: dict,
+                             base_unix: float = None) -> None:
         """Matching child spans under the flush span, one per family
         device segment tree: the span's start/end reconstruct the
         measured dispatch->transfer window (the reference ships its own
-        observability as SSF spans; so does the waterfall)."""
-        base = self.last_flush_unix + self.flush_phase_timings.get(
-            "preflush_s", 0.0)
+        observability as SSF spans; so does the waterfall). `base_unix`
+        anchors the segment offsets at the READOUT's wall-clock start —
+        an async readout runs after its interval's flush span finished,
+        and stamping it off this tick's flush time would both misplace
+        the segments and parent them under the wrong interval's trace."""
+        base = base_unix if base_unix is not None else (
+            self.last_flush_unix + self.flush_phase_timings.get(
+                "preflush_s", 0.0))
         for family, rec in families.items():
             start_off = rec.get("dispatch_start_s", 0.0)
             end_off = start_off + rec.get("dispatch_s", 0.0)
@@ -1748,21 +2045,23 @@ class Server:
             child.proto.start_timestamp = int((base + start_off) * 1e9)
             child.finish(end_time=base + end_off)
 
-    def _timed_sink_flush(self, key: str, parent_span, round_info: dict,
-                          target, *args) -> None:
+    def _timed_sink_flush(self, key: str, parent_span, span_traced,
+                          round_info: dict, target, *args) -> None:
         """Body of one per-sink flush thread: a child span under the
-        flush span, wall-clock duration, the sink-outcome row shared with
-        the flight recorder, and the per-sink duration self-metric."""
+        DELIVERED interval's flush span (an async round delivers the
+        previous interval's readout — its sink spans parent there),
+        wall-clock duration, the sink-outcome row shared with the
+        flight recorder, and the per-sink duration self-metric."""
         outcome = round_info["sinks"].setdefault(key, {})
         child = parent_span.child("flush.sink", tags={"sink": key})
         # make this sink's span the ambient parent for the duration of
         # the flush call (each sink thread has its own context): the
         # forward client reads it to inject (trace_id, span_id) gRPC
         # metadata, which is how the interval trace crosses the tier.
-        # Gated on the round being traced so unsampled intervals add no
-        # metadata downstream.
+        # Gated on the delivered round being traced so unsampled
+        # intervals add no metadata downstream.
         ctx_token = None
-        if round_info.get("trace_id"):
+        if span_traced:
             from veneur_tpu.trace import context as trace_ctx
             ctx_token = trace_ctx._current_span.set(child)
         start = time.perf_counter()

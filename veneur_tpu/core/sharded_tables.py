@@ -49,7 +49,8 @@ import numpy as np
 
 from veneur_tpu.core.columnstore import (CounterTable, GaugeTable,
                                          HistoTable, LLHistTable, PAD_ROW,
-                                         SetTable, _SetRegisters)
+                                         SetTable, _BaseTable,
+                                         _SetRegisters, _zeros_like_spare)
 from veneur_tpu.ops import batch_hll, batch_llhist, batch_tdigest, scalars
 from veneur_tpu.parallel import collectives
 from veneur_tpu.parallel.collectives import SHARD_AXIS
@@ -121,6 +122,11 @@ class _DigestRouted:
     def _put_sharded(self, host_arr: np.ndarray):
         return jax.device_put(host_arr, self._shard_sharding)
 
+    def _prewarm_apply(self, state, cols, capacity: int):
+        # rung compiles must not inflate the serving plane's routed/
+        # dispatch accounting — the batch is all-PAD throwaway
+        return self._apply_cols_state(state, cols, note=False)
+
     def _stacked_batch(self, rows: np.ndarray, value_cols: Tuple
                        ) -> Tuple:
         """Masked (n, batch) row column + tiled value columns for one
@@ -166,20 +172,28 @@ class ShardedCounterTable(_DigestRouted, CounterTable):
         self.state = collectives.grow_stacked(self._mesh, self.state,
                                               new_cap)
 
-    def _apply_cols(self, cols):
+    def _fresh_state_at(self, capacity: int):
+        return collectives.init_stacked(
+            self._mesh, scalars.init_counters, capacity)
+
+    def _apply_cols_state(self, state, cols, note: bool = True):
         rows, vals, rates = cols
         srows, (svals, srates), counts = self._stacked_batch(
             rows, (vals, rates))
-        self.state = collectives.apply_counters_sharded(
-            self.state, srows, svals, srates)
-        self._plane.note_routed(self.family, counts)
+        if note:
+            self._plane.note_routed(self.family, counts)
+        return collectives.apply_counters_sharded(
+            state, srows, svals, srates)
 
-    def _capture_and_reset(self):
-        dev = collectives.merge_counters_stacked(self.state)
+    def _readout_device(self, state, snap) -> None:
+        """Fused donated collective merge: the drained stacked
+        generation's buffers come back as the next interval's spare."""
+        snap["dev"], snap["_spare"] = \
+            collectives.merge_counters_stacked_reset(state)
         self._plane.note_merge_round()
-        self.state = collectives.init_stacked(
-            self._mesh, scalars.init_counters, self.capacity)
-        return dev
+
+    def _prewarm_readout(self, state, capacity, ps, need_export):
+        return collectives.merge_counters_stacked_reset(state)
 
 
 class ShardedGaugeTable(_DigestRouted, GaugeTable):
@@ -205,12 +219,16 @@ class ShardedGaugeTable(_DigestRouted, GaugeTable):
         self.state = collectives.grow_stacked(self._mesh, self.state,
                                               new_cap)
 
-    def _apply_cols(self, cols):
+    def _fresh_state_at(self, capacity: int):
+        return collectives.init_stacked(
+            self._mesh, scalars.init_gauges, capacity)
+
+    def _apply_cols_state(self, state, cols, note: bool = True):
         rows, vals = cols
         srows, (svals,), counts = self._stacked_batch(rows, (vals,))
-        self.state = collectives.apply_gauges_sharded(
-            self.state, srows, svals)
-        self._plane.note_routed(self.family, counts)
+        if note:
+            self._plane.note_routed(self.family, counts)
+        return collectives.apply_gauges_sharded(state, srows, svals)
 
     def merge_batch(self, stubs, values) -> None:
         """Import-path overwrite, routed to each row's home shard (the
@@ -233,12 +251,14 @@ class ShardedGaugeTable(_DigestRouted, GaugeTable):
         finally:
             self.apply_lock.release()
 
-    def _capture_and_reset(self):
-        dev, _set = collectives.merge_gauges_stacked(self.state)
+    def _readout_device(self, state, snap) -> None:
+        (dev, _set), snap["_spare"] = \
+            collectives.merge_gauges_stacked_reset(state)
+        snap["dev"] = dev
         self._plane.note_merge_round()
-        self.state = collectives.init_stacked(
-            self._mesh, scalars.init_gauges, self.capacity)
-        return dev
+
+    def _prewarm_readout(self, state, capacity, ps, need_export):
+        return collectives.merge_gauges_stacked_reset(state)
 
 
 class ShardedLLHistTable(_DigestRouted, LLHistTable):
@@ -267,13 +287,17 @@ class ShardedLLHistTable(_DigestRouted, LLHistTable):
         self.state = collectives.grow_stacked(self._mesh, self.state,
                                               new_cap)
 
-    def _apply_cols(self, cols):
+    def _fresh_state_at(self, capacity: int):
+        return collectives.init_stacked(
+            self._mesh, batch_llhist.init_state, capacity)
+
+    def _apply_cols_state(self, state, cols, note: bool = True):
         rows, bins, wts = cols
         srows, (sbins, swts), counts = self._stacked_batch(
             rows, (bins, wts))
-        self.state = collectives.apply_llhist_sharded(
-            self.state, srows, sbins, swts)
-        self._plane.note_routed(self.family, counts)
+        if note:
+            self._plane.note_routed(self.family, counts)
+        return collectives.apply_llhist_sharded(state, srows, sbins, swts)
 
     def merge_batch(self, stubs, in_bins) -> None:
         """Import-path register ADD, each incoming row landed on its
@@ -300,18 +324,22 @@ class ShardedLLHistTable(_DigestRouted, LLHistTable):
         finally:
             self.apply_lock.release()
 
-    def _flush_device(self, ps: tuple, need_bins: bool, touched):
-        merged = collectives.merge_llhist_stacked(self.state)
+    def _readout_device(self, state, snap) -> None:
+        merged, snap["_spare"] = \
+            collectives.merge_llhist_stacked_reset(state)
         self._plane.note_merge_round()
-        packed = batch_llhist.flush_packed(merged, ps)
-        rows = np.flatnonzero(touched)
+        packed = batch_llhist.flush_packed(merged, snap["ps"])
+        rows = np.flatnonzero(snap["touched"])
         bins_dev = None
-        if need_bins and rows.size:
+        if snap.pop("need_bins") and rows.size:
             bins_dev = jnp.take(merged, jnp.asarray(rows, jnp.int32),
                                 axis=0)
-        self.state = collectives.init_stacked(
-            self._mesh, batch_llhist.init_state, self.capacity)
-        return packed, bins_dev
+        snap["packed"] = packed
+        snap["bins_dev"] = bins_dev
+
+    def _prewarm_readout(self, state, capacity, ps, need_export):
+        merged, fresh = collectives.merge_llhist_stacked_reset(state)
+        return (batch_llhist.flush_packed(merged, ps), fresh)
 
 
 # ---------------------------------------------------------------------------
@@ -320,7 +348,22 @@ class ShardedLLHistTable(_DigestRouted, LLHistTable):
 # ---------------------------------------------------------------------------
 
 
-class ShardedHistoTable(_DigestRouted, HistoTable):
+class _PerDeviceStates:
+    """Generation swap over the per-device `states` list (the histo/set
+    sharded families keep one committed state per device rather than a
+    stacked array; `self.state` stays None)."""
+
+    def _swap_device_locked(self):
+        captured = self.states
+        spare, self._spare = self._spare, None
+        if spare is not None and self._spare_cap == self._state_capacity():
+            self.states = spare
+        else:
+            self.states = self._fresh_state()
+        return captured
+
+
+class ShardedHistoTable(_PerDeviceStates, _DigestRouted, HistoTable):
     """HistoTable whose interval state lives across N local devices;
     ingest routes each key's samples to its home shard (digest mode) or
     round-robins whole batches (legacy mode); flush merges across the
@@ -357,30 +400,36 @@ class ShardedHistoTable(_DigestRouted, HistoTable):
             extended.append(e)
         self._shard_counts = extended
 
-    def _apply_to_shard(self, i: int, rows, vals, wts) -> None:
-        """One shard's masked fixed-shape batch apply (caller holds
-        apply_lock); handles the per-shard staging compact."""
+    def _fresh_state_at(self, capacity: int):
+        return [jax.device_put(batch_tdigest.init_state(capacity), d)
+                for d in self._devices]
+
+    def _apply_to_shard(self, states, shard_counts, i: int, rows, vals,
+                        wts) -> None:
+        """One shard's masked fixed-shape batch apply over an explicit
+        (states, staging-occupancy) generation — the live path passes
+        the table's own, the flush readout the captured one; handles
+        the per-shard staging compact."""
         dev = self._devices[i]
         slots, overflow = batch_tdigest.host_slots(
-            rows, vals, wts, self._shard_counts[i])
+            rows, vals, wts, shard_counts[i])
         if overflow:
-            self.states[i] = batch_tdigest.compact(self.states[i])
-            self._shard_counts[i][:] = 0
+            states[i] = batch_tdigest.compact(states[i])
+            shard_counts[i][:] = 0
             slots, _ = batch_tdigest.host_slots(
-                rows, vals, wts, self._shard_counts[i])
-        self.states[i] = batch_tdigest.apply_batch(
-            self.states[i], jax.device_put(rows, dev),
+                rows, vals, wts, shard_counts[i])
+        states[i] = batch_tdigest.apply_batch(
+            states[i], jax.device_put(rows, dev),
             jax.device_put(vals, dev), jax.device_put(wts, dev),
             jax.device_put(slots, dev))
 
-    def _apply_cols(self, cols):
+    def _apply_cols_states(self, states, shard_counts, cols) -> None:
         rows, vals, wts = cols
         if not self._digest_routed:
             # legacy round-robin: whole batch to the next shard
             i = self._rr_next
             self._rr_next = (i + 1) % self._n_shards
-            self._apply_to_shard(i, rows, vals, wts)
-            self._applies += 1
+            self._apply_to_shard(states, shard_counts, i, rows, vals, wts)
             return
         home = self._home_of(rows)
         counts = self._shard_counts_of(home)
@@ -388,9 +437,13 @@ class ShardedHistoTable(_DigestRouted, HistoTable):
             # masked, not split: the kernels' compiled (batch_cap,)
             # shape is preserved; non-home rows scatter-drop
             rows_i = np.where(home == i, rows, PAD_ROW)
-            self._apply_to_shard(i, rows_i, vals, wts)
-        self._applies += 1
+            self._apply_to_shard(states, shard_counts, i, rows_i, vals,
+                                 wts)
         self._plane.note_routed(self.family, counts)
+
+    def _apply_cols(self, cols):
+        self._apply_cols_states(self.states, self._shard_counts, cols)
+        self._applies += 1
 
     def merge_batch(self, stubs, in_means, in_weights, in_min, in_max,
                     in_recip) -> None:
@@ -428,56 +481,61 @@ class ShardedHistoTable(_DigestRouted, HistoTable):
         finally:
             self.apply_lock.release()
 
-    def _merged_state(self) -> Dict[str, jnp.ndarray]:
+    def _merged_state(self, states, note: bool = True
+                      ) -> Dict[str, jnp.ndarray]:
         stacked = {
             k: collectives.stack_on_mesh(
-                self._mesh, [st[k] for st in self.states])
-            for k in self.states[0]}
-        self._plane.note_merge_round()
+                self._mesh, [st[k] for st in states])
+            for k in states[0]}
+        if note:
+            self._plane.note_merge_round()
         return collectives.merge_histo_stacked(stacked)
 
-    def snapshot_and_reset(self, percentiles: Tuple[float, ...],
-                           need_export: bool = True):
-        return self.snapshot_finish(
-            self.snapshot_begin(percentiles, need_export))
+    def _swap_extras_locked(self, snap: dict) -> None:
+        snap["staged"] = self._shard_counts
+        self._shard_counts = [np.zeros(self.capacity, np.int32)
+                              for _ in self._devices]
+        self._applies = 0
 
-    def snapshot_begin(self, percentiles: Tuple[float, ...],
-                       need_export: bool = True) -> dict:
-        with self.lock:
-            cols = self._swap_locked()
-            self.apply_lock.acquire()
-            self._note_generation_locked()
-            touched = self.touched.copy()
-            meta = list(self.meta)
-            self.touched[:] = False
-        try:
-            if cols is not None:
-                self._apply_cols(cols)
-            merged = self._merged_state()
-            ps = tuple(percentiles)
-            if need_export:
-                # fused flush+export: one dispatch, two transfers (the
-                # merged state's staging is already folded, so the fold
-                # inside the fused op is a no-op concat of zeros).
-                # Routed through the pallas-aware wrappers so
-                # tpu.pallas_tdigest_flush applies to sharded stores too.
-                packed, export_packed = self._flush_export(ps, merged)
-            else:
-                packed = self._flush_packed(ps, merged,
-                                            fold_staging=False)
-                export_packed = None
-            self.states = [
-                jax.device_put(batch_tdigest.init_state(self.capacity), d)
-                for d in self._devices]
-            self._shard_counts = [np.zeros(self.capacity, np.int32)
-                                  for _ in self._devices]
-        finally:
-            self.apply_lock.release()
-        return {"packed": packed, "export_packed": export_packed,
-                "ps": ps, "touched": touched, "meta": meta}
+    def _readout_apply(self, states, cols, snap: dict):
+        self._apply_cols_states(states, snap.pop("staged"), cols)
+        return states
+
+    def _readout_device(self, states, snap: dict) -> None:
+        merged = self._merged_state(states)
+        ps = snap["ps"]
+        if snap.pop("need_export"):
+            # fused flush+export: one dispatch, two transfers (the
+            # merged state's staging is already folded, so the fold
+            # inside the fused op is a no-op concat of zeros).
+            # Routed through the pallas-aware wrappers so
+            # tpu.pallas_tdigest_flush applies to sharded stores too.
+            packed, export_packed = self._flush_export(ps, merged)
+        else:
+            packed = self._flush_packed(ps, merged, fold_staging=False)
+            export_packed = None
+        snap["packed"] = packed
+        snap["export_packed"] = export_packed
+        snap["_recycle"] = states
+
+    def _prewarm_apply(self, states, cols, capacity: int):
+        counts = [np.zeros(capacity, np.int32) for _ in self._devices]
+        rows, vals, wts = cols
+        for i in range(self._n_shards):
+            self._apply_to_shard(states, counts, i, rows, vals, wts)
+        return states
+
+    def _prewarm_readout(self, states, capacity: int, ps: tuple,
+                         need_export: bool):
+        merged = self._merged_state(states, note=False)
+        if need_export:
+            out = self._flush_export(ps, merged)
+        else:
+            out = self._flush_packed(ps, merged, fold_staging=False)
+        return (out, self._reset_state_donated(states))
 
 
-class ShardedSetTable(_DigestRouted, SetTable):
+class ShardedSetTable(_PerDeviceStates, _DigestRouted, SetTable):
     """SetTable whose HLL register banks live across N local devices;
     ingest routes each key's stream to its home shard, flush merges
     registers with an all-reduce max (exact under any routing — max
@@ -506,25 +564,39 @@ class ShardedSetTable(_DigestRouted, SetTable):
                 jnp.pad(st, [(0, new_cap - st.shape[0]), (0, 0)]), dev)
             for dev, st in zip(self._devices, self.states)]
 
-    def _apply_cols(self, cols):
+    def _state_capacity(self) -> int:
+        # dense per-device banks track row capacity (no slot ladder)
+        return self.capacity
+
+    def _fresh_state_at(self, capacity: int):
+        return [jax.device_put(batch_hll.init_state(capacity), d)
+                for d in self._devices]
+
+    def _apply_cols_states(self, states, cols) -> None:
         rows, idxs, rhos = cols
         if not self._digest_routed:
             i = self._rr_next
             self._rr_next = (i + 1) % self._n_shards
             dev = self._devices[i]
             r, ix, rh = (jax.device_put(c, dev) for c in cols)
-            self.states[i] = batch_hll.apply_batch(self.states[i], r, ix,
-                                                   rh)
+            states[i] = batch_hll.apply_batch(states[i], r, ix, rh)
             return
         home = self._home_of(rows)
         counts = self._shard_counts_of(home)
         for i in np.flatnonzero(counts).tolist():
             dev = self._devices[i]
             rows_i = np.where(home == i, rows, PAD_ROW)
-            self.states[i] = batch_hll.apply_batch(
-                self.states[i], jax.device_put(rows_i, dev),
+            states[i] = batch_hll.apply_batch(
+                states[i], jax.device_put(rows_i, dev),
                 jax.device_put(idxs, dev), jax.device_put(rhos, dev))
         self._plane.note_routed(self.family, counts)
+
+    def _apply_cols(self, cols):
+        self._apply_cols_states(self.states, cols)
+
+    def _readout_apply(self, states, cols, snap: dict):
+        self._apply_cols_states(states, cols)
+        return states
 
     def merge_batch(self, stubs, in_regs) -> None:
         with self.lock:
@@ -552,31 +624,39 @@ class ShardedSetTable(_DigestRouted, SetTable):
         finally:
             self.apply_lock.release()
 
-    def _merged_state(self) -> jnp.ndarray:
-        stacked = collectives.stack_on_mesh(self._mesh, self.states)
-        self._plane.note_merge_round()
+    def _merged_state(self, states, note: bool = True) -> jnp.ndarray:
+        stacked = collectives.stack_on_mesh(self._mesh, states)
+        if note:
+            self._plane.note_merge_round()
         return collectives.merge_hll_stacked(stacked)
 
-    def snapshot_and_reset(self):
-        with self.lock:
-            cols = self._swap_locked()
-            self.apply_lock.acquire()
-            self._note_generation_locked()
-            touched = self.touched.copy()
-            meta = list(self.meta)
-            self.touched[:] = False
-        try:
-            if cols is not None:
-                self._apply_cols(cols)
-            merged = self._merged_state()
-            estimates = np.asarray(batch_hll.estimate(merged))
-            # lazy per-row provider (columnstore._SetRegisters): the
-            # merged (K, M) bank only crosses the device link if a
-            # consumer (the forward exporter) actually reads registers
-            registers = _SetRegisters.dense(merged, self.capacity)
-            self.states = [
-                jax.device_put(batch_hll.init_state(self.capacity), d)
-                for d in self._devices]
-        finally:
-            self.apply_lock.release()
-        return estimates, registers, touched, meta
+    def _readout_device(self, states, snap: dict) -> None:
+        merged = self._merged_state(states)
+        snap["estimates"] = np.asarray(batch_hll.estimate(merged))
+        # lazy per-row provider (columnstore._SetRegisters): the
+        # merged (K, M) bank only crosses the device link if a
+        # consumer (the forward exporter) actually reads registers.
+        # The provider references the MERGED bank, so the drained
+        # per-device generations are recyclable.
+        snap["registers"] = _SetRegisters.dense(merged, self.capacity)
+        snap["_recycle"] = states
+
+    def prewarm_rung(self, capacity: int, percentiles=(),
+                     need_export: bool = True) -> bool:
+        """Unlike the sparse table, the dense per-device banks DO track
+        row capacity, so a resize retraces — prewarm the rung."""
+        return _BaseTable.prewarm_rung(self, capacity, percentiles,
+                                       need_export)
+
+    def _prewarm_apply(self, states, cols, capacity: int):
+        rows, idxs, rhos = cols
+        for i, dev in enumerate(self._devices):
+            states[i] = batch_hll.apply_batch(
+                states[i], jax.device_put(rows, dev),
+                jax.device_put(idxs, dev), jax.device_put(rhos, dev))
+        return states
+
+    def _prewarm_readout(self, states, capacity: int, ps: tuple,
+                         need_export: bool):
+        merged = self._merged_state(states, note=False)
+        return (batch_hll.estimate(merged), _zeros_like_spare(states))

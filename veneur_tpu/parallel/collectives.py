@@ -183,6 +183,39 @@ def merge_llhist_rows_at(regs, shard_ids, rows, in_rows):
 
 
 # -- collective interval merges ----------------------------------------
+#
+# Two shapes per family: the read-only merge (kept for parity tests and
+# any caller that wants the stacked state to survive), and the fused
+# donated merge+reset the flush readout runs — `donate_argnums=0` lets
+# XLA alias the drained interval's buffers for the returned fresh
+# generation, so the double-buffered flush never allocates per interval
+# and the merged readout leaves the swapped-out state's HBM in place.
+
+
+def _zeros_tree(state):
+    return jax.tree.map(jnp.zeros_like, state)
+
+
+@partial(jax.jit, donate_argnums=0)
+def merge_counters_stacked_reset(state):
+    """Fused donated interval merge: (merged Kahan pair, fresh zeroed
+    stacked generation aliasing the donated input)."""
+    merged = (jnp.sum(state["sum"], axis=0), jnp.sum(state["comp"], axis=0))
+    return merged, _zeros_tree(state)
+
+
+@partial(jax.jit, donate_argnums=0)
+def merge_gauges_stacked_reset(state):
+    """Fused donated LWW merge: ((value, set), fresh generation)."""
+    value = jnp.sum(jnp.where(state["set"], state["value"], 0.0), axis=0)
+    return (value, jnp.any(state["set"], axis=0)), _zeros_tree(state)
+
+
+@partial(jax.jit, donate_argnums=0)
+def merge_llhist_stacked_reset(stacked: jnp.ndarray):
+    """Fused donated register-ADD merge: ((K, BINS_PAD) merged
+    registers, fresh stacked generation)."""
+    return jnp.sum(stacked, axis=0), _zeros_tree(stacked)
 
 @jax.jit
 def merge_counters_stacked(state) -> Tuple[jnp.ndarray, jnp.ndarray]:

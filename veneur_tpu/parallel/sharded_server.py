@@ -19,6 +19,7 @@ an operator can see a skewed key space (one hot shard) or a dead chip
 from __future__ import annotations
 
 import logging
+import threading
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -66,10 +67,13 @@ class ShardedServingPlane:
         self.n = len(self.devices)
         self.routing = routing
         self.mesh = collectives.local_mesh(self.devices)
-        # per-shard routed-sample counters, written under each table's
-        # buffer lock (GIL-atomic int adds; a torn scrape is one row
-        # stale, never corrupt), keyed by family
+        # per-shard routed-sample counters, keyed by family. Writers
+        # used to all sit under a table's apply lock; the overlapped
+        # flush's background readout folds counts lock-free, so the
+        # numpy read-modify-write adds now need their own leaf lock
+        # (scrapes stay lock-free point reads — one row stale at worst)
         self._samples: Dict[str, np.ndarray] = {}
+        self._acc_lock = threading.Lock()
         self.batches_dispatched = 0
         self.merge_rounds = 0
 
@@ -85,15 +89,19 @@ class ShardedServingPlane:
     # -- accounting ------------------------------------------------------
 
     def note_routed(self, family: str, per_shard_counts) -> None:
-        """Fold one dispatch's per-shard sample counts (len n array)."""
-        acc = self._samples.get(family)
-        if acc is None:
-            acc = self._samples[family] = np.zeros(self.n, np.int64)
-        acc += np.asarray(per_shard_counts, np.int64)
-        self.batches_dispatched += 1
+        """Fold one dispatch's per-shard sample counts (len n array).
+        Thread-safe: called from ingest (under table locks) AND from
+        the background flush readout (lock-free by design)."""
+        with self._acc_lock:
+            acc = self._samples.get(family)
+            if acc is None:
+                acc = self._samples[family] = np.zeros(self.n, np.int64)
+            acc += np.asarray(per_shard_counts, np.int64)
+            self.batches_dispatched += 1
 
     def note_merge_round(self) -> None:
-        self.merge_rounds += 1
+        with self._acc_lock:
+            self.merge_rounds += 1
 
     # -- surfaces --------------------------------------------------------
 
