@@ -38,6 +38,7 @@ METRIC_NAMES = {
     "mesh": "mesh_samples_per_sec",
     "mesh-worker": "mesh_samples_per_sec",
     "resize_storm": "resize_storm_flush_p99_ratio",
+    "query": "query_reads_per_sec",
 }
 
 # accumulates fields as stages complete, so the deadline guard can emit a
@@ -1449,6 +1450,99 @@ def run_scenario_resize_storm(duration_s: float = 0.0,
     return ratio
 
 
+def run_scenario_query(duration_s: float, num_keys: int = 2000):
+    """Live query plane read-path (PR 16): query throughput and read
+    latency under sustained ingest at 1, 8, and 64 concurrent readers.
+    Readers rotate the four dashboard kinds (quantile / count /
+    cardinality / value) against a live server while an ingest thread
+    keeps the pending fold busy — every query takes a consistent
+    read-only capture and syncs on the shared flush executor, so the
+    rungs measure real capture/readout contention, not a cached value.
+    Headline: reads/s at 8 readers; per-rung reads/s and p50/p99 read
+    latency ride along in the result record."""
+    from veneur_tpu.core.query import QuerySpec
+
+    server = _mk_server(num_keys, families=4, interval=3600.0)
+    packets, _samples = make_packets(num_keys)
+    datagrams = make_datagrams(packets)
+    server.handle_packet_batch(datagrams)
+    server.store.apply_all_pending()
+
+    specs = [
+        QuerySpec.build("bench.timer.2", "quantile", q=0.99),
+        QuerySpec.build("bench.counter.0", "count"),
+        QuerySpec.build("bench.set.3", "cardinality"),
+        QuerySpec.build("bench.gauge.1", "value"),
+    ]
+    # first pass compiles/warms every family's capture + readout path
+    for s in specs:
+        server.query_plane.query(s)
+
+    stop_ingest = threading.Event()
+
+    def ingest():
+        while not stop_ingest.is_set():
+            server.handle_packet_batch(datagrams)
+            time.sleep(0.001)
+
+    def reader(lat: list, stop_rung: threading.Event):
+        i = 0
+        while not stop_rung.is_set():
+            t0 = time.perf_counter()
+            server.query_plane.query(specs[i % len(specs)])
+            lat.append(time.perf_counter() - t0)
+            i += 1
+
+    rung_s = max(2.0, duration_s / 3)
+    rungs = {}
+    feeder = threading.Thread(target=ingest, daemon=True)
+    feeder.start()
+    try:
+        for readers in (1, 8, 64):
+            if time_left() < rung_s + 10:
+                log(f"query rung {readers} skipped: "
+                    f"{time_left():.0f}s left")
+                break
+            stop_rung = threading.Event()
+            lats = [[] for _ in range(readers)]
+            threads = [threading.Thread(target=reader,
+                                        args=(lats[i], stop_rung),
+                                        daemon=True)
+                       for i in range(readers)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            time.sleep(rung_s)
+            stop_rung.set()
+            for t in threads:
+                t.join(timeout=30)
+            elapsed = time.perf_counter() - t0
+            merged = sorted(x for l in lats for x in l)
+            n = len(merged)
+            rungs[readers] = {
+                "reads_per_sec": round(n / elapsed, 1),
+                "read_p50_ms": round(merged[n // 2] * 1e3, 3) if n else None,
+                "read_p99_ms": round(merged[min(n - 1, int(n * 0.99))]
+                                     * 1e3, 3) if n else None,
+            }
+            log(f"query rung {readers} readers: "
+                f"{rungs[readers]['reads_per_sec']}/s "
+                f"p50={rungs[readers]['read_p50_ms']}ms "
+                f"p99={rungs[readers]['read_p99_ms']}ms")
+    finally:
+        stop_ingest.set()
+        feeder.join(timeout=10)
+        server.config.flush_on_shutdown = False
+        server.shutdown()
+
+    for readers, r in rungs.items():
+        RESULT[f"query_reads_per_sec_{readers}"] = r["reads_per_sec"]
+        RESULT[f"query_read_p50_ms_{readers}"] = r["read_p50_ms"]
+        RESULT[f"query_read_p99_ms_{readers}"] = r["read_p99_ms"]
+    headline = rungs.get(8) or (rungs[max(rungs)] if rungs else None)
+    return headline["reads_per_sec"] if headline else 0.0
+
+
 def run_scenario_hll(duration_s: float, num_keys: int = 10_000,
                      cardinality: int = 100):
     """BASELINE config 3: mixed keys at tag cardinality 100 — HLL stress
@@ -1468,7 +1562,7 @@ def run_scenario_hll(duration_s: float, num_keys: int = 10_000,
 
 SCENARIOS = ["default", "mixed", "single", "counter", "timers", "hll",
              "llhist", "forward", "ssf", "device", "sustained", "tdigest",
-             "mesh", "mesh-worker", "resize_storm"]
+             "mesh", "mesh-worker", "resize_storm", "query"]
 
 
 def clamp_keys(keys: int, on_tpu: bool) -> int:
@@ -1548,6 +1642,8 @@ def run_one(scenario: str, duration: float, keys: int, on_tpu: bool = True):
         rate = run_scenario_mesh_worker(duration, min(keys, 2000))
     elif scenario == "resize_storm":
         rate = run_scenario_resize_storm(duration)
+    elif scenario == "query":
+        rate = run_scenario_query(duration, min(keys, 2000))
     else:
         rate = run_scenario_ssf(duration, keys)
     return metric, rate, extra

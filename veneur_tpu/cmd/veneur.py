@@ -85,6 +85,22 @@ def main(argv=None) -> int:
 
     signal.signal(signal.SIGINT, handle_signal)
     signal.signal(signal.SIGTERM, handle_signal)
+
+    # SIGHUP: hot-reload the `alerts:` block from the config file —
+    # rule table swaps in place, in-flight alert state survives for
+    # rule ids present in both tables. A bad table keeps the old one.
+    def handle_hup(signum, frame):
+        def _reload():
+            try:
+                server.reload_alerts(args.config)
+            except Exception:
+                log.exception("SIGHUP alert reload failed; "
+                              "keeping the previous rule table")
+        threading.Thread(target=_reload, name="alert-reload",
+                         daemon=True).start()
+
+    if hasattr(signal, "SIGHUP"):
+        signal.signal(signal.SIGHUP, handle_hup)
     # SIGUSR2: zero-gap graceful restart via SO_REUSEPORT handoff (the
     # einhorn equivalent, reference server.go:1404, README.md:170-178)
     from veneur_tpu.core import restart

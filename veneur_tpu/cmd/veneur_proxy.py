@@ -224,13 +224,19 @@ def build_from_config(raw: dict, args, log):
     http_addr = raw.get("http_address", args.http)
     if http_addr:
         from veneur_tpu.core.httpapi import HTTPApi
+        from veneur_tpu.core.query import ProxyQueryView
+        # /query on the proxy tier: aggregate views over the routing
+        # plane (per-destination forwarded-key cardinality / volume)
+        query_view = ProxyQueryView(proxy)
+        telemetry.registry.add_collector(query_view.telemetry_rows)
         http_api = HTTPApi(raw, server=None, address=http_addr,
                            telemetry=telemetry,
                            cardinality=proxy.cardinality_report,
                            latency=proxy.latency.report,
                            ledger=proxy.ledger.report,
                            traces=proxy.trace_plane.report,
-                           ready=proxy.ready_state)
+                           ready=proxy.ready_state,
+                           query=query_view.query)
         http_api.start()
 
     return proxy, stats_loop, http_api

@@ -135,6 +135,21 @@ class TpuConfig:
 
 
 @dataclass
+class AlertsConfig:
+    """Declarative alert rule table (core/alerts.py). Each rule is a
+    mapping — {id, metric, kind, op, threshold, q, for, tags, lo, hi} —
+    validated at engine load, not here, so a SIGHUP reload of a bad
+    table reports the offending rule instead of failing config parse."""
+
+    enabled: bool = True
+    interval: float = 1.0  # duration between evaluation rounds
+    rules: List[Dict[str, Any]] = field(default_factory=list)
+
+    def __post_init__(self) -> None:
+        self.interval = parse_duration(self.interval) or 1.0
+
+
+@dataclass
 class Config:
     aggregates: List[str] = field(default_factory=lambda: ["min", "max", "count"])
     count_unique_timeseries: bool = False
@@ -421,6 +436,7 @@ class Config:
     veneur_metrics_additional_tags: List[str] = field(default_factory=list)
     veneur_metrics_scopes: Dict[str, str] = field(default_factory=dict)
     tpu: TpuConfig = field(default_factory=TpuConfig)
+    alerts: AlertsConfig = field(default_factory=AlertsConfig)
 
     def apply_defaults(self) -> "Config":
         if not self.aggregates:
@@ -451,6 +467,7 @@ class Config:
 _SUBSECTION_TYPES = {
     "features": Features,
     "tpu": TpuConfig,
+    "alerts": AlertsConfig,
 }
 _LIST_TYPES = {
     "metric_sinks": SinkConfig,

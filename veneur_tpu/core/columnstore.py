@@ -414,6 +414,106 @@ class _BaseTable:
                 self._spare = spare
                 self._spare_cap = cap
 
+    # -- live-query capture: read-only snapshot between flushes ----------
+    #
+    # The query plane (core/query.py) reads the LIVE generation without
+    # swapping it: no reset, no generation advance, no recycle. Safety
+    # rests on two invariants the flush path already establishes:
+    #
+    #   * jax arrays are immutable — capturing `self.state` by reference
+    #     under apply_lock yields a consistent point-in-time view even
+    #     while ingest keeps rebinding the live attribute to new arrays;
+    #   * every DONATING kernel on the readout path is either avoided
+    #     (sharded tables override _query_readout_device with the
+    #     non-reset collective merges) or fed a private copy (the sparse
+    #     set table's hot-COO fold).
+    #
+    # Pending columns fold into the live state first through the normal
+    # dispatch path (donation-safe: the donated input is the OLD live
+    # buffer, replaced by the kernel's output), so absent further ingest
+    # the captured generation is exactly what the next swap_out would
+    # capture — the bit-identity the consistency pin asserts. Under
+    # sustained ingest the fold retries a bounded number of rounds;
+    # anything still pending after that is the query's (bounded)
+    # staleness, one batch_cap of samples at most per round lost.
+
+    _CAPTURE_FOLD_ROUNDS = 8
+
+    def capture_readonly(self, **kw) -> dict:
+        """Read-only counterpart of swap_out: capture the live device
+        generation plus touched/meta/extras WITHOUT swapping or
+        resetting anything, and dispatch the readout kernels over it.
+        Extra kwargs ride into the snap exactly as for swap_out (ps,
+        need_export, need_bins).
+
+        The readout DISPATCH happens here, under apply_lock, and that
+        placement is load-bearing: the next pending apply DONATES the
+        live buffers, deleting the captured references — a dispatch
+        after the lock releases would race that deletion. Dispatch is
+        asynchronous (no device sync under the lock); its result
+        buffers are fresh, so later donation cannot touch them. The
+        sync itself happens in query_readout(), off the table locks."""
+        snap = dict(kw)
+        with self.lock:
+            if self._idle_capture_locked(snap):
+                return snap
+            for _ in range(self._CAPTURE_FOLD_ROUNDS):
+                if self._n == 0:
+                    break
+                self._dispatch_pending_locked()  # may release/reacquire
+            # residual pending samples after the bounded fold ARE the
+            # query's staleness — surfaced to the caller, never lost
+            # (they fold into the live state on the next dispatch)
+            snap["stale_pending"] = self._n
+            with self.apply_lock:
+                snap["touched"] = self.touched.copy()
+                snap["meta"] = list(self.meta)
+                self._capture_extras_locked(snap)
+                self._query_readout_device(
+                    self._capture_device_locked(), snap)
+                # the snap must NEVER reach recycle(): the state it read
+                # IS the live generation
+                for key in ("_recycle", "_spare", "cap"):
+                    snap.pop(key, None)
+        return snap
+
+    def _idle_capture_locked(self, snap: dict) -> bool:
+        """Family-specific idle fast path for queries (caller holds
+        ``lock``): mirrors _idle_swap_locked but advances nothing."""
+        return False
+
+    def _capture_extras_locked(self, snap: dict) -> None:
+        """Read-only counterpart of _swap_extras_locked: COPY family
+        host-side interval state into the snap without resetting it
+        (caller holds ``lock`` + ``apply_lock``)."""
+
+    def _capture_device_locked(self):
+        """Reference to the live device generation (caller holds
+        ``apply_lock``). A reference, not a copy: the arrays are
+        immutable, and later applies rebind the live attribute without
+        touching the captured value."""
+        return self.state
+
+    def query_readout(self, snap: dict) -> dict:
+        """The device-sync half of a query: wait for the result buffers
+        capture_readonly dispatched. Runs lock-free on the server's
+        supervised flush executor, so query syncs serialize with the
+        in-flight flush readout instead of colliding with it."""
+        import jax
+        jax.block_until_ready(
+            {k: v for k, v in snap.items() if k != "meta"})
+        return snap
+
+    def _query_readout_device(self, state, snap: dict) -> None:
+        """Family hook for the query readout. The default is safe only
+        when the flush readout stores nothing but fresh kernel outputs
+        into the snap (histogram/llhist). Families whose flush readout
+        captures the state by reference (counter/gauge transfer rows),
+        donates it (the sharded fused merge+reset kernels), or writes
+        into it (the sparse set fold) override this — a query reads the
+        LIVE generation, which stays exposed to later donating applies."""
+        self._readout_device(state, snap)
+
     # -- shape-ladder prewarm --------------------------------------------
 
     def prewarm_rung(self, capacity: int, percentiles=(),
@@ -805,12 +905,24 @@ class CounterTable(_BaseTable):
         snap["import_acc"] = self._import_acc
         self._import_acc = np.zeros(self.capacity, np.float64)
 
+    def _capture_extras_locked(self, snap: dict) -> None:
+        # copy, not reference: merge_batch mutates the accumulator in
+        # place (np.add.at), so a live reference could tear mid-read
+        snap["import_acc"] = self._import_acc.copy()
+
     def _readout_device(self, state, snap: dict) -> None:
         """Counter readout is a pure transfer of the Kahan pair; the
         sharded table overrides this with the collective merge. The
         captured generation is recycled after the transfer."""
         snap["dev"] = (state["sum"], state["comp"])
         snap["_recycle"] = state
+
+    def _query_readout_device(self, state, snap: dict) -> None:
+        # the flush readout stores the Kahan pair BY REFERENCE — safe
+        # there because the swapped-out generation is exclusive. A query
+        # reads the LIVE pair, which the next pending apply DONATES, so
+        # snapshot fresh buffers with an async copy kernel instead.
+        snap["dev"] = (jnp.copy(state["sum"]), jnp.copy(state["comp"]))
 
     def snapshot_begin(self) -> dict:
         """Dispatch half of snapshot_and_reset: swap + readout, but do
@@ -898,6 +1010,11 @@ class GaugeTable(_BaseTable):
         sharded table overrides this with the collective merge."""
         snap["dev"] = state["value"]
         snap["_recycle"] = state
+
+    def _query_readout_device(self, state, snap: dict) -> None:
+        # see CounterTable: the live LWW column gets donated by the
+        # next pending apply — a query must capture a fresh copy
+        snap["dev"] = jnp.copy(state["value"])
 
     def snapshot_begin(self) -> dict:
         """Dispatch-only snapshot half; see CounterTable.snapshot_begin."""
@@ -1512,6 +1629,30 @@ class SetTable(_BaseTable):
         self._nslots = 0
         self._counts[:] = 0
 
+    def _capture_extras_locked(self, snap: dict) -> None:
+        """Read-only sparse-tier capture: the COO backlog and slot map
+        copied WITHOUT the reset — the live tier keeps accumulating."""
+        if not self._sparse:
+            return
+        coo = list(self._coo)  # entries are append-once, never mutated
+        sc = self._coo_scalar
+        if sc[0]:
+            coo.append((np.asarray(sc[0], np.int32),
+                        np.asarray(sc[1], np.int32),
+                        np.asarray(sc[2], np.int32)))
+        snap["sparse"] = {"coo": coo, "slot_of": self._slot_of.copy(),
+                          "slot_row": list(self._slot_row),
+                          "nslots": self._nslots}
+
+    def _query_readout_device(self, state, snap: dict) -> None:
+        # the sparse readout folds the hot-COO backlog through the
+        # DONATING scatter-max kernel — feed it a private copy so the
+        # live bank's buffers survive the query (single-device table,
+        # so the default-device copy placement is the right one)
+        if self._sparse:
+            state = jnp.copy(state)
+        self._readout_device(state, snap)
+
     def _readout_device(self, state, snap: dict) -> None:
         """Estimate + register-provider assembly over the captured
         generation. The register provider keeps a live device reference
@@ -1708,6 +1849,16 @@ class LLHistTable(_BaseTable):
         # keyset keeps working.
         if self._n == 0 and not self.touched.any():
             self._note_generation_locked()
+            snap.update(packed=None, bins_dev=None,
+                        touched=self.touched.copy(),
+                        meta=list(self.meta))
+            return True
+        return False
+
+    def _idle_capture_locked(self, snap: dict) -> bool:
+        # same skip for queries — minus the generation advance (a
+        # read-only capture must not perturb idle-row reclamation)
+        if self._n == 0 and not self.touched.any():
             snap.update(packed=None, bins_dev=None,
                         touched=self.touched.copy(),
                         meta=list(self.meta))

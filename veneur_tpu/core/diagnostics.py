@@ -47,10 +47,15 @@ def collect(stats: ScopedClient, start_time: float,
     # ru_maxrss is the PEAK high-water mark, not the current footprint;
     # report it under its own name and the live value from /proc
     rss = _current_rss_bytes()
-    stats.gauge("mem.rss_bytes",
-                rss if rss is not None
-                else ru.ru_maxrss * _RU_MAXRSS_SCALE)
-    stats.gauge("mem.max_rss_bytes", ru.ru_maxrss * _RU_MAXRSS_SCALE)
+    max_rss = ru.ru_maxrss * _RU_MAXRSS_SCALE
+    if rss is not None and rss > max_rss:
+        # the kernel updates the hiwater mark lazily (batched rss_stat
+        # accounting), so a growing process can read a live RSS above
+        # the reported peak; clamp so the export keeps the invariant
+        # operators (and dashboards dividing the two) rely on
+        max_rss = rss
+    stats.gauge("mem.rss_bytes", rss if rss is not None else max_rss)
+    stats.gauge("mem.max_rss_bytes", max_rss)
     stats.gauge("cpu.user_seconds", ru.ru_utime)
     stats.gauge("cpu.system_seconds", ru.ru_stime)
     counts = gc.get_count()

@@ -21,8 +21,9 @@ import os
 import socket
 import sys
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Optional
+from typing import Any, Dict, Optional
 
 import yaml
 
@@ -30,6 +31,27 @@ import veneur_tpu
 from veneur_tpu.util.secret import StringSecret
 
 BUILD_DATE = "dev"
+
+# llhist series exported per route: http.route renders .p50/.p99 gauges
+# + .count counter, tagged method:/path: (scripts/check_metric_names.py
+# expands HIST_ROWS tuples against the README inventory)
+HIST_ROWS = ("http.route",)
+
+# routes timed individually; anything else buckets under path:other so
+# scanning garbage paths can't mint unbounded label values
+_TIMED_ROUTES = frozenset({
+    "/healthcheck", "/healthcheck/tracing", "/healthcheck/ready",
+    "/version", "/builddate", "/config/json", "/config/yaml", "/metrics",
+    "/query", "/alerts", "/quitquitquit", "/import",
+    "/debug/events", "/debug/flush", "/debug/latency", "/debug/ledger",
+    "/debug/traces", "/debug/cardinality", "/debug/memory",
+    "/debug/threads", "/debug/profile/cpu", "/debug/profile/device",
+    "/debug/pprof", "/debug/pprof/", "/debug/pprof/profile",
+    "/debug/pprof/heap", "/debug/pprof/allocs", "/debug/pprof/goroutine",
+    "/debug/pprof/block", "/debug/pprof/mutex",
+    "/debug/pprof/threadcreate", "/debug/pprof/cmdline",
+    "/debug/pprof/symbol", "/debug/pprof/trace",
+})
 
 
 def config_to_dict(cfg: Any) -> Any:
@@ -66,6 +88,14 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     def do_GET(self) -> None:  # noqa: N802
+        t0 = time.perf_counter()
+        try:
+            self._route_GET()
+        finally:
+            self.server_ref.observe_route(
+                "GET", self.path, time.perf_counter() - t0)
+
+    def _route_GET(self) -> None:
         api = self.server_ref
         path = self.path.split("?", 1)[0]
         if path == "/healthcheck":
@@ -223,6 +253,60 @@ class _Handler(BaseHTTPRequestHandler):
             body = json.dumps(source(top=top, name=name), indent=2,
                               default=str).encode()
             self._send(200, body, "application/json")
+        elif path == "/query":
+            # the live query plane (core/query.py): percentile / count /
+            # rate / cardinality / value / bin-occupancy lookups against
+            # a consistent read-only capture of the LIVE device
+            # generation — sub-interval staleness, no flush perturbation.
+            # ?metric=&kind=&q=&tags=a:b,c:d&lo=&hi=. A standalone API
+            # (the proxy) passes its own aggregate view as the source.
+            source = api.query_source
+            if source is None:
+                plane = getattr(api.server, "query_plane", None)
+                source = getattr(plane, "query", None)
+            if source is None:
+                self._send(404, b"no query source\n")
+                return
+            from veneur_tpu.core.query import (QueryError, QuerySpec,
+                                               parse_tags)
+            try:
+                spec = QuerySpec.build(
+                    metric=_query_str(self.path, "metric"),
+                    kind=_query_str(self.path, "kind", "value"),
+                    q=_query_str(self.path, "q") or None,
+                    tags=parse_tags(_query_str(self.path, "tags")),
+                    lo=_query_str(self.path, "lo") or None,
+                    hi=_query_str(self.path, "hi") or None)
+            except (QueryError, ValueError) as e:
+                self._send(400, json.dumps({"error": str(e)}).encode()
+                           + b"\n", "application/json")
+                return
+            try:
+                result = source(spec)
+            except QueryError as e:
+                self._send(400, json.dumps({"error": str(e)}).encode()
+                           + b"\n", "application/json")
+                return
+            except Exception as e:  # timeout / device fault: the
+                # query plane is best-effort, never a crash surface
+                self._send(500, json.dumps({"error": str(e)}).encode()
+                           + b"\n", "application/json")
+                return
+            self._send(200, json.dumps(result, indent=2,
+                                       default=str).encode(),
+                       "application/json")
+        elif path == "/alerts":
+            # the alert engine's rule table + state machines
+            # (core/alerts.py): per-rule state, last value, hold-down
+            engine = api.alerts_source
+            if engine is None:
+                engine = getattr(api.server, "alerts", None)
+            if engine is None:
+                self._send(404, b"no alert engine\n")
+                return
+            self._send(200, json.dumps(engine.report(), indent=2,
+                                       default=str).encode(),
+                       "application/json")
         elif path == "/debug/memory":
             self._send(200, _device_memory_report(),
                        "application/json")
@@ -325,6 +409,8 @@ class _Handler(BaseHTTPRequestHandler):
                 b"  /debug/latency                  latency observatory\n"
                 b"  /debug/ledger?intervals=N       flow-ledger conservation\n"
                 b"  /debug/cardinality?top=N&name=  series cardinality\n"
+                b"  /query?metric=&kind=&q=         live query plane\n"
+                b"  /alerts                         alert rule states\n"
                 b"  /metrics                        Prometheus exposition\n"))
         elif path == "/debug/profile/device":
             # jax.profiler trace (TensorBoard-loadable zip) — the TPU
@@ -357,6 +443,14 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(404, b"not found\n")
 
     def do_POST(self) -> None:  # noqa: N802
+        t0 = time.perf_counter()
+        try:
+            self._route_POST()
+        finally:
+            self.server_ref.observe_route(
+                "POST", self.path, time.perf_counter() - t0)
+
+    def _route_POST(self) -> None:
         api = self.server_ref
         path = self.path.split("?", 1)[0]
         if path == "/quitquitquit" and api.http_quit:
@@ -408,12 +502,25 @@ class HTTPApi:
                  http_quit: bool = False, on_quit=None,
                  require_flush_for_ready: bool = False, telemetry=None,
                  cardinality=None, latency=None, ready=None, ledger=None,
-                 traces=None):
+                 traces=None, query=None, alerts=None):
         self.config = config
         self.server = server
         self.http_quit = http_quit
         self.on_quit = on_quit
         self.require_flush_for_ready = require_flush_for_ready
+        # /query source: a callable(QuerySpec) -> dict. The owning
+        # server's query_plane.query is used by default; a standalone
+        # API (the proxy) passes its ProxyQueryView's aggregate query
+        self.query_source = query
+        # /alerts source: an object with .report() -> dict; the owning
+        # server's AlertEngine by default (a proxy has none)
+        self.alerts_source = alerts
+        # per-route latency (core/latency.py): every request through
+        # do_GET/do_POST lands in a per-(method, path) llhist, exported
+        # as http.route.* rows — the request plane was the last untimed
+        # hand-off in the latency observatory
+        self._route_hists: Dict[str, "object"] = {}
+        self._route_lock = threading.Lock()
         # /debug/cardinality source: a callable(top=N, name="") -> dict.
         # The owning server's cardinality_report is used by default; a
         # standalone API (the proxy) passes its own.
@@ -446,6 +553,7 @@ class HTTPApi:
             telemetry.registry.add_collector(
                 telemetry_mod.device_memory_rows)
         self.telemetry = telemetry
+        self.telemetry.registry.add_collector(self.route_telemetry_rows)
         host, _, port = address.rpartition(":")
         handler = type("BoundHandler", (_Handler,), {"server_ref": self})
 
@@ -470,6 +578,35 @@ class HTTPApi:
     @property
     def address(self):
         return self._httpd.server_address
+
+    def observe_route(self, method: str, raw_path: str,
+                      elapsed_s: float) -> None:
+        from veneur_tpu.core.latency import LatencyHist
+        path = raw_path.split("?", 1)[0]
+        if path not in _TIMED_ROUTES:
+            path = "other"
+        key = f"{method} {path}"
+        with self._route_lock:
+            hist = self._route_hists.get(key)
+            if hist is None:
+                hist = self._route_hists[key] = LatencyHist("http.route")
+        hist.observe(elapsed_s)
+
+    def route_telemetry_rows(self):
+        """http.route.{p50,p99} gauges + .count counter per route."""
+        with self._route_lock:
+            items = sorted(self._route_hists.items())
+        rows = []
+        for key, hist in items:
+            method, _, path = key.partition(" ")
+            tags = [f"method:{method}", f"path:{path}"]
+            snap = hist.snapshot()
+            for label in ("p50", "p99"):
+                rows.append((f"http.route.{label}", "gauge",
+                             snap[label], tags))
+            rows.append(("http.route.count", "counter",
+                         float(snap["count"]), tags))
+        return rows
 
     def start(self) -> None:
         self._thread = threading.Thread(

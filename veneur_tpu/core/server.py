@@ -487,6 +487,26 @@ class Server:
         self.telemetry.registry.add_collector(self.store.telemetry_rows)
         self.telemetry.registry.add_collector(
             self.cardinality.telemetry_rows)
+        # live query plane (core/query.py): consistent read-only
+        # captures of the live device generation, served between
+        # flushes by GET /query and evaluated every tick by the alert
+        # engine (core/alerts.py). Built here, not start(), so
+        # in-process test topologies can query without an HTTP listener.
+        from veneur_tpu.core.alerts import AlertEngine
+        from veneur_tpu.core.query import LiveQueryPlane
+        self.query_plane = LiveQueryPlane(self)
+        self.telemetry.registry.add_collector(
+            self.query_plane.telemetry_rows)
+        self.alerts = AlertEngine(self, self.query_plane,
+                                  interval_s=config.alerts.interval)
+        try:
+            self.alerts.configure(config.alerts.rules)
+        except Exception:
+            # a bad rule table must not keep the server down: start
+            # with an empty table, loudly — SIGHUP reloads it once fixed
+            logger.exception("invalid alerts.rules; starting with an "
+                             "empty rule table")
+        self.telemetry.registry.add_collector(self.alerts.telemetry_rows)
         self._flush_thread: Optional[threading.Thread] = None
         self._watchdog_thread: Optional[threading.Thread] = None
         self._shutdown = threading.Event()
@@ -1076,6 +1096,16 @@ class Server:
                 self.overload.supervisor.deadline, 2.5 * self.interval,
                 60.0))
         self._flush_thread.start()
+        if self.config.alerts.enabled:
+            # alert evaluation loop: supervised like every pipeline
+            # thread, with a generous deadline — one tick's capture
+            # rides the shared readout executor and can queue behind a
+            # seconds-long flush readout
+            self.overload.supervisor.register(
+                "alert-loop", deadline=max(
+                    self.overload.supervisor.deadline,
+                    10 * self.alerts.interval_s, 60.0))
+            self.alerts.start()
         self.overload.start()
         if self.config.flush_watchdog_missed_flushes > 0:
             self._watchdog_thread = threading.Thread(
@@ -1261,12 +1291,37 @@ class Server:
                                f"{since:.1f}s (allowed {allowed:.1f}s)")
         return True, ""
 
+    def reload_alerts(self, config_path: Optional[str] = None) -> int:
+        """SIGHUP hot-reload of the `alerts:` block: re-read the config
+        file (when the process has one), swap the rule table in place —
+        in-flight state machines survive for rule ids present in both
+        tables — and record the reload in the flight recorder. Returns
+        the new rule count; raises (keeping the old table) on a bad
+        rule, so a fat-fingered reload can't silence a firing alert."""
+        rules = self.config.alerts.rules
+        interval_s = self.config.alerts.interval
+        if config_path:
+            from veneur_tpu.config import read_config
+            fresh = read_config(config_path)
+            rules = fresh.alerts.rules
+            interval_s = fresh.alerts.interval
+            self.config.alerts = fresh.alerts
+        n = self.alerts.configure(rules, interval_s=interval_s)
+        self.telemetry.record_event("alerts_reload", rules=n,
+                                    interval_s=round(interval_s, 3))
+        logger.info("alerts reloaded: %d rule(s), interval %.3fs",
+                    n, interval_s)
+        return n
+
     def shutdown(self) -> None:
         self.telemetry.record_event("shutdown", pid=os.getpid())
         self._shutdown.set()
         # stop supervision first: pipeline threads exiting on the
         # shutdown path must not be flagged (or escalated) as stalls
         self.overload.stop()
+        # stop the alert loop before anything drains: its captures ride
+        # the shared readout executor the flush path stops below
+        self.alerts.stop()
         if self.chaos is not None:
             # only clear the global seam if WE installed this plan (two
             # servers in one test process chaos independently)

@@ -192,6 +192,14 @@ class ShardedCounterTable(_DigestRouted, CounterTable):
             collectives.merge_counters_stacked_reset(state)
         self._plane.note_merge_round()
 
+    def _query_readout_device(self, state, snap) -> None:
+        # read-only merge over the LIVE stacked generation: the fused
+        # reset variant would donate (and zero) the live buffers. Same
+        # reduction expression, so query results stay bit-identical to
+        # the flush readout under digest routing.
+        snap["dev"] = collectives.merge_counters_stacked(state)
+        self._plane.note_merge_round()
+
     def _prewarm_readout(self, state, capacity, ps, need_export):
         return collectives.merge_counters_stacked_reset(state)
 
@@ -254,6 +262,12 @@ class ShardedGaugeTable(_DigestRouted, GaugeTable):
     def _readout_device(self, state, snap) -> None:
         (dev, _set), snap["_spare"] = \
             collectives.merge_gauges_stacked_reset(state)
+        snap["dev"] = dev
+        self._plane.note_merge_round()
+
+    def _query_readout_device(self, state, snap) -> None:
+        # non-donating LWW merge (see ShardedCounterTable note)
+        dev, _set = collectives.merge_gauges_stacked(state)
         snap["dev"] = dev
         self._plane.note_merge_round()
 
@@ -337,6 +351,20 @@ class ShardedLLHistTable(_DigestRouted, LLHistTable):
         snap["packed"] = packed
         snap["bins_dev"] = bins_dev
 
+    def _query_readout_device(self, state, snap) -> None:
+        # non-donating register-ADD merge over the live stacked bank
+        # (integer addition: bit-identical to the fused reset merge)
+        merged = collectives.merge_llhist_stacked(state)
+        self._plane.note_merge_round()
+        packed = batch_llhist.flush_packed(merged, snap["ps"])
+        rows = np.flatnonzero(snap["touched"])
+        bins_dev = None
+        if snap.pop("need_bins") and rows.size:
+            bins_dev = jnp.take(merged, jnp.asarray(rows, jnp.int32),
+                                axis=0)
+        snap["packed"] = packed
+        snap["bins_dev"] = bins_dev
+
     def _prewarm_readout(self, state, capacity, ps, need_export):
         merged, fresh = collectives.merge_llhist_stacked_reset(state)
         return (batch_llhist.flush_packed(merged, ps), fresh)
@@ -361,6 +389,11 @@ class _PerDeviceStates:
         else:
             self.states = self._fresh_state()
         return captured
+
+    def _capture_device_locked(self):
+        # shallow list copy under apply_lock: a consistent point-in-time
+        # set of per-device array refs (ingest rebinds list entries)
+        return list(self.states)
 
 
 class ShardedHistoTable(_PerDeviceStates, _DigestRouted, HistoTable):
