@@ -39,6 +39,8 @@ METRIC_NAMES = {
     "mesh-worker": "mesh_samples_per_sec",
     "resize_storm": "resize_storm_flush_p99_ratio",
     "query": "query_reads_per_sec",
+    "reshard": "reshard_flush_p99_ratio",
+    "reshard-worker": "reshard_flush_p99_ratio",
 }
 
 # accumulates fields as stages complete, so the deadline guard can emit a
@@ -1450,6 +1452,174 @@ def run_scenario_resize_storm(duration_s: float = 0.0,
     return ratio
 
 
+def run_scenario_reshard(duration_s: float = 0.0):
+    """PR-18 acceptance gate: flush-latency FLATNESS through a live
+    elastic reshard. The mesh needs its virtual device count fixed
+    before the backend initializes (same constraint as the mesh
+    ladder), so the measurement runs in a fresh reshard-worker
+    subprocess; this parent relays its result fields."""
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    cmd = [sys.executable, os.path.abspath(__file__),
+           "--scenario", "reshard-worker",
+           "--duration", str(duration_s), "--deadline", "0"]
+    budget = time_left()
+    try:
+        proc = subprocess.run(
+            cmd, env=env, capture_output=True,
+            timeout=None if budget == float("inf")
+            else max(120, budget - 5))
+        line = proc.stdout.decode().strip().splitlines()[-1]
+        obj = json.loads(line)
+    except Exception as e:
+        RESULT["reshard_error"] = f"{type(e).__name__}: {e}"
+        log(f"reshard worker failed: {e}")
+        return 0.0
+    for key, val in obj.items():
+        if key.startswith("reshard_"):
+            RESULT[key] = val
+    ratio = float(obj.get("value") or 0.0)
+    log(f"reshard: p99 ratio={ratio:.2f} "
+        f"cutover={obj.get('reshard_cutover_s')}s "
+        f"segments={obj.get('reshard_segments')} "
+        f"flat={obj.get('reshard_flat')}")
+    return ratio
+
+
+def run_scenario_reshard_worker(duration_s: float = 0.0,
+                                interval_s: float = 1.5,
+                                intervals: int = 3):
+    """One fresh-process reshard measurement: a live ticker mesh server
+    (2 shards) under steady mixed UDP load takes a flush-p99 baseline,
+    then a live 2 -> 3 elastic reshard (parallel/reshard.py) runs —
+    plan, prewarm, WAL-backed cutover — while the load keeps flowing,
+    then the baseline runs again. Reports flush p99 before/during/after
+    (the acceptance, mirroring resize_storm: during <= 1.25x pre — the
+    plan/prewarm phases must not crater the flush loop; the cutover
+    itself happens under the flush lock, between ticks), plus the
+    cutover duration and WAL segment count. Returns the during/pre p99
+    ratio."""
+    import socket
+    import tempfile
+    import threading
+
+    from veneur_tpu.config import Config
+    from veneur_tpu.core.server import Server
+    from veneur_tpu.sinks.blackhole import BlackholeMetricSink
+
+    cfg = Config()
+    cfg.interval = interval_s
+    cfg.statsd_listen_addresses = ["udp://127.0.0.1:0"]
+    cfg.tpu.shards = 2
+    cfg.reshard_spool_dir = tempfile.mkdtemp(prefix="bench-reshard-")
+    cfg.tpu.counter_capacity = 2048
+    cfg.tpu.gauge_capacity = 2048
+    cfg.tpu.histo_capacity = 2048
+    cfg.tpu.set_capacity = 1024
+    cfg.tpu.llhist_capacity = 1024
+    cfg.tpu.batch_cap = BATCH_CAP[0]
+    cfg.apply_defaults()
+    server = Server(cfg, extra_metric_sinks=[BlackholeMetricSink()])
+    server.start()
+    if server.store.shard_plane is None:
+        RESULT["reshard_error"] = "no serving plane (device count)"
+        server.shutdown()
+        return 0.0
+    host, port = server.local_addr("udp")
+
+    flush_times = []
+    orig = server._flush_locked
+
+    def timed():
+        t0 = time.perf_counter()
+        orig()
+        flush_times.append(time.perf_counter() - t0)
+
+    server._flush_locked = timed
+
+    stop = threading.Event()
+
+    def sender():
+        # steady mixed load, keys well below capacity (no resize rungs
+        # — this scenario isolates the reshard's cost)
+        sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        packets = []
+        for i in range(1000):
+            packets.append(b"bench.rs.c.%d:1|c" % i)
+            packets.append(b"bench.rs.t.%d:%d|ms" % (i, i % 97))
+        i = 0
+        while not stop.is_set():
+            sock.sendto(packets[i % len(packets)], (host, port))
+            i += 1
+            if i % 50 == 0:
+                time.sleep(0.01)  # ~5k pps offered: steady load, not a
+                # saturation probe — the scenario isolates the
+                # reshard's cost, so the baseline must have headroom
+
+    feeder = threading.Thread(target=sender, daemon=True)
+    feeder.start()
+
+    def p99_of(times):
+        times = sorted(times) or [0.0]
+        return times[min(len(times) - 1, int(len(times) * 0.99))]
+
+    def settle(label, min_flushes=intervals):
+        flush_times.clear()
+        deadline = time.perf_counter() + interval_s * (min_flushes + 3)
+        while len(flush_times) < min_flushes and \
+                time.perf_counter() < deadline and time_left() > 10:
+            time.sleep(0.1)
+        p99 = p99_of(flush_times)
+        log(f"reshard {label}: {len(flush_times)} flushes, "
+            f"p99={p99:.3f}s")
+        return p99
+
+    try:
+        if server._warmup_thread is not None:
+            server._warmup_thread.join(timeout=120)
+        settle("warmup")  # compile the steady-state kernels off-window
+        pre_p99 = settle("pre")
+        flush_times.clear()
+        ctl = server.reshard
+        ctl.begin(shards=3, deadline_s=600.0)
+        deadline = time.perf_counter() + 600
+        while (ctl.state != "idle" or ctl.epoch == 0) and \
+                time.perf_counter() < deadline and time_left() > 10:
+            time.sleep(0.1)
+        while len(flush_times) < intervals and time_left() > 10:
+            time.sleep(0.1)
+        during_p99 = p99_of(flush_times)
+        log(f"reshard during: {len(flush_times)} flushes, "
+            f"p99={during_p99:.3f}s (cutover "
+            f"{ctl.last_cutover_seconds:.3f}s)")
+        post_p99 = settle("post")
+    finally:
+        stop.set()
+        feeder.join(timeout=5)
+        server.config.flush_on_shutdown = False
+        server.shutdown()
+
+    ratio = during_p99 / pre_p99 if pre_p99 > 0 else 0.0
+    RESULT.update(
+        reshard_flush_p99_pre_s=round(pre_p99, 4),
+        reshard_flush_p99_during_s=round(during_p99, 4),
+        reshard_flush_p99_post_s=round(post_p99, 4),
+        reshard_shards="2->3",
+        reshard_epoch=ctl.epoch,
+        reshard_cutover_s=round(ctl.last_cutover_seconds, 4),
+        reshard_segments=ctl.segments_written,
+        reshard_last_error=ctl.last_error,
+        reshard_flat=bool(pre_p99 and ratio <= 1.25))
+    log(f"reshard: 2->3, p99 pre={pre_p99:.3f}s during={during_p99:.3f}s "
+        f"post={post_p99:.3f}s ratio={ratio:.2f} "
+        f"cutover={ctl.last_cutover_seconds:.3f}s")
+    return ratio
+
+
 def run_scenario_query(duration_s: float, num_keys: int = 2000):
     """Live query plane read-path (PR 16): query throughput and read
     latency under sustained ingest at 1, 8, and 64 concurrent readers.
@@ -1562,7 +1732,8 @@ def run_scenario_hll(duration_s: float, num_keys: int = 10_000,
 
 SCENARIOS = ["default", "mixed", "single", "counter", "timers", "hll",
              "llhist", "forward", "ssf", "device", "sustained", "tdigest",
-             "mesh", "mesh-worker", "resize_storm", "query"]
+             "mesh", "mesh-worker", "resize_storm", "query",
+             "reshard", "reshard-worker"]
 
 
 def clamp_keys(keys: int, on_tpu: bool) -> int:
@@ -1642,6 +1813,10 @@ def run_one(scenario: str, duration: float, keys: int, on_tpu: bool = True):
         rate = run_scenario_mesh_worker(duration, min(keys, 2000))
     elif scenario == "resize_storm":
         rate = run_scenario_resize_storm(duration)
+    elif scenario == "reshard":
+        rate = run_scenario_reshard(duration)
+    elif scenario == "reshard-worker":
+        rate = run_scenario_reshard_worker(duration)
     elif scenario == "query":
         rate = run_scenario_query(duration, min(keys, 2000))
     else:

@@ -7,6 +7,7 @@ counter loss, group-confined re-homing, strict ledgers clean)."""
 
 import time
 
+import jax
 import numpy as np
 import pytest
 
@@ -262,6 +263,48 @@ class TestShardedServerFlush:
             # (percentiles, counts, buckets, counters, gauges) matches
             # bit for bit
             assert got1[key] == got4[key], key
+
+    def test_recycled_spare_keeps_per_device_placement(self):
+        """Repeated non-idle flush rounds on the sharded per-device
+        families (histo/set) must keep each recycled generation on ITS
+        shard device. The donated reset kernels' outputs carry no data
+        dependence on their input, so without an explicit out_sharding
+        XLA commits them to the default device — the spare list
+        collapses onto device 0 and round 3's cross-shard stack raises
+        (the round-1 recycle makes the bad spare, round 2 installs it,
+        round 3 reads it out)."""
+        from veneur_tpu.core.server import Server
+        from veneur_tpu.sinks.channel import ChannelMetricSink
+
+        cfg = Config()
+        cfg.interval = 60.0
+        cfg.statsd_listen_addresses = []
+        cfg.tpu.histo_capacity = 128
+        cfg.tpu.set_capacity = 64
+        cfg.tpu.shards = 2
+        server = Server(cfg.apply_defaults(),
+                        extra_metric_sinks=[sink := ChannelMetricSink()])
+        try:
+            for rnd in range(4):
+                for i in range(20):
+                    server.handle_metric_packet(
+                        b"mp.spare.t:%0.1f|ms" % (i + 1.0))
+                    server.handle_metric_packet(b"mp.spare.s:m%d|s" % (i % 5))
+                server.store.apply_all_pending()
+                server.flush()
+                got = {m.name: m.value for m in sink.wait_flush()}
+                assert got["mp.spare.t.count"] == 20.0, rnd
+                assert got["mp.spare.t.max"] == 20.0, rnd
+                assert got["mp.spare.s"] == 5.0, rnd
+                for table in (server.store.histos, server.store.sets):
+                    placements = [
+                        next(iter(jax.tree.leaves(st)[0].devices()))
+                        for st in table.states]
+                    assert len(set(placements)) == len(placements), \
+                        (rnd, table.family, placements)
+        finally:
+            server.config.flush_on_shutdown = False
+            server.shutdown()
 
 
 # -------------------------------------------------------------------------

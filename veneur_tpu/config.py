@@ -217,6 +217,18 @@ class Config:
     # restarts). Off = the PR-7 behavior (spool only past the
     # carryover bound).
     forward_wal: bool = False
+    # -- elastic resharding (parallel/reshard.py) -----------------------
+    # range-segment WAL for live N->M cutovers: the captured per-range
+    # state is appended here (one segment per migrating digest range,
+    # fsync'd) BEFORE any state moves, so a SIGKILL anywhere mid-reshard
+    # replays exactly-once at restart. Empty falls back to
+    # <carryover_spool_dir>/reshard when that is set; with neither, a
+    # cutover still works but loses its crash-replay guarantee (logged
+    # loudly, flagged in /debug/reshard).
+    reshard_spool_dir: str = ""
+    # a plan (prewarm) + cutover that has not completed this long after
+    # begin() flips /healthcheck/ready to 503 with a JSON reason
+    reshard_deadline: float = 30.0  # duration
     # segments whose interval stamp is older than this many flush
     # intervals are BACKFILL: the local drains them behind fresh
     # segments under the replay token bucket below, and the receiving
@@ -388,6 +400,12 @@ class Config:
     chaos_ingest_truncate_rate: float = 0.0
     chaos_ingest_duplicate_rate: float = 0.0
     chaos_ingest_rss_bytes: int = 0
+    # reshard crossings (all deterministic — see util/chaos.py): plan-
+    # thread prewarm delay, every-Nth faulted range-segment append, and
+    # the durable-segments->merge-back kill window the soak SIGKILLs in
+    chaos_reshard_prewarm_delay_s: float = 0.0
+    chaos_reshard_append_fault_nth: int = 0
+    chaos_reshard_cutover_delay_s: float = 0.0
     grpc_address: str = ""
     grpc_listen_addresses: List[str] = field(default_factory=list)
     hostname: str = ""
@@ -479,7 +497,7 @@ _DURATION_FIELDS = {"interval", "forward_retry_base", "forward_retry_max",
                     "circuit_breaker_recovery", "chaos_delay",
                     "ingest_rate_limit_burst", "overload_watermark_poll",
                     "supervisor_deadline", "supervisor_poll",
-                    "supervisor_escalation_deadline"}
+                    "supervisor_escalation_deadline", "reshard_deadline"}
 
 
 def _coerce(name: str, value: Any) -> Any:

@@ -31,7 +31,7 @@ import logging
 import threading
 import time
 from dataclasses import dataclass, field
-from functools import partial
+from functools import lru_cache, partial
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -60,12 +60,31 @@ def _zeros_like_donated(tree):
     return jax.tree.map(jnp.zeros_like, tree)
 
 
+def _state_device(tree):
+    return next(iter(jax.tree.leaves(tree)[0].devices()))
+
+
+@lru_cache(maxsize=None)
+def _zeros_like_donated_on(device):
+    """Per-device reset variant for the sharded histo/set spare lists.
+    The reset's output carries no data dependence on the donated input,
+    so without an explicit out_sharding XLA commits it to the DEFAULT
+    device — every entry of a per-device spare list would silently land
+    on device 0 and the next flush's cross-shard stack would reject the
+    duplicate placement."""
+    return jax.jit(
+        lambda tree: jax.tree.map(jnp.zeros_like, tree),
+        donate_argnums=0,
+        out_shardings=jax.sharding.SingleDeviceSharding(device))
+
+
 def _zeros_like_spare(captured):
     """Donate-and-zero one captured generation — a state pytree, or a
     per-device list of them (the sharded histo/set tables), which must
     zero per device because one jit call cannot mix committed devices."""
     if isinstance(captured, list):
-        return [_zeros_like_donated(st) for st in captured]
+        return [_zeros_like_donated_on(_state_device(st))(st)
+                for st in captured]
     return _zeros_like_donated(captured)
 
 
@@ -76,9 +95,20 @@ def _reset_tdigest_donated(state):
     return batch_tdigest.init_state(state["wv"].shape[0])
 
 
+@lru_cache(maxsize=None)
+def _reset_tdigest_donated_on(device):
+    # same device pin as _zeros_like_donated_on: init_state's values
+    # are constants, so the output needs an explicit placement
+    return jax.jit(
+        lambda st: batch_tdigest.init_state(st["wv"].shape[0]),
+        donate_argnums=0,
+        out_shardings=jax.sharding.SingleDeviceSharding(device))
+
+
 def _reset_tdigest_spare(captured):
     if isinstance(captured, list):
-        return [_reset_tdigest_donated(st) for st in captured]
+        return [_reset_tdigest_donated_on(_state_device(st))(st)
+                for st in captured]
     return _reset_tdigest_donated(captured)
 
 

@@ -44,6 +44,7 @@ _TIMED_ROUTES = frozenset({
     "/version", "/builddate", "/config/json", "/config/yaml", "/metrics",
     "/query", "/alerts", "/quitquitquit", "/import",
     "/debug/events", "/debug/flush", "/debug/latency", "/debug/ledger",
+    "/debug/reshard", "/reshard",
     "/debug/traces", "/debug/cardinality", "/debug/memory",
     "/debug/threads", "/debug/profile/cpu", "/debug/profile/device",
     "/debug/pprof", "/debug/pprof/", "/debug/pprof/profile",
@@ -195,6 +196,16 @@ class _Handler(BaseHTTPRequestHandler):
                 return
             body = json.dumps(source(), indent=2, default=str).encode()
             self._send(200, body, "application/json")
+        elif path == "/debug/reshard":
+            # elastic reshard state machine: phase, epoch, deadline,
+            # WAL segment counters (parallel/reshard.py)
+            controller = getattr(api.server, "reshard", None)
+            if controller is None:
+                self._send(404, b"no reshard controller\n")
+                return
+            self._send(200, json.dumps(controller.describe(),
+                                       indent=2).encode() + b"\n",
+                       "application/json")
         elif path == "/debug/ledger":
             # the flow ledger's conservation report: per-identity
             # imbalances, lifetime stage totals, live inventory stocks,
@@ -281,8 +292,17 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(400, json.dumps({"error": str(e)}).encode()
                            + b"\n", "application/json")
                 return
+            from veneur_tpu.core.query import ReshardRetry
             try:
                 result = source(spec)
+            except ReshardRetry as e:
+                # typed retry, not an error: a reshard cutover is
+                # swapping the topology under the capture — the caller
+                # re-issues once the swap settles (sub-second)
+                self._send(503, json.dumps(
+                    {"error": str(e), "retry": True}).encode() + b"\n",
+                    "application/json")
+                return
             except QueryError as e:
                 self._send(400, json.dumps({"error": str(e)}).encode()
                            + b"\n", "application/json")
@@ -456,6 +476,34 @@ class _Handler(BaseHTTPRequestHandler):
         if path == "/quitquitquit" and api.http_quit:
             self._send(200, b"bye\n")
             threading.Thread(target=api.quit, daemon=True).start()
+        elif path == "/reshard":
+            # elastic reshard (parallel/reshard.py): {"shards": M}
+            # plans + prewarms in the background and cuts over at the
+            # next flush boundary; poll GET /debug/reshard for state
+            controller = getattr(api.server, "reshard", None)
+            if controller is None:
+                self._send(404, b"no reshard controller\n")
+                return
+            try:
+                n = int(self.headers.get("Content-Length") or 0)
+                body = json.loads(self.rfile.read(n) or b"{}")
+                shards = int(body["shards"])
+            except (ValueError, KeyError, TypeError) as e:
+                self._send(400, json.dumps(
+                    {"error": f"bad request: {e}"}).encode() + b"\n",
+                    "application/json")
+                return
+            from veneur_tpu.parallel.reshard import ReshardError
+            try:
+                state = controller.begin(
+                    shards, deadline_s=body.get("deadline_s"))
+            except ReshardError as e:
+                self._send(409, json.dumps(
+                    {"error": str(e)}).encode() + b"\n",
+                    "application/json")
+                return
+            self._send(202, json.dumps(state, indent=2).encode()
+                       + b"\n", "application/json")
         else:
             self._send(404, b"not found\n")
 

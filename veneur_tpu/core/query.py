@@ -77,6 +77,13 @@ class QueryError(ValueError):
     """A malformed or unanswerable query (surfaced as HTTP 400)."""
 
 
+class ReshardRetry(QueryError):
+    """A reshard cutover is swapping the serving topology under this
+    capture — retry once it settles (surfaced as HTTP 503 + retry:
+    true, never a shape error). Subclasses QueryError so existing
+    catch-alls (the alert engine's tick guard) stay safe."""
+
+
 def parse_tags(raw: Optional[str]) -> Tuple[str, ...]:
     """'env:prod,region:us' -> a sorted tag tuple (empty for None)."""
     if not raw:
@@ -174,8 +181,15 @@ class LiveQueryPlane:
         {family: {values/flush/..., touched, meta, stale_pending}}."""
         if self._server._shutdown.is_set():
             raise QueryError("server is shutting down")
+        reshard = getattr(self._server, "reshard", None)
+        if reshard is not None and reshard.state == "cutover":
+            # the topology swap is in flight: captures taken now could
+            # straddle generations (family A on the new plane, family B
+            # still on the old) — typed retry, never a shape error
+            raise ReshardRetry("reshard cutover in progress")
         tables = self._tables()
         bundle: dict = {"as_of_unix": time.time()}
+        epochs = set()
         for family in families:
             table = tables[family]
             if family == "histogram":
@@ -184,10 +198,19 @@ class LiveQueryPlane:
                 snap = table.capture_readonly(ps=ps, need_bins=need_bins)
             else:
                 snap = table.capture_readonly()
+            epoch = snap.get("topo_epoch")
+            if epoch is not None:
+                epochs.add(epoch)
             fut = self._server._readout_executor().submit(
                 lambda t=table, s=snap: t.query_readout(s))
             snap = fut.result(timeout=self._timeout_s)
             bundle[family] = self._finish(family, table, snap)
+        if len(epochs) > 1 or (reshard is not None
+                               and reshard.state == "cutover"):
+            # a cutover began mid-capture: the bundle mixes topology
+            # generations (sharded captures stamp their table's
+            # topo_epoch) — retry against the settled plane
+            raise ReshardRetry("reshard cutover landed mid-capture")
         return bundle
 
     @staticmethod

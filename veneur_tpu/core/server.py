@@ -318,7 +318,12 @@ class Server:
         # at the mint gate — anything else is a silent drop
         self.ledger.declare(
             "ingest", inputs=("ingest.admitted",),
-            outputs=("agg.applied", "agg.rejected"))
+            outputs=("agg.applied", "agg.rejected"),
+            # migrating digest-range rows captured out of the old
+            # topology but not yet merged into the new one (always 0
+            # at close — the cutover runs under _flush_lock — so a
+            # nonzero closing level is itself a conservation break)
+            stocks=("reshard_inflight",))
         # snapshotted = acked + merged-away + shed, with the carryover,
         # the durable spool, and the in-flight send as inventory stocks
         self.ledger.declare(
@@ -507,6 +512,15 @@ class Server:
             logger.exception("invalid alerts.rules; starting with an "
                              "empty rule table")
         self.telemetry.registry.add_collector(self.alerts.telemetry_rows)
+        # elastic reshard controller (parallel/reshard.py): live
+        # digest-range migration N->M with a WAL-backed exactly-once
+        # cutover. Built here (not start()) so in-process topologies
+        # can drive begin()/recover() directly.
+        from veneur_tpu.parallel.reshard import ReshardController
+        self.reshard = ReshardController(self)
+        self.ledger.stock("reshard_inflight",
+                          self.reshard.inflight_metrics)
+        self.telemetry.registry.add_collector(self.reshard.telemetry_rows)
         self._flush_thread: Optional[threading.Thread] = None
         self._watchdog_thread: Optional[threading.Thread] = None
         self._shutdown = threading.Event()
@@ -1082,6 +1096,14 @@ class Server:
             self.prewarmer.prewarm_initial()
         if self.diagnostics is not None:
             self.diagnostics.start()
+        # replay range segments an interrupted reshard cutover left
+        # behind — before the flush loop starts, so the recovered rows
+        # land in the first interval and the ledger books them cleanly
+        try:
+            self.reshard.recover()
+        except Exception:
+            logger.exception("reshard recovery failed; segments left "
+                             "in place for the next start")
         self._flush_thread = threading.Thread(
             target=guarded(self._flush_loop), name="flush-ticker",
             daemon=True)
@@ -1283,6 +1305,13 @@ class Server:
         if self.overload.state == overload_mod.SHEDDING:
             return False, (f"overload state {overload_mod.SHEDDING} "
                            f"(rss {self.overload.watermarks.last_rss} bytes)")
+        if self.reshard.past_deadline():
+            # a cutover past its deadline means the topology swap is
+            # wedged (prewarm hung, device link down) — stop routing
+            # to this instance until it completes or is abandoned
+            return False, (f"reshard past deadline: state "
+                           f"{self.reshard.state}, deadline "
+                           f"{self.reshard.deadline_unix:.0f}")
         if self.config.flush_watchdog_missed_flushes > 0:
             allowed = self.config.flush_watchdog_missed_flushes * self.interval
             since = time.time() - self.last_flush_unix

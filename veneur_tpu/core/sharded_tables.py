@@ -94,6 +94,10 @@ class _DigestRouted:
         # int8 bounds the mesh at 128 shards, far past any host
         self._shard_of = np.zeros(capacity, np.int8)
         self._rr_next = 0  # roundrobin mode's rotation cursor
+        # bumped by every live reshard (_retopo_locked): snapshots carry
+        # the epoch they were swapped under, so a readout that crossed a
+        # cutover can never donate old-mesh buffers back as a spare
+        self._topo_epoch = 0
 
     @property
     def _digest_routed(self) -> bool:
@@ -142,6 +146,114 @@ class _DigestRouted:
         return (self._put_sharded(srows),
                 tuple(self._put_sharded(t) for t in tiled),
                 self._shard_counts_of(home))
+
+    # -- elastic resharding (parallel/reshard.py) ------------------------
+
+    def swap_out(self, **kw) -> dict:
+        snap = super().swap_out(**kw)
+        snap["_topo_epoch"] = self._topo_epoch
+        return snap
+
+    def capture_readonly(self, **kw) -> dict:
+        snap = super().capture_readonly(**kw)
+        snap["topo_epoch"] = self._topo_epoch
+        return snap
+
+    def recycle(self, snap: dict) -> None:
+        if snap.pop("_topo_epoch", self._topo_epoch) != self._topo_epoch:
+            # the snapshot was swapped out under the OLD mesh and its
+            # readout finished after a cutover retopologized this table:
+            # its spare/recycle buffers are shaped (N_old, ...) and must
+            # never be installed into the (M, ...) generation ladder
+            for key in ("cap", "_spare", "_recycle"):
+                snap.pop(key, None)
+            return
+        super().recycle(snap)
+
+    def reshard_swap(self, new_plane: ShardedServingPlane, **kw) -> dict:
+        """The per-family cutover primitive: ONE critical section that
+        (a) swaps the current interval's generation out exactly like a
+        flush boundary (pending columns folded, extras captured), (b)
+        reduces the captured per-shard state to a single merged copy on
+        the OLD mesh (`_reshard_capture_device` — the same selection /
+        reduction expressions the flush merge uses, so the migrated
+        values are the values a flush would have emitted), and (c)
+        rebinds the table to `new_plane` (`_retopo_locked`). Ingest that
+        lands after the locks release accumulates directly in the new
+        topology; everything before is in the returned snap, which the
+        reshard controller serializes to the range-segment WAL and
+        merges back through the family's own merge_batch path.
+
+        Atomic because the table locks are plain (non-reentrant) Locks:
+        holding them across an external WAL write would deadlock every
+        concurrent ingest dispatch, and releasing between swap and
+        retopo would let a sample land in a generation nobody drains."""
+        snap = dict(kw)
+        with self.lock:
+            idle = self._idle_swap_locked(snap)
+            if not idle:
+                snap["cols"] = self._swap_locked()
+            with self.apply_lock:
+                if not idle:
+                    self._note_generation_locked()
+                    snap["touched"] = self.touched.copy()
+                    snap["meta"] = list(self.meta)
+                    # per-row 64-bit key digests, for the range-cell
+                    # partition of the migrating rows (dict key is
+                    # (digest64 << 2) | scope)
+                    digests = np.zeros(self.touched.shape[0], np.uint64)
+                    for row, dict_key in enumerate(self._dict_key_of):
+                        if row < digests.shape[0]:
+                            digests[row] = np.uint64(
+                                (dict_key >> 2) & 0xFFFFFFFFFFFFFFFF)
+                    snap["digest64"] = digests
+                    self.touched[:] = False
+                    self._swap_extras_locked(snap)
+                    state = self._swap_device_locked()
+                    cols = snap.pop("cols", None)
+                    if cols is not None:
+                        # folds the final pending columns on the OLD
+                        # topology (the routing attrs are still bound)
+                        state = self._readout_apply(state, cols, snap)
+                    snap.pop("staged", None)
+                    self._reshard_capture_device(state, snap)
+                self._retopo_locked(new_plane)
+        snap["_topo_epoch"] = self._topo_epoch
+        return snap
+
+    def _reshard_capture_device(self, state, snap: dict) -> None:
+        """Family hook: reduce the captured per-shard generation to one
+        merged, NON-donated copy the controller can serialize (runs on
+        the old mesh, inside the cutover critical section)."""
+        raise NotImplementedError
+
+    def _retopo_locked(self, plane: ShardedServingPlane) -> None:
+        """Rebind this table to a new serving plane (caller holds
+        ``lock`` + ``apply_lock``): new mesh/sharding, every live row's
+        home recomputed under the new range assignment, fresh device
+        state, and all old-mesh spares/prewarm records invalidated."""
+        self._plane = plane
+        self._devices = plane.devices
+        self._mesh = plane.mesh
+        self._n_shards = plane.n
+        self._shard_sharding = collectives.shard_sharding(plane.mesh)
+        self._rr_next = 0
+        shard_of = np.zeros(self._shard_of.shape[0], np.int8)
+        for dict_key, row in self.rows.items():
+            if row < shard_of.shape[0]:
+                shard_of[row] = plane.home(dict_key >> 2)
+        self._shard_of = shard_of
+        # old-mesh buffers can never serve the new topology
+        self._spare = None
+        self._spare_cap = -1
+        self._prewarmed_caps = set()
+        self._topo_epoch += 1
+        self._retopo_device_locked()
+
+    def _retopo_device_locked(self) -> None:
+        # stacked families: a fresh (M, K) zero generation on the new
+        # mesh (per-device families override)
+        self.state = self._fresh_state()
 
 
 # ---------------------------------------------------------------------------
@@ -202,6 +314,14 @@ class ShardedCounterTable(_DigestRouted, CounterTable):
 
     def _prewarm_readout(self, state, capacity, ps, need_export):
         return collectives.merge_counters_stacked_reset(state)
+
+    def _reshard_capture_device(self, state, snap: dict) -> None:
+        # psum selection, non-donating: (sum, comp) per row, the exact
+        # pair snapshot_finish differences (counter totals are integral
+        # by the apply kernel's trunc contract, so the f64 host total
+        # survives the metricpb int64 wire bit-exactly)
+        snap["dev"] = collectives.merge_counters_stacked(state)
+        self._plane.note_merge_round()
 
 
 class ShardedGaugeTable(_DigestRouted, GaugeTable):
@@ -273,6 +393,14 @@ class ShardedGaugeTable(_DigestRouted, GaugeTable):
 
     def _prewarm_readout(self, state, capacity, ps, need_export):
         return collectives.merge_gauges_stacked_reset(state)
+
+    def _reshard_capture_device(self, state, snap: dict) -> None:
+        # home-shard LWW selection, non-donating; the set mask rides
+        # along so untouched rows are distinguishable from value 0.0
+        dev, set_mask = collectives.merge_gauges_stacked(state)
+        snap["dev"] = dev
+        snap["set"] = set_mask
+        self._plane.note_merge_round()
 
 
 class ShardedLLHistTable(_DigestRouted, LLHistTable):
@@ -369,6 +497,13 @@ class ShardedLLHistTable(_DigestRouted, LLHistTable):
         merged, fresh = collectives.merge_llhist_stacked_reset(state)
         return (batch_llhist.flush_packed(merged, ps), fresh)
 
+    def _reshard_capture_device(self, state, snap: dict) -> None:
+        # register ADD, non-donating: the merged (K, BINS_PAD) bank is
+        # bit-identical to what a flush would have reduced, and integer
+        # addition keeps the replay merge bit-exact too
+        snap["bins"] = collectives.merge_llhist_stacked(state)
+        self._plane.note_merge_round()
+
 
 # ---------------------------------------------------------------------------
 # Sketch families with per-shard grids (histograms, sets): per-device
@@ -394,6 +529,10 @@ class _PerDeviceStates:
         # shallow list copy under apply_lock: a consistent point-in-time
         # set of per-device array refs (ingest rebinds list entries)
         return list(self.states)
+
+    def _retopo_device_locked(self) -> None:
+        self.states = self._fresh_state()
+        self.state = None
 
 
 class ShardedHistoTable(_PerDeviceStates, _DigestRouted, HistoTable):
@@ -567,6 +706,56 @@ class ShardedHistoTable(_PerDeviceStates, _DigestRouted, HistoTable):
             out = self._flush_packed(ps, merged, fold_staging=False)
         return (out, self._reset_state_donated(states))
 
+    def _retopo_device_locked(self) -> None:
+        super()._retopo_device_locked()
+        self._shard_counts = [np.zeros(self.capacity, np.int32)
+                              for _ in self._devices]
+        self._applies = 0
+
+    def _reshard_capture_device(self, states, snap: dict) -> None:
+        # concat + recompress across shards (staging already folded by
+        # the readout apply above); the merged dict carries BOTH the
+        # digest-side d* stats and the local-sample l* stats — the wire
+        # encodes d* into MergingDigestData and the controller sidecars
+        # l*, because merge_centroid_rows deliberately never touches l*
+        snap["hstate"] = self._merged_state(states)
+
+    def merge_local_stats(self, stubs, lmin, lmax, lsum, lweight,
+                          lrecip) -> None:
+        """Re-attach migrated LOCAL sample stats to their (new) home
+        shards. The import merge path (merge_batch above) carries only
+        the digest-side state — by design: a forwarded digest is remote
+        data, its receiver has no local samples. A reshard migration is
+        the one caller for which the l* stats ARE local history, so the
+        controller replays them here right after the centroid merge
+        (same stub batch, rows already interned and ledger-booked — no
+        _note_applied)."""
+        with self.lock:
+            rows = np.fromiter(
+                (self.row_for(s) for s in stubs), np.int32, len(stubs))
+            ok = rows >= 0
+            rows = rows[ok]
+            home = self._home_of(rows)
+            self.apply_lock.acquire()
+        try:
+            arrs = tuple(np.asarray(a, np.float32)[ok]
+                         for a in (lmin, lmax, lsum, lweight, lrecip))
+            for i in np.unique(home[home >= 0]).tolist():
+                sel = home == i
+                dev = self._devices[i]
+                put = lambda a: jax.device_put(a, dev)  # noqa: E731
+                rsel = put(rows[sel])
+                st = dict(self.states[i])
+                st["lmin"] = st["lmin"].at[rsel].min(put(arrs[0][sel]))
+                st["lmax"] = st["lmax"].at[rsel].max(put(arrs[1][sel]))
+                st["lsum"] = st["lsum"].at[rsel].add(put(arrs[2][sel]))
+                st["lweight"] = st["lweight"].at[rsel].add(
+                    put(arrs[3][sel]))
+                st["lrecip"] = st["lrecip"].at[rsel].add(put(arrs[4][sel]))
+                self.states[i] = st
+        finally:
+            self.apply_lock.release()
+
 
 class ShardedSetTable(_PerDeviceStates, _DigestRouted, SetTable):
     """SetTable whose HLL register banks live across N local devices;
@@ -693,3 +882,8 @@ class ShardedSetTable(_PerDeviceStates, _DigestRouted, SetTable):
                          need_export: bool):
         merged = self._merged_state(states, note=False)
         return (batch_hll.estimate(merged), _zeros_like_spare(states))
+
+    def _reshard_capture_device(self, states, snap: dict) -> None:
+        # elementwise register max, non-donating — bit-exact under
+        # migration (max is idempotent and commutative)
+        snap["regs"] = self._merged_state(states)

@@ -57,9 +57,29 @@ def home_shards(digest64, n_shards: int) -> np.ndarray:
     """Key digest(s) -> home shard id(s). Pure function of the 64-bit
     fnv1a key digest, so every tier (ingest routing, import merges, the
     proxy's shard groups) that derives a home from the same digest
-    agrees without coordination."""
-    return (np.asarray(digest64, np.uint64)
-            % np.uint64(n_shards)).astype(np.int32)
+    agrees without coordination.
+
+    Contiguous range partition — home = (digest * n) >> 64, the same
+    top-bits split the proxy's ShardGroupRing uses — so each shard owns
+    ONE digest range and an N->M reshard migrates at most N+M-1
+    contiguous cells instead of rehashing the whole key space (the
+    modulo it replaced moved ~every key on any N change). Computed in
+    32-bit halves to stay exact in uint64."""
+    d = np.asarray(digest64, np.uint64)
+    n = np.uint64(n_shards)
+    hi = d >> np.uint64(32)
+    lo = d & np.uint64(0xFFFFFFFF)
+    return ((hi * n + ((lo * n) >> np.uint64(32)))
+            >> np.uint64(32)).astype(np.int32)
+
+
+def range_bounds(n_shards: int) -> List[int]:
+    """The digest-space lower bound of every shard's range under
+    home_shards: shard i owns [bounds[i], bounds[i+1]) with an implicit
+    final bound of 2**64. bounds[i] is the smallest digest with
+    home == i (ceil(i * 2**64 / n))."""
+    return [(i << 64) // n_shards + (1 if (i << 64) % n_shards else 0)
+            for i in range(n_shards)]
 
 
 def stack_on_mesh(mesh: Mesh, leaves: List[jnp.ndarray]) -> jnp.ndarray:

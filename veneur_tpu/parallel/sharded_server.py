@@ -80,8 +80,12 @@ class ShardedServingPlane:
     # -- routing ---------------------------------------------------------
 
     def home(self, digest64: int) -> int:
-        """One key's home shard (digest routing)."""
-        return int(np.uint64(digest64) % np.uint64(self.n))
+        """One key's home shard: contiguous range partition of the
+        64-bit digest space (top bits pick the shard, matching
+        collectives.home_shards and the proxy ring's group split), so
+        an N->M reshard migrates only the cells whose range boundary
+        moved."""
+        return ((int(digest64) & 0xFFFFFFFFFFFFFFFF) * self.n) >> 64
 
     def homes(self, digest64_arr) -> np.ndarray:
         return collectives.home_shards(digest64_arr, self.n)

@@ -607,6 +607,24 @@ class Destinations:
                     if address not in self._ejected:
                         self.ring.add(address)
 
+    def regroup(self, shard_groups: int) -> int:
+        """Follow a serving-tier elastic reshard (parallel/reshard.py):
+        re-partition the door's digest-range groups to the new shard
+        count. Sticky assignments survive (proxy/ring.py regroup), so
+        every key whose group membership didn't change keeps its owner
+        exactly; ejected members stay out of the ring and rejoin their
+        (re-derived) group at readmission. Returns the number of
+        members whose group changed."""
+        with self._lock:
+            if not isinstance(self.ring, ShardGroupRing):
+                raise ValueError(
+                    "shard groups are not enabled on this pool")
+            moved = self.ring.regroup(int(shard_groups))
+            self.shard_groups = int(shard_groups)
+            # memoized failover survivors reference old-group walks
+            self._failover_cache.clear()
+            return moved
+
     def addresses(self) -> List[str]:
         """Current pool membership (discovery/elasticity observability)."""
         with self._lock:

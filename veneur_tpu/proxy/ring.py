@@ -180,6 +180,12 @@ class ShardGroupRing:
         # address -> group, sticky for the address's lifetime (and past
         # it: ejection/readmission must round-trip to the same group)
         self._group_assign: Dict[str, int] = {}
+        # member -> RAW `#<g>` discovery-suffix pin (assign()), kept
+        # unfolded: a regroup re-derives pins as raw % G' and hash
+        # assignments from the address hash, so both stay deterministic
+        # functions of (address, G') and a regrouped proxy agrees with
+        # a freshly-started one at G'
+        self._pinned: Dict[str, int] = {}
 
     point_of = staticmethod(ConsistentRing.point_of)
 
@@ -202,7 +208,8 @@ class ShardGroupRing:
         Must happen before the member is added; re-pinning a live
         member to a different group is refused (a silent migration
         would leak its old range's keys to the wrong group)."""
-        group = int(group) % self.groups
+        raw = int(group)
+        group = raw % self.groups
         with self._lock:
             current = self._group_assign.get(member)
             if current is not None and current != group \
@@ -211,6 +218,7 @@ class ShardGroupRing:
                     f"{member} is live in shard group {current}; "
                     f"cannot reassign to {group}")
             self._group_assign[member] = group
+            self._pinned[member] = raw
 
     def add(self, member: str) -> None:
         with self._lock:
@@ -243,6 +251,50 @@ class ShardGroupRing:
         """Per-group live membership (ready-state / debug surfaces)."""
         with self._lock:
             return [ring.members() for ring in self._rings]
+
+    def regroup(self, groups: int) -> int:
+        """Live G -> G' regroup, the proxy-tier half of an elastic
+        reshard (parallel/reshard.py): the serving tier's shard count
+        changed, so the door's range partition must follow. Sticky
+        pins survive: an explicitly-assigned member re-derives from
+        its RAW discovery-suffix pin (raw % G'), a hash-assigned
+        member from the same stable address hash — so a G -> G
+        round-trip is the identity, and every key whose group's member
+        set is unchanged
+        keeps its owner EXACTLY (a group's ConsistentRing points are a
+        pure function of its membership). Returns the number of
+        members whose group id changed."""
+        groups = int(groups)
+        if groups < 1:
+            raise ValueError("shard group count must be >= 1")
+        with self._lock:
+            live = self.members()
+            old_of = {m: self.group_of(m) for m in live}
+            replicas = self._rings[0].replicas if self._rings \
+                else DEFAULT_REPLICAS
+            self.groups = groups
+            self._rings = [ConsistentRing(replicas)
+                           for _ in range(groups)]
+            # re-derive every assignment under the new modulus from
+            # its SOURCE (raw suffix pin, or address hash) — both
+            # deterministic functions of (address, G'), so a proxy
+            # fleet regrouping to the same G' converges on one table
+            # without coordination, and a freshly-started proxy at G'
+            # agrees with a regrouped one
+            moved = 0
+            for member in list(self._group_assign):
+                pin = self._pinned.get(member)
+                if pin is not None:
+                    self._group_assign[member] = pin % groups
+                else:
+                    self._group_assign[member] = \
+                        fnv.fnv1a_64(member.encode()) % groups
+            for member in live:
+                new_group = self._group_assign[member]
+                self._rings[new_group].add(member)
+                if old_of.get(member) != new_group:
+                    moved += 1
+            return moved
 
     def __len__(self) -> int:
         return len(self.members())

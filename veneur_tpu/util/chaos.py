@@ -68,6 +68,9 @@ class Chaos:
                  ingest_duplicate_rate: float = 0.0,
                  ingest_rss_bytes: int = 0,
                  ledger_leak: int = 0,
+                 reshard_prewarm_delay_s: float = 0.0,
+                 reshard_append_fault_nth: int = 0,
+                 reshard_cutover_delay_s: float = 0.0,
                  sleep=time.sleep):
         self.enabled = bool(enabled)
         self.error_rate = min(1.0, max(0.0, float(error_rate)))
@@ -93,6 +96,23 @@ class Chaos:
         self.ledger_leak = max(0, int(ledger_leak))
         self._leak_roll = 0
         self.leaked_samples = 0
+        # reshard seams (all deterministic, no RNG roll — a kill/restore
+        # soak must be able to hit the same crossing every run):
+        # - prewarm_delay: sleep injected in the PLAN thread before the
+        #   background compile, so a deadline overrun (and the 503 ready
+        #   answer it triggers) is reproducible;
+        # - append_fault_nth: every Nth reshard WAL range-segment append
+        #   raises ChaosError("reshard_append") — the faulted-append
+        #   degradation path;
+        # - cutover_delay: sleep between the range segments becoming
+        #   durable and the merge-back — the widest SIGKILL window where
+        #   ALL migrating state exists only in the WAL.
+        self.reshard_prewarm_delay_s = max(0.0, float(reshard_prewarm_delay_s))
+        self.reshard_append_fault_nth = max(0, int(reshard_append_fault_nth))
+        self.reshard_cutover_delay_s = max(0.0, float(reshard_cutover_delay_s))
+        self._reshard_append_roll = 0
+        self.reshard_faulted_appends = 0
+        self.reshard_injected_delays = 0
         self._rng = random.Random(seed)
         self._sleep = sleep
         self._lock = threading.Lock()
@@ -122,7 +142,13 @@ class Chaos:
                        config, "chaos_ingest_duplicate_rate", 0.0),
                    ingest_rss_bytes=getattr(
                        config, "chaos_ingest_rss_bytes", 0),
-                   ledger_leak=getattr(config, "chaos_ledger_leak", 0))
+                   ledger_leak=getattr(config, "chaos_ledger_leak", 0),
+                   reshard_prewarm_delay_s=getattr(
+                       config, "chaos_reshard_prewarm_delay_s", 0.0),
+                   reshard_append_fault_nth=getattr(
+                       config, "chaos_reshard_append_fault_nth", 0),
+                   reshard_cutover_delay_s=getattr(
+                       config, "chaos_reshard_cutover_delay_s", 0.0))
 
     def inject(self, seam: str) -> None:
         """Run the seam: maybe sleep, maybe raise ChaosError. Called on
@@ -216,6 +242,39 @@ class Chaos:
                 return True
         return False
 
+    # -- reshard seams -----------------------------------------------------
+
+    def reshard_prewarm_delay(self) -> None:
+        """Plan-thread crossing: deterministic sleep before the
+        background prewarm compile starts."""
+        if not self.enabled or self.reshard_prewarm_delay_s <= 0:
+            return
+        with self._lock:
+            self.reshard_injected_delays += 1
+        self._sleep(self.reshard_prewarm_delay_s)
+
+    def reshard_append_seam(self) -> None:
+        """Cutover crossing: every `reshard_append_fault_nth`-th range
+        segment append raises (deterministic counter, no RNG)."""
+        if not self.enabled or self.reshard_append_fault_nth <= 0:
+            return
+        with self._lock:
+            self._reshard_append_roll += 1
+            if self._reshard_append_roll >= self.reshard_append_fault_nth:
+                self._reshard_append_roll = 0
+                self.reshard_faulted_appends += 1
+                raise ChaosError("reshard_append")
+
+    def reshard_cutover_delay(self) -> None:
+        """Handoff crossing: deterministic sleep after the range
+        segments are durable, before any state merges back — the
+        kill-window trigger for the soak's SIGKILL."""
+        if not self.enabled or self.reshard_cutover_delay_s <= 0:
+            return
+        with self._lock:
+            self.reshard_injected_delays += 1
+        self._sleep(self.reshard_cutover_delay_s)
+
     def simulated_rss_bytes(self) -> int:
         """Extra bytes the watermark monitor adds to real RSS."""
         if not self.enabled:
@@ -243,6 +302,14 @@ class Chaos:
             if self.leaked_samples:
                 rows.append(("chaos.ledger_leaked", "counter",
                              float(self.leaked_samples), ()))
+            if self.reshard_faulted_appends:
+                rows.append(("chaos.injected_errors", "counter",
+                             float(self.reshard_faulted_appends),
+                             ["seam:reshard_append"]))
+            if self.reshard_injected_delays:
+                rows.append(("chaos.injected_delays", "counter",
+                             float(self.reshard_injected_delays),
+                             ["seam:reshard"]))
         return rows
 
 

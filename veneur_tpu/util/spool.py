@@ -114,15 +114,20 @@ class SpoolSegment:
     `interval_unix` is the interval-start timestamp the snapshot covers
     (0.0 for pre-WAL segments written without a stamp)."""
 
-    __slots__ = ("path", "created_unix", "count", "nbytes", "interval_unix")
+    __slots__ = ("path", "created_unix", "count", "nbytes",
+                 "interval_unix", "extra")
 
     def __init__(self, path: str, created_unix: float, count: int,
-                 nbytes: int, interval_unix: float = 0.0):
+                 nbytes: int, interval_unix: float = 0.0,
+                 extra: Optional[dict] = None):
         self.path = path
         self.created_unix = created_unix
         self.count = count
         self.nbytes = nbytes
         self.interval_unix = interval_unix
+        # caller-owned header metadata (the reshard WAL stamps its cell
+        # bounds and cutover token here); None for plain segments
+        self.extra = extra
 
     def read_metrics(self) -> List[bytes]:
         with open(self.path, "rb") as f:
@@ -258,7 +263,8 @@ class CarryoverSpool:
                 nbytes = os.fstat(f.fileno()).st_size
             return SpoolSegment(path, float(meta["created_unix"]),
                                 int(meta["count"]), nbytes,
-                                float(meta.get("interval_unix", 0.0)))
+                                float(meta.get("interval_unix", 0.0)),
+                                extra=meta.get("extra"))
         except (OSError, ValueError, KeyError):
             return None
 
@@ -306,7 +312,8 @@ class CarryoverSpool:
     # -- spill / WAL append ----------------------------------------------
 
     def append(self, metrics: List[bytes],
-               interval_unix: float = 0.0) -> int:
+               interval_unix: float = 0.0,
+               extra: Optional[dict] = None) -> int:
         """Append one interval's serialized metrics as a new segment;
         returns the count written. `interval_unix` is the interval-start
         timestamp the snapshot covers (stamped into the header and onto
@@ -317,16 +324,22 @@ class CarryoverSpool:
         if not metrics:
             return 0
         with self._append_lock:
-            return self._append_locked(metrics, interval_unix)
+            return self._append_locked(metrics, interval_unix, extra)
 
     def _append_locked(self, metrics: List[bytes],
-                       interval_unix: float) -> int:
+                       interval_unix: float,
+                       extra: Optional[dict] = None) -> int:
         body = frame_metrics(metrics)
         created = time.time()
         header_fields = {"created_unix": round(created, 3),
                          "count": len(metrics)}
         if interval_unix:
             header_fields["interval_unix"] = round(float(interval_unix), 3)
+        if extra:
+            # caller metadata (reshard WAL cell bounds / cutover token);
+            # must stay small — the whole header line is bounded by
+            # _HEADER_MAX at replay
+            header_fields["extra"] = extra
         header = json.dumps(header_fields).encode() + b"\n"
         with self._lock:
             self._seq += 1
@@ -344,7 +357,8 @@ class CarryoverSpool:
         # from the restart scan — the durability the spool exists for
         self._fsync_dir(self.directory)
         seg = SpoolSegment(path, created, len(metrics),
-                           len(header) + len(body), float(interval_unix))
+                           len(header) + len(body), float(interval_unix),
+                           extra=extra)
         shed: List[SpoolSegment] = []
         with self._lock:
             self._segments.append(seg)
