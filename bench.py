@@ -41,6 +41,7 @@ METRIC_NAMES = {
     "query": "query_reads_per_sec",
     "reshard": "reshard_flush_p99_ratio",
     "reshard-worker": "reshard_flush_p99_ratio",
+    "egress": "egress_encode_rate",
 }
 
 # accumulates fields as stages complete, so the deadline guard can emit a
@@ -878,13 +879,15 @@ def _run_udp_scenario(duration_s: float, packets, samples: int,
 
 
 def run_scenario_counter(duration_s: float):
-    """BASELINE config 1: one counter key at 10k single-metric datagrams
-    per second (the veneur-emit shape — one metric per send, unlike the
-    other scenarios' 40-metric pipelined datagrams) into a blackhole
-    sink."""
+    """BASELINE config 1: one counter key, single-metric datagrams (the
+    veneur-emit shape — one metric per send, unlike the other
+    scenarios' 40-metric pipelined datagrams) into a blackhole sink.
+    Unpaced since BENCH_r06: the original 10k/s offered pace (matching
+    the paper's emit rate) CAPPED the measurement once the pipeline
+    outran it — the knee is what the config tracks now."""
     packets = [b"bench.one:1|c"] * 512
     return _run_udp_scenario(duration_s, packets, len(packets), 16,
-                             offered=10_000.0, per_datagram=1)
+                             per_datagram=1)
 
 
 def run_scenario_timers(duration_s: float, num_keys: int = 1000):
@@ -1713,6 +1716,106 @@ def run_scenario_query(duration_s: float, num_keys: int = 2000):
     return headline["reads_per_sec"] if headline else 0.0
 
 
+def run_scenario_egress(duration_s: float, num_keys: int = 100_000):
+    """Columnar egress encode throughput per wire format off a synthetic
+    100k-key FlushBatch (no UDP, no HTTP — pure encode). The first
+    encode per format warms the fragment caches (cold cost is one flush
+    by design); the timed loop measures the steady-state regime. The
+    headline `egress_encode_rate` is the SLOWEST format's lines/s — the
+    bound a multi-sink deployment actually feels. Returns
+    (headline, per_format_rates)."""
+    import numpy as np
+    from veneur_tpu.core.columnstore import RowMeta
+    from veneur_tpu.core.egress import (
+        CortexColumnarEncoder, DatadogColumnarEncoder,
+        PrometheusColumnarRenderer,
+    )
+    from veneur_tpu.core.flusher import (
+        BucketSection, FlushBatch, FlushSection, ForwardableState,
+    )
+    from veneur_tpu.forward.convert import forwardable_to_wire
+    from veneur_tpu.ops import llhist_ref
+    from veneur_tpu.samplers.metrics import MetricScope, MetricType
+    from veneur_tpu.sinks.cortex import CortexMetricSink
+    from veneur_tpu.sinks.datadog import DatadogMetricSink
+
+    rng = np.random.default_rng(7)
+    n_half = num_keys // 2
+
+    def section(prefix, n, mtype):
+        names = np.empty(n, object)
+        tags = np.empty(n, object)
+        for i in range(n):
+            names[i] = f"bench.{prefix}.{i}"
+            tags[i] = [f"env:prod", f"shard:{i % 64}"]
+        vals = rng.uniform(0.5, 5000.0, n)
+        return FlushSection(names, vals, tags, mtype)
+
+    sec_c = section("c", n_half, MetricType.COUNTER)
+    sec_g = section("g", num_keys - n_half, MetricType.GAUGE)
+    # llhist bucket matrix: 2% of keys are histograms, ~16 occupied bins
+    # each — the cumsum table the encoders splice `le:` rows from
+    n_hist = max(num_keys // 50, 1)
+    bins = len(llhist_ref.UPPER_SORTED)
+    counts = np.zeros((n_hist, bins))
+    for i in range(n_hist):
+        occ = rng.choice(bins, size=16, replace=False)
+        counts[i, occ] = rng.integers(1, 50, size=16)
+    bnames = np.empty(n_hist, object)
+    btags = np.empty(n_hist, object)
+    for i in range(n_hist):
+        bnames[i] = f"bench.ll.{i}.bucket"
+        btags[i] = [f"env:prod", f"shard:{i % 64}"]
+    bucket = BucketSection(bnames, btags,
+                           np.cumsum(counts, axis=1, dtype=np.float64),
+                           counts != 0)
+    batch = FlushBatch(int(time.time()), [sec_c, sec_g], [], [bucket])
+    lines = len(batch)
+
+    # forward wire: same key population as mergeable state — scalar
+    # frames hand-packed, llhist registers through the native encoder
+    fwd = ForwardableState()
+    for i in range(n_half):
+        meta = RowMeta(f"bench.c.{i}", sec_c.tags[i],
+                       ",".join(sec_c.tags[i]), 0, MetricScope.MIXED,
+                       "counter")
+        fwd.counters.append((meta, float(i + 1)))
+    for i in range(num_keys - n_half):
+        meta = RowMeta(f"bench.g.{i}", sec_g.tags[i],
+                       ",".join(sec_g.tags[i]), 0, MetricScope.MIXED,
+                       "gauge")
+        fwd.gauges.append((meta, float(sec_g.values[i])))
+    ll_bins = np.zeros(bins, np.int64)
+    ll_bins[::300] = 7
+    for i in range(n_hist):
+        meta = RowMeta(f"bench.ll.{i}", btags[i], ",".join(btags[i]),
+                       0, MetricScope.MIXED, "timer")
+        fwd.llhists.append((meta, ll_bins))
+
+    dd = DatadogMetricSink("datadog", "key", "https://dd.invalid",
+                           "bench", 10.0)
+    cx = CortexMetricSink("cortex", "http://cx.invalid/api", "bench")
+    encoders = {
+        "datadog": (DatadogColumnarEncoder(dd).encode, lines),
+        "prometheus": (PrometheusColumnarRenderer().render, lines),
+        "cortex": (CortexColumnarEncoder(cx).encode, lines),
+        "metricpb": (forwardable_to_wire, len(fwd)),
+    }
+    budget = max(duration_s / len(encoders), 1.0)
+    rates = {}
+    for fmt, (encode, units) in encoders.items():
+        arg = fwd if fmt == "metricpb" else batch
+        encode(arg)  # warm the fragment caches / pb frames
+        t0 = time.perf_counter()
+        done = 0
+        while time.perf_counter() - t0 < budget:
+            encode(arg)
+            done += units
+        rates[fmt] = round(done / (time.perf_counter() - t0), 1)
+        log(f"egress encode {fmt}: {rates[fmt]:,.0f} lines/s")
+    return min(rates.values()), rates
+
+
 def run_scenario_hll(duration_s: float, num_keys: int = 10_000,
                      cardinality: int = 100):
     """BASELINE config 3: mixed keys at tag cardinality 100 — HLL stress
@@ -1733,7 +1836,7 @@ def run_scenario_hll(duration_s: float, num_keys: int = 10_000,
 SCENARIOS = ["default", "mixed", "single", "counter", "timers", "hll",
              "llhist", "forward", "ssf", "device", "sustained", "tdigest",
              "mesh", "mesh-worker", "resize_storm", "query",
-             "reshard", "reshard-worker"]
+             "reshard", "reshard-worker", "egress"]
 
 
 def clamp_keys(keys: int, on_tpu: bool) -> int:
@@ -1762,6 +1865,20 @@ def run_one(scenario: str, duration: float, keys: int, on_tpu: bool = True):
         rate = run_scenario_llhist(duration, min(keys, 1000))
     elif scenario == "forward":
         rate = run_scenario_forward(duration, keys)
+    elif scenario == "egress":
+        # pure host-side encode — no device in the loop, so the 100k
+        # north-star snapshot shape holds on the CPU fallback too
+        rate, per_format = run_scenario_egress(duration,
+                                               max(keys, 100_000))
+        extra["egress_encode_rates"] = per_format
+        # the egress acceptance pins BASELINE configs 1 and 4: re-run
+        # them alongside so one record carries all three measurements
+        if time_left() >= 60:
+            extra["counter_samples_per_sec"] = round(
+                run_scenario_counter(min(duration, 6.0)), 1)
+        if time_left() >= 90:
+            extra["forwarded_digest_keys_per_sec"] = round(
+                run_scenario_forward(min(duration, 6.0), 50_000), 1)
     elif scenario == "device":
         if on_tpu and os.environ.get("BENCH_DEVICE_SWEEP") == "1":
             # opt-in batch-size ladder (manual captures only: each shape

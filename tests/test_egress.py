@@ -1,0 +1,406 @@
+"""Golden byte-parity for the columnar egress plane (core/egress.py).
+
+The columnar encoders must emit exactly what the legacy per-InterMetric
+paths emit for the SAME FlushBatch — byte-identical for Prometheus
+exposition and Cortex remote-write wire, JSON key-order-normalized for
+Datadog (the series-object key order legitimately differs; JSON objects
+are unordered). The batches come from the real flusher over a mixed
+corpus so every family is covered: counters, gauges, timer percentile
+gauges + aggregate counters, set-cardinality gauges, and llhist
+percentile/sum/count plus the cumulative `.bucket{le:}` matrix.
+`extras` add the legacy-only shapes: status checks, hostname-carrying
+rows, and WAL-backfilled timestamp lines.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from veneur_tpu.core.columnstore import ColumnStore
+from veneur_tpu.core.egress import (
+    CortexColumnarEncoder, DatadogColumnarEncoder,
+    PrometheusColumnarRenderer,
+)
+from veneur_tpu.core.flusher import flush_columnstore_batch
+from veneur_tpu.samplers.metrics import (
+    HistogramAggregates, InterMetric, MetricType,
+)
+from veneur_tpu.samplers.parser import Parser
+from veneur_tpu.sinks.cortex import CortexMetricSink, encode_write_request
+from veneur_tpu.sinks.datadog import DatadogMetricSink
+from veneur_tpu.sinks.prometheus import (
+    PrometheusMetricSink, render_exposition,
+)
+
+pytestmark = pytest.mark.egress
+
+PCTS = (0.5, 0.99)
+AGGS = HistogramAggregates.from_names(["min", "max", "count"])
+
+
+def _mk_batch(extras=(), is_local=False):
+    # global mode by default: mixed-scope llhists EMIT (bucket sections
+    # in the batch) instead of forwarding; forward tests pass True
+    store = ColumnStore(counter_capacity=64, gauge_capacity=64,
+                        histo_capacity=64, set_capacity=32, batch_cap=256)
+    p = Parser()
+    lines = []
+    for i in range(5):
+        lines.append(b"c.%d:%d|c|#env:t,i:%d" % (i, i + 1, i))
+        lines.append(b"g.%d:%.2f|g|#env:t" % (i, i * 1.5))
+        lines.append(b"t.%d:%.2f|ms|#env:t" % (i, 10.0 + i))
+        lines.append(b"t.%d:%.2f|ms|#env:t" % (i, 20.0 + i))
+        lines.append(b"s.%d:user%d|s|#env:t" % (i, i))
+        lines.append(b"ll.%d:%.3f|l|#env:t,svc:x" % (i, 5.0 + i))
+        lines.append(b"ll.%d:%.3f|l|#env:t,svc:x" % (i, 500.0 + i))
+    # tag-free rows, host:/device: magic tags, drop-prefix candidates
+    lines += [
+        b"bare:3|c",
+        b"hosted:4|c|#host:other,device:sda,env:t",
+        b"dropme.x:1|c|#env:t",
+        b"ll.bare:42.5|l",
+    ]
+    for line in lines:
+        p.parse_metric_fast(line, store.process)
+    store.apply_all_pending()
+    batch, fwd = flush_columnstore_batch(store, is_local, PCTS, AGGS,
+                                         collect_forward=is_local)
+    batch.extras.extend(extras)
+    return batch, fwd
+
+
+def _extras():
+    return [
+        InterMetric(name="extra.count", timestamp=1700000000, value=4.0,
+                    tags=["q:r"], type=MetricType.COUNTER, hostname="hX"),
+        InterMetric(name="svc.ok", timestamp=1700000001, value=1.0,
+                    tags=["chk:y"], type=MetricType.STATUS,
+                    hostname="hX", message="degraded"),
+        InterMetric(name="backfill.g", timestamp=1699990000, value=7.5,
+                    tags=["o:p"], type=MetricType.GAUGE, hostname="hB",
+                    backfilled=True),
+        InterMetric(name="backfill.c", timestamp=1699990000, value=2.0,
+                    tags=[], type=MetricType.COUNTER, backfilled=True),
+    ]
+
+
+def _dd_sink(**kw):
+    kw.setdefault("tags", ["glob:t"])
+    kw.setdefault("metric_name_prefix_drops", ["dropme."])
+    kw.setdefault("excluded_tag_prefixes", ["i:"])
+    return DatadogMetricSink("datadog", "key", "https://dd.example", "me",
+                             10.0, **kw)
+
+
+# -- Datadog ---------------------------------------------------------------
+
+
+def test_datadog_parity_normalized():
+    batch, _ = _mk_batch(_extras())
+    sink = _dd_sink()
+    parts, checks = DatadogColumnarEncoder(sink).encode(batch)
+    col = [json.loads(p) for p in parts]
+    leg = json.loads(json.dumps([
+        sink._dd_metric(m) for m in batch.materialize()
+        if m.type != MetricType.STATUS
+        and not m.name.startswith("dropme.")]))
+    assert col == leg  # same objects in the same ORDER
+    assert [c.name for c in checks] == ["svc.ok"]
+
+
+def test_datadog_flush_columnar_posts_same_series(monkeypatch):
+    """End to end through flush_batch: the raw byte-assembled bodies
+    decode to the same series the legacy dict+json.dumps flush posts."""
+    from veneur_tpu.sinks import datadog as ddmod
+
+    posted = []
+
+    def fake_post(url, body, **kw):
+        # vhttp.post gzips internally; the fake sees the raw body
+        posted.append((url, bytes(body)))
+
+    def fake_post_json(url, payload, **kw):
+        posted.append((url, json.dumps(payload).encode()))
+
+    monkeypatch.setattr(ddmod.vhttp, "post", fake_post)
+    monkeypatch.setattr(ddmod.vhttp, "post_json", fake_post_json)
+    batch, _ = _mk_batch(_extras())
+    sink = _dd_sink(num_workers=1)
+    sink.flush_batch(batch)
+    col_series = [json.loads(b)["series"] for u, b in posted
+                  if "/series" in u]
+    col_checks = [json.loads(b) for u, b in posted if "check_run" in u]
+    posted.clear()
+    sink2 = _dd_sink(num_workers=1)
+    sink2.flush(batch.materialize())
+    leg_series = [json.loads(b)["series"] for u, b in posted
+                  if "/series" in u]
+    leg_checks = [json.loads(b) for u, b in posted if "check_run" in u]
+    assert col_series == leg_series
+    assert col_checks == leg_checks
+
+
+def test_datadog_columnar_fallback_on_encoder_error(monkeypatch):
+    from veneur_tpu.sinks import datadog as ddmod
+
+    calls = []
+    monkeypatch.setattr(ddmod.vhttp, "post",
+                        lambda *a, **k: calls.append("raw"))
+    monkeypatch.setattr(ddmod.vhttp, "post_json",
+                        lambda *a, **k: calls.append("json"))
+    batch, _ = _mk_batch()
+    sink = _dd_sink(num_workers=1)
+    from veneur_tpu.core import egress as egmod
+    monkeypatch.setattr(
+        egmod.DatadogColumnarEncoder, "encode",
+        lambda self, b: (_ for _ in ()).throw(RuntimeError("boom")))
+    sink.flush_batch(batch)  # must not raise; legacy path delivers
+    assert "json" in calls
+
+
+# -- Prometheus ------------------------------------------------------------
+
+
+def _fake_exemplars(clauses):
+    def exemplars(name, tags):
+        return clauses.get(name, "")
+    return exemplars
+
+
+def test_prometheus_parity_plain_and_openmetrics():
+    batch, _ = _mk_batch(_extras())
+    legacy = batch.materialize()
+    r = PrometheusColumnarRenderer()
+    assert r.render(batch) == render_exposition(legacy)
+    ex = _fake_exemplars({
+        "c.0": ' # {trace_id="ab"} 1.0 1700000000.000',
+        "ll.1.bucket": ' # {trace_id="cd"} 501.0 1700000000.000',
+        "extra.count": ' # {trace_id="ef"} 4.0 1700000000.000',
+    })
+    for om in (False, True):
+        got = PrometheusColumnarRenderer().render(
+            batch, exemplars=ex, openmetrics=om)
+        want = render_exposition(legacy, exemplars=ex, openmetrics=om)
+        assert got == want
+    # the suite must actually exercise the clauses + backfilled stamps
+    om_text = render_exposition(legacy, exemplars=ex, openmetrics=True)
+    assert '# {trace_id="ab"}' in om_text
+    assert '# {trace_id="cd"}' in om_text
+    assert "backfill_g" in om_text and " 1699990000" in om_text
+
+
+def test_prometheus_sink_columnar_exposition():
+    batch, _ = _mk_batch(_extras())
+    sink = PrometheusMetricSink("prometheus")
+    sink.flush_batch(batch)
+    assert sink.exposition_plain() == render_exposition(
+        batch.materialize())
+    # lazy OM render comes from the stored batch
+    assert sink.exposition_openmetrics() == render_exposition(
+        batch.materialize(), openmetrics=True) + "# EOF\n"
+
+
+def test_prometheus_repeater_falls_back_to_legacy(monkeypatch):
+    batch, _ = _mk_batch()
+    sink = PrometheusMetricSink("prometheus",
+                                repeater_address="127.0.0.1:1",
+                                network="udp")
+    sink.flush_batch(batch)  # repeater wants InterMetrics; no raise
+    assert sink.exposition_plain() == render_exposition(
+        batch.materialize())
+
+
+# -- Cortex ----------------------------------------------------------------
+
+
+class _FakeExemplarStore:
+    def __init__(self, entries):
+        self.entries = entries  # name -> (trace_id, value, ts)
+
+    def for_series(self, name, tags=()):
+        return self.entries.get(name)
+
+
+def _cortex_series(sink, metrics):
+    exemplified = set()
+    series = []
+    for m in metrics:
+        if m.type == MetricType.STATUS:
+            continue
+        if (m.type == MetricType.COUNTER
+                and sink.convert_counters_to_monotonic):
+            key = (m.name, tuple(sorted(m.tags)), m.hostname)
+            sink._monotonic[key] = (
+                sink._monotonic.get(key, 0.0) + float(m.value))
+            continue
+        row = sink._series(m)
+        entry = sink._exemplar_entry(m, exemplified)
+        if entry is not None:
+            from veneur_tpu.trace.store import trace_id_hex
+            tid, ev, ets = entry
+            row = row + ((trace_id_hex(tid), float(ev), int(ets * 1000)),)
+        series.append(row)
+    return series
+
+
+def test_cortex_parity_bytes():
+    batch, _ = _mk_batch(_extras())
+    sink = CortexMetricSink("cortex", "http://c/api", "myhost",
+                            excluded_tags=["i"])
+    sink._exemplars = _FakeExemplarStore({
+        "c.0": (0xAB, 1.5, 1700000000.25),
+        "extra.count": (0xEF, 4.0, 1700000001.0),
+    })
+    frames, max_ts = CortexColumnarEncoder(sink).encode(batch)
+    legacy = batch.materialize()
+    sink2 = CortexMetricSink("cortex", "http://c/api", "myhost",
+                             excluded_tags=["i"])
+    sink2._exemplars = sink._exemplars
+    want = encode_write_request(_cortex_series(sink2, legacy))
+    assert b"".join(frames) == want
+    assert max_ts == max(m.timestamp for m in legacy)
+
+
+def test_cortex_parity_monotonic_mode():
+    batch, _ = _mk_batch(_extras())
+    col = CortexMetricSink("cortex", "http://c/api", "myhost",
+                           convert_counters_to_monotonic=True)
+    leg = CortexMetricSink("cortex", "http://c/api", "myhost",
+                           convert_counters_to_monotonic=True)
+    frames, max_ts = CortexColumnarEncoder(col).encode(batch)
+    series = _cortex_series(leg, batch.materialize())
+    assert b"".join(frames) == encode_write_request(series)
+    assert col._monotonic == leg._monotonic  # counters + buckets folded
+    assert any("le:+Inf" in k[1] for k in col._monotonic)
+    # the re-emit stamp comes from the SAME fold, legacy-compatible
+    assert max_ts == max(m.timestamp for m in batch.materialize())
+    col_frames = [encode_write_request([r])
+                  for r in col._monotonic_series(max_ts)]
+    leg_frames = [encode_write_request([r])
+                  for r in leg._monotonic_series(max_ts)]
+    assert b"".join(col_frames) == b"".join(leg_frames)
+
+
+def test_cortex_flush_columnar_posts_same_bytes(monkeypatch):
+    from veneur_tpu.sinks import cortex as cxmod
+
+    posted = []
+    monkeypatch.setattr(
+        cxmod.vhttp, "post",
+        lambda url, body, **kw: posted.append(bytes(body)))
+    batch, _ = _mk_batch(_extras())
+    sink = CortexMetricSink("cortex", "http://c/api", "myhost",
+                            batch_write_size=7)
+    sink.flush_batch(batch)
+    col = list(posted)
+    posted.clear()
+    sink2 = CortexMetricSink("cortex", "http://c/api", "myhost",
+                             batch_write_size=7)
+    sink2.flush(batch.materialize())
+    assert col == posted  # chunk boundaries AND bytes identical
+
+
+# -- streaming forward (pre-encoded wire) ----------------------------------
+
+
+def test_forward_wire_prebuilt_matches_reencode():
+    _, fwd = _mk_batch(is_local=True)
+    from veneur_tpu.forward.convert import forwardable_to_wire
+
+    assert len(fwd)
+    first = forwardable_to_wire(fwd)
+    fwd.wire = first
+    assert forwardable_to_wire(fwd) == first  # deterministic
+    fwd.invalidate_wire()
+    assert fwd.wire is None
+
+
+def test_carryover_merge_invalidates_wire():
+    from veneur_tpu.forward.convert import forwardable_to_wire
+    from veneur_tpu.util.resilience import Carryover
+
+    _, fwd_a = _mk_batch(is_local=True)
+    _, fwd_b = _mk_batch(is_local=True)
+    co = Carryover(max_intervals=4)
+    fwd_a.wire = forwardable_to_wire(fwd_a)
+    co.stash(fwd_a)
+    fwd_b.wire = forwardable_to_wire(fwd_b)
+    merged = co.drain_into(fwd_b)
+    assert merged.wire is None  # stale frames must not be sent
+    # stash-merge path too: pending + new both had wire set
+    fwd_b.wire = forwardable_to_wire(fwd_b)
+    co.stash(fwd_b)
+    _, fwd_c = _mk_batch(is_local=True)
+    fwd_c.wire = forwardable_to_wire(fwd_c)
+    co.stash(fwd_c)
+    assert co._pending.wire is None
+
+
+# -- encode/send observability ---------------------------------------------
+
+
+def test_note_egress_rows_in_observatory():
+    from veneur_tpu.core.latency import LatencyObservatory
+
+    obs = LatencyObservatory(enabled=True)
+    obs.note_egress("datadog", 0.002, 0.030)
+    obs.note_egress("datadog", 0.004, 0.010)
+    obs.note_egress("cortex", 0.001, 0.020)
+    rows = obs.telemetry_rows()
+    names = {(n, tuple(sorted(tags))) for n, _v, _k, tags in rows}
+    assert any(n == "egress.encode_s.p99" and ("sink:datadog",) == t
+               for n, t in names)
+    assert any(n == "egress.send_s.count" and ("sink:cortex",) == t
+               for n, t in names)
+    rep = obs.report()
+    assert set(rep["egress"]) == {"datadog", "cortex"}
+    assert rep["egress"]["datadog"]["encode"]["count"] == 2
+
+
+def test_sink_note_egress_reports_and_tags_span():
+    class _Lat:
+        def __init__(self):
+            self.calls = []
+
+        def note_egress(self, sink, enc, snd):
+            self.calls.append((sink, enc, snd))
+
+    sink = PrometheusMetricSink("prometheus")
+    lat = _Lat()
+    sink._latency = lat
+    sink.note_egress(0.5, 0.25)
+    assert lat.calls == [("prometheus", 0.5, 0.25)]
+
+
+# -- sustained churn soak --------------------------------------------------
+
+
+@pytest.mark.slow
+def test_egress_parity_soak():
+    """Rounds of fresh flushes through LONG-lived encoders (caches warm
+    and churn across rounds: id-keyed fragments must never serve stale
+    bytes) stay byte-exact against the legacy renderers."""
+    dd = _dd_sink()
+    dd_enc = DatadogColumnarEncoder(dd)
+    prom = PrometheusColumnarRenderer()
+    cx = CortexMetricSink("cortex", "http://c/api", "myhost")
+    cx_enc = CortexColumnarEncoder(cx)
+    for round_no in range(8):
+        extras = _extras() if round_no % 2 else []
+        batch, _ = _mk_batch(extras)
+        legacy = batch.materialize()
+        parts, _checks = dd_enc.encode(batch)
+        leg = json.loads(json.dumps([
+            dd._dd_metric(m) for m in legacy
+            if m.type != MetricType.STATUS
+            and not m.name.startswith("dropme.")]))
+        assert [json.loads(p) for p in parts] == leg
+        assert prom.render(batch) == render_exposition(legacy)
+        frames, _ = cx_enc.encode(batch)
+        want = encode_write_request(
+            [cx._series(m) for m in legacy
+             if m.type != MetricType.STATUS])
+        assert b"".join(frames) == want

@@ -47,10 +47,18 @@ class ForwardableState:
     sets: List[Tuple[RowMeta, np.ndarray]] = field(default_factory=list)
     # (meta, llhist bins int64) — exact-merge family: registers ADD
     llhists: List[Tuple[RowMeta, np.ndarray]] = field(default_factory=list)
+    # pre-serialized metricpb frames (forward/convert.forwardable_to_wire),
+    # populated on the flush-readout executor so serialization overlaps
+    # sink delivery; MUST be dropped whenever the state lists mutate
+    # (carryover stash/drain call invalidate_wire)
+    wire: Optional[List[bytes]] = None
 
     def __len__(self):
         return (len(self.counters) + len(self.gauges) + len(self.histograms)
                 + len(self.sets) + len(self.llhists))
+
+    def invalidate_wire(self) -> None:
+        self.wire = None
 
 
 def _percentile_name(name: str, p: float) -> str:
@@ -382,21 +390,57 @@ class FlushSection:
     mtype: MetricType
 
 
+_LE_TAGS: Optional[List[str]] = None
+
+
+def le_tags() -> List[str]:
+    """`le:<bound>` tag strings for every sorted llhist bin plus the
+    final `le:+Inf`, index-aligned with BucketSection.csum columns."""
+    global _LE_TAGS
+    if _LE_TAGS is None:
+        from veneur_tpu.ops import llhist_ref
+        _LE_TAGS = [f"le:{_fmt_le(u)}" for u in llhist_ref.UPPER_SORTED]
+        _LE_TAGS.append("le:+Inf")
+    return _LE_TAGS
+
+
+@dataclass
+class BucketSection:
+    """Cumulative llhist bucket columns: one row per emitted llhist, the
+    full `np.cumsum` over its value-sorted bins. A row materializes as
+    COUNTER `<name>` lines tagged `le:<bound>` for every NONZERO sorted
+    bin (mask `nz`) plus an unconditional `le:+Inf` line carrying
+    `csum[:, -1]` — exactly `_flush_llhist_family`'s per-row loop. The
+    `le:` tag strings are shared and index-aligned via `le_tags()`;
+    `tags` rows are base tag-list refs (copy before mutating)."""
+
+    names: np.ndarray  # object ndarray of str ("<base>.bucket")
+    tags: np.ndarray   # object ndarray of List[str] (base tags, no le:)
+    csum: np.ndarray   # (rows, bins) float64 cumulative counts
+    nz: np.ndarray     # (rows, bins) bool — sorted bin is nonzero
+
+    def line_count(self) -> int:
+        return int(self.nz.sum()) + self.names.shape[0]
+
+
 class FlushBatch:
     """Columnar flush result. len() counts metrics; materialize() yields
     the legacy List[InterMetric] (cached, thread-safe — sink flush
     threads share one materialization)."""
 
     def __init__(self, timestamp: int, sections: List[FlushSection],
-                 extras: List[InterMetric]):
+                 extras: List[InterMetric],
+                 bucket_sections: Optional[List[BucketSection]] = None):
         self.timestamp = timestamp
         self.sections = sections
+        self.bucket_sections: List[BucketSection] = bucket_sections or []
         self.extras = extras  # statuses: carry message/hostname fields
         self._materialized: Optional[List[InterMetric]] = None
         self._mat_lock = threading.Lock()
 
     def __len__(self) -> int:
         return (sum(s.names.shape[0] for s in self.sections)
+                + sum(b.line_count() for b in self.bucket_sections)
                 + len(self.extras))
 
     def materialize(self) -> List[InterMetric]:
@@ -412,6 +456,22 @@ class FlushBatch:
                         for n, v, t in zip(sec.names.tolist(),
                                            sec.values.tolist(),
                                            sec.tags.tolist()))
+                les = le_tags()
+                for bs in self.bucket_sections:
+                    nz, csum = bs.nz, bs.csum
+                    for i, (nm, base) in enumerate(zip(bs.names.tolist(),
+                                                       bs.tags.tolist())):
+                        row = csum[i]
+                        tags = list(base)
+                        for k in np.flatnonzero(nz[i]).tolist():
+                            out.append(InterMetric(
+                                name=nm, timestamp=ts, value=float(row[k]),
+                                tags=tags + [les[k]],
+                                type=MetricType.COUNTER))
+                        out.append(InterMetric(
+                            name=nm, timestamp=ts, value=float(row[-1]),
+                            tags=tags + ["le:+Inf"],
+                            type=MetricType.COUNTER))
                 out.extend(self.extras)
                 self._materialized = out
             return self._materialized
@@ -751,13 +811,63 @@ def readout_columnstore(
                 stab.flush_tags(er, s_meta), MetricType.GAUGE))
 
     # ---- log-linear histograms ------------------------------------------
-    # per-row variable-length bucket emission doesn't columnarize; the
-    # family flows through `extras` via the same helper the legacy path
-    # runs (fed the snapshot finished in phase 2 above), so the two
-    # paths are parity-equal by construction
+    # percentiles/sum/count columnarize like every other family; the
+    # variable-length cumulative buckets become a BucketSection — one
+    # vectorized cumsum over the value-sorted bin table plus a nonzero
+    # mask, exploded per-row only by materialize() and the legacy
+    # `_flush_llhist_family` oracle (parity pinned by tests)
     extras: List[InterMetric] = []
-    _flush_llhist_family(store, is_local, full_ps, now, extras, fwd,
-                         collect_forward, finished=finished["llhist"])
+    bucket_sections: List[BucketSection] = []
+    ll_out, ll_bins, ll_touched, ll_meta = finished["llhist"]
+    llr = np.flatnonzero(ll_touched)
+    if llr.size:
+        from veneur_tpu.ops import llhist_ref
+
+        lltab = store.llhists
+        # ll_bins is compact over the touched rows in `llr` order; keep
+        # the compact index aligned while dropping reclaim stragglers
+        keep = np.fromiter((ll_meta[r] is not None for r in llr.tolist()),
+                           bool, llr.size)
+        llr, bins_sel = llr[keep], ll_bins[keep]
+        emit = np.ones(llr.size, bool)
+        if is_local and llr.size:
+            fwd_mask = lltab.scope_code[llr] != local_code
+            if fwd_mask.any():
+                if need_export:
+                    for j, row in zip(np.flatnonzero(fwd_mask).tolist(),
+                                      llr[fwd_mask].tolist()):
+                        fwd.llhists.append((ll_meta[row], bins_sel[j]))
+                emit = ~fwd_mask
+        er = llr[emit]
+        if er.size:
+            ebins = bins_sel[emit]
+            quants = np.asarray(ll_out["quantiles"], np.float64)[er]
+            tags_er = lltab.flush_tags(er, ll_meta)
+            for j, p in enumerate(full_ps):
+                sections.append(FlushSection(
+                    lltab.flush_names(
+                        p, er, ll_meta,
+                        lambda m, p=p: _percentile_name(m.name, p)),
+                    quants[:, j], tags_er, MetricType.GAUGE))
+            # count and sum from the HOST-side int64 bins (see the
+            # legacy helper: count must equal the le:+Inf bucket)
+            sections.append(FlushSection(
+                lltab.flush_names("sum", er, ll_meta,
+                                  lambda m: f"{m.name}.sum"),
+                ebins.astype(np.float64) @ llhist_ref.BIN_MID,
+                tags_er, MetricType.GAUGE))
+            sections.append(FlushSection(
+                lltab.flush_names("count", er, ll_meta,
+                                  lambda m: f"{m.name}.count"),
+                ebins.sum(axis=1).astype(np.float64),
+                tags_er, MetricType.COUNTER))
+            c_sorted = ebins[:, llhist_ref.ORDER]
+            bucket_sections.append(BucketSection(
+                lltab.flush_names("bucket", er, ll_meta,
+                                  lambda m: f"{m.name}.bucket"),
+                tags_er,
+                np.cumsum(c_sorted, axis=1, dtype=np.float64),
+                c_sorted != 0))
 
     # ---- status checks --------------------------------------------------
     for row in np.flatnonzero(st_touched).tolist():
@@ -783,7 +893,7 @@ def readout_columnstore(
             # mesh-scaling scenario and the waterfall view read the
             # shard width the measured flush actually merged over
             timings["mesh"] = store.shard_plane.describe()
-    return FlushBatch(now, sections, extras), fwd
+    return FlushBatch(now, sections, extras, bucket_sections), fwd
 
 
 def flush_columnstore_batch(

@@ -58,7 +58,8 @@ from veneur_tpu.ops import llhist_ref
 # plus a `.count` counter in /metrics. Listed literally so
 # scripts/check_metric_names.py can lint the expanded names against the
 # README inventory.
-HIST_ROWS = ("pipeline.sample_age", "queue.dwell")
+HIST_ROWS = ("pipeline.sample_age", "queue.dwell",
+             "egress.encode_s", "egress.send_s")
 
 # quantiles exported per llhist series (1.0 = the occupied-bin maximum)
 _EXPORT_QUANTILES = ((0.5, "p50"), (0.99, "p99"), (1.0, "max"))
@@ -199,6 +200,9 @@ class LatencyObservatory:
         # outcome ("hit"/"miss") per family when the cache is enabled
         self._retraces: Dict[str, float] = {}
         self._retrace_cache: Dict[str, str] = {}
+        # (phase, sink) -> hist; phase is "encode" or "send" — the
+        # per-sink flush split reported by MetricSink.note_egress
+        self._egress_hists: Dict[tuple, LatencyHist] = {}
 
     # -- queue dwell -----------------------------------------------------
 
@@ -313,6 +317,23 @@ class LatencyObservatory:
                     f"pipeline.sample_age:{plane}")
             return hist
 
+    # -- egress encode/send split ----------------------------------------
+
+    def note_egress(self, sink: str, encode_s: float,
+                    send_s: float) -> None:
+        """Record one sink flush's encode-vs-send wall split (fed by
+        MetricSink.note_egress): the waterfall's answer to whether a
+        slow sink burns CPU (encode) or waits on the network (send)."""
+        if not self.enabled:
+            return
+        for phase, v in (("encode", encode_s), ("send", send_s)):
+            with self._lock:
+                hist = self._egress_hists.get((phase, sink))
+                if hist is None:
+                    hist = self._egress_hists[(phase, sink)] = LatencyHist(
+                        f"egress.{phase}_s:{sink}")
+            hist.observe(max(0.0, float(v)))
+
     # -- retrace tagging -------------------------------------------------
 
     def note_retrace(self, family: str, seconds: float,
@@ -350,6 +371,7 @@ class LatencyObservatory:
             queues = dict(self._queues)
             q_hists = dict(self._queue_hists)
             age_hists = dict(self._age_hists)
+            egress_hists = dict(self._egress_hists)
         rows: List[tuple] = []
         for name, (depth_fn, capacity) in queues.items():
             tags = [f"queue:{name}"]
@@ -365,7 +387,13 @@ class LatencyObservatory:
         # names here and the lint can't drift apart
         for base, tag_key, hists in (
                 ("queue.dwell", "queue", q_hists),
-                ("pipeline.sample_age", "plane", age_hists)):
+                ("pipeline.sample_age", "plane", age_hists),
+                ("egress.encode_s", "sink",
+                 {s: h for (ph, s), h in egress_hists.items()
+                  if ph == "encode"}),
+                ("egress.send_s", "sink",
+                 {s: h for (ph, s), h in egress_hists.items()
+                  if ph == "send"})):
             for key, hist in hists.items():
                 snap = hist.snapshot()
                 tags = [f"{tag_key}:{key}"]
@@ -384,6 +412,7 @@ class LatencyObservatory:
             queues = dict(self._queues)
             q_hists = dict(self._queue_hists)
             age_hists = dict(self._age_hists)
+            egress_hists = dict(self._egress_hists)
             marks = {plane: {"oldest_unix": round(m.oldest, 3),
                              "newest_unix": round(m.newest, 3),
                              "batches": m.batches, "samples": m.samples}
@@ -400,12 +429,16 @@ class LatencyObservatory:
             except Exception:
                 entry["depth"] = None
             entry["capacity"] = capacity
+        egress: Dict[str, dict] = {}
+        for (phase, sink), hist in egress_hists.items():
+            egress.setdefault(sink, {})[phase] = hist.snapshot()
         return {
             "enabled": self.enabled,
             "generated_unix": round(time.time(), 3),
             "sample_age": planes,
             "pending_watermarks": marks,
             "queues": qs,
+            "egress": egress,
             "pending_retraces": {k: round(v, 6)
                                  for k, v in retraces.items()},
         }

@@ -2025,6 +2025,19 @@ class Server:
                 batch.extras.extend(backfilled)
                 self.statsd.count("flush.backfilled_series_total",
                                   len(backfilled))
+        if self.is_local and self.forwarder is not None and len(fwd):
+            # wire-encode the forward payload HERE, on the readout
+            # executor, so serialization overlaps sink delivery — the
+            # forward thread later finds fwd.wire pre-built and skips
+            # straight to the POST. Carryover merges invalidate it.
+            t0 = time.perf_counter()
+            from veneur_tpu.forward.convert import forwardable_to_wire
+            try:
+                fwd.wire = forwardable_to_wire(fwd)
+            except Exception:
+                fwd.wire = None  # forward thread re-encodes
+                logger.exception("forward pre-encode failed")
+            r_phases["forward_encode_s"] = time.perf_counter() - t0
         return batch, fwd, r_phases
 
     def _readout_executor(self):

@@ -268,7 +268,14 @@ class ForwardClient:
                     "forward breaker %s to %s: carrying %d metrics over",
                     self.breaker.state, self.address, len(fwd))
             return 0
-        protos = forwardable_to_wire(fwd) if len(fwd) else []
+        # prefer the frames the readout executor pre-encoded (overlapped
+        # with sink delivery); carryover merges invalidate the cache, so
+        # a non-None wire is always current
+        if len(fwd):
+            protos = (fwd.wire if fwd.wire is not None
+                      else forwardable_to_wire(fwd))
+        else:
+            protos = []
         if not protos and not spool_pending:
             # nonempty state that serialized to nothing leaves the
             # pipeline here — explained as a convert shed
@@ -381,7 +388,8 @@ class ForwardClient:
         crashes is the on-disk segment order and the breaker/budget
         logic has exactly one seam. Returns metrics delivered."""
         if len(fwd):
-            protos = forwardable_to_wire(fwd)
+            protos = (fwd.wire if fwd.wire is not None
+                      else forwardable_to_wire(fwd))
             if len(fwd) > len(protos):
                 # rows the wire conversion dropped leave the pipeline at
                 # the append boundary (the WAL only ever holds sendable

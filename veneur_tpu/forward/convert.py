@@ -7,7 +7,8 @@ samplers' Metric() methods; import parity with worker.go:410-467
 
 from __future__ import annotations
 
-from typing import Iterable, List, Tuple
+import struct
+from typing import Iterable, List, Optional, Tuple
 
 import numpy as np
 
@@ -106,6 +107,122 @@ def _pb_frame(meta) -> Tuple[bytes, bytes]:
     return frame
 
 
+_MASK64 = (1 << 64) - 1
+_INT64_MIN, _INT64_MAX = -(1 << 63), (1 << 63) - 1
+_ZERO8 = b"\x00" * 8
+
+
+def _upb() -> bool:
+    """The hand-packed frames below are calibrated against upb's
+    BITWISE implicit-presence rule (same contract as
+    _histograms_to_wire: -0.0 is emitted, 0.0 omitted); the pure-Python
+    backend compares by value, so fall back to protos there."""
+    from google.protobuf.internal import api_implementation
+    return api_implementation.Type() == "upb"
+
+
+def _wire_frame(meta, type_code: int, scope_code: int) -> Tuple[bytes, bytes]:
+    """Hand-packed metricpb frame: (fields 1-3 bytes, field-9 bytes),
+    cached on meta.pb_frame like the histogram `_pb_frame` — a meta
+    lives in exactly one family table, so the slot never collides and
+    the name/tags/type/scope bytes are paid once per key lifetime."""
+    from veneur_tpu.forward.wire import _append_varint
+
+    frame = meta.pb_frame
+    if frame is None:
+        head = bytearray()
+        nb = meta.name.encode()
+        head += b"\x0a"
+        _append_varint(head, len(nb))
+        head += nb
+        for t in meta.tags:
+            tb = t.encode()
+            head += b"\x12"
+            _append_varint(head, len(tb))
+            head += tb
+        if type_code:  # proto3 implicit presence: enum 0 omitted
+            head += b"\x18"
+            _append_varint(head, type_code)
+        tail = b"" if scope_code == 0 else bytes((0x48, scope_code))
+        frame = meta.pb_frame = (bytes(head), tail)
+    return frame
+
+
+def _scalars_to_wire(counters, gauges) -> Optional[List[bytes]]:
+    """Counters + gauges straight to metricpb wire bytes, no proto
+    objects (byte-identical to forwardable_to_protos, pinned by
+    tests/test_egress.py). Forwarded scalars are always Global scope
+    (worker.go:420-423 coerces on import anyway)."""
+    if not _upb():
+        return None
+    from veneur_tpu.forward.wire import _append_varint
+
+    global_code = int(metric_pb2.Global)
+    out: List[bytes] = []
+    for meta, value in counters:
+        v = int(value)
+        if not _INT64_MIN <= v <= _INT64_MAX:
+            return None  # protos raise on int64 overflow; keep that
+        head, tail = _wire_frame(meta, int(metric_pb2.Counter), global_code)
+        if v:
+            cv = bytearray(b"\x08")
+            _append_varint(cv, v & _MASK64)
+        else:
+            cv = b""  # oneof: empty CounterValue still emitted
+        frame = bytearray(head)
+        frame += b"\x2a"
+        _append_varint(frame, len(cv))
+        frame += cv
+        frame += tail
+        out.append(bytes(frame))
+    for meta, value in gauges:
+        head, tail = _wire_frame(meta, int(metric_pb2.Gauge), global_code)
+        vb = struct.pack("<d", float(value))
+        gv = b"" if vb == _ZERO8 else b"\x09" + vb
+        frame = bytearray(head)
+        frame += b"\x32"
+        _append_varint(frame, len(gv))
+        frame += gv
+        frame += tail
+        out.append(bytes(frame))
+    return out
+
+
+def _payload_family_to_wire(entries, type_code: int, field_tag: int,
+                            marshal) -> Optional[List[bytes]]:
+    """Sets/llhists to wire: per-row `marshal(state)` bytes wrapped as
+    `field 1` of the value submessage, framed with the cached
+    name/tags/type head and scope bytes. upb serializes in field-number
+    order, so the scope (field 9) lands BEFORE an llhist value (field
+    10) but AFTER a set value (field 8)."""
+    if not _upb():
+        return None
+    from veneur_tpu.forward.wire import _append_varint
+
+    value_after_scope = field_tag > 0x48  # field number > 9
+    out: List[bytes] = []
+    for meta, state in entries:
+        payload = marshal(state)
+        head, tail = _wire_frame(meta, type_code,
+                                 int(_SCOPE_TO_PB[meta.scope]))
+        if payload:
+            sv = bytearray(b"\x0a")
+            _append_varint(sv, len(payload))
+            sv += payload
+        else:
+            sv = b""
+        frame = bytearray(head)
+        if value_after_scope:
+            frame += tail
+        frame.append(field_tag)
+        _append_varint(frame, len(sv))
+        frame += sv
+        if not value_after_scope:
+            frame += tail
+        out.append(bytes(frame))
+    return out
+
+
 def _histograms_to_wire(histograms) -> List[bytes]:
     """Native bulk serialization of the digest rows: the per-centroid
     Python proto loop was the forward plane's wall (883 keys/s and blown
@@ -201,9 +318,13 @@ def forwardable_to_wire(fwd: ForwardableState) -> List[bytes]:
     forwardable_to_protos + SerializeToString."""
     out: List[bytes] = []
     if fwd.counters or fwd.gauges:
-        slim = ForwardableState(counters=fwd.counters, gauges=fwd.gauges)
-        out.extend(p.SerializeToString()
-                   for p in forwardable_to_protos(slim))
+        wired = _scalars_to_wire(fwd.counters, fwd.gauges)
+        if wired is None:  # non-upb backend / int64 overflow
+            slim = ForwardableState(counters=fwd.counters,
+                                    gauges=fwd.gauges)
+            wired = [p.SerializeToString()
+                     for p in forwardable_to_protos(slim)]
+        out.extend(wired)
     if fwd.histograms:
         wired = _histograms_to_wire(fwd.histograms)
         if wired is None:  # no native lib / odd dtype: proto fallback
@@ -212,13 +333,24 @@ def forwardable_to_wire(fwd: ForwardableState) -> List[bytes]:
                      for p in forwardable_to_protos(slim)]
         out.extend(wired)
     if fwd.sets:
-        slim = ForwardableState(sets=fwd.sets)
-        out.extend(p.SerializeToString()
-                   for p in forwardable_to_protos(slim))
+        from veneur_tpu.forward import hllwire
+        wired = _payload_family_to_wire(
+            fwd.sets, int(metric_pb2.Set), 0x42,
+            lambda r: hllwire.marshal(np.asarray(r, np.uint8)))
+        if wired is None:
+            slim = ForwardableState(sets=fwd.sets)
+            wired = [p.SerializeToString()
+                     for p in forwardable_to_protos(slim)]
+        out.extend(wired)
     if fwd.llhists:
-        slim = ForwardableState(llhists=fwd.llhists)
-        out.extend(p.SerializeToString()
-                   for p in forwardable_to_protos(slim))
+        from veneur_tpu.forward import llhistwire
+        wired = _payload_family_to_wire(
+            fwd.llhists, int(metric_pb2.LLHist), 0x52, llhistwire.marshal)
+        if wired is None:
+            slim = ForwardableState(llhists=fwd.llhists)
+            wired = [p.SerializeToString()
+                     for p in forwardable_to_protos(slim)]
+        out.extend(wired)
     return out
 
 

@@ -27,7 +27,14 @@ class MetricSink(abc.ABC):
     def kind(self) -> str: ...
 
     def start(self, server) -> None:  # noqa: B027
-        pass
+        self.bind_server(server)
+
+    def bind_server(self, server) -> None:
+        """Capture the owning server's self-metrics client and latency
+        observatory so flushes can report the encode-vs-send split
+        (note_egress). Sinks that override start() call this first."""
+        self._statsd = getattr(server, "statsd", None)
+        self._latency = getattr(server, "latency", None)
 
     @abc.abstractmethod
     def flush(self, metrics: List[InterMetric]) -> None: ...
@@ -39,6 +46,28 @@ class MetricSink(abc.ABC):
         columns directly (or discard them — blackhole) override this to
         skip object materialization entirely."""
         self.flush(batch.materialize())
+
+    def note_egress(self, encode_s: float, send_s: float,
+                    encoder: str = "columnar") -> None:
+        """Report one flush's encode-vs-send split: `egress.encode_s` /
+        `egress.send_s` observatory rows tagged with the sink name, plus
+        span tags on the ambient `flush.sink` span so the trace
+        waterfall shows whether a slow sink is CPU or network."""
+        lat = getattr(self, "_latency", None)
+        if lat is not None:
+            try:
+                lat.note_egress(self.name(), encode_s, send_s)
+            except Exception:
+                _logger.exception("egress latency report failed")
+        try:
+            from veneur_tpu.trace import context as trace_ctx
+            span = trace_ctx.current_span()
+            if span is not None:
+                span.set_tag("egress.encoder", encoder)
+                span.set_tag("egress.encode_s", f"{encode_s:.6f}")
+                span.set_tag("egress.send_s", f"{send_s:.6f}")
+        except Exception:
+            pass
 
     def flush_other_samples(self, samples: Sequence[Any]) -> None:  # noqa: B027
         """Receive events/service-check samples that aren't InterMetrics."""

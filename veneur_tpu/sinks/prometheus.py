@@ -109,6 +109,8 @@ class PrometheusMetricSink(MetricSink):
         self._exposition = ""
         self._exposition_om: Optional[str] = None
         self._om_metrics: List[InterMetric] = []
+        self._om_batch = None  # FlushBatch behind the lazy OM render
+        self._renderer = None  # PrometheusColumnarRenderer, built lazily
         self._lock = threading.Lock()
         self._httpd: Optional[ThreadingHTTPServer] = None
         # OpenMetrics exemplars: the owning server's self-trace plane
@@ -123,6 +125,7 @@ class PrometheusMetricSink(MetricSink):
         return "prometheus"
 
     def start(self, server) -> None:
+        self.bind_server(server)
         plane = getattr(server, "trace_plane", None)
         if plane is not None:
             self._exemplars = plane.exemplar_for
@@ -168,19 +171,67 @@ class PrometheusMetricSink(MetricSink):
         and cached until the next flush invalidates it."""
         with self._lock:
             if self._exposition_om is None:
-                self._exposition_om = render_exposition(
-                    self._om_metrics, exemplars=self._exemplars,
-                    openmetrics=True) + "# EOF\n"
+                if self._om_batch is not None:
+                    self._exposition_om = self._columnar_renderer().render(
+                        self._om_batch, exemplars=self._exemplars,
+                        openmetrics=True) + "# EOF\n"
+                else:
+                    self._exposition_om = render_exposition(
+                        self._om_metrics, exemplars=self._exemplars,
+                        openmetrics=True) + "# EOF\n"
             return self._exposition_om
 
+    def _columnar_renderer(self):
+        if self._renderer is None:
+            from veneur_tpu.core.egress import PrometheusColumnarRenderer
+            self._renderer = PrometheusColumnarRenderer()
+        return self._renderer
+
+    def flush_batch(self, batch) -> None:
+        if self.repeater_address:
+            # the repeater re-emits per-metric statsd lines, which wants
+            # the object list anyway — no columnar win to chase there
+            self.flush(batch.materialize())
+            return
+        try:
+            self.flush_columnar(batch)
+        except Exception:
+            logger.exception("prometheus columnar flush failed; "
+                             "falling back to materialize()")
+            self.flush(batch.materialize())
+
+    def flush_columnar(self, batch) -> None:
+        """Columnar fast path: render the plain 0.0.4 exposition straight
+        from the FlushBatch arrays (byte-identical to render_exposition
+        over materialize()), and park the batch so the lazy OpenMetrics
+        variant renders columnar too on first negotiated scrape."""
+        import time as _time
+
+        t0 = _time.perf_counter()
+        plain = self._columnar_renderer().render(batch)
+        encode_s = _time.perf_counter() - t0
+        with self._lock:
+            self._exposition = plain
+            self._om_metrics = []
+            self._om_batch = batch
+            self._exposition_om = None
+        self.note_egress(encode_s, 0.0)
+
     def flush(self, metrics: List[InterMetric]) -> None:
+        import time as _time
+
+        t0 = _time.perf_counter()
         plain = render_exposition(metrics)
+        encode_s = _time.perf_counter() - t0
         with self._lock:
             self._exposition = plain
             self._om_metrics = metrics
+            self._om_batch = None
             self._exposition_om = None
         if not self.repeater_address or not metrics:
+            self.note_egress(encode_s, 0.0, encoder="legacy")
             return
+        t1 = _time.perf_counter()
         host, _, port = self.repeater_address.rpartition(":")
         lines = []
         for m in metrics:
@@ -205,6 +256,8 @@ class PrometheusMetricSink(MetricSink):
                     s.close()
         except OSError as e:
             logger.error("prometheus repeater send failed: %s", e)
+        self.note_egress(encode_s, _time.perf_counter() - t1,
+                         encoder="legacy")
 
     def stop(self) -> None:
         if self._httpd is not None:

@@ -411,6 +411,9 @@ class Carryover:
                 before = len(fwd) + len(self._pending)
                 fwd = merge_forwardable(fwd, self._pending)
                 merged_away = before - len(fwd)
+            # any pre-encoded wire frames describe the UNMERGED state
+            if hasattr(fwd, "invalidate_wire"):
+                fwd.invalidate_wire()
             self._pending = fwd
             self._age += 1
             self.stashed_total += 1
@@ -463,6 +466,10 @@ class Carryover:
                     "interval(s) into this flush", len(pending), age)
         before = len(fwd) + len(pending)
         fwd = merge_forwardable(fwd, pending)
+        # the merge changed row contents: any wire frames pre-encoded on
+        # the readout executor are stale, force a re-encode at send time
+        if hasattr(fwd, "invalidate_wire"):
+            fwd.invalidate_wire()
         self._note("forward.merged_away", before - len(fwd), key="drain")
         return fwd
 
