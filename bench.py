@@ -696,6 +696,14 @@ def run_scenario_sustained(num_keys: int = 100_000, interval_s: float = 10.0,
         "sustained_keys": num_keys,
         "flush_async": bool(server.config.flush_async),
     }
+    # capacity headroom columns (PR-20 device observatory): peak HBM
+    # held by registered generations over the run, and the end-of-run
+    # shard balance (None on single-device stores)
+    devobs = getattr(server, "deviceobs", None)
+    if devobs is not None and devobs.enabled:
+        extra["device_mem_peak_bytes"] = int(devobs.peak_bytes)
+        skew = devobs.shard_skew()
+        extra["shard_skew"] = round(skew, 4) if skew is not None else None
     # overlap acceptance: ingest processed-rate inside flush windows vs
     # between them (PR-15's pin — was gated behind the dispatch stall)
     if len(ingest_samples) >= 3 and flush_windows:
@@ -1259,6 +1267,13 @@ def run_scenario_mesh(duration_s: float, num_keys: int = 2000):
     if base > 0:
         RESULT["mesh_scaling"] = {
             n: round(rates[n] / base, 3) for n in rates}
+    # capacity-headroom columns from the widest rung (each rung also
+    # carries its own in the ladder)
+    widest = max(rates, key=int, default=None)
+    if widest is not None and isinstance(ladder.get(widest), dict):
+        for col in ("device_mem_peak_bytes", "shard_skew"):
+            if col in ladder[widest]:
+                RESULT[col] = ladder[widest][col]
     best = max(rates.values()) if rates else 0.0
     return best
 
@@ -1285,6 +1300,11 @@ def run_scenario_mesh_worker(duration_s: float, num_keys: int) -> float:
         shard_devices=shards if shards > 1 else 0)
     RESULT["mesh_shards"] = (store.shard_plane.n
                              if store.shard_plane is not None else 1)
+    # standalone device observatory: the capacity-headroom columns the
+    # BASELINE trajectory records beside the rates
+    from veneur_tpu.core.deviceobs import DeviceObservatory
+    devobs = DeviceObservatory()
+    store.attach_deviceobs(devobs)
     parser = Parser()
     for i in range(num_keys):
         parser.parse_metric_fast(b"mesh.c.%d:1|c" % i, store.process)
@@ -1326,6 +1346,9 @@ def run_scenario_mesh_worker(duration_s: float, num_keys: int) -> float:
     batch, _fwd = flush()  # final flush inside the measurement contract
     elapsed = time.perf_counter() - t0
     RESULT["mesh_flush_metrics"] = len(batch)
+    RESULT["device_mem_peak_bytes"] = int(devobs.peak_bytes)
+    skew = devobs.shard_skew()
+    RESULT["shard_skew"] = round(skew, 4) if skew is not None else None
     return samples / max(elapsed, 1e-9)
 
 

@@ -1,0 +1,172 @@
+#!/usr/bin/env python
+"""Pretty-print a device capacity & shard-balance report.
+
+Reads ``GET /debug/device`` from a live veneur-tpu server — or a saved
+JSON file — and renders the device observatory as text: the HBM
+generation ledger (per family, per lifecycle state, with the
+next-resize forecast and backend reconciliation where the runtime
+exposes allocator stats), the kernel dispatch/compile registry, the
+per-shard balance picture with the skew ratio and any recommended
+reshard plan, and the overload ladder's device watermark rung.
+
+Usage:
+    python scripts/device_report.py http://127.0.0.1:8127/debug/device
+    python scripts/device_report.py http://host:8127
+    python scripts/device_report.py saved-device.json
+    python scripts/device_report.py http://host:8127 --skew-threshold 2
+
+Exit codes: 0 = healthy, 1 = ledger occupancy at/over the hard device
+watermark OR shard skew at/over the alert threshold, 2 = could not
+read input.
+
+stdlib-only (urllib) so it runs anywhere the operator has Python.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List
+
+# the hot-shard bar deviceobs uses; --skew-threshold overrides
+DEFAULT_SKEW_THRESHOLD = 2.0
+
+_STATE_ORDER = ("live", "spare", "inflight", "prewarm", "reshard_capture")
+
+
+def _mb(v) -> str:
+    if v is None:
+        return "-"
+    return f"{float(v) / (1 << 20):.2f}MiB"
+
+
+def load_report(source: str) -> dict:
+    """Fetch the report from a URL (``/debug/device`` appended when the
+    path is missing) or read it from a JSON file."""
+    if source.startswith(("http://", "https://")):
+        from urllib.request import urlopen
+        url = source
+        if "/debug/device" not in url:
+            url = url.rstrip("/") + "/debug/device"
+        with urlopen(url, timeout=10) as resp:
+            return json.loads(resp.read())
+    with open(source) as f:
+        return json.loads(f.read())
+
+
+def format_report(report: dict) -> str:
+    lines: List[str] = []
+    add = lines.append
+    led = report.get("ledger", {})
+    add("device observatory — HBM ledger & shard balance")
+    add(f"  total {_mb(led.get('total_bytes'))}"
+        f"   live {_mb(led.get('live_bytes'))}"
+        f"   peak {_mb(led.get('peak_bytes'))}"
+        f"   generations {led.get('generations', 0)}")
+    add(f"  forecast at next resize: "
+        f"{_mb(led.get('forecast_next_resize_bytes'))}")
+    add("")
+    by_family = led.get("by_family", {})
+    if by_family:
+        add("ledger by family (bytes per lifecycle state):")
+        for family in sorted(by_family):
+            states = by_family[family]
+            detail = "  ".join(
+                f"{s}={_mb(states[s])}" for s in _STATE_ORDER
+                if states.get(s))
+            add(f"  {family}: {detail or '-'}")
+        add("")
+    recon = report.get("reconciliation")
+    if recon:
+        add("backend reconciliation (jax.device_memory_stats):")
+        add(f"  allocator in use {_mb(recon.get('backend_bytes_in_use'))}"
+            f"   ledger {_mb(recon.get('ledger_bytes'))}"
+            f"   unaccounted {_mb(recon.get('unaccounted_bytes'))}")
+        add("")
+    elif report.get("backend_devices") == []:
+        add("backend reconciliation: unavailable (CPU backend exposes "
+            "no allocator stats)")
+        add("")
+    kernels = report.get("kernels", [])
+    if kernels:
+        add("kernel registry (dispatches, wall p50/p99):")
+        for k in kernels:
+            wall = k.get("wall") or {}
+            timing = (f"  p50={wall.get('p50', 0):.6f}s"
+                      f" p99={wall.get('p99', 0):.6f}s"
+                      if wall else "")
+            add(f"  {k['kind']:8s} {k['family']:10s}"
+                f" x{k['dispatches']}{timing}")
+        add("")
+    compiles = report.get("compiles", {})
+    if compiles:
+        add("compiles/retraces: " + ", ".join(
+            f"{fam}={n}" for fam, n in sorted(compiles.items())))
+        add("")
+    bal = report.get("shard_balance")
+    if bal:
+        skew = bal.get("skew")
+        add(f"shard balance ({bal.get('n_shards')} shards, "
+            f"skew={skew if skew is None else round(skew, 4)}):")
+        add(f"  rows/shard: {bal.get('rows_per_shard')}")
+        if bal.get("hot_shards"):
+            add(f"  ** hot shards: {bal['hot_shards']} **")
+        plan = bal.get("reshard_plan")
+        if plan:
+            add(f"  recommended reshard: {plan['from_shards']} -> "
+                f"{plan['to_shards']} (projected skew "
+                f"{plan['projected_skew']:.4f}, {plan['rows_moved']} "
+                f"rows over {plan['migration_cells']} cells)")
+        add("")
+    wm = report.get("watermarks", {})
+    if wm:
+        add(f"device watermark rung: state={wm.get('state', 'ok')}"
+            f"  last={_mb(wm.get('last_bytes'))}"
+            f"  soft={_mb(wm.get('soft_bytes')) if wm.get('soft_bytes') else '-'}"
+            f"  hard={_mb(wm.get('hard_bytes')) if wm.get('hard_bytes') else '-'}"
+            f"  transitions={wm.get('transitions', 0)}")
+    return "\n".join(lines)
+
+
+def breaches(report: dict, skew_threshold: float) -> List[str]:
+    """Exit-1 conditions: occupancy at/over the hard device watermark,
+    or shard skew at/over the alert threshold."""
+    out: List[str] = []
+    total = float(report.get("ledger", {}).get("total_bytes", 0))
+    hard = float(report.get("watermarks", {}).get("hard_bytes", 0) or 0)
+    if hard and total >= hard:
+        out.append(f"HBM occupancy {_mb(total)} >= hard watermark "
+                   f"{_mb(hard)}")
+    bal = report.get("shard_balance") or {}
+    skew = bal.get("skew")
+    if skew is not None and float(skew) >= skew_threshold:
+        out.append(f"shard skew {float(skew):.4f} >= threshold "
+                   f"{skew_threshold}")
+    return out
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("source",
+                        help="device URL (http://host:port[/debug/device])"
+                             " or a saved JSON file")
+    parser.add_argument("--skew-threshold", type=float,
+                        default=DEFAULT_SKEW_THRESHOLD,
+                        help="shard skew at/over this exits 1 "
+                             f"(default {DEFAULT_SKEW_THRESHOLD})")
+    args = parser.parse_args(argv)
+    try:
+        report = load_report(args.source)
+    except Exception as e:
+        print(f"error: could not read {args.source}: {e}", file=sys.stderr)
+        return 2
+    print(format_report(report))
+    bad = breaches(report, args.skew_threshold)
+    for b in bad:
+        print(f"** {b} **")
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

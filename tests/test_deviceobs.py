@@ -1,0 +1,475 @@
+"""Device capacity & shard-balance observatory (the `deviceobs` marker).
+
+The HBM ledger's contract is *conservation*: `total_bytes()` equals the
+exact sum of every registered generation's nbytes — live generations
+plus parked spares at quiescence, plus the in-flight snapshot mid
+overlap — at every step of the lifecycle the column store can drive:
+
+- generation swap under the overlapped (flush_async-shaped) flush,
+  including the recycled-spare reuse on the following interval;
+- a capacity resize (the grow drops and re-registers the live
+  generation at the new rung);
+- a prewarm-rung compile (the throwaway state is booked `prewarm` and
+  dropped before the call returns);
+- a live 2 -> 3 reshard (capture buffers ride `reshard_capture` into
+  the snapshot and are dropped at cutover merge).
+
+The shard-balance plane is pinned by a hot-key storm: rejection-sampled
+names homed onto one shard drive `device.shard.skew` over threshold and
+a `shard_skew` alert rule through idle -> pending -> firing with
+trace-stamped alert_transition events. A `slow`-marked soak holds the
+enabled-vs-disabled flush overhead under the same 2% bar as the
+latency/query observatories.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+
+from veneur_tpu.config import Config
+from veneur_tpu.core.columnstore import ColumnStore
+from veneur_tpu.core.deviceobs import (DeviceObservatory, HIST_ROWS,
+                                       KERNEL_KINDS)
+from veneur_tpu.core.flusher import (flush_columnstore_batch,
+                                     readout_columnstore,
+                                     swap_columnstore)
+from veneur_tpu.core.server import Server
+from veneur_tpu.samplers.metrics import HistogramAggregates
+from veneur_tpu.samplers.parser import Parser
+from veneur_tpu.sinks.channel import ChannelMetricSink
+
+pytestmark = pytest.mark.deviceobs
+
+PCTS = (0.5, 0.99)
+AGGS = HistogramAggregates.from_names(
+    ["min", "max", "median", "avg", "count", "sum"])
+
+
+def corpus(round_no: int = 0):
+    lines = []
+    for i in range(8):
+        lines.append(b"c.%d:%d|c|#env:t" % (i, i + 1 + round_no))
+        lines.append(b"g.%d:%.2f|g" % (i, i * 1.5 + round_no))
+        lines.append(b"t.%d:%.2f|ms" % (i, 10.0 + i + round_no))
+        lines.append(b"s.%d:m%d|s" % (i, i))
+        lines.append(b"ll.%d:%.2f|l" % (i, 3.0 + i + round_no))
+    return lines
+
+
+def _mk_store(**kw):
+    kw.setdefault("counter_capacity", 64)
+    kw.setdefault("gauge_capacity", 64)
+    kw.setdefault("histo_capacity", 64)
+    kw.setdefault("set_capacity", 32)
+    kw.setdefault("llhist_capacity", 64)
+    kw.setdefault("batch_cap", 128)
+    return ColumnStore(**kw)
+
+
+def _feed_store(store, lines):
+    p = Parser()
+    for line in lines:
+        p.parse_metric_fast(line, store.process)
+    store.apply_all_pending()
+
+
+def mk_server(**kw):
+    cfg = Config()
+    cfg.interval = 3600.0
+    cfg.hostname = "test"
+    cfg.statsd_listen_addresses = []
+    cfg.tpu.counter_capacity = 128
+    cfg.tpu.gauge_capacity = 128
+    cfg.tpu.histo_capacity = 128
+    cfg.tpu.set_capacity = 64
+    cfg.tpu.llhist_capacity = 64
+    cfg.tpu.batch_cap = 512
+    cfg.ledger_strict = True
+    for k, v in kw.items():
+        if "." in k:
+            ns, field = k.split(".", 1)
+            setattr(getattr(cfg, ns), field, v)
+        else:
+            setattr(cfg, k, v)
+    cfg.apply_defaults()
+    obs = ChannelMetricSink()
+    return Server(cfg, extra_metric_sinks=[obs]), obs
+
+
+def _feed(server, lines, apply=True):
+    for line in lines:
+        server.handle_metric_packet(line)
+    if apply:
+        server.store.apply_all_pending()
+
+
+def expected_bytes(store) -> int:
+    """Ground truth the ledger must match at quiescence: the exact
+    nbytes sum over every table's live device state plus its parked
+    spare. (Mid-overlap the in-flight snapshot is extra — the overlap
+    test accounts for it separately.)"""
+    total = 0
+    for _family, t in store.tables():
+        state_of = getattr(t, "_devobs_state", None)
+        if state_of is None:
+            continue
+        for tree in (state_of(), getattr(t, "_spare", None)):
+            if tree is None:
+                continue
+            for leaf in jax.tree_util.tree_leaves(tree):
+                total += int(getattr(leaf, "nbytes", 0))
+    return total
+
+
+def _inflight_bytes(obs) -> int:
+    led = obs.ledger()
+    return sum(states.get("inflight", 0) + states.get("reshard_capture", 0)
+               for states in led["by_family"].values())
+
+
+def _skewed_names(n_shards: int, shard: int, count: int, salt: str = "skew"):
+    """Rejection-sample metric names whose digest64 homes onto `shard`
+    under the (digest * n) >> 64 routing."""
+    p = Parser()
+    grabbed = []
+    names, i = [], 0
+    while len(names) < count:
+        line = b"%s.%d:1|c" % (salt.encode(), i)
+        i += 1
+        del grabbed[:]
+        p.parse_metric_fast(line, grabbed.append)
+        d = grabbed[-1].digest64 & 0xFFFFFFFFFFFFFFFF
+        if (d * n_shards) >> 64 == shard:
+            names.append(line)
+        assert i < 100_000, "rejection sampling runaway"
+    return names
+
+
+# -------------------------------------------------------------------------
+# HBM ledger conservation
+# -------------------------------------------------------------------------
+
+
+class TestLedgerConservation:
+    def test_attach_registers_exact(self):
+        store = _mk_store()
+        obs = DeviceObservatory()
+        _feed_store(store, corpus())
+        store.attach_deviceobs(obs)
+        assert obs.total_bytes() == expected_bytes(store) > 0
+        led = obs.ledger()
+        assert led["live_bytes"] == led["total_bytes"]
+        assert led["forecast_next_resize_bytes"] == 2 * led["live_bytes"]
+
+    @pytest.mark.parametrize("is_local", [False, True])
+    def test_swap_under_overlapped_flush(self, is_local):
+        """The flush_async shape: swap on the interval thread, readout
+        on a background thread while ingest continues. Mid-overlap the
+        old generation is booked `inflight`; after the join/recycle it
+        is the parked spare and the ledger is exact again — and the
+        next interval's spare REUSE conserves bytes too."""
+        store = _mk_store()
+        obs = DeviceObservatory()
+        store.attach_deviceobs(obs)
+        _feed_store(store, corpus())
+        swap = swap_columnstore(store, is_local, PCTS)
+        # old generations in flight, fresh ones live: exact, with the
+        # in-flight bytes on top of live+spare
+        inflight = _inflight_bytes(obs)
+        assert inflight > 0
+        assert obs.total_bytes() == expected_bytes(store) + inflight
+
+        result = {}
+
+        def _readout():
+            result["out"] = readout_columnstore(store, swap, is_local,
+                                                AGGS)
+
+        t = threading.Thread(target=_readout)
+        t.start()
+        _feed_store(store, corpus(round_no=7))
+        t.join(30.0)
+        assert not t.is_alive()
+        # quiescent: snapshots recycled into spares, ledger exact
+        assert _inflight_bytes(obs) == 0
+        assert obs.total_bytes() == expected_bytes(store) > 0
+        led = obs.ledger()
+        spares = sum(s.get("spare", 0) for s in led["by_family"].values())
+        assert spares > 0
+        # interval 2 swaps INTO the recycled spares (retag, not fresh
+        # registration) — still exact at quiescence
+        flush_columnstore_batch(store, is_local, PCTS, AGGS)
+        assert obs.total_bytes() == expected_bytes(store)
+
+    def test_resize_grow_rebooks_live_generation(self):
+        store = _mk_store(counter_capacity=64)
+        obs = DeviceObservatory()
+        store.attach_deviceobs(obs)
+        before = obs.total_bytes()
+        # mint past capacity to force the grow
+        _feed_store(store, [b"rz.%d:1|c" % i for i in range(100)])
+        assert store.counters.capacity > 64
+        after = obs.total_bytes()
+        assert after > before
+        assert after == expected_bytes(store)
+        # grown table survives a flush round with conservation intact
+        flush_columnstore_batch(store, True, PCTS, AGGS)
+        assert obs.total_bytes() == expected_bytes(store)
+
+    def test_prewarm_rung_token_is_transient(self):
+        store = _mk_store(counter_capacity=64)
+        obs = DeviceObservatory()
+        store.attach_deviceobs(obs)
+        before = obs.total_bytes()
+        assert store.counters.prewarm_rung(128, PCTS)
+        # the throwaway rung state was booked `prewarm` and dropped
+        assert obs.total_bytes() == before == expected_bytes(store)
+        rep = obs.kernel_report()
+        kinds = {(k["kind"], k["family"]) for k in rep["kernels"]}
+        assert ("prewarm", "counter") in kinds
+        assert rep["compiles"].get("counter", 0) >= 1
+
+    def test_live_reshard_2_to_3_conserves(self, tmp_path):
+        """The full migration: capture buffers ride `reshard_capture`
+        through the WAL'd merge and are dropped at cutover; the
+        re-topologized 3-shard generations register fresh. Exact at
+        every quiescent point."""
+        server, _obs = mk_server(**{"tpu.shards": 2},
+                                 reshard_spool_dir=str(tmp_path / "wal"))
+        try:
+            obs = server.deviceobs
+            assert obs is not None and obs.enabled
+            _feed(server, corpus())
+            assert obs.total_bytes() == expected_bytes(server.store)
+            server.flush()
+            assert obs.total_bytes() == expected_bytes(server.store)
+            server.reshard.begin(shards=3, block=True)
+            assert _inflight_bytes(obs) == 0
+            assert obs.total_bytes() == expected_bytes(server.store) > 0
+            # post-reshard interval still conserves
+            _feed(server, corpus(round_no=3))
+            server.flush()
+            assert obs.total_bytes() == expected_bytes(server.store)
+            bal = obs.shard_balance()
+            assert bal is not None and bal["n_shards"] == 3
+        finally:
+            server.config.flush_on_shutdown = False
+            server.shutdown()
+
+    def test_disabled_observatory_is_inert(self):
+        server, _obs = mk_server(device_observatory=False)
+        try:
+            _feed(server, corpus())
+            server.flush()
+            assert server.deviceobs.total_bytes() == 0
+            assert server.deviceobs.telemetry_rows() == []
+            rep = server.device_report()
+            assert rep["enabled"] is False
+        finally:
+            server.config.flush_on_shutdown = False
+            server.shutdown()
+
+
+# -------------------------------------------------------------------------
+# Kernel registry & telemetry export
+# -------------------------------------------------------------------------
+
+
+class TestKernelRegistry:
+    def test_flush_populates_dispatches_and_hists(self):
+        store = _mk_store()
+        obs = DeviceObservatory()
+        store.attach_deviceobs(obs)
+        _feed_store(store, corpus())
+        flush_columnstore_batch(store, True, PCTS, AGGS)
+        _feed_store(store, corpus(round_no=1))
+        flush_columnstore_batch(store, True, PCTS, AGGS)
+        rep = obs.kernel_report()
+        kinds = {(k["kind"], k["family"]) for k in rep["kernels"]}
+        assert ("apply", "counter") in kinds
+        assert ("readout", "counter") in kinds
+        assert ("reset", "counter") in kinds  # spare re-init on recycle
+        # compiles are counted on the retrace paths: force a resize
+        _feed_store(store, [b"kr.%d:1|c" % i for i in range(100)])
+        rep = obs.kernel_report()
+        assert rep["compiles"].get("counter", 0) >= 1
+        timed = [k for k in rep["kernels"] if k.get("wall")]
+        assert timed and all(k["wall"]["count"] >= 1 for k in timed)
+        rows = {r[0] for r in obs.telemetry_rows()}
+        assert {"device.mem.total_bytes", "device.mem.peak_bytes",
+                "device.mem.generations", "device.mem.bytes",
+                "device.kernel.dispatches",
+                "device.compile.count"} <= rows
+        # every exported hist row expands from the linted HIST_ROWS set
+        hist_rows = {r for r in rows if ".kernel." in r
+                     and r != "device.kernel.dispatches"}
+        bases = {r.rsplit(".", 1)[0] for r in hist_rows}
+        assert bases <= set(HIST_ROWS)
+        assert set(KERNEL_KINDS) == {
+            b.split(".")[-1][:-2] for b in HIST_ROWS}
+
+
+# -------------------------------------------------------------------------
+# Shard balance, skew alert, HTTP surface
+# -------------------------------------------------------------------------
+
+
+class TestShardBalance:
+    def test_hot_key_storm_fires_shard_skew_rule(self):
+        """Hot-key storm: names homed onto shard 0 drive the skew over
+        threshold; a `shard_skew` rule walks idle -> pending -> firing
+        with trace-stamped alert_transition events."""
+        server, _obs = mk_server(**{"tpu.shards": 2})
+        try:
+            hot = _skewed_names(2, 0, 30)
+            cold = _skewed_names(2, 1, 5, salt="cold")
+            _feed(server, hot + cold)
+            obs = server.deviceobs
+            skew = obs.shard_skew()
+            assert skew is not None and skew > 1.5
+            server.alerts.configure([
+                {"id": "skew", "kind": "shard_skew", "op": ">",
+                 "threshold": 1.5, "for": "0.2s"},
+            ])
+            now = time.time()
+            trs = server.alerts.evaluate_once(now=now)
+            assert [(t["from_state"], t["to_state"]) for t in trs] == \
+                [("idle", "pending")]
+            assert server.alerts.evaluate_once(now=now + 0.1) == []
+            trs = server.alerts.evaluate_once(now=now + 0.3)
+            assert [(t["from_state"], t["to_state"]) for t in trs] == \
+                [("pending", "firing")]
+            rep = server.alerts.report()
+            assert rep["rules"][0]["state"] == "firing"
+            assert rep["rules"][0]["value"] == pytest.approx(skew,
+                                                             rel=1e-6)
+            events = server.telemetry.events.snapshot(
+                kind="alert_transition")
+            assert [e["to_state"] for e in events] == ["pending",
+                                                       "firing"]
+            assert all(e["rule"] == "skew" for e in events)
+            assert all(e.get("trace_id") for e in events)
+            # the gauge the rule watches is exported
+            rows = {r[0]: r[2] for r in obs.telemetry_rows()}
+            assert rows["device.shard.skew"] == pytest.approx(skew)
+        finally:
+            server.config.flush_on_shutdown = False
+            server.shutdown()
+
+    def test_hot_shard_detection_and_reshard_plan(self):
+        """All rows on one of four shards: skew 4.0, shard 0 flagged
+        hot, and the planner recommends a rebalancing target priced in
+        migration cells."""
+        server, _obs = mk_server(**{"tpu.shards": 4})
+        try:
+            _feed(server, _skewed_names(4, 0, 24))
+            bal = server.deviceobs.shard_balance()
+            assert bal is not None
+            assert bal["n_shards"] == 4
+            assert sum(bal["rows_per_shard"]) == 24
+            assert bal["rows_per_shard"][0] == 24
+            assert bal["skew"] == pytest.approx(4.0)
+            assert bal["hot_shards"] == [0]
+            assert sum(bal["digest_occupancy"]) == 24
+            plan = bal.get("reshard_plan")
+            assert plan is not None
+            assert plan["from_shards"] == 4
+            assert plan["to_shards"] != 4
+            assert plan["rows_moved"] >= 0
+            assert plan["migration_cells"] is None or \
+                plan["migration_cells"] >= 1
+        finally:
+            server.config.flush_on_shutdown = False
+            server.shutdown()
+
+    def test_unsharded_store_has_no_balance(self):
+        store = _mk_store()
+        obs = DeviceObservatory()
+        store.attach_deviceobs(obs)
+        _feed_store(store, corpus())
+        assert obs.shard_balance() is None
+        assert obs.shard_skew() is None
+
+    def test_debug_device_http_surface(self):
+        from veneur_tpu.core.httpapi import HTTPApi
+        server, _obs = mk_server(**{"tpu.shards": 2})
+        api = None
+        try:
+            _feed(server, corpus())
+            server.flush()
+            api = HTTPApi(server.config, server=server,
+                          address="127.0.0.1:0")
+            api.start()
+            host, port = api.address
+            with urllib.request.urlopen(
+                    f"http://{host}:{port}/debug/device",
+                    timeout=10) as r:
+                assert r.status == 200
+                body = json.loads(r.read())
+            assert body["enabled"] is True
+            assert body["ledger"]["total_bytes"] == \
+                expected_bytes(server.store)
+            assert body["kernels"]
+            assert body["shard_balance"]["n_shards"] == 2
+            assert "watermarks" in body
+        finally:
+            if api is not None:
+                api.stop()
+            server.config.flush_on_shutdown = False
+            server.shutdown()
+
+
+# -------------------------------------------------------------------------
+# Overhead soak
+# -------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+class TestOverheadSoak:
+    def test_observatory_overhead_bounded(self):
+        """The acceptance soak: observatory enabled vs disabled, same
+        corpus, same flush cadence (flush_async overlap shape) — flush
+        wall and flush.critical_path_s p99 within 2% (plus the same
+        absolute CI-jitter floor the query-plane soak uses)."""
+        def soak(enabled):
+            server, _obs = mk_server(flush_async=True,
+                                     device_observatory=enabled)
+            try:
+                walls = []
+                for k in range(2):  # warmup: compiles off both sides
+                    _feed(server, corpus(round_no=k))
+                    server.flush()
+                for k in range(8):
+                    _feed(server, corpus(round_no=10 + k))
+                    t0 = time.perf_counter()
+                    server.flush()
+                    walls.append(time.perf_counter() - t0)
+                crits = []
+                for ri in server.telemetry.flushes.snapshot():
+                    cp = ri.get("phases", {}).get("critical_path_s")
+                    if cp is not None:
+                        crits.append(float(cp))
+                return walls, crits
+            finally:
+                server.config.flush_on_shutdown = False
+                server.shutdown()
+
+        base_walls, base_crits = soak(enabled=False)
+        on_walls, on_crits = soak(enabled=True)
+        base = float(np.mean(base_walls))
+        loaded = float(np.mean(on_walls))
+        assert loaded - base <= 0.02 * base + 0.25, \
+            f"flush wall moved: off={base:.3f}s on={loaded:.3f}s"
+        if base_crits and on_crits:
+            bp99 = float(np.percentile(base_crits, 99))
+            lp99 = float(np.percentile(on_crits, 99))
+            assert lp99 <= bp99 * 1.02 + 0.25, \
+                f"critical_path p99 moved: {bp99:.3f} -> {lp99:.3f}"

@@ -37,6 +37,31 @@ def flush_rows(server, ch):
         for m in ch.wait_flush())
 
 
+def _settle_span_workers(server, timeout=15.0, settle=0.25):
+    """Wait until the span workers have fully drained AND applied their
+    extractions. `span_chan.empty()` alone races the in-flight worker
+    iteration (the span was taken off the channel but its metrics not
+    yet applied — the load-dependent flake); an empty channel plus a
+    `store.processed` count that has been stable for `settle` seconds
+    is a deterministic quiesce under any scheduler load."""
+    import time
+    deadline = time.time() + timeout
+    stable_since = time.time()
+    last = server.store.processed
+    while time.time() < deadline:
+        if not server.span_chan.empty():
+            stable_since = time.time()
+            time.sleep(0.02)
+            continue
+        cur = server.store.processed
+        if cur != last:
+            last = cur
+            stable_since = time.time()
+        elif time.time() - stable_since >= settle:
+            return
+        time.sleep(0.02)
+
+
 def run_both(datagram_batches):
     """Feed the same batches through native and Python servers; return
     ((metrics, stats), (metrics, stats))."""
@@ -310,7 +335,6 @@ class TestSsfNative:
         return packets
 
     def _run(self, packets, disable_native: bool, repeats: int = 2):
-        import time
         server, ch = make_server(disable_native)
         # uniqueness must be deterministic across paths for the oracle
         server.metric_extraction._uniqueness_rate = 1.0
@@ -322,10 +346,7 @@ class TestSsfNative:
                         server.handle_ssf_packet(p)
                 else:
                     server.handle_ssf_batch(packets)
-            deadline = time.time() + 10
-            while not server.span_chan.empty() and time.time() < deadline:
-                time.sleep(0.02)
-            time.sleep(0.2)  # let the last worker iteration finish
+            _settle_span_workers(server)
             rows = flush_rows(server, ch)
             return rows, dict(server.stats), server
         finally:
@@ -393,12 +414,7 @@ class TestSsfNative:
                     server.handle_ssf_batch([packet])
                 else:
                     server.handle_ssf_packet(packet)
-                import time
-                deadline = time.time() + 10
-                while (not server.span_chan.empty()
-                       and time.time() < deadline):
-                    time.sleep(0.02)
-                time.sleep(0.2)
+                _settle_span_workers(server)
                 results.append(flush_rows(server, ch))
             finally:
                 server.shutdown()

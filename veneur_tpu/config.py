@@ -363,6 +363,17 @@ class Config:
     overload_watermark_hard_bytes: int = 0
     overload_watermark_poll: float = 1.0   # duration between RSS polls
     overload_watermark_degraded_keep: float = 0.25
+    # device watermark rung (core/deviceobs.py HBM ledger bytes): same
+    # ladder semantics as the RSS rung, thresholds on device-resident
+    # generation bytes instead of host RSS (0 = disabled). The combined
+    # overload state is the severity max of the two rungs.
+    overload_device_soft_bytes: int = 0
+    overload_device_hard_bytes: int = 0
+    # -- device observatory (core/deviceobs.py) -------------------------
+    # HBM generation ledger + kernel dispatch/compile registry + shard
+    # balance scrape, served at /debug/device. Off, every hook is one
+    # attribute read (the <2% overhead soak's off switch).
+    device_observatory: bool = True
     # -- pipeline supervision (core/overload.py) ------------------------
     # a pipeline thread (ingest pump dispatch, span workers, flush loop)
     # whose heartbeat goes stale past supervisor_deadline is flagged

@@ -115,6 +115,15 @@ class AlertRule:
         tags = d.get("tags") or ()
         if isinstance(tags, str):
             tags = parse_tags(tags)
+        if str(d.get("kind", "quantile")) == "shard_skew":
+            # device-observatory rule: no query-plane lookup — the
+            # value is DeviceObservatory.shard_skew() each tick
+            return cls(id=rid,
+                       metric=str(d.get("metric") or "device.shard.skew"),
+                       kind="shard_skew", op=op,
+                       threshold=float(d["threshold"]),
+                       for_s=_duration_s(d.get("for", 0.0)), spec=None,
+                       tags=tuple(tags))
         spec = QuerySpec.build(
             metric=str(d.get("metric") or ""),
             kind=str(d.get("kind", "quantile")),
@@ -224,7 +233,7 @@ class AlertEngine:
             return []
         t0 = time.perf_counter()
         self.evals_total += 1
-        specs = [r.spec for r in rules]
+        specs = [r.spec for r in rules if r.spec is not None]
         families: List[str] = []
         for s in specs:
             for fam in _KIND_FAMILIES[s.kind]:
@@ -232,9 +241,18 @@ class AlertEngine:
                     families.append(fam)
         ps = self._plane.ps_for(specs)
         need_bins = any(s.kind == "bin_occupancy" for s in specs)
-        bundle = self._plane.capture(families, ps=ps, need_bins=need_bins)
+        bundle = None
+        if specs:  # pure shard_skew rule sets never touch the store
+            bundle = self._plane.capture(families, ps=ps,
+                                         need_bins=need_bins)
         values = np.full(len(rules), np.nan, np.float32)
         for i, rule in enumerate(rules):
+            if rule.kind == "shard_skew":
+                obs = getattr(self._server, "deviceobs", None)
+                skew = obs.shard_skew() if obs is not None else None
+                if skew is not None and not np.isnan(skew):
+                    values[i] = np.float32(skew)
+                continue
             try:
                 res = self._plane.evaluate(bundle, rule.spec, ps)
             except Exception:

@@ -238,6 +238,15 @@ class _BaseTable:
         # already compiled (core/flushexec.py): the post-resize
         # recompile probe reads this to tag the round prewarmed
         self._prewarmed_caps = set()
+        # device observatory (core/deviceobs.py): duck-typed HBM-ledger
+        # + kernel-registry sink, None = unregistered. The three token
+        # slots track this table's generations through the double-buffer
+        # lifecycle (live -> inflight -> spare -> live ...); all three
+        # are guarded by apply_lock.
+        self._deviceobs = None
+        self._devobs_live = None
+        self._devobs_spare = None
+        self._devobs_inflight = None
         self._init_arrays()
 
     # subclasses define _init_arrays / _grow_arrays / _apply_cols / reset
@@ -293,6 +302,10 @@ class _BaseTable:
                 elapsed = time.perf_counter() - t0
                 self.recompile_last_seconds = elapsed
                 self.recompile_seconds_total += elapsed
+                obs = self._deviceobs
+                if obs is not None:
+                    obs.note_compile(self.family, elapsed)
+                    obs.note_kernel("apply", self.family, elapsed)
                 hook = self.on_resize
                 if hook is not None:
                     try:
@@ -302,7 +315,14 @@ class _BaseTable:
                     except Exception:
                         logger.exception("resize hook failed")
             else:
-                self._apply_cols(cols)
+                obs = self._deviceobs
+                if obs is not None:
+                    t0 = time.perf_counter()
+                    self._apply_cols(cols)
+                    obs.note_kernel("apply", self.family,
+                                    time.perf_counter() - t0)
+                else:
+                    self._apply_cols(cols)
             self.dispatch_total += 1
         finally:
             self.apply_lock.release()
@@ -349,6 +369,10 @@ class _BaseTable:
                 self._swap_extras_locked(snap)
                 snap["state"] = self._swap_device_locked()
                 snap["cap"] = self._state_capacity()
+                # flush-inflight ledger token rides the snap; recycle()
+                # retags it spare or drops it when the generation dies
+                snap["_devobs"] = self._devobs_inflight
+                self._devobs_inflight = None
         return snap
 
     def _idle_swap_locked(self, snap: dict) -> bool:
@@ -368,11 +392,47 @@ class _BaseTable:
         a fresh allocation."""
         captured = self.state
         spare, self._spare = self._spare, None
-        if spare is not None and self._spare_cap == self._state_capacity():
+        used_spare = (spare is not None
+                      and self._spare_cap == self._state_capacity())
+        if used_spare:
             self.state = spare
         else:
             self.state = self._fresh_state()
+        self._devobs_swap_locked(used_spare)
         return captured
+
+    def _devobs_state(self):
+        """The live device generation pytree for HBM-ledger
+        registration. Sharded per-device tables keep it in `states`;
+        the host-only status table has neither and registers nothing."""
+        state = getattr(self, "state", None)
+        if state is None:
+            state = getattr(self, "states", None)
+        return state
+
+    def _devobs_swap_locked(self, used_spare: bool) -> None:
+        """HBM-ledger bookkeeping for a generation swap (caller holds
+        ``apply_lock``; the new live state is already bound): the old
+        live token goes flush-inflight, and the spare token — when its
+        generation was the one installed — becomes the new live token
+        (conserving its bytes); otherwise the fresh allocation registers
+        anew and any stale spare token (capacity mismatch dropped its
+        generation) is unregistered."""
+        obs = self._deviceobs
+        if obs is None:
+            return
+        tok, self._devobs_live = self._devobs_live, None
+        if tok is not None:
+            obs.retag(tok, "inflight")
+            self._devobs_inflight = tok
+        spare_tok, self._devobs_spare = self._devobs_spare, None
+        if used_spare and spare_tok is not None:
+            obs.retag(spare_tok, "live")
+            self._devobs_live = spare_tok
+        else:
+            obs.drop(spare_tok)
+            self._devobs_live = obs.note_generation(
+                self.family, "live", self._devobs_state())
 
     def _state_capacity(self) -> int:
         """Key-axis capacity the device state is shaped for (the set
@@ -430,19 +490,39 @@ class _BaseTable:
         cap = snap.pop("cap", -1)
         spare = snap.pop("_spare", None)
         captured = snap.pop("_recycle", None)
+        tok = snap.pop("_devobs", None)
+        obs = self._deviceobs
         if spare is None and captured is not None:
+            t0 = time.perf_counter()
             try:
                 spare = self._reset_state_donated(captured)
             except Exception:
                 logger.exception("%s generation recycle failed",
                                  self.family)
+                if obs is not None:
+                    obs.drop(tok)
                 return
+            if obs is not None:
+                obs.note_kernel("reset", self.family,
+                                time.perf_counter() - t0)
         if spare is None:
+            # generation not recyclable (sparse set readout consumed
+            # it): its ledger token dies with it
+            if obs is not None:
+                obs.drop(tok)
             return
         with self.apply_lock:
             if cap == self._state_capacity() and self._spare is None:
                 self._spare = spare
                 self._spare_cap = cap
+                if obs is not None:
+                    obs.retag(tok, "spare")
+                    self._devobs_spare = tok
+                    tok = None
+        # resized-under-flush or spare slot already occupied: the
+        # zeroed generation is discarded, unregister its bytes
+        if obs is not None and tok is not None:
+            obs.drop(tok)
 
     # -- live-query capture: read-only snapshot between flushes ----------
     #
@@ -558,13 +638,27 @@ class _BaseTable:
         cols = self._prewarm_cols()
         if cols is None:
             return False
+        obs = self._deviceobs
+        t0 = time.perf_counter()
         state = self._fresh_state_at(capacity)
-        state = self._prewarm_apply(state, cols, capacity)
-        out = self._prewarm_readout(state, capacity, tuple(percentiles),
-                                    need_export)
-        jax.block_until_ready([leaf for leaf in jax.tree.leaves(out)
-                               if leaf is not None])
+        # the throwaway rung state is real HBM while the compile runs;
+        # ledger it as a transient `prewarm` generation
+        tok = obs.note_generation(self.family, "prewarm", state) \
+            if obs is not None else None
+        try:
+            state = self._prewarm_apply(state, cols, capacity)
+            out = self._prewarm_readout(state, capacity,
+                                        tuple(percentiles), need_export)
+            jax.block_until_ready([leaf for leaf in jax.tree.leaves(out)
+                                   if leaf is not None])
+        finally:
+            if obs is not None:
+                obs.drop(tok)
         self._prewarmed_caps.add(capacity)
+        if obs is not None:
+            elapsed = time.perf_counter() - t0
+            obs.note_kernel("prewarm", self.family, elapsed)
+            obs.note_compile(self.family, elapsed)
         return True
 
     def _prewarm_cols(self):
@@ -803,7 +897,18 @@ class _BaseTable:
             # capacity; drop it rather than let a stale swap install it
             self._spare = None
             self._spare_cap = -1
+            obs = self._deviceobs
+            if obs is not None:
+                obs.drop(self._devobs_spare)
+                self._devobs_spare = None
             self._grow_arrays(new_cap)
+            # the live generation was re-laid-out at the new capacity:
+            # re-register its (doubled) footprint
+            if obs is not None:
+                obs.drop(self._devobs_live)
+                self._devobs_live = obs.note_generation(
+                    self.family, "live", self._devobs_state())
+                obs.note_resize()
         old_cap, self.capacity = self.capacity, new_cap
         # capacity doublings are permanent HBM growth AND a pending jit
         # recompile (every kernel specializes on capacity; the retrace
@@ -2070,6 +2175,7 @@ class ColumnStore:
             table.family = family
         self.processed = 0
         self.ledger = None  # set by attach_ledger
+        self.deviceobs = None  # set by attach_deviceobs
         self._processed_lock = threading.Lock()
 
     def tables(self):
@@ -2083,6 +2189,25 @@ class ColumnStore:
         every table's interning path."""
         for _family, table in self.tables():
             table.cardinality = accountant
+
+    def attach_deviceobs(self, obs) -> None:
+        """Wire the device observatory (core/deviceobs.py) into every
+        table's generation lifecycle and kernel dispatch paths, register
+        the current live generations (and any parked spares) in its HBM
+        ledger, and hand it the store for shard-balance scrapes."""
+        self.deviceobs = obs
+        obs.attach_store(self)
+        for family, table in self.tables():
+            table._deviceobs = obs
+            with table.apply_lock:
+                state = table._devobs_state()
+                if state is not None and table._devobs_live is None:
+                    table._devobs_live = obs.note_generation(
+                        family, "live", state)
+                if table._spare is not None \
+                        and table._devobs_spare is None:
+                    table._devobs_spare = obs.note_generation(
+                        family, "spare", table._spare)
 
     def attach_ledger(self, ledger) -> None:
         """Wire the flow ledger (core/ledger.py) into every table's

@@ -589,10 +589,15 @@ def readout_columnstore(
     global_code = int(MetricScope.GLOBAL_ONLY)
     fam_seg: Optional[Dict[str, dict]] = \
         {} if (attribute and timings is not None) else None
+    deviceobs = getattr(store, "deviceobs", None)
 
     def _mark(family: str, start: float) -> float:
         """Close one family's dispatch segment; returns the next start."""
         end = time.perf_counter()
+        if deviceobs is not None and family != "status":
+            # kernel-registry row: the waterfall's per-family dispatch_s
+            # decomposed as a device.kernel.readout_s distribution
+            deviceobs.note_kernel("readout", family, end - start)
         if fam_seg is not None:
             fam_seg[family] = {"dispatch_s": end - start,
                                "dispatch_start_s": start - t0,

@@ -45,7 +45,8 @@ _TIMED_ROUTES = frozenset({
     "/query", "/alerts", "/quitquitquit", "/import",
     "/debug/events", "/debug/flush", "/debug/latency", "/debug/ledger",
     "/debug/reshard", "/reshard",
-    "/debug/traces", "/debug/cardinality", "/debug/memory",
+    "/debug/traces", "/debug/cardinality", "/debug/device",
+    "/debug/memory",
     "/debug/threads", "/debug/profile/cpu", "/debug/profile/device",
     "/debug/pprof", "/debug/pprof/", "/debug/pprof/profile",
     "/debug/pprof/heap", "/debug/pprof/allocs", "/debug/pprof/goroutine",
@@ -264,6 +265,19 @@ class _Handler(BaseHTTPRequestHandler):
             body = json.dumps(source(top=top, name=name), indent=2,
                               default=str).encode()
             self._send(200, body, "application/json")
+        elif path == "/debug/device":
+            # device capacity & shard-balance observatory
+            # (core/deviceobs.py): HBM generation ledger by family/
+            # lifecycle state with backend reconciliation, kernel
+            # dispatch/compile registry, per-shard balance + recommended
+            # reshard plan, and the device watermark rung
+            source = getattr(api.server, "device_report", None)
+            if source is None:
+                self._send(404, b"no device source\n")
+                return
+            body = json.dumps(source(), indent=2,
+                              default=str).encode()
+            self._send(200, body, "application/json")
         elif path == "/query":
             # the live query plane (core/query.py): percentile / count /
             # rate / cardinality / value / bin-occupancy lookups against
@@ -429,6 +443,7 @@ class _Handler(BaseHTTPRequestHandler):
                 b"  /debug/latency                  latency observatory\n"
                 b"  /debug/ledger?intervals=N       flow-ledger conservation\n"
                 b"  /debug/cardinality?top=N&name=  series cardinality\n"
+                b"  /debug/device                   HBM ledger & shard balance\n"
                 b"  /query?metric=&kind=&q=         live query plane\n"
                 b"  /alerts                         alert rule states\n"
                 b"  /metrics                        Prometheus exposition\n"))

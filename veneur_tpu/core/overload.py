@@ -498,6 +498,17 @@ class OverloadManager:
             on_transition=on_transition,
             pressure=(chaos.simulated_rss_bytes if chaos is not None
                       else None))
+        # device watermark rung: HBM occupancy from the device
+        # observatory's ledger, beside the host-RSS rung. The byte
+        # source attaches late (attach_device_source) because the
+        # observatory is constructed after this manager; until then the
+        # reader returns None and the rung observes 0.
+        self._device_source: Optional[Callable[[], int]] = None
+        self.device_watermarks = WatermarkMonitor(
+            soft_bytes=getattr(config, "overload_device_soft_bytes", 0),
+            hard_bytes=getattr(config, "overload_device_hard_bytes", 0),
+            on_transition=on_transition,
+            rss_reader=self._read_device_bytes)
         self.kernel_drops = KernelDropMonitor()
         self.supervisor = Supervisor(
             deadline=getattr(config, "supervisor_deadline", 0.0),
@@ -514,9 +525,28 @@ class OverloadManager:
 
     # -- state -----------------------------------------------------------
 
+    def attach_device_source(self, fn: Callable[[], int]) -> None:
+        """Wire the HBM-ledger byte source (DeviceObservatory
+        .total_bytes) into the device watermark rung."""
+        self._device_source = fn
+
+    def _read_device_bytes(self) -> Optional[int]:
+        fn = self._device_source
+        if fn is None:
+            return None
+        try:
+            return int(fn())
+        except Exception:
+            logger.exception("device watermark byte source failed")
+            return None
+
     @property
     def state(self) -> str:
-        return self.watermarks.state
+        # severity max across the RSS and device-HBM rungs: either
+        # breaching degrades/sheds, so the ladder below reads ONE state
+        code = max(STATE_CODES[self.watermarks.state],
+                   STATE_CODES[self.device_watermarks.state])
+        return (OK, DEGRADED, SHEDDING)[code]
 
     # -- admission (the shed ladder) -------------------------------------
 
@@ -535,7 +565,7 @@ class OverloadManager:
     def admit_span(self) -> bool:
         """Spans shed first: any degradation state pauses span ingest,
         and the span-plane token bucket bounds the happy path."""
-        if self.watermarks.state != OK:
+        if self.state != OK:
             self.shed(CLASS_SPAN, reason="overload")
             return False
         if not self.span_bucket.admit():
@@ -550,7 +580,7 @@ class OverloadManager:
         one burst would otherwise NEVER fit and be shed forever even on
         an idle server; clamping keeps the long-run rate bounded while
         treating an oversized batch as one full burst."""
-        if self.watermarks.state != OK:
+        if self.state != OK:
             self.shed(CLASS_SPAN, n, reason="overload")
             return False
         bucket = self.span_bucket
@@ -585,7 +615,7 @@ class OverloadManager:
         """Fraction of histogram/set samples to admit right now, for
         batch (native-column) consumers: 1.0 in ok, the degraded keep
         ratio in degraded, 0.0 in shedding."""
-        state = self.watermarks.state
+        state = self.state
         if state == SHEDDING:
             return 0.0
         if state == DEGRADED:
@@ -595,7 +625,7 @@ class OverloadManager:
     def admit_sample(self, cls: str, over_limit: bool = False) -> bool:
         """Per-sample ladder for histogram/set samples. Counter/gauge
         samples never pass through here — they are always admitted."""
-        state = self.watermarks.state
+        state = self.state
         if state == SHEDDING or over_limit:
             self.shed(cls, reason="rate_limit" if over_limit else "overload")
             return False
@@ -623,6 +653,7 @@ class OverloadManager:
         # watermarks configured, or UDP sockets registered for kernel-
         # drop visibility (Server.start() binds listeners before this)
         if self._thread is None and (self.watermarks.enabled
+                                     or self.device_watermarks.enabled
                                      or self.kernel_drops.watching):
             self._thread = threading.Thread(
                 target=self._monitor_loop, name="overload-monitor",
@@ -641,6 +672,7 @@ class OverloadManager:
         while not self._stop.wait(self.poll_interval):
             try:
                 self.watermarks.tick()
+                self.device_watermarks.tick()
                 self.kernel_drops.poll()
             except Exception:
                 logger.exception("overload monitor tick failed")
@@ -650,11 +682,19 @@ class OverloadManager:
     def telemetry_rows(self):
         """(name, kind, value, tags) rows for the /metrics collector."""
         rows = [("overload.state", "gauge",
+                 float(STATE_CODES[self.state]), ()),
+                ("overload.rss_state", "gauge",
                  float(STATE_CODES[self.watermarks.state]), ()),
                 ("overload.rss_bytes", "gauge",
                  float(self.watermarks.last_rss), ()),
                 ("overload.transitions", "counter",
-                 float(self.watermarks.transitions), ())]
+                 float(self.watermarks.transitions), ()),
+                ("overload.device_state", "gauge",
+                 float(STATE_CODES[self.device_watermarks.state]), ()),
+                ("overload.device_bytes", "gauge",
+                 float(self.device_watermarks.last_rss), ()),
+                ("overload.device_transitions", "counter",
+                 float(self.device_watermarks.transitions), ())]
         with self._shed_lock:
             shed = dict(self.shed_total)
         for key, n in sorted(shed.items()):

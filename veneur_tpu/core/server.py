@@ -485,6 +485,18 @@ class Server:
             on_shed=self.overload.shed,
             on_event=self.telemetry.record_event)
         self.store.attach_cardinality(self.cardinality)
+        # device observatory (core/deviceobs.py): HBM generation ledger,
+        # kernel dispatch/compile registry, shard-balance scrape —
+        # served at /debug/device, feeding the overload ladder's device
+        # watermark rung and the shard_skew alert rule kind
+        from veneur_tpu.core.deviceobs import DeviceObservatory
+        self.deviceobs = DeviceObservatory(
+            enabled=bool(getattr(config, "device_observatory", True)))
+        if self.deviceobs.enabled:
+            self.store.attach_deviceobs(self.deviceobs)
+            self.telemetry.registry.add_collector(
+                self.deviceobs.telemetry_rows)
+            self.overload.attach_device_source(self.deviceobs.total_bytes)
         # persistent-compilation-cache probe state: entry counts
         # snapshotted at resize time, compared after the recompile
         self._cache_entries_at_resize: Dict[str, int] = {}
@@ -1231,6 +1243,23 @@ class Server:
             # be separable from steady-state execute cost (and, with
             # the persistent cache on, whether disk served it)
             self.latency.note_retrace(family, seconds, cache=cache)
+
+    def device_report(self) -> dict:
+        """The /debug/device payload: the HBM generation ledger (by
+        family / lifecycle state, with forecast and backend
+        reconciliation), the kernel dispatch/compile registry, the
+        shard-balance observatory, and the overload ladder's device
+        watermark rung."""
+        out = self.deviceobs.report()
+        dw = self.overload.device_watermarks
+        out["watermarks"] = {
+            "state": dw.state,
+            "soft_bytes": dw.soft_bytes,
+            "hard_bytes": dw.hard_bytes,
+            "last_bytes": dw.last_rss,
+            "transitions": dw.transitions,
+        }
+        return out
 
     def adopt_flush_trace(self, trace_id: int, parent_span_id: int) -> None:
         """Called by the import server when a fresh (non-duplicate)

@@ -41,6 +41,7 @@ Enable with config `tpu.shards: N` (0/1 = single-device tables).
 from __future__ import annotations
 
 import logging
+import time
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -167,8 +168,18 @@ class _DigestRouted:
             # never be installed into the (M, ...) generation ladder
             for key in ("cap", "_spare", "_recycle"):
                 snap.pop(key, None)
+            tok = snap.pop("_devobs", None)
+            obs = self._deviceobs
+            if obs is not None:
+                obs.drop(tok)
             return
         super().recycle(snap)
+
+    def _devobs_note_merge(self, seconds: float) -> None:
+        """Kernel-registry row for one collective merge dispatch."""
+        obs = self._deviceobs
+        if obs is not None:
+            obs.note_kernel("merge", self.family, seconds)
 
     def reshard_swap(self, new_plane: ShardedServingPlane, **kw) -> dict:
         """The per-family cutover primitive: ONE critical section that
@@ -217,6 +228,15 @@ class _DigestRouted:
                         state = self._readout_apply(state, cols, snap)
                     snap.pop("staged", None)
                     self._reshard_capture_device(state, snap)
+                    # the captured old-mesh generation stays resident
+                    # until the controller's WAL+merge completes: its
+                    # ledger token rides the snap as `reshard_capture`
+                    obs = self._deviceobs
+                    if obs is not None:
+                        tok = self._devobs_inflight
+                        self._devobs_inflight = None
+                        obs.retag(tok, "reshard_capture")
+                        snap["_devobs"] = tok
                 self._retopo_locked(new_plane)
         snap["_topo_epoch"] = self._topo_epoch
         return snap
@@ -246,9 +266,22 @@ class _DigestRouted:
         # old-mesh buffers can never serve the new topology
         self._spare = None
         self._spare_cap = -1
+        obs = self._deviceobs
+        if obs is not None:
+            # the parked spare is discarded with the old mesh, and the
+            # live generation is about to be rebound to a fresh one —
+            # on the IDLE cutover path no swap ran, so the original
+            # live token is still held here and dies now
+            obs.drop(self._devobs_spare)
+            self._devobs_spare = None
+            obs.drop(self._devobs_live)
+            self._devobs_live = None
         self._prewarmed_caps = set()
         self._topo_epoch += 1
         self._retopo_device_locked()
+        if obs is not None:
+            self._devobs_live = obs.note_generation(
+                self.family, "live", self._devobs_state())
 
     def _retopo_device_locked(self) -> None:
         # stacked families: a fresh (M, K) zero generation on the new
@@ -300,8 +333,10 @@ class ShardedCounterTable(_DigestRouted, CounterTable):
     def _readout_device(self, state, snap) -> None:
         """Fused donated collective merge: the drained stacked
         generation's buffers come back as the next interval's spare."""
+        t0 = time.perf_counter()
         snap["dev"], snap["_spare"] = \
             collectives.merge_counters_stacked_reset(state)
+        self._devobs_note_merge(time.perf_counter() - t0)
         self._plane.note_merge_round()
 
     def _query_readout_device(self, state, snap) -> None:
@@ -380,8 +415,10 @@ class ShardedGaugeTable(_DigestRouted, GaugeTable):
             self.apply_lock.release()
 
     def _readout_device(self, state, snap) -> None:
+        t0 = time.perf_counter()
         (dev, _set), snap["_spare"] = \
             collectives.merge_gauges_stacked_reset(state)
+        self._devobs_note_merge(time.perf_counter() - t0)
         snap["dev"] = dev
         self._plane.note_merge_round()
 
@@ -467,8 +504,10 @@ class ShardedLLHistTable(_DigestRouted, LLHistTable):
             self.apply_lock.release()
 
     def _readout_device(self, state, snap) -> None:
+        t0 = time.perf_counter()
         merged, snap["_spare"] = \
             collectives.merge_llhist_stacked_reset(state)
+        self._devobs_note_merge(time.perf_counter() - t0)
         self._plane.note_merge_round()
         packed = batch_llhist.flush_packed(merged, snap["ps"])
         rows = np.flatnonzero(snap["touched"])
@@ -519,10 +558,13 @@ class _PerDeviceStates:
     def _swap_device_locked(self):
         captured = self.states
         spare, self._spare = self._spare, None
-        if spare is not None and self._spare_cap == self._state_capacity():
+        used_spare = (spare is not None
+                      and self._spare_cap == self._state_capacity())
+        if used_spare:
             self.states = spare
         else:
             self.states = self._fresh_state()
+        self._devobs_swap_locked(used_spare)
         return captured
 
     def _capture_device_locked(self):
@@ -674,7 +716,9 @@ class ShardedHistoTable(_PerDeviceStates, _DigestRouted, HistoTable):
         return states
 
     def _readout_device(self, states, snap: dict) -> None:
+        t0 = time.perf_counter()
         merged = self._merged_state(states)
+        self._devobs_note_merge(time.perf_counter() - t0)
         ps = snap["ps"]
         if snap.pop("need_export"):
             # fused flush+export: one dispatch, two transfers (the
@@ -853,7 +897,9 @@ class ShardedSetTable(_PerDeviceStates, _DigestRouted, SetTable):
         return collectives.merge_hll_stacked(stacked)
 
     def _readout_device(self, states, snap: dict) -> None:
+        t0 = time.perf_counter()
         merged = self._merged_state(states)
+        self._devobs_note_merge(time.perf_counter() - t0)
         snap["estimates"] = np.asarray(batch_hll.estimate(merged))
         # lazy per-row provider (columnstore._SetRegisters): the
         # merged (K, M) bank only crosses the device link if a

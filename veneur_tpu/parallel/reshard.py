@@ -390,6 +390,14 @@ class ReshardController:
                 store.shard_plane = plane
                 cells = self._encode_cells(snaps, n_old, n_new)
                 self._wal_and_merge(cells, chaos)
+                # the merged old-mesh capture generations are dead: the
+                # HBM-ledger tokens that rode each family's snap as
+                # `reshard_capture` unregister here
+                for family, table in store.tables():
+                    snap = snaps.get(family)
+                    obs = getattr(table, "_deviceobs", None)
+                    if snap is not None and obs is not None:
+                        obs.drop(snap.pop("_devobs", None))
                 self.epoch += 1
                 self.cutovers += 1
         finally:
